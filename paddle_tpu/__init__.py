@@ -1,0 +1,24 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities of
+PaddlePaddle Fluid (reference mounted at /root/reference; see SURVEY.md).
+
+Public surface mirrors `paddle.fluid`: program-construction layers API,
+append_backward autodiff, optimizers, Executor/ParallelExecutor, readers,
+metrics, io — implemented TPU-first: programs trace to jax functions compiled
+by XLA; parallelism is SPMD over a jax.sharding.Mesh with compiled collectives.
+"""
+
+from . import clip, initializer, layers, optimizer, regularizer  # noqa: F401
+from .core import (CPUPlace, Place, TPUPlace, default_place,  # noqa: F401
+                   device_count, devices, is_compiled_with_tpu)
+from .core import flags  # noqa: F401
+from .core import unique_name  # noqa: F401
+from .framework.backward import append_backward, calc_gradient  # noqa: F401
+from .framework.executor import Executor  # noqa: F401
+from .framework.program import (Program, Variable, default_main_program,  # noqa: F401
+                                default_startup_program, program_guard,
+                                reset_default_programs)
+from .framework.registry import registered_ops  # noqa: F401
+from .framework.scope import Scope, global_scope, reset_global_scope  # noqa: F401
+from .param_attr import ParamAttr  # noqa: F401
+
+__version__ = "0.1.0"

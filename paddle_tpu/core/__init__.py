@@ -1,0 +1,7 @@
+from . import dtypes, enforce, flags, places, unique_name  # noqa: F401
+from .enforce import (EnforceError, InvalidArgumentError, NotFoundError,  # noqa: F401
+                      enforce, enforce_eq, enforce_ge, enforce_gt, enforce_le,
+                      enforce_lt, enforce_ne)
+from .flags import get_flag, set_flag, set_flags  # noqa: F401
+from .places import (CPUPlace, Place, TPUPlace, default_place, device_count,  # noqa: F401
+                     devices, is_compiled_with_tpu, place_to_device)

@@ -1,0 +1,59 @@
+"""Dtype system.
+
+Capability equivalent of the reference's VarType dtype enum
+(reference: paddle/fluid/framework/framework.proto:91-115) and the software
+float16 type (reference: paddle/fluid/platform/float16.h:87). On TPU the
+native low-precision type is bfloat16 (MXU-preferred); float16 is kept for
+API parity.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .enforce import InvalidArgumentError
+
+# Canonical names → jnp dtypes
+_NAME_TO_DTYPE = {
+    "bool": jnp.bool_,
+    "int8": jnp.int8,
+    "uint8": jnp.uint8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "fp16": jnp.float16,
+    "bf16": jnp.bfloat16,
+    "fp32": jnp.float32,
+    "fp64": jnp.float64,
+}
+
+FLOAT_DTYPES = (jnp.float16, jnp.bfloat16, jnp.float32, jnp.float64)
+INT_DTYPES = (jnp.int8, jnp.uint8, jnp.int16, jnp.int32, jnp.int64)
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalize a string/np/jnp dtype spec to a numpy dtype object."""
+    if dtype is None:
+        return np.dtype(jnp.float32)
+    if isinstance(dtype, str):
+        if dtype not in _NAME_TO_DTYPE:
+            raise InvalidArgumentError(f"unknown dtype {dtype!r}")
+        return np.dtype(_NAME_TO_DTYPE[dtype])
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    return np.dtype(dtype).name
+
+
+def is_floating(dtype) -> bool:
+    return np.dtype(dtype) in [np.dtype(d) for d in FLOAT_DTYPES]
+
+
+def is_integer(dtype) -> bool:
+    return np.dtype(dtype) in [np.dtype(d) for d in INT_DTYPES]

@@ -1,0 +1,86 @@
+"""Typed error enforcement.
+
+TPU-native capability equivalent of the reference's PADDLE_ENFORCE macro family
+(reference: paddle/fluid/platform/enforce.h:253) — structured error types with
+contextual messages instead of C++ exception + demangled stack traces.
+"""
+
+from __future__ import annotations
+
+
+class EnforceError(RuntimeError):
+    """Base error for framework invariant violations (≙ platform::EnforceNotMet)."""
+
+
+class InvalidArgumentError(EnforceError):
+    pass
+
+
+class NotFoundError(EnforceError):
+    pass
+
+
+class OutOfRangeError(EnforceError):
+    pass
+
+
+class AlreadyExistsError(EnforceError):
+    pass
+
+
+class PermissionDeniedError(EnforceError):
+    pass
+
+
+class UnimplementedError(EnforceError):
+    pass
+
+
+class UnavailableError(EnforceError):
+    pass
+
+
+def enforce(cond, msg="enforce failed", *args, exc=EnforceError):
+    """Assert `cond` and raise a typed framework error otherwise.
+
+    ≙ PADDLE_ENFORCE(cond, fmt, ...) (reference platform/enforce.h:253).
+    """
+    if not cond:
+        raise exc(msg % args if args else msg)
+    return cond
+
+
+def enforce_eq(a, b, msg=None, exc=InvalidArgumentError):
+    if a != b:
+        raise exc(f"enforce_eq failed: {a!r} != {b!r}" + (f": {msg}" if msg else ""))
+
+
+def enforce_ne(a, b, msg=None, exc=InvalidArgumentError):
+    if a == b:
+        raise exc(f"enforce_ne failed: {a!r} == {b!r}" + (f": {msg}" if msg else ""))
+
+
+def enforce_gt(a, b, msg=None, exc=InvalidArgumentError):
+    if not a > b:
+        raise exc(f"enforce_gt failed: {a!r} <= {b!r}" + (f": {msg}" if msg else ""))
+
+
+def enforce_ge(a, b, msg=None, exc=InvalidArgumentError):
+    if not a >= b:
+        raise exc(f"enforce_ge failed: {a!r} < {b!r}" + (f": {msg}" if msg else ""))
+
+
+def enforce_lt(a, b, msg=None, exc=InvalidArgumentError):
+    if not a < b:
+        raise exc(f"enforce_lt failed: {a!r} >= {b!r}" + (f": {msg}" if msg else ""))
+
+
+def enforce_le(a, b, msg=None, exc=InvalidArgumentError):
+    if not a <= b:
+        raise exc(f"enforce_le failed: {a!r} > {b!r}" + (f": {msg}" if msg else ""))
+
+
+def not_none(value, name="value", exc=NotFoundError):
+    if value is None:
+        raise exc(f"{name} must not be None")
+    return value

@@ -1,0 +1,101 @@
+"""Global typed flag registry.
+
+Capability equivalent of the reference's gflags surface (DEFINE_bool/int/double in
+C++, e.g. FLAGS_benchmark / FLAGS_check_nan_inf at reference
+paddle/fluid/framework/executor.cc:27 and operator.cc:726) plus the Python env
+bridge (`read_env_flags` in reference python/paddle/fluid/__init__.py:121-137).
+
+Flags are typed, documented, and can be set from the environment with the
+``PTPU_`` prefix, e.g. ``PTPU_CHECK_NAN_INF=1``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict
+
+from .enforce import AlreadyExistsError, NotFoundError
+
+
+@dataclass
+class _FlagSpec:
+    name: str
+    default: Any
+    parser: Callable[[str], Any]
+    help: str
+    value: Any
+
+
+_REGISTRY: Dict[str, _FlagSpec] = {}
+
+_ENV_PREFIX = "PTPU_"
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _define(name: str, default: Any, parser, help: str) -> None:
+    if name in _REGISTRY:
+        raise AlreadyExistsError(f"flag {name!r} already defined")
+    value = default
+    env = os.environ.get(_ENV_PREFIX + name.upper())
+    if env is not None:
+        value = parser(env)
+    _REGISTRY[name] = _FlagSpec(name, default, parser, help, value)
+
+
+def define_bool(name: str, default: bool, help: str = "") -> None:
+    _define(name, default, _parse_bool, help)
+
+
+def define_int(name: str, default: int, help: str = "") -> None:
+    _define(name, default, int, help)
+
+
+def define_float(name: str, default: float, help: str = "") -> None:
+    _define(name, default, float, help)
+
+
+def define_string(name: str, default: str, help: str = "") -> None:
+    _define(name, default, str, help)
+
+
+def get_flag(name: str) -> Any:
+    spec = _REGISTRY.get(name)
+    if spec is None:
+        raise NotFoundError(f"unknown flag {name!r}")
+    return spec.value
+
+
+def set_flag(name: str, value: Any) -> None:
+    spec = _REGISTRY.get(name)
+    if spec is None:
+        raise NotFoundError(f"unknown flag {name!r}")
+    spec.value = value
+
+
+def set_flags(mapping: Dict[str, Any]) -> None:
+    for k, v in mapping.items():
+        set_flag(k, v)
+
+
+def all_flags() -> Dict[str, Any]:
+    return {k: v.value for k, v in _REGISTRY.items()}
+
+
+# --- Core framework flags (≙ the reference's gflags config surface, SURVEY §5) ---
+define_bool("check_nan_inf", False,
+            "Scan every op's outputs for NaN/Inf during execution "
+            "(≙ FLAGS_check_nan_inf, reference operator.cc:726-736).")
+define_bool("benchmark", False,
+            "Block on device after each program run and log timings "
+            "(≙ FLAGS_benchmark, reference executor.cc:27).")
+define_int("vlog", 0, "Verbose logging level (≙ glog VLOG).")
+define_bool("use_bf16_matmul", True,
+            "Prefer bfloat16 MXU matmul precision where layers opt in.")
+define_string("jit_cache", "", "Persistent XLA compilation cache directory.")
+define_int("num_iteration_per_drop_scope", 1,
+           "Iterations between temporary-scope cleanups "
+           "(≙ ExecutionStrategy::num_iteration_per_drop_scope_).")

@@ -1,0 +1,12 @@
+"""Data layer: reader decorators, datasets, feeder, device prefetch.
+
+≙ reference python/paddle/reader/ + python/paddle/dataset/ + the C++
+reader-op pipeline (SURVEY §1 L10). The in-graph reader ops translate to a
+host-side prefetching pipeline feeding compiled steps.
+"""
+
+from . import datasets  # noqa: F401
+from .decorator import (batch, buffered, chain, compose, firstn,  # noqa: F401
+                        map_readers, shuffle, xmap_readers)
+from .feeder import DataFeeder  # noqa: F401
+from .prefetch import DevicePrefetcher  # noqa: F401

@@ -1,0 +1,250 @@
+"""Builtin datasets.
+
+≙ reference python/paddle/dataset/ (mnist, cifar, imdb, uci_housing,
+imikolov, ...). This environment has no network egress, so each dataset is
+backed by a deterministic synthetic generator with the same sample shapes and
+reader contract; if the real files exist under PTPU_DATA_HOME they are used
+instead. The reader API (train()/test() -> reader) matches the reference.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Callable
+
+import numpy as np
+
+DATA_HOME = os.environ.get("PTPU_DATA_HOME",
+                           os.path.expanduser("~/.cache/paddle_tpu/dataset"))
+
+
+def _synthetic_images(n, shape, classes, seed):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(int(np.prod(shape)), classes).astype(np.float32)
+
+    def reader():
+        r = np.random.RandomState(seed + 1)
+        for _ in range(n):
+            x = r.rand(*shape).astype(np.float32)
+            y = int(np.argmax(x.reshape(-1) @ w))
+            yield x, y
+
+    return reader
+
+
+# ------------------------------------------------------------------ mnist
+def _mnist_files_exist():
+    d = os.path.join(DATA_HOME, "mnist")
+    return all(os.path.exists(os.path.join(d, f)) for f in
+               ["train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz"])
+
+
+def _read_mnist(img_path, lbl_path):
+    with gzip.open(lbl_path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        labels = np.frombuffer(f.read(), dtype=np.uint8)
+    with gzip.open(img_path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        images = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows * cols)
+    images = images.astype(np.float32) / 127.5 - 1.0
+
+    def reader():
+        for i in range(n):
+            yield images[i], int(labels[i])
+
+    return reader
+
+
+class mnist:
+    """≙ paddle.dataset.mnist — 784-dim float images in [-1,1], int label."""
+
+    @staticmethod
+    def train() -> Callable:
+        if _mnist_files_exist():
+            d = os.path.join(DATA_HOME, "mnist")
+            return _read_mnist(os.path.join(d, "train-images-idx3-ubyte.gz"),
+                               os.path.join(d, "train-labels-idx1-ubyte.gz"))
+        return _synthetic_images(8192, (784,), 10, seed=7)
+
+    @staticmethod
+    def test() -> Callable:
+        if _mnist_files_exist():
+            d = os.path.join(DATA_HOME, "mnist")
+            return _read_mnist(os.path.join(d, "t10k-images-idx3-ubyte.gz"),
+                               os.path.join(d, "t10k-labels-idx1-ubyte.gz"))
+        return _synthetic_images(1024, (784,), 10, seed=8)
+
+
+class cifar:
+    """≙ paddle.dataset.cifar — 3x32x32 images."""
+
+    @staticmethod
+    def train10():
+        return _synthetic_images(8192, (3 * 32 * 32,), 10, seed=17)
+
+    @staticmethod
+    def test10():
+        return _synthetic_images(1024, (3 * 32 * 32,), 10, seed=18)
+
+    @staticmethod
+    def train100():
+        return _synthetic_images(8192, (3 * 32 * 32,), 100, seed=19)
+
+
+class uci_housing:
+    """≙ paddle.dataset.uci_housing — 13 features, scalar target."""
+
+    @staticmethod
+    def train():
+        rng = np.random.RandomState(3)
+        w = rng.randn(13).astype(np.float32)
+
+        def reader():
+            r = np.random.RandomState(4)
+            for _ in range(404):
+                x = r.rand(13).astype(np.float32)
+                y = float(x @ w + 0.05 * r.randn())
+                yield x, np.array([y], dtype=np.float32)
+
+        return reader
+
+    @staticmethod
+    def test():
+        rng = np.random.RandomState(3)
+        w = rng.randn(13).astype(np.float32)
+
+        def reader():
+            r = np.random.RandomState(5)
+            for _ in range(102):
+                x = r.rand(13).astype(np.float32)
+                yield x, np.array([float(x @ w)], dtype=np.float32)
+
+        return reader
+
+
+class imdb:
+    """≙ paddle.dataset.imdb — variable-length word-id sequences, binary
+    label. Synthetic: class-dependent unigram distributions."""
+
+    word_dict_size = 5148
+
+    @staticmethod
+    def word_dict():
+        return {i: i for i in range(imdb.word_dict_size)}
+
+    @staticmethod
+    def _make(seed, n):
+        def reader():
+            r = np.random.RandomState(seed)
+            v = imdb.word_dict_size
+            for _ in range(n):
+                label = int(r.rand() > 0.5)
+                length = int(r.randint(20, 200))
+                center = v // 4 if label == 0 else 3 * v // 4
+                ids = np.clip(r.normal(center, v // 8, length), 0, v - 1) \
+                    .astype(np.int64)
+                yield ids, label
+
+        return reader
+
+    @staticmethod
+    def train(word_dict=None):
+        return imdb._make(11, 2048)
+
+    @staticmethod
+    def test(word_dict=None):
+        return imdb._make(12, 512)
+
+
+class imikolov:
+    """≙ paddle.dataset.imikolov — PTB-style n-gram language model data."""
+
+    vocab_size = 2074
+
+    @staticmethod
+    def build_dict(min_word_freq=50):
+        return {i: i for i in range(imikolov.vocab_size)}
+
+    @staticmethod
+    def _make(seed, n, ngram):
+        def reader():
+            r = np.random.RandomState(seed)
+            v = imikolov.vocab_size
+            # markov-ish: next word correlated with sum of context
+            for _ in range(n):
+                ctx = r.randint(0, v, size=ngram - 1)
+                nxt = int((ctx.sum() * 31 + r.randint(0, 7)) % v)
+                yield tuple(int(c) for c in ctx) + (nxt,)
+
+        return reader
+
+    @staticmethod
+    def train(word_dict=None, n=5):
+        return imikolov._make(21, 4096, n)
+
+    @staticmethod
+    def test(word_dict=None, n=5):
+        return imikolov._make(22, 512, n)
+
+
+class ptb:
+    """PTB-style token stream for the stacked-LSTM LM benchmark."""
+
+    vocab_size = 10000
+
+    @staticmethod
+    def train(seq_len=20, n=2048):
+        def reader():
+            r = np.random.RandomState(31)
+            for _ in range(n):
+                seq = r.randint(0, ptb.vocab_size, size=seq_len + 1)
+                yield seq[:-1].astype(np.int64), seq[1:].astype(np.int64)
+
+        return reader
+
+
+class wmt_synthetic:
+    """Synthetic parallel corpus for the Transformer NMT benchmark
+    (≙ paddle.dataset.wmt14/wmt16 shapes)."""
+
+    src_vocab = 10000
+    trg_vocab = 10000
+    bos, eos = 0, 1
+
+    @staticmethod
+    def train(n=2048, max_len=30):
+        def reader():
+            r = np.random.RandomState(41)
+            for _ in range(n):
+                slen = int(r.randint(5, max_len))
+                src = r.randint(2, wmt_synthetic.src_vocab, size=slen)
+                trg = (src[:max(1, slen - 1)] + 7) % wmt_synthetic.trg_vocab
+                trg = np.clip(trg, 2, None)
+                yield (src.astype(np.int64),
+                       np.concatenate([[wmt_synthetic.bos], trg]).astype(np.int64),
+                       np.concatenate([trg, [wmt_synthetic.eos]]).astype(np.int64))
+
+        return reader
+
+
+class ctr_synthetic:
+    """Synthetic CTR data (sparse id features + dense) for DeepFM/Wide&Deep
+    (≙ the distributed-lookup-table workload, SURVEY §2.3)."""
+
+    @staticmethod
+    def train(n=4096, num_fields=26, vocab_per_field=1000, dense_dim=13):
+        def reader():
+            r = np.random.RandomState(51)
+            w_sparse = np.random.RandomState(52).randn(num_fields)
+            w_dense = np.random.RandomState(53).randn(dense_dim)
+            for _ in range(n):
+                sparse = r.randint(0, vocab_per_field, size=num_fields)
+                dense = r.rand(dense_dim).astype(np.float32)
+                logit = (sparse / vocab_per_field - 0.5) @ w_sparse + \
+                    dense @ w_dense
+                label = int(logit + 0.3 * r.randn() > 0)
+                yield sparse.astype(np.int64), dense, label
+
+        return reader

@@ -1,0 +1,58 @@
+"""Async host→device prefetch.
+
+≙ reference double-buffered readers (operators/reader/buffered_reader.h:27,
+create_double_buffer_reader_op.cc) and the py_reader blocking queue
+(reader/lod_tensor_blocking_queue.h:31). TPU translation: a worker thread
+stages upcoming batches onto the device with jax.device_put while the current
+step runs, overlapping host input with device compute.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, Iterator, Optional
+
+import jax
+
+
+class DevicePrefetcher:
+    """Wrap a feed-dict iterator; yields batches already resident on device."""
+
+    _END = object()
+
+    def __init__(self, feed_iter_fn: Callable[[], Iterator[Dict]],
+                 capacity: int = 2, device=None, sharding=None):
+        self._fn = feed_iter_fn
+        self._capacity = capacity
+        self._device = device
+        self._sharding = sharding
+
+    def _put(self, batch: Dict):
+        target = self._sharding or self._device
+        if target is None:
+            return {k: jax.device_put(v) for k, v in batch.items()}
+        return {k: jax.device_put(v, target) for k, v in batch.items()}
+
+    def __iter__(self):
+        q: queue.Queue = queue.Queue(maxsize=self._capacity)
+        err = []
+
+        def worker():
+            try:
+                for b in self._fn():
+                    q.put(self._put(b))
+            except Exception as e:  # propagate to consumer
+                err.append(e)
+            finally:
+                q.put(self._END)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is self._END:
+                if err:
+                    raise err[0]
+                return
+            yield item

@@ -1,0 +1,194 @@
+"""Block → jax function tracing.
+
+This replaces the reference's op-by-op interpreting Executor hot loop
+(reference: paddle/fluid/framework/executor.cc:321-340 "for op in ctx->ops_:
+op->Run(scope, place)") with a single trace of the whole block into one jax
+function, which XLA compiles and fuses. The `vjp_region` pseudo-op (appended by
+backward.append_backward) is executed via jax.vjp — compiler-native source
+transformation replacing the reference's per-op GradOpDescMaker pipeline
+(reference python/paddle/fluid/backward.py:469).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Set
+
+import jax
+import jax.numpy as jnp
+
+from ..core import flags
+from ..core.enforce import EnforceError, NotFoundError
+from .program import Block, Operator
+from .registry import LowerCtx, lookup_op
+
+SEQLEN_SUFFIX = "@SEQLEN"
+GRAD_SUFFIX = "@GRAD"
+
+
+def grad_var_name(name: str) -> str:
+    return name + GRAD_SUFFIX
+
+
+def _gather_inputs(op: Operator, env: Dict[str, Any]) -> Dict[str, List[Any]]:
+    ins = {}
+    for slot, names in op.inputs.items():
+        vals = []
+        for n in names:
+            if n not in env:
+                raise NotFoundError(
+                    f"op {op.type!r} reads variable {n!r} (slot {slot!r}) "
+                    f"which is not initialized — run the startup program or "
+                    f"feed it")
+            vals.append(env[n])
+        ins[slot] = vals
+    return ins
+
+
+def _scatter_outputs(op: Operator, outs: Dict[str, List[Any]],
+                     env: Dict[str, Any], block: Block):
+    check_nan = flags.get_flag("check_nan_inf")
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot)
+        if vals is None:
+            continue
+        for n, v in zip(names, vals):
+            if v is None:
+                continue
+            try:
+                var = block.var(n)
+                if var.stop_gradient and not var.persistable:
+                    v = jax.lax.stop_gradient(v)
+            except NotFoundError:
+                pass
+            if check_nan and hasattr(v, "dtype") and jnp.issubdtype(
+                    v.dtype, jnp.floating):
+                _nan_guard(op.type, n, v)
+            env[n] = v
+
+
+def _nan_guard(op_type: str, name: str, value):
+    """Debug-mode NaN/Inf scan (≙ FLAGS_check_nan_inf + CheckTensorNANOrInf,
+    reference framework/operator.cc:651,726-736)."""
+    bad = jnp.logical_not(jnp.all(jnp.isfinite(value)))
+
+    def _report(bad_flag, op_type=op_type, name=name):
+        if bool(bad_flag):
+            raise FloatingPointError(
+                f"NaN/Inf detected in output {name!r} of op {op_type!r}")
+
+    jax.debug.callback(_report, bad)
+
+
+def run_op(op: Operator, env: Dict[str, Any], block: Block, ctx: LowerCtx):
+    opdef = lookup_op(op.type)
+    ins = _gather_inputs(op, env)
+    try:
+        outs = opdef.lower(ctx, ins, op.attrs)
+    except EnforceError:
+        raise
+    except Exception as e:  # re-raise with op context, keep traceback
+        raise type(e)(f"[while lowering op {op.type!r} "
+                      f"{op.inputs} -> {op.outputs}] {e}") from e
+    _scatter_outputs(op, outs or {}, env, block)
+
+
+def _ancestor_op_indices(block: Block, upto: int, roots: Set[str]) -> List[int]:
+    """Indices (< upto) of ops needed to compute vars in `roots`
+    (≙ _find_op_path_, reference python/paddle/fluid/backward.py:645)."""
+    needed = set(roots)
+    keep = []
+    for i in range(upto - 1, -1, -1):
+        op = block.ops[i]
+        if needed & set(op.output_names()):
+            keep.append(i)
+            needed |= set(op.input_names())
+    keep.reverse()
+    return keep
+
+
+def build_plan(block: Block):
+    """Pre-scan the block into an execution plan.
+
+    Ops consumed by a vjp_region execute *inside* jax.vjp; the region runs at
+    the position of its earliest forward op so downstream consumers (metric
+    ops etc.) see the forward values.
+    """
+    regions: Dict[int, list] = {}  # first_fwd_index -> [(region_op, seg), ...]
+    consumed: Set[int] = set()
+    region_ops: Set[int] = set()
+    for i, op in enumerate(block.ops):
+        if op.type == "vjp_region":
+            seg = op.attrs["fwd_ops"]
+            if not seg:
+                continue
+            # multiple regions may share the earliest forward op (two losses
+            # over one trunk) — keep them all, in program order
+            regions.setdefault(min(seg), []).append((op, list(seg)))
+            consumed |= set(seg)
+            region_ops.add(i)
+
+    plan = []
+    for i, op in enumerate(block.ops):
+        for region_op, seg in regions.get(i, ()):
+            plan.append(("region", region_op, seg))
+        if i in consumed or i in region_ops:
+            continue
+        plan.append(("op", op))
+    return plan
+
+
+def run_vjp_region(region_op: Operator, seg_indices: Sequence[int],
+                   env: Dict[str, Any], block: Block, ctx: LowerCtx):
+    """Execute a forward segment under jax.vjp, producing forward vars AND
+    gradients (≙ append_backward's emitted grad-op chain, reference
+    backward.py:315-469, executed by the compiler instead)."""
+    attrs = region_op.attrs
+    target_names: List[str] = attrs["targets"]        # vars to differentiate wrt
+    loss_name: str = attrs["loss"]
+    seg_ops = [block.ops[i] for i in seg_indices]
+    produced: List[str] = []
+    for op in seg_ops:
+        for n in op.output_names():
+            if n not in produced:
+                produced.append(n)
+
+    # Snapshot of everything the segment may read, minus the diff targets.
+    base_env = {k: v for k, v in env.items()}
+
+    def fwd(target_vals):
+        env2 = dict(base_env)
+        env2.update(zip(target_names, target_vals))
+        for op in seg_ops:
+            run_op(op, env2, block, ctx)
+        loss = env2[loss_name]
+        aux = tuple(env2[n] for n in produced)
+        return loss, aux
+
+    target_vals = tuple(env[n] for n in target_names)
+    loss_val, vjp_fn, aux = jax.vjp(fwd, target_vals, has_aux=True)
+    seed = jnp.ones_like(loss_val)  # ≙ fill_constant loss@GRAD=1 (backward.py:566)
+    (grads,) = vjp_fn(seed)
+    env.update(zip(produced, aux))
+    env[grad_var_name(loss_name)] = seed
+    for name, g in zip(target_names, grads):
+        env[grad_var_name(name)] = g
+
+
+from .registry import register_op  # noqa: E402
+
+
+@register_op("vjp_region", stop_gradient=True)
+def _vjp_region_stub(ctx, ins, attrs):
+    # Never lowered directly — handled by build_plan/run_vjp_region. Appears in
+    # the registry so Operator construction validates (≙ OpInfoMap entry).
+    raise RuntimeError("vjp_region must be executed via the block planner")
+
+
+def run_plan(plan, env: Dict[str, Any], block: Block, ctx: LowerCtx):
+    for step in plan:
+        if step[0] == "op":
+            run_op(step[1], env, block, ctx)
+        else:
+            _, region_op, seg = step
+            run_vjp_region(region_op, seg, env, block, ctx)
+    return env

@@ -1,0 +1,101 @@
+"""Op registry: op type → jax lowering rule.
+
+Capability equivalent of the reference's operator registry + kernel dispatch
+(reference: paddle/fluid/framework/op_registry.h:185-236, op_kernel_type.h:27,
+operator.cc:657-737). Where the reference dispatches at *runtime* to a
+(place, dtype, layout, library) kernel per op, here each op registers ONE
+lowering rule that emits jax/XLA operations at *trace* time; XLA then does the
+per-backend kernel selection, layout assignment, and fusion. Pallas kernels
+plug in as alternative lowerings gated on backend.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core.enforce import AlreadyExistsError, NotFoundError
+
+# A lowering takes (ctx, ins, attrs) where ins: slot -> list of jax values, and
+# returns outs: slot -> list of jax values.
+LowerFn = Callable[["LowerCtx", Dict[str, List[Any]], Dict[str, Any]],
+                   Dict[str, List[Any]]]
+
+
+@dataclass
+class OpDef:
+    type: str
+    lower: LowerFn
+    # ops whose outputs must never be differentiated through (metrics, prints)
+    stop_gradient: bool = False
+    # extra metadata for passes/inspection
+    tags: tuple = ()
+
+
+_OPS: Dict[str, OpDef] = {}
+
+
+def register_op(op_type: str, *, stop_gradient: bool = False, tags=()):
+    """Decorator registering a lowering rule (≙ REGISTER_OPERATOR +
+    REGISTER_OP_*_KERNEL, reference op_registry.h:185-217)."""
+
+    def deco(fn: LowerFn) -> LowerFn:
+        if op_type in _OPS:
+            raise AlreadyExistsError(f"op {op_type!r} already registered")
+        _OPS[op_type] = OpDef(op_type, fn, stop_gradient=stop_gradient,
+                              tags=tuple(tags))
+        return fn
+
+    return deco
+
+
+def lookup_op(op_type: str) -> OpDef:
+    op = _OPS.get(op_type)
+    if op is None:
+        # Make sure all builtin op modules are imported (they self-register).
+        _ensure_builtin_ops()
+        op = _OPS.get(op_type)
+    if op is None:
+        raise NotFoundError(f"no op registered with type {op_type!r}; "
+                            f"known ops: {sorted(_OPS)[:20]}...")
+    return op
+
+
+def registered_ops() -> List[str]:
+    _ensure_builtin_ops()
+    return sorted(_OPS)
+
+
+_builtins_loaded = False
+
+
+def _ensure_builtin_ops():
+    global _builtins_loaded
+    if _builtins_loaded:
+        return
+    _builtins_loaded = True
+    # import for registration side effects
+    from ..ops import (elementwise, nn_ops, tensor_ops, reduce_ops,  # noqa: F401
+                       optimizer_ops, random_ops, sequence_ops, metric_ops,
+                       control_ops)
+
+
+@dataclass
+class LowerCtx:
+    """Per-trace context handed to lowerings (≙ ExecutionContext,
+    reference framework/operator.h ExecutionContext).
+
+    rng_key: base PRNG key for this step; ops take fresh keys via next_key().
+    is_test: inference mode (dropout/batch-norm behave accordingly).
+    mesh / axis info is used by parallel-aware lowerings.
+    """
+    rng_key: Any = None
+    is_test: bool = False
+    mesh: Any = None
+    _rng_counter: int = 0
+    extras: dict = field(default_factory=dict)
+
+    def next_key(self):
+        import jax
+        self._rng_counter += 1
+        return jax.random.fold_in(self.rng_key, self._rng_counter)

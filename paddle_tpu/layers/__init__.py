@@ -1,0 +1,11 @@
+"""fluid.layers-equivalent namespace (≙ reference python/paddle/fluid/layers/)."""
+
+from . import io, math_ops, nn, ops, tensor  # noqa: F401
+from .io import data  # noqa: F401
+from .math_ops import scale  # noqa: F401
+from .nn import *  # noqa: F401,F403
+from .ops import *  # noqa: F401,F403
+from .tensor import (argmax, argmin, argsort, assign, cast, concat,  # noqa: F401
+                     create_tensor, fill_constant,
+                     fill_constant_batch_size_like, ones, reverse, sums,
+                     zeros, zeros_like)

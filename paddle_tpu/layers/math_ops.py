@@ -1,0 +1,61 @@
+"""Operator-overload dispatch + scale layer.
+
+≙ reference python/paddle/fluid/layers/math_op_patch.py (monkey-patched
+Variable arithmetic) — here Variable calls into this module directly.
+"""
+
+from __future__ import annotations
+
+import numbers
+
+from ..core.dtypes import dtype_name
+from ..layer_helper import LayerHelper
+
+_COMPARE_OPS = {"less_than", "less_equal", "greater_than", "greater_equal",
+                "equal", "not_equal"}
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    helper = LayerHelper("scale", name=name, act=act)
+    out = helper.create_tmp_variable(dtype=dtype_name(x.dtype), shape=x.shape)
+    helper.append_op(type="scale", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"scale": float(scale), "bias": float(bias),
+                            "bias_after_scale": bias_after_scale})
+    return helper.append_activation(out)
+
+
+def _fill_like_scalar(x, value):
+    from . import tensor as tensor_layers
+    return tensor_layers.fill_constant(shape=[1], dtype=dtype_name(x.dtype),
+                                       value=float(value))
+
+
+def elementwise_binary_dispatch(x, other, op_type, reverse=False):
+    """Implements Variable.__add__ & co."""
+    if isinstance(other, numbers.Number):
+        if op_type in _COMPARE_OPS:
+            other = _fill_like_scalar(x, other)
+        elif not reverse:
+            if op_type == "elementwise_add":
+                return scale(x, 1.0, float(other))
+            if op_type == "elementwise_sub":
+                return scale(x, 1.0, -float(other))
+            if op_type == "elementwise_mul":
+                return scale(x, float(other))
+            if op_type == "elementwise_div":
+                return scale(x, 1.0 / float(other))
+            other = _fill_like_scalar(x, other)
+        else:
+            if op_type == "elementwise_sub":  # other - x
+                return scale(x, -1.0, float(other))
+            other = _fill_like_scalar(x, other)
+    a, b = (other, x) if reverse else (x, other)
+    helper = LayerHelper(op_type)
+    out_dtype = "bool" if op_type in _COMPARE_OPS else dtype_name(a.dtype)
+    shape = a.shape if (a.shape and b.shape and
+                        len(a.shape) >= len(b.shape)) else b.shape
+    out = helper.create_tmp_variable(dtype=out_dtype, shape=shape,
+                                     stop_gradient=op_type in _COMPARE_OPS)
+    helper.append_op(type=op_type, inputs={"X": [a], "Y": [b]},
+                     outputs={"Out": [out]}, attrs={"axis": -1})
+    return out

@@ -1,0 +1,758 @@
+"""Neural-network layers.
+
+≙ reference python/paddle/fluid/layers/nn.py (79 layers: fc:114,
+embedding:226, conv2d:1369, batch_norm:2004, layer_norm:2155, ...). Each layer
+creates parameters via LayerHelper and appends ops; the TPU executor traces
+and XLA-compiles the resulting program.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..core.dtypes import dtype_name
+from ..core.enforce import InvalidArgumentError, enforce
+from ..framework.program import Variable
+from ..initializer import ConstantInitializer, NormalInitializer
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+
+
+def _prod(xs):
+    out = 1
+    for x in xs:
+        out *= x
+    return out
+
+
+# ---------------------------------------------------------------- fc
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, is_test=False, name=None, use_bf16=False):
+    """Fully connected layer (≙ reference layers/nn.py:114).
+
+    use_bf16 routes the matmul through bfloat16 on the MXU with fp32
+    accumulation (TPU-native analogue of fp16 kernels)."""
+    helper = LayerHelper("fc", name=name, act=act, bias_attr=bias_attr)
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    param_attrs = param_attr if isinstance(param_attr, (list, tuple)) \
+        else [param_attr] * len(inputs)
+    mul_results = []
+    for inp, pattr in zip(inputs, param_attrs):
+        in_dim = _prod(inp.shape[num_flatten_dims:])
+        w = helper.create_parameter(pattr, shape=[in_dim, size],
+                                    dtype=dtype_name(inp.dtype))
+        out_shape = list(inp.shape[:num_flatten_dims]) + [size]
+        tmp = helper.create_tmp_variable(dtype=dtype_name(inp.dtype),
+                                         shape=out_shape)
+        helper.append_op(type="mul", inputs={"X": [inp], "Y": [w]},
+                         outputs={"Out": [tmp]},
+                         attrs={"x_num_col_dims": num_flatten_dims,
+                                "y_num_col_dims": 1, "use_bf16": use_bf16})
+        mul_results.append(tmp)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_tmp_variable(
+            dtype=dtype_name(inputs[0].dtype), shape=mul_results[0].shape)
+        helper.append_op(type="sum", inputs={"X": mul_results},
+                         outputs={"Out": [pre_bias]})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims)
+    return helper.append_activation(pre_act)
+
+
+# ---------------------------------------------------------------- embedding
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """≙ reference layers/nn.py:226 + lookup_table_op.cc:21. On TPU the table
+    is a dense (shardable) array; is_sparse/is_distributed accepted for API
+    parity — sharding is configured via the parallel strategy instead."""
+    helper = LayerHelper("embedding", name=None)
+    w = helper.create_parameter(param_attr, shape=list(size), dtype=dtype,
+                                default_initializer=NormalInitializer(0., 0.02))
+    in_shape = list(input.shape)
+    if in_shape and in_shape[-1] == 1:
+        in_shape = in_shape[:-1]
+    out = helper.create_tmp_variable(dtype=dtype,
+                                     shape=in_shape + [size[1]])
+    helper.append_op(type="lookup_table",
+                     inputs={"W": [w], "Ids": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"is_sparse": is_sparse,
+                            "is_distributed": is_distributed,
+                            "padding_idx": padding_idx})
+    return out
+
+
+# ---------------------------------------------------------------- conv
+def _pair(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x, x]
+
+
+def _conv_out_dim(in_dim, k, pad, stride, dilation=1):
+    if in_dim == -1:
+        return -1
+    return (in_dim + 2 * pad - (dilation * (k - 1) + 1)) // stride + 1
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           use_cudnn=True, name=None, data_format="NCHW", use_bf16=False):
+    """≙ reference layers/nn.py:1369 (conv2d). use_cudnn accepted for API
+    parity and ignored — XLA picks the conv implementation."""
+    helper = LayerHelper("conv2d", name=name, act=act, bias_attr=bias_attr)
+    filter_size = _pair(filter_size)
+    stride = _pair(stride)
+    padding = _pair(padding)
+    dilation = _pair(dilation)
+    groups = groups or 1
+    c_axis = 1 if data_format == "NCHW" else 3
+    num_channels = input.shape[c_axis]
+    w_shape = [num_filters, num_channels // groups] + filter_size
+    fan_in = (num_channels // groups) * filter_size[0] * filter_size[1]
+    std = (2.0 / fan_in) ** 0.5
+    w = helper.create_parameter(param_attr, shape=w_shape,
+                                dtype=dtype_name(input.dtype),
+                                default_initializer=NormalInitializer(0., std))
+    if data_format == "NCHW":
+        n, c, h, wd = input.shape
+        out_shape = [n, num_filters,
+                     _conv_out_dim(h, filter_size[0], padding[0], stride[0],
+                                   dilation[0]),
+                     _conv_out_dim(wd, filter_size[1], padding[1], stride[1],
+                                   dilation[1])]
+    else:
+        n, h, wd, c = input.shape
+        out_shape = [n,
+                     _conv_out_dim(h, filter_size[0], padding[0], stride[0],
+                                   dilation[0]),
+                     _conv_out_dim(wd, filter_size[1], padding[1], stride[1],
+                                   dilation[1]),
+                     num_filters]
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=out_shape)
+    helper.append_op(type="conv2d",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": stride, "paddings": padding,
+                            "dilations": dilation, "groups": groups,
+                            "data_format": data_format, "use_bf16": use_bf16})
+    pre_act = helper.append_bias_op(out, dim_start=c_axis,
+                                    dim_end=c_axis + 1)
+    return helper.append_activation(pre_act)
+
+
+def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, param_attr=None,
+                     bias_attr=None, act=None, name=None):
+    """≙ reference layers/nn.py conv2d_transpose."""
+    helper = LayerHelper("conv2d_transpose", name=name, act=act,
+                         bias_attr=bias_attr)
+    stride = _pair(stride)
+    padding = _pair(padding)
+    dilation = _pair(dilation)
+    n, c, h, wd = input.shape
+    if filter_size is None:
+        enforce(output_size is not None,
+                "need filter_size or output_size", exc=InvalidArgumentError)
+        output_size = _pair(output_size)
+        filter_size = [output_size[0] - (h - 1) * stride[0] + 2 * padding[0],
+                       output_size[1] - (wd - 1) * stride[1] + 2 * padding[1]]
+    else:
+        filter_size = _pair(filter_size)
+    w = helper.create_parameter(param_attr,
+                                shape=[c, num_filters] + filter_size,
+                                dtype=dtype_name(input.dtype))
+
+    def _out(in_dim, k, pad, s):
+        return -1 if in_dim == -1 else (in_dim - 1) * s - 2 * pad + k
+
+    out_shape = [n, num_filters,
+                 _out(h, filter_size[0], padding[0], stride[0]),
+                 _out(wd, filter_size[1], padding[1], stride[1])]
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=out_shape)
+    helper.append_op(type="conv2d_transpose",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": stride, "paddings": padding,
+                            "dilations": dilation})
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+# ---------------------------------------------------------------- pool
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, use_cudnn=True, name=None, data_format="NCHW"):
+    """≙ reference layers/nn.py pool2d."""
+    helper = LayerHelper("pool2d", name=name)
+    pool_size = _pair(pool_size)
+    pool_stride = _pair(pool_stride)
+    pool_padding = _pair(pool_padding)
+    spatial = (2, 3) if data_format == "NCHW" else (1, 2)
+    out_shape = list(input.shape)
+    for i, d in enumerate(spatial):
+        if global_pooling:
+            out_shape[d] = 1
+        elif out_shape[d] != -1:
+            span = out_shape[d] + 2 * pool_padding[i] - pool_size[i]
+            if ceil_mode:
+                out_shape[d] = -(-span // pool_stride[i]) + 1
+            else:
+                out_shape[d] = span // pool_stride[i] + 1
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=out_shape)
+    helper.append_op(type="pool2d", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pooling_type": pool_type, "ksize": pool_size,
+                            "strides": pool_stride, "paddings": pool_padding,
+                            "global_pooling": global_pooling,
+                            "exclusive": exclusive, "ceil_mode": ceil_mode,
+                            "data_format": data_format})
+    return out
+
+
+# ---------------------------------------------------------------- norms
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               name=None, moving_mean_name=None, moving_variance_name=None):
+    """≙ reference layers/nn.py:2004. Moving stats are persistable vars
+    updated functionally each step."""
+    helper = LayerHelper("batch_norm", name=name, act=act)
+    c_axis = 1 if data_layout == "NCHW" else input.ndim - 1
+    c = input.shape[c_axis]
+    dtype = dtype_name(input.dtype)
+    scale = helper.create_parameter(param_attr, shape=[c], dtype=dtype,
+                                    default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(bias_attr, shape=[c], dtype=dtype,
+                                   is_bias=True)
+    mean = helper.create_parameter(
+        ParamAttr(name=moving_mean_name, trainable=False), shape=[c],
+        dtype=dtype, default_initializer=ConstantInitializer(0.0))
+    variance = helper.create_parameter(
+        ParamAttr(name=moving_variance_name, trainable=False), shape=[c],
+        dtype=dtype, default_initializer=ConstantInitializer(1.0))
+    mean.stop_gradient = True
+    variance.stop_gradient = True
+    y = helper.create_tmp_variable(dtype=dtype, shape=input.shape)
+    saved_mean = helper.create_tmp_variable(dtype=dtype, shape=[c],
+                                            stop_gradient=True)
+    saved_var = helper.create_tmp_variable(dtype=dtype, shape=[c],
+                                           stop_gradient=True)
+    helper.append_op(type="batch_norm",
+                     inputs={"X": [input], "Scale": [scale], "Bias": [bias],
+                             "Mean": [mean], "Variance": [variance]},
+                     outputs={"Y": [y], "MeanOut": [mean],
+                              "VarianceOut": [variance],
+                              "SavedMean": [saved_mean],
+                              "SavedVariance": [saved_var]},
+                     attrs={"momentum": momentum, "epsilon": epsilon,
+                            "data_layout": data_layout, "is_test": is_test})
+    return helper.append_activation(y)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """≙ reference layers/nn.py:2155."""
+    helper = LayerHelper("layer_norm", name=name, act=act)
+    dtype = dtype_name(input.dtype)
+    norm_shape = [_prod(input.shape[begin_norm_axis:])]
+    inputs = {"X": [input]}
+    if scale:
+        s = helper.create_parameter(param_attr, shape=norm_shape, dtype=dtype,
+                                    default_initializer=ConstantInitializer(1.0))
+        inputs["Scale"] = [s]
+    if shift:
+        b = helper.create_parameter(bias_attr, shape=norm_shape, dtype=dtype,
+                                    is_bias=True)
+        inputs["Bias"] = [b]
+    y = helper.create_tmp_variable(dtype=dtype, shape=input.shape)
+    mean = helper.create_tmp_variable(dtype=dtype,
+                                      shape=input.shape[:begin_norm_axis],
+                                      stop_gradient=True)
+    var = helper.create_tmp_variable(dtype=dtype,
+                                     shape=input.shape[:begin_norm_axis],
+                                     stop_gradient=True)
+    helper.append_op(type="layer_norm", inputs=inputs,
+                     outputs={"Y": [y], "Mean": [mean], "Variance": [var]},
+                     attrs={"begin_norm_axis": begin_norm_axis,
+                            "epsilon": epsilon})
+    return helper.append_activation(y)
+
+
+# ---------------------------------------------------------------- dropout
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_tmp_variable(dtype=dtype_name(x.dtype), shape=x.shape)
+    mask = helper.create_tmp_variable(dtype=dtype_name(x.dtype),
+                                      shape=x.shape, stop_gradient=True)
+    helper.append_op(type="dropout", inputs={"X": [x]},
+                     outputs={"Out": [out], "Mask": [mask]},
+                     attrs={"dropout_prob": dropout_prob, "is_test": is_test,
+                            "seed": seed or 0,
+                            "dropout_implementation": dropout_implementation})
+    return out
+
+
+# ---------------------------------------------------------------- losses
+def softmax(input, name=None, axis=-1):
+    helper = LayerHelper("softmax", name=name)
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=input.shape)
+    helper.append_op(type="softmax", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    return out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, return_softmax=False):
+    helper = LayerHelper("softmax_with_cross_entropy")
+    loss_shape = list(logits.shape[:-1]) + [1]
+    loss = helper.create_tmp_variable(dtype=dtype_name(logits.dtype),
+                                      shape=loss_shape)
+    sm = helper.create_tmp_variable(dtype=dtype_name(logits.dtype),
+                                    shape=logits.shape)
+    helper.append_op(type="softmax_with_cross_entropy",
+                     inputs={"Logits": [logits], "Label": [label]},
+                     outputs={"Loss": [loss], "Softmax": [sm]},
+                     attrs={"soft_label": soft_label,
+                            "ignore_index": ignore_index})
+    if return_softmax:
+        return loss, sm
+    return loss
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    helper = LayerHelper("cross_entropy")
+    loss_shape = list(input.shape[:-1]) + [1]
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=loss_shape)
+    helper.append_op(type="cross_entropy",
+                     inputs={"X": [input], "Label": [label]},
+                     outputs={"Y": [out]},
+                     attrs={"soft_label": soft_label,
+                            "ignore_index": ignore_index})
+    return out
+
+
+def sigmoid_cross_entropy_with_logits(x, label, name=None):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", name=name)
+    out = helper.create_tmp_variable(dtype=dtype_name(x.dtype), shape=x.shape)
+    helper.append_op(type="sigmoid_cross_entropy_with_logits",
+                     inputs={"X": [x], "Label": [label]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def square_error_cost(input, label):
+    """≙ reference layers/nn.py square_error_cost (fit-a-line loss)."""
+    helper = LayerHelper("square_error_cost")
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=input.shape)
+    helper.append_op(type="mse_loss", inputs={"X": [input], "Y": [label]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    helper = LayerHelper("smooth_l1_loss")
+    inputs = {"X": [x], "Y": [y]}
+    if inside_weight is not None:
+        inputs["InsideWeight"] = [inside_weight]
+    if outside_weight is not None:
+        inputs["OutsideWeight"] = [outside_weight]
+    loss = helper.create_tmp_variable(dtype=dtype_name(x.dtype),
+                                      shape=[x.shape[0], 1])
+    diff = helper.create_tmp_variable(dtype=dtype_name(x.dtype),
+                                      shape=x.shape, stop_gradient=True)
+    helper.append_op(type="smooth_l1_loss", inputs=inputs,
+                     outputs={"Out": [loss], "Diff": [diff]},
+                     attrs={"sigma": sigma or 1.0})
+    return loss
+
+
+def huber_loss(input, label, delta):
+    helper = LayerHelper("huber_loss")
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=input.shape)
+    resid = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                       shape=input.shape, stop_gradient=True)
+    helper.append_op(type="huber_loss",
+                     inputs={"X": [input], "Y": [label]},
+                     outputs={"Out": [out], "Residual": [resid]},
+                     attrs={"delta": delta})
+    return out
+
+
+# ---------------------------------------------------------------- reductions
+def mean(x, name=None):
+    helper = LayerHelper("mean", name=name)
+    out = helper.create_tmp_variable(dtype=dtype_name(x.dtype), shape=[])
+    helper.append_op(type="mean", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def _reduce_layer(op_type, input, dim, keep_dim, name):
+    helper = LayerHelper(op_type, name=name)
+    shape = list(input.shape)
+    if dim is None:
+        out_shape = [] if not keep_dim else [1] * len(shape)
+    else:
+        dims = [dim] if isinstance(dim, int) else list(dim)
+        dims = [d if d >= 0 else len(shape) + d for d in dims]
+        out_shape = [1 if i in dims else d for i, d in enumerate(shape)] \
+            if keep_dim else [d for i, d in enumerate(shape)
+                              if i not in dims]
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=out_shape)
+    helper.append_op(type=op_type, inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"dim": dim, "keep_dim": keep_dim,
+                            "reduce_all": dim is None})
+    return out
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_sum", input, dim, keep_dim, name)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_mean", input, dim, keep_dim, name)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_max", input, dim, keep_dim, name)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_min", input, dim, keep_dim, name)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_prod", input, dim, keep_dim, name)
+
+
+# ---------------------------------------------------------------- manip
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    helper = LayerHelper("reshape", name=name, act=act)
+    out_shape = list(shape)
+    out = helper.create_tmp_variable(dtype=dtype_name(x.dtype),
+                                     shape=[d if d != 0 else x.shape[i]
+                                            for i, d in enumerate(out_shape)])
+    helper.append_op(type="reshape", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"shape": list(shape)})
+    return helper.append_activation(out)
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper("transpose", name=name)
+    out = helper.create_tmp_variable(
+        dtype=dtype_name(x.dtype),
+        shape=[x.shape[p] for p in perm] if x.shape else None)
+    helper.append_op(type="transpose", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"axis": list(perm)})
+    return out
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper("split", name=name)
+    shape = list(input.shape)
+    axis = dim if dim >= 0 else len(shape) + dim
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        sections = [shape[axis] // n] * n
+        attrs = {"num": n, "axis": axis, "sections": []}
+    else:
+        sections = list(num_or_sections)
+        attrs = {"num": 0, "axis": axis, "sections": sections}
+    outs = []
+    for s in sections:
+        os = list(shape)
+        os[axis] = s
+        outs.append(helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                               shape=os))
+    helper.append_op(type="split", inputs={"X": [input]},
+                     outputs={"Out": outs}, attrs=attrs)
+    return outs
+
+
+def squeeze(input, axes, name=None):
+    helper = LayerHelper("squeeze", name=name)
+    shape = [d for i, d in enumerate(input.shape) if i not in axes] \
+        if input.shape else None
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=shape)
+    helper.append_op(type="squeeze", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs={"axes": list(axes)})
+    return out
+
+
+def unsqueeze(input, axes, name=None):
+    helper = LayerHelper("unsqueeze", name=name)
+    shape = list(input.shape)
+    for ax in sorted(axes):
+        shape.insert(ax, 1)
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=shape)
+    helper.append_op(type="unsqueeze", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs={"axes": list(axes)})
+    return out
+
+
+def flatten(x, axis=1, name=None):
+    helper = LayerHelper("flatten", name=name)
+    lead = _prod(x.shape[:axis]) if axis > 0 else 1
+    trail = _prod(x.shape[axis:])
+    if any(d == -1 for d in x.shape[:axis]):
+        lead = -1
+    out = helper.create_tmp_variable(dtype=dtype_name(x.dtype),
+                                     shape=[lead, trail])
+    helper.append_op(type="flatten", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    return out
+
+
+def stack(x, axis=0):
+    helper = LayerHelper("stack")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    shape = list(xs[0].shape)
+    shape.insert(axis if axis >= 0 else len(shape) + 1 + axis, len(xs))
+    out = helper.create_tmp_variable(dtype=dtype_name(xs[0].dtype),
+                                     shape=shape)
+    helper.append_op(type="stack", inputs={"X": list(xs)},
+                     outputs={"Y": [out]}, attrs={"axis": axis})
+    return out
+
+
+def gather(input, index):
+    helper = LayerHelper("gather")
+    out_shape = list(index.shape) + list(input.shape[1:])
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=out_shape)
+    helper.append_op(type="gather",
+                     inputs={"X": [input], "Index": [index]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def scatter(input, index, updates, overwrite=True):
+    helper = LayerHelper("scatter")
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=input.shape)
+    helper.append_op(type="scatter",
+                     inputs={"X": [input], "Ids": [index],
+                             "Updates": [updates]},
+                     outputs={"Out": [out]}, attrs={"overwrite": overwrite})
+    return out
+
+
+def expand(x, expand_times, name=None):
+    helper = LayerHelper("expand", name=name)
+    shape = [(-1 if d == -1 else d * t)
+             for d, t in zip(x.shape, expand_times)]
+    out = helper.create_tmp_variable(dtype=dtype_name(x.dtype), shape=shape)
+    helper.append_op(type="expand", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"expand_times": list(expand_times)})
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    helper = LayerHelper("pad", name=name)
+    shape = [(-1 if d == -1 else d + paddings[2 * i] + paddings[2 * i + 1])
+             for i, d in enumerate(x.shape)]
+    out = helper.create_tmp_variable(dtype=dtype_name(x.dtype), shape=shape)
+    helper.append_op(type="pad", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"paddings": list(paddings),
+                            "pad_value": pad_value})
+    return out
+
+
+def one_hot(input, depth):
+    helper = LayerHelper("one_hot")
+    shape = list(input.shape)
+    if shape and shape[-1] == 1:
+        shape = shape[:-1]
+    out = helper.create_tmp_variable(dtype="float32", shape=shape + [depth],
+                                     stop_gradient=True)
+    helper.append_op(type="one_hot", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs={"depth": depth})
+    return out
+
+
+def clip(x, min, max, name=None):
+    helper = LayerHelper("clip", name=name)
+    out = helper.create_tmp_variable(dtype=dtype_name(x.dtype), shape=x.shape)
+    helper.append_op(type="clip", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"min": min, "max": max})
+    return out
+
+
+def clip_by_norm(x, max_norm, name=None):
+    helper = LayerHelper("clip_by_norm", name=name)
+    out = helper.create_tmp_variable(dtype=dtype_name(x.dtype), shape=x.shape)
+    helper.append_op(type="clip_by_norm", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"max_norm": max_norm})
+    return out
+
+
+# ---------------------------------------------------------------- metrics
+def accuracy(input, label, k=1, correct=None, total=None):
+    """≙ reference layers/metric_op.py accuracy: top-k then accuracy op."""
+    helper = LayerHelper("accuracy")
+    topk_out, topk_indices = topk(input, k=k)
+    acc = helper.create_tmp_variable(dtype="float32", shape=[],
+                                     stop_gradient=True)
+    correct = correct or helper.create_tmp_variable(dtype="int32", shape=[],
+                                                    stop_gradient=True)
+    total = total or helper.create_tmp_variable(dtype="int32", shape=[],
+                                                stop_gradient=True)
+    helper.append_op(type="accuracy",
+                     inputs={"Out": [topk_out], "Indices": [topk_indices],
+                             "Label": [label]},
+                     outputs={"Accuracy": [acc], "Correct": [correct],
+                              "Total": [total]})
+    return acc
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper("top_k", name=name)
+    shape = list(input.shape[:-1]) + [k]
+    values = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                        shape=shape, stop_gradient=True)
+    indices = helper.create_tmp_variable(dtype="int64", shape=shape,
+                                         stop_gradient=True)
+    helper.append_op(type="top_k", inputs={"X": [input]},
+                     outputs={"Out": [values], "Indices": [indices]},
+                     attrs={"k": k})
+    return values, indices
+
+
+def auc(input, label, curve="ROC", num_thresholds=200, topk=1):
+    """≙ reference layers/metric_op.py auc — streaming AUC with persistable
+    bucket state."""
+    helper = LayerHelper("auc")
+    stat_pos = helper.create_global_variable(
+        name=helper.name + ".stat_pos", shape=[num_thresholds + 1],
+        dtype="float32")
+    stat_neg = helper.create_global_variable(
+        name=helper.name + ".stat_neg", shape=[num_thresholds + 1],
+        dtype="float32")
+    for var in (stat_pos, stat_neg):
+        sb = helper.startup_program.global_block()
+        if var.name not in sb.vars:
+            sv = sb.create_var(name=var.name, shape=var.shape,
+                               dtype=var.dtype, persistable=True)
+            sb.append_op("fill_constant", outputs={"Out": [sv.name]},
+                         attrs={"shape": list(var.shape), "value": 0.0,
+                                "dtype": "float32"})
+    auc_out = helper.create_tmp_variable(dtype="float32", shape=[],
+                                         stop_gradient=True)
+    helper.append_op(type="auc",
+                     inputs={"Predict": [input], "Label": [label],
+                             "StatPos": [stat_pos], "StatNeg": [stat_neg]},
+                     outputs={"AUC": [auc_out], "StatPosOut": [stat_pos],
+                              "StatNegOut": [stat_neg]},
+                     attrs={"num_thresholds": num_thresholds})
+    return auc_out, [stat_pos, stat_neg]
+
+
+# ---------------------------------------------------------------- misc
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None,
+           use_bf16=False):
+    helper = LayerHelper("matmul", name=name)
+    xs, ys = list(x.shape), list(y.shape)
+    if transpose_x:
+        xs[-1], xs[-2] = xs[-2], xs[-1]
+    if transpose_y:
+        ys[-1], ys[-2] = ys[-2], ys[-1]
+    batch = xs[:-2] if len(xs) >= len(ys) else ys[:-2]
+    out_shape = batch + [xs[-2] if len(xs) > 1 else 1, ys[-1]]
+    out = helper.create_tmp_variable(dtype=dtype_name(x.dtype),
+                                     shape=out_shape)
+    helper.append_op(type="matmul", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"transpose_X": transpose_x,
+                            "transpose_Y": transpose_y, "alpha": alpha,
+                            "use_bf16": use_bf16})
+    return out
+
+
+def elementwise_op_layer(op_type, x, y, axis=-1, act=None, name=None):
+    helper = LayerHelper(op_type, name=name, act=act)
+    shape = x.shape if len(x.shape or ()) >= len(y.shape or ()) else y.shape
+    out = helper.create_tmp_variable(dtype=dtype_name(x.dtype), shape=shape)
+    helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    return helper.append_activation(out)
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    return elementwise_op_layer("elementwise_add", x, y, axis, act, name)
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return elementwise_op_layer("elementwise_sub", x, y, axis, act, name)
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return elementwise_op_layer("elementwise_mul", x, y, axis, act, name)
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return elementwise_op_layer("elementwise_div", x, y, axis, act, name)
+
+
+def elementwise_max(x, y, axis=-1, act=None, name=None):
+    return elementwise_op_layer("elementwise_max", x, y, axis, act, name)
+
+
+def elementwise_min(x, y, axis=-1, act=None, name=None):
+    return elementwise_op_layer("elementwise_min", x, y, axis, act, name)
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    return elementwise_op_layer("elementwise_pow", x, y, axis, act, name)
+
+
+def dropout_infer_guard():  # pragma: no cover - convenience stub
+    raise NotImplementedError
+
+
+def lrn(input, n=5, k=2.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper("lrn", name=name)
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=input.shape)
+    mid = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=input.shape, stop_gradient=True)
+    helper.append_op(type="lrn", inputs={"X": [input]},
+                     outputs={"Out": [out], "MidOut": [mid]},
+                     attrs={"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    helper = LayerHelper("l2_normalize", name=name)
+    out = helper.create_tmp_variable(dtype=dtype_name(x.dtype), shape=x.shape)
+    norm = helper.create_tmp_variable(dtype=dtype_name(x.dtype),
+                                      shape=x.shape, stop_gradient=True)
+    helper.append_op(type="l2_normalize", inputs={"X": [x]},
+                     outputs={"Out": [out], "Norm": [norm]},
+                     attrs={"axis": axis, "epsilon": epsilon})
+    return out
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    helper = LayerHelper("label_smooth", name=name)
+    inputs = {"X": [label]}
+    if prior_dist is not None:
+        inputs["PriorDist"] = [prior_dist]
+    out = helper.create_tmp_variable(dtype=dtype, shape=label.shape)
+    helper.append_op(type="label_smooth", inputs=inputs,
+                     outputs={"Out": [out]}, attrs={"epsilon": epsilon})
+    return out

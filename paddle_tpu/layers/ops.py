@@ -1,0 +1,96 @@
+"""Auto-generated thin layer wrappers for activation/unary ops.
+
+≙ reference python/paddle/fluid/layers/ops.py + layer_function_generator.py
+(generates ~40 wrappers from registered OpProtos).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..core.dtypes import dtype_name
+from ..layer_helper import LayerHelper
+
+_UNARY_OPS = [
+    "sigmoid", "logsigmoid", "exp", "tanh", "tanh_shrink", "sqrt", "rsqrt",
+    "abs", "ceil", "floor", "cos", "sin", "round", "reciprocal", "log",
+    "square", "relu", "relu6", "softplus", "softsign", "gelu", "silu",
+    "sign",
+]
+
+
+def _make_unary(op_type):
+    def layer(x, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_tmp_variable(dtype=dtype_name(x.dtype),
+                                         shape=x.shape)
+        helper.append_op(type=op_type, inputs={"X": [x]},
+                         outputs={"Out": [out]})
+        return out
+
+    layer.__name__ = op_type
+    layer.__doc__ = f"Elementwise {op_type} (≙ activation_op.cc kernel)."
+    return layer
+
+
+_mod = sys.modules[__name__]
+for _op in _UNARY_OPS:
+    setattr(_mod, _op, _make_unary(_op))
+
+__all__ = list(_UNARY_OPS) + ["leaky_relu", "elu", "pow", "hard_sigmoid",
+                              "swish", "prelu", "brelu", "soft_shrink",
+                              "hard_shrink", "thresholded_relu", "maxout"]
+
+
+def _attr_unary(op_type, **defaults):
+    def layer(x, name=None, **kwargs):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_tmp_variable(dtype=dtype_name(x.dtype),
+                                         shape=x.shape)
+        attrs = dict(defaults)
+        attrs.update(kwargs)
+        helper.append_op(type=op_type, inputs={"X": [x]},
+                         outputs={"Out": [out]}, attrs=attrs)
+        return out
+
+    layer.__name__ = op_type
+    return layer
+
+
+leaky_relu = _attr_unary("leaky_relu", alpha=0.02)
+elu = _attr_unary("elu", alpha=1.0)
+pow = _attr_unary("pow", factor=1.0)
+hard_sigmoid = _attr_unary("hard_sigmoid", slope=0.2, offset=0.5)
+swish = _attr_unary("swish", beta=1.0)
+brelu = _attr_unary("brelu", t_min=0.0, t_max=24.0)
+soft_shrink = _attr_unary("soft_shrink", **{"lambda": 0.5})
+hard_shrink = _attr_unary("hard_shrink", threshold=0.5)
+thresholded_relu = _attr_unary("thresholded_relu", threshold=1.0)
+
+
+def maxout(x, groups, name=None):
+    helper = LayerHelper("maxout", name=name)
+    n, c, h, w = x.shape
+    out = helper.create_tmp_variable(dtype=dtype_name(x.dtype),
+                                     shape=[n, c // groups, h, w])
+    helper.append_op(type="maxout", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"groups": groups})
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    from ..initializer import ConstantInitializer
+    helper = LayerHelper("prelu", name=name)
+    if mode == "all":
+        alpha_shape = [1]
+    elif mode == "channel":
+        alpha_shape = [x.shape[1]]
+    else:
+        alpha_shape = list(x.shape[1:])
+    alpha = helper.create_parameter(
+        param_attr, shape=alpha_shape, dtype=dtype_name(x.dtype),
+        default_initializer=ConstantInitializer(0.25))
+    out = helper.create_tmp_variable(dtype=dtype_name(x.dtype), shape=x.shape)
+    helper.append_op(type="prelu", inputs={"X": [x], "Alpha": [alpha]},
+                     outputs={"Out": [out]}, attrs={"mode": mode})
+    return out

@@ -1,0 +1,10 @@
+"""Builtin op lowerings — importing this package registers every op.
+
+≙ the reference's static REGISTER_OPERATOR initializers across
+paddle/fluid/operators/ (SURVEY §2.2). Modules self-register via
+framework.registry.register_op.
+"""
+
+from . import (control_ops, elementwise, metric_ops, nn_ops,  # noqa: F401
+               optimizer_ops, random_ops, reduce_ops, sequence_ops,
+               tensor_ops)

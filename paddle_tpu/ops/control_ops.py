@@ -1,0 +1,62 @@
+"""Control-flow and misc framework op lowerings.
+
+≙ reference operators/{compare,is_empty,get_places}_op plus select/where and
+the quantization fake ops. Structured control flow (while/cond) lowers to
+lax.while_loop/lax.cond via layers/control_flow.py builders — no interpreter
+involvement (replacing the reference's sub-block executors in while_op.cc:36,
+conditional_block_op.cc).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+@register_op("where")
+def _where(ctx, ins, attrs):
+    return {"Out": [jnp.where(ins["Condition"][0], ins["X"][0], ins["Y"][0])]}
+
+
+@register_op("is_empty", stop_gradient=True)
+def _is_empty(ctx, ins, attrs):
+    return {"Out": [jnp.asarray(ins["X"][0].size == 0)]}
+
+
+@register_op("fake_quantize_abs_max")
+def _fake_quantize_abs_max(ctx, ins, attrs):
+    """≙ fake_quantize_op.cc — quantize-dequantize for QAT."""
+    x = ins["X"][0]
+    bit_length = attrs.get("bit_length", 8)
+    s = jnp.max(jnp.abs(x))
+    bnt = (1 << (bit_length - 1)) - 1
+    inv_s = bnt / jnp.maximum(s, 1e-12)
+    q = jnp.round(x * inv_s) / inv_s
+    # straight-through estimator
+    out = x + jax.lax.stop_gradient(q - x)
+    return {"Out": [out], "OutScale": [s]}
+
+
+@register_op("fake_dequantize_max_abs")
+def _fake_dequantize_max_abs(ctx, ins, attrs):
+    x = ins["X"][0]
+    scale = ins["Scale"][0]
+    bnt = (1 << (attrs.get("bit_length", 8) - 1)) - 1
+    return {"Out": [x.astype(jnp.float32) * scale / bnt]}
+
+
+@register_op("fake_quantize_moving_average_abs_max")
+def _fake_quantize_moving_avg(ctx, ins, attrs):
+    x = ins["X"][0]
+    state = ins["InScale"][0]
+    bit_length = attrs.get("bit_length", 8)
+    rate = attrs.get("moving_rate", 0.9)
+    cur = jnp.max(jnp.abs(x))
+    s = rate * state + (1 - rate) * cur
+    bnt = (1 << (bit_length - 1)) - 1
+    inv_s = bnt / jnp.maximum(s, 1e-12)
+    q = jnp.round(x * inv_s) / inv_s
+    out = x + jax.lax.stop_gradient(q - x)
+    return {"Out": [out], "OutScale": [s]}

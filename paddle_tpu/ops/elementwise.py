@@ -1,0 +1,212 @@
+"""Elementwise / scale / compare / logical op lowerings.
+
+≙ reference paddle/fluid/operators/elementwise_*.cc, scale_op.cc, clip_op.cc,
+compare_op.cc, logical_op.cc, activation_op.cc. Each lowering emits jax ops;
+XLA fuses chains of these into single kernels (replacing the reference's
+hand-fused CUDA elementwise kernels).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+def _broadcast_y(x, y, axis):
+    """Reference elementwise broadcast semantics: align y's dims to x starting
+    at `axis` (reference operators/elementwise_op_function.h)."""
+    if jnp.ndim(y) == jnp.ndim(x):
+        return y
+    if axis is None or axis == -1:
+        return y  # trailing-aligned: numpy broadcasting handles it
+    # leading-aligned at `axis`: pad y with trailing singleton dims
+    pad = jnp.ndim(x) - axis - jnp.ndim(y)
+    return jnp.reshape(y, y.shape + (1,) * pad)
+
+
+def _binary(fn):
+    def lower(ctx, ins, attrs):
+        x, y = ins["X"][0], ins["Y"][0]
+        y = _broadcast_y(x, y, attrs.get("axis", -1))
+        return {"Out": [fn(x, y)]}
+    return lower
+
+
+register_op("elementwise_add")(_binary(jnp.add))
+register_op("elementwise_sub")(_binary(jnp.subtract))
+register_op("elementwise_mul")(_binary(jnp.multiply))
+register_op("elementwise_div")(_binary(jnp.divide))
+register_op("elementwise_max")(_binary(jnp.maximum))
+register_op("elementwise_min")(_binary(jnp.minimum))
+register_op("elementwise_pow")(_binary(jnp.power))
+register_op("elementwise_mod")(_binary(jnp.mod))
+register_op("elementwise_floordiv")(_binary(jnp.floor_divide))
+
+register_op("less_than", stop_gradient=True)(_binary(jnp.less))
+register_op("less_equal", stop_gradient=True)(_binary(jnp.less_equal))
+register_op("greater_than", stop_gradient=True)(_binary(jnp.greater))
+register_op("greater_equal", stop_gradient=True)(_binary(jnp.greater_equal))
+register_op("equal", stop_gradient=True)(_binary(jnp.equal))
+register_op("not_equal", stop_gradient=True)(_binary(jnp.not_equal))
+
+register_op("logical_and", stop_gradient=True)(_binary(jnp.logical_and))
+register_op("logical_or", stop_gradient=True)(_binary(jnp.logical_or))
+register_op("logical_xor", stop_gradient=True)(_binary(jnp.logical_xor))
+
+
+@register_op("logical_not", stop_gradient=True)
+def _logical_not(ctx, ins, attrs):
+    return {"Out": [jnp.logical_not(ins["X"][0])]}
+
+
+@register_op("scale")
+def _scale(ctx, ins, attrs):
+    # ≙ scale_op.cc: out = scale * (x + bias) or scale*x + bias
+    x = ins["X"][0]
+    scale = attrs.get("scale", 1.0)
+    bias = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        return {"Out": [x * scale + bias]}
+    return {"Out": [(x + bias) * scale]}
+
+
+@register_op("clip")
+def _clip(ctx, ins, attrs):
+    return {"Out": [jnp.clip(ins["X"][0], attrs["min"], attrs["max"])]}
+
+
+@register_op("clip_by_norm")
+def _clip_by_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": [x * scale]}
+
+
+@register_op("sign")
+def _sign(ctx, ins, attrs):
+    return {"Out": [jnp.sign(ins["X"][0])]}
+
+
+@register_op("isfinite", stop_gradient=True)
+def _isfinite(ctx, ins, attrs):
+    # ≙ isfinite_op: reduces to a single bool over all inputs
+    vals = [jnp.all(jnp.isfinite(x)) for x in ins["X"]]
+    out = vals[0]
+    for v in vals[1:]:
+        out = jnp.logical_and(out, v)
+    return {"Out": [out]}
+
+
+# --- activations (≙ activation_op.cc ~20 kernels) ---
+
+def _unary(fn):
+    def lower(ctx, ins, attrs):
+        return {"Out": [fn(ins["X"][0])]}
+    return lower
+
+
+register_op("sigmoid")(_unary(jax.nn.sigmoid))
+register_op("logsigmoid")(_unary(jax.nn.log_sigmoid))
+register_op("exp")(_unary(jnp.exp))
+register_op("tanh")(_unary(jnp.tanh))
+register_op("tanh_shrink")(_unary(lambda x: x - jnp.tanh(x)))
+register_op("sqrt")(_unary(jnp.sqrt))
+register_op("rsqrt")(_unary(jax.lax.rsqrt))
+register_op("abs")(_unary(jnp.abs))
+register_op("ceil")(_unary(jnp.ceil))
+register_op("floor")(_unary(jnp.floor))
+register_op("cos")(_unary(jnp.cos))
+register_op("sin")(_unary(jnp.sin))
+register_op("round")(_unary(jnp.round))
+register_op("reciprocal")(_unary(jnp.reciprocal))
+register_op("log")(_unary(jnp.log))
+register_op("square")(_unary(jnp.square))
+register_op("relu")(_unary(jax.nn.relu))
+register_op("relu6")(_unary(jax.nn.relu6))
+register_op("softplus")(_unary(jax.nn.softplus))
+register_op("softsign")(_unary(lambda x: x / (1 + jnp.abs(x))))
+register_op("gelu")(_unary(jax.nn.gelu))
+register_op("silu")(_unary(jax.nn.silu))
+
+
+@register_op("leaky_relu")
+def _leaky_relu(ctx, ins, attrs):
+    alpha = attrs.get("alpha", 0.02)
+    x = ins["X"][0]
+    return {"Out": [jnp.where(x >= 0, x, alpha * x)]}
+
+
+@register_op("elu")
+def _elu(ctx, ins, attrs):
+    return {"Out": [jax.nn.elu(ins["X"][0], alpha=attrs.get("alpha", 1.0))]}
+
+
+@register_op("pow")
+def _pow(ctx, ins, attrs):
+    return {"Out": [jnp.power(ins["X"][0], attrs.get("factor", 1.0))]}
+
+
+@register_op("hard_sigmoid")
+def _hard_sigmoid(ctx, ins, attrs):
+    slope = attrs.get("slope", 0.2)
+    offset = attrs.get("offset", 0.5)
+    return {"Out": [jnp.clip(ins["X"][0] * slope + offset, 0.0, 1.0)]}
+
+
+@register_op("hard_shrink")
+def _hard_shrink(ctx, ins, attrs):
+    t = attrs.get("threshold", 0.5)
+    x = ins["X"][0]
+    return {"Out": [jnp.where(jnp.abs(x) > t, x, 0.0)]}
+
+
+@register_op("soft_shrink")
+def _soft_shrink(ctx, ins, attrs):
+    lam = attrs.get("lambda", 0.5)
+    x = ins["X"][0]
+    return {"Out": [jnp.where(x > lam, x - lam, jnp.where(x < -lam, x + lam, 0.0))]}
+
+
+@register_op("thresholded_relu")
+def _thresholded_relu(ctx, ins, attrs):
+    t = attrs.get("threshold", 1.0)
+    x = ins["X"][0]
+    return {"Out": [jnp.where(x > t, x, 0.0)]}
+
+
+@register_op("swish")
+def _swish(ctx, ins, attrs):
+    beta = attrs.get("beta", 1.0)
+    x = ins["X"][0]
+    return {"Out": [x * jax.nn.sigmoid(beta * x)]}
+
+
+@register_op("brelu")
+def _brelu(ctx, ins, attrs):
+    t_min = attrs.get("t_min", 0.0)
+    t_max = attrs.get("t_max", 24.0)
+    return {"Out": [jnp.clip(ins["X"][0], t_min, t_max)]}
+
+
+@register_op("prelu")
+def _prelu(ctx, ins, attrs):
+    x = ins["X"][0]
+    alpha = ins["Alpha"][0]
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        alpha = jnp.reshape(alpha, (1, -1) + (1,) * (x.ndim - 2))
+    return {"Out": [jnp.where(x >= 0, x, alpha * x)]}
+
+
+@register_op("maxout")
+def _maxout(ctx, ins, attrs):
+    # ≙ maxout_op: NCHW, channel groups
+    x = ins["X"][0]
+    groups = attrs["groups"]
+    n, c, h, w = x.shape
+    return {"Out": [jnp.max(jnp.reshape(x, (n, c // groups, groups, h, w)),
+                            axis=2)]}

@@ -1,0 +1,98 @@
+"""Metric op lowerings.
+
+≙ reference operators/{accuracy,auc,precision_recall,mean_iou}_op.cc and
+edit_distance / chunk_eval from the sequence family.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+@register_op("accuracy", stop_gradient=True)
+def _accuracy(ctx, ins, attrs):
+    """≙ accuracy_op.cc: Out=(Indices hit rate), inputs are top-k indices."""
+    indices = ins["Indices"][0]  # [N, k]
+    label = ins["Label"][0]      # [N, 1]
+    if label.ndim == 1:
+        label = label[:, None]
+    hit = jnp.any(indices == label, axis=1)
+    correct = jnp.sum(hit.astype(jnp.float32))
+    total = jnp.asarray(indices.shape[0], dtype=jnp.float32)
+    return {"Accuracy": [correct / total],
+            "Correct": [correct.astype(jnp.int32)],
+            "Total": [total.astype(jnp.int32)]}
+
+
+@register_op("auc", stop_gradient=True)
+def _auc(ctx, ins, attrs):
+    """Streaming AUC via threshold buckets (≙ auc_op.cc)."""
+    preds = ins["Predict"][0]  # [N, 2] probabilities
+    label = ins["Label"][0].reshape(-1)
+    stat_pos = ins["StatPos"][0]
+    stat_neg = ins["StatNeg"][0]
+    num_thresholds = attrs.get("num_thresholds", 200)
+    pos_prob = preds[:, 1] if preds.ndim == 2 else preds.reshape(-1)
+    bucket = jnp.clip((pos_prob * num_thresholds).astype(jnp.int32),
+                      0, num_thresholds)
+    is_pos = (label > 0).astype(stat_pos.dtype)
+    stat_pos = stat_pos.at[bucket].add(is_pos)
+    stat_neg = stat_neg.at[bucket].add(1 - is_pos)
+    # integrate: for each threshold t, tp = sum_{b>=t} pos, fp = sum_{b>=t} neg
+    tp = jnp.cumsum(stat_pos[::-1])[::-1]
+    fp = jnp.cumsum(stat_neg[::-1])[::-1]
+    tot_pos = tp[0]
+    tot_neg = fp[0]
+    # trapezoid over ROC points (sorted by threshold descending)
+    tpr = tp / jnp.maximum(tot_pos, 1)
+    fpr = fp / jnp.maximum(tot_neg, 1)
+    auc = -jnp.trapezoid(tpr, fpr)
+    return {"AUC": [auc], "StatPosOut": [stat_pos], "StatNegOut": [stat_neg]}
+
+
+@register_op("precision_recall", stop_gradient=True)
+def _precision_recall(ctx, ins, attrs):
+    preds = ins["MaxProbs"][0] if "MaxProbs" in ins else None
+    indices = ins["Indices"][0].reshape(-1)
+    labels = ins["Labels"][0].reshape(-1)
+    cls_num = attrs["class_number"]
+    states = ins["StatesInfo"][0] if ins.get("StatesInfo") else \
+        jnp.zeros((cls_num, 4))
+    tp = jnp.zeros((cls_num,)).at[labels].add(
+        (indices == labels).astype(jnp.float32))
+    fp = jnp.zeros((cls_num,)).at[indices].add(
+        (indices != labels).astype(jnp.float32))
+    fn = jnp.zeros((cls_num,)).at[labels].add(
+        (indices != labels).astype(jnp.float32))
+    states = states + jnp.stack(
+        [tp, fp, jnp.zeros((cls_num,)), fn], axis=1)
+    tp_t, fp_t, fn_t = states[:, 0], states[:, 1], states[:, 3]
+    precision = tp_t / jnp.maximum(tp_t + fp_t, 1e-12)
+    recall = tp_t / jnp.maximum(tp_t + fn_t, 1e-12)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-12)
+    macro = jnp.stack([jnp.mean(precision), jnp.mean(recall), jnp.mean(f1)])
+    micro_p = jnp.sum(tp_t) / jnp.maximum(jnp.sum(tp_t + fp_t), 1e-12)
+    micro_r = jnp.sum(tp_t) / jnp.maximum(jnp.sum(tp_t + fn_t), 1e-12)
+    micro_f1 = 2 * micro_p * micro_r / jnp.maximum(micro_p + micro_r, 1e-12)
+    metrics = jnp.concatenate([macro, jnp.stack([micro_p, micro_r, micro_f1])])
+    return {"BatchMetrics": [metrics], "AccumMetrics": [metrics],
+            "AccumStatesInfo": [states]}
+
+
+@register_op("mean_iou", stop_gradient=True)
+def _mean_iou(ctx, ins, attrs):
+    pred = ins["Predictions"][0].reshape(-1)
+    label = ins["Labels"][0].reshape(-1)
+    n = attrs["num_classes"]
+    idx = label * n + pred
+    cm = jnp.zeros((n * n,)).at[idx].add(1.0).reshape(n, n)
+    inter = jnp.diag(cm)
+    union = jnp.sum(cm, axis=0) + jnp.sum(cm, axis=1) - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1e-12), 0.0)
+    mean_iou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1)
+    return {"OutMeanIou": [mean_iou], "OutWrong": [jnp.sum(cm, 0) - inter],
+            "OutCorrect": [inter]}

@@ -1,0 +1,436 @@
+"""NN op lowerings: matmul/fc, conv, pool, norms, softmax/losses.
+
+≙ reference operators/{mul,matmul,conv,conv_transpose,pool,batch_norm,
+layer_norm,softmax,cross_entropy,softmax_with_cross_entropy,lrn,fc}_op.*
+(SURVEY §2.2 NN family). MXU notes: matmuls/convs go through
+lax.dot_general/lax.conv_general_dilated so XLA tiles them onto the systolic
+array; `use_bf16` attr lets layers request bfloat16 accumulation inputs while
+keeping fp32 params (the TPU-native analogue of the reference's fp16 kernels).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+
+
+def _maybe_bf16(x, attrs):
+    if attrs.get("use_bf16", False) and x.dtype == jnp.float32:
+        return x.astype(jnp.bfloat16)
+    return x
+
+
+@register_op("mul")
+def _mul(ctx, ins, attrs):
+    """≙ mul_op.cc — the fc matmul core: flattens x to 2-D by x_num_col_dims."""
+    x, y = ins["X"][0], ins["Y"][0]
+    xd = attrs.get("x_num_col_dims", 1)
+    yd = attrs.get("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = jnp.reshape(x, (int(np.prod(xs[:xd])), -1))
+    y2 = jnp.reshape(y, (int(np.prod(ys[:yd])), -1))
+    x2, y2 = _maybe_bf16(x2, attrs), _maybe_bf16(y2, attrs)
+    out = jnp.dot(x2, y2, preferred_element_type=jnp.float32)
+    out = jnp.reshape(out, xs[:xd] + ys[yd:]).astype(x.dtype)
+    return {"Out": [out]}
+
+
+@register_op("matmul")
+def _matmul(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    if attrs.get("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2)
+    if attrs.get("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2)
+    x, y = _maybe_bf16(x, attrs), _maybe_bf16(y, attrs)
+    out = jnp.matmul(x, y, preferred_element_type=jnp.float32)
+    alpha = attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": [out.astype(ins["X"][0].dtype)]}
+
+
+def _conv_dimension_numbers(data_format, ndim):
+    if ndim == 4:
+        if data_format == "NHWC":
+            return ("NHWC", "HWIO", "NHWC")
+        return ("NCHW", "OIHW", "NCHW")
+    if data_format == "NDHWC":
+        return ("NDHWC", "DHWIO", "NDHWC")
+    return ("NCDHW", "OIDHW", "NCDHW")
+
+
+@register_op("conv2d")
+def _conv2d(ctx, ins, attrs):
+    """≙ conv_op.cc / conv_cudnn_op.cu.cc. Filter layout is OIHW as in the
+    reference; groups>1 supported (depthwise = groups == C_in)."""
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = tuple(attrs.get("strides", [1, 1]))
+    pads = attrs.get("paddings", [0, 0])
+    dilations = tuple(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1) or 1
+    data_format = attrs.get("data_format", "NCHW")
+    dn = _conv_dimension_numbers(data_format, x.ndim)
+    if data_format == "NHWC":
+        # framework stores filters OIHW; convert to HWIO for NHWC convs
+        w = jnp.transpose(w, (2, 3, 1, 0))
+    padding = [(p, p) for p in pads]
+    x, w = _maybe_bf16(x, attrs), _maybe_bf16(w, attrs)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding,
+        rhs_dilation=dilations, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32)
+    return {"Output": [out.astype(ins["Input"][0].dtype)]}
+
+
+register_op("conv3d")(_conv2d.__wrapped__ if hasattr(_conv2d, "__wrapped__")
+                      else _conv2d)
+
+
+@register_op("depthwise_conv2d")
+def _depthwise_conv2d(ctx, ins, attrs):
+    attrs = dict(attrs)
+    x = ins["Input"][0]
+    c_in = x.shape[1] if attrs.get("data_format", "NCHW") == "NCHW" else x.shape[-1]
+    attrs["groups"] = c_in
+    return _conv2d(ctx, ins, attrs)
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = tuple(attrs.get("strides", [1, 1]))
+    pads = attrs.get("paddings", [0, 0])
+    dilations = tuple(attrs.get("dilations", [1, 1]))
+    # filter stored as (C_in, C_out, H, W) per reference conv_transpose_op;
+    # transpose_kernel=True expects the *forward* conv kernel layout, i.e.
+    # HWIO with O = C_in of x (the forward conv maps C_out -> C_in).
+    # jax applies `padding` to the stride-dilated input, so the reference's
+    # deconv padding p becomes kernel_extent-1-p, giving
+    # out = (i-1)*s - 2p + kernel_extent as in conv_transpose_op.cc.
+    ks = w.shape[2:]
+    padding = [(d * (k - 1) - p, d * (k - 1) - p)
+               for k, p, d in zip(ks, pads, dilations)]
+    out = jax.lax.conv_transpose(
+        x, jnp.transpose(w, (2, 3, 1, 0)),  # -> (H, W, C_out, C_in)
+        strides=strides, padding=padding,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "HWIO", "NCHW"),
+        transpose_kernel=True)
+    return {"Output": [out]}
+
+
+@register_op("pool2d")
+def _pool2d(ctx, ins, attrs):
+    """≙ pool_op.cc: max/avg, global_pooling, ceil_mode, exclusive avg."""
+    x = ins["X"][0]
+    ptype = attrs.get("pooling_type", "max")
+    ksize = list(attrs.get("ksize", [2, 2]))
+    strides = list(attrs.get("strides", ksize))
+    pads = list(attrs.get("paddings", [0, 0]))
+    data_format = attrs.get("data_format", "NCHW")
+    spatial = (2, 3) if data_format == "NCHW" else (1, 2)
+    if attrs.get("global_pooling", False):
+        ksize = [x.shape[d] for d in spatial]
+        strides = ksize
+        pads = [0, 0]
+    window = [1, 1, 1, 1]
+    stride4 = [1, 1, 1, 1]
+    pad4 = [(0, 0)] * 4
+    ceil_mode = attrs.get("ceil_mode", False)
+    for i, d in enumerate(spatial):
+        window[d] = ksize[i]
+        stride4[d] = strides[i]
+        hi = pads[i]
+        if ceil_mode:
+            # extra high padding so the last partial window is included
+            span = x.shape[d] + 2 * pads[i] - ksize[i]
+            rem = span % strides[i]
+            if rem != 0:
+                hi += strides[i] - rem
+        pad4[d] = (pads[i], hi)
+    if ptype == "max":
+        init = -jnp.inf
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, stride4, pad4)
+    else:
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, stride4, pad4)
+        if attrs.get("exclusive", True) and any(p > 0 for p in pads):
+            ones = jnp.ones_like(x)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                        stride4, pad4)
+            out = s / cnt
+        else:
+            out = s / float(np.prod(ksize))
+    return {"Out": [out]}
+
+
+@register_op("batch_norm")
+def _batch_norm(ctx, ins, attrs):
+    """≙ batch_norm_op.cc: train mode uses batch stats and emits updated
+    moving stats; test mode uses the running estimates."""
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    data_layout = attrs.get("data_layout", "NCHW")
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    axis = 1 if data_layout == "NCHW" else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+    bshape = [1] * x.ndim
+    bshape[axis] = x.shape[axis]
+
+    if is_test:
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+    else:
+        use_mean = jnp.mean(x, axis=reduce_axes)
+        use_var = jnp.var(x, axis=reduce_axes)
+        # running stats must not carry gradients
+        m_d = jax.lax.stop_gradient(use_mean)
+        v_d = jax.lax.stop_gradient(use_var)
+        mean_out = momentum * mean + (1 - momentum) * m_d
+        var_out = momentum * var + (1 - momentum) * v_d
+    inv = jax.lax.rsqrt(use_var + eps)
+    y = (x - use_mean.reshape(bshape)) * inv.reshape(bshape) \
+        * scale.reshape(bshape) + bias.reshape(bshape)
+    return {"Y": [y], "MeanOut": [mean_out], "VarianceOut": [var_out],
+            "SavedMean": [use_mean], "SavedVariance": [inv]}
+
+
+@register_op("layer_norm")
+def _layer_norm(ctx, ins, attrs):
+    """≙ layer_norm_op.cc: normalize over dims >= begin_norm_axis."""
+    x = ins["X"][0]
+    begin = attrs.get("begin_norm_axis", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(begin, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    norm_shape = x.shape[begin:]
+    if ins.get("Scale"):
+        y = y * jnp.reshape(ins["Scale"][0], norm_shape)
+    if ins.get("Bias"):
+        y = y + jnp.reshape(ins["Bias"][0], norm_shape)
+    return {"Y": [y], "Mean": [jnp.reshape(mean, mean.shape[:begin])],
+            "Variance": [jnp.reshape(var, var.shape[:begin])]}
+
+
+@register_op("softmax")
+def _softmax(ctx, ins, attrs):
+    return {"Out": [jax.nn.softmax(ins["X"][0], axis=-1)]}
+
+
+@register_op("log_softmax")
+def _log_softmax(ctx, ins, attrs):
+    return {"Out": [jax.nn.log_softmax(ins["X"][0], axis=-1)]}
+
+
+@register_op("softmax_with_cross_entropy")
+def _softmax_with_cross_entropy(ctx, ins, attrs):
+    """≙ softmax_with_cross_entropy_op.cc (fused, numerically stable)."""
+    logits = ins["Logits"][0]
+    label = ins["Label"][0]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        lbl = label
+        if lbl.ndim == logits.ndim and lbl.shape[-1] == 1:
+            lbl = jnp.squeeze(lbl, axis=-1)
+        # labels equal to ignore_index (default -100, commonly -1 for
+        # padding) contribute zero loss and zero gradient
+        ignore = attrs.get("ignore_index", -100)
+        valid = (lbl != ignore)
+        safe = jnp.where(valid, lbl, 0)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)
+        loss = jnp.where(valid[..., None], nll, 0.0)
+    return {"Loss": [loss], "Softmax": [jnp.exp(logp)]}
+
+
+@register_op("cross_entropy")
+def _cross_entropy(ctx, ins, attrs):
+    """≙ cross_entropy_op.cc over probabilities (not logits)."""
+    x = ins["X"][0]
+    label = ins["Label"][0]
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(jnp.maximum(x, 1e-20)), axis=-1,
+                        keepdims=True)
+    else:
+        lbl = label
+        if lbl.ndim == x.ndim and lbl.shape[-1] == 1:
+            lbl = jnp.squeeze(lbl, axis=-1)
+        ignore = attrs.get("ignore_index", -100)
+        valid = (lbl != ignore)
+        safe = jnp.where(valid, lbl, 0)
+        p = jnp.take_along_axis(x, safe[..., None], axis=-1)
+        loss = jnp.where(valid[..., None],
+                         -jnp.log(jnp.maximum(p, 1e-20)), 0.0)
+    return {"Y": [loss]}
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def _sigmoid_ce(ctx, ins, attrs):
+    x = ins["X"][0]
+    label = ins["Label"][0]
+    # max(x,0) - x*z + log(1+exp(-|x|)) — stable formulation
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return {"Out": [loss]}
+
+
+@register_op("lrn")
+def _lrn(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    n = attrs.get("n", 5)
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {"Out": [x / jnp.power(mid, beta)], "MidOut": [mid]}
+
+
+@register_op("l2_normalize")
+def _l2_normalize(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": [x / norm], "Norm": [norm]}
+
+
+@register_op("huber_loss")
+def _huber_loss(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    delta = attrs.get("delta", 1.0)
+    r = y - x
+    a = jnp.abs(r)
+    loss = jnp.where(a <= delta, 0.5 * jnp.square(r),
+                     delta * (a - 0.5 * delta))
+    return {"Out": [loss], "Residual": [r]}
+
+
+@register_op("smooth_l1_loss")
+def _smooth_l1(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = x - y
+    if ins.get("InsideWeight"):
+        diff = diff * ins["InsideWeight"][0]
+    a = jnp.abs(diff)
+    loss = jnp.where(a < 1.0 / s2, 0.5 * s2 * jnp.square(diff), a - 0.5 / s2)
+    if ins.get("OutsideWeight"):
+        loss = loss * ins["OutsideWeight"][0]
+    return {"Out": [jnp.sum(loss, axis=tuple(range(1, loss.ndim)),
+                            keepdims=False)[..., None]],
+            "Diff": [diff]}
+
+
+@register_op("log_loss")
+def _log_loss(ctx, ins, attrs):
+    p = ins["Predicted"][0]
+    y = ins["Labels"][0]
+    eps = attrs.get("epsilon", 1e-4)
+    loss = -y * jnp.log(p + eps) - (1 - y) * jnp.log(1 - p + eps)
+    return {"Loss": [loss]}
+
+
+@register_op("hinge_loss")
+def _hinge_loss(ctx, ins, attrs):
+    logits = ins["Logits"][0]
+    labels = ins["Labels"][0]
+    return {"Loss": [jnp.maximum(0.0, 1.0 - (2 * labels - 1) * logits)]}
+
+
+@register_op("rank_loss")
+def _rank_loss(ctx, ins, attrs):
+    label = ins["Label"][0]
+    left, right = ins["Left"][0], ins["Right"][0]
+    d = left - right
+    return {"Out": [jnp.log1p(jnp.exp(d)) - label * d]}
+
+
+@register_op("margin_rank_loss")
+def _margin_rank_loss(ctx, ins, attrs):
+    label = ins["Label"][0]
+    x1, x2 = ins["X1"][0], ins["X2"][0]
+    margin = attrs.get("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": [out], "Activated": [(out > 0).astype(x1.dtype)]}
+
+
+@register_op("mse_loss")
+def _mse_loss(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.square(x - y)]}
+
+
+@register_op("bilinear_tensor_product")
+def _bilinear_tensor_product(ctx, ins, attrs):
+    x, y, w = ins["X"][0], ins["Y"][0], ins["Weight"][0]
+    # w: [out, dx, dy]
+    out = jnp.einsum("bi,oij,bj->bo", x, w, y)
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0]
+    return {"Out": [out]}
+
+
+@register_op("bilinear_interp")
+def _bilinear_interp(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    out_h = attrs["out_h"]
+    out_w = attrs["out_w"]
+    out = jax.image.resize(x, (x.shape[0], x.shape[1], out_h, out_w),
+                           method="bilinear")
+    return {"Out": [out]}
+
+
+@register_op("im2sequence")
+def _im2sequence(ctx, ins, attrs):
+    # unfold image into patch sequence (≙ im2sequence_op)
+    x = ins["X"][0]  # NCHW
+    kh, kw = attrs["kernels"]
+    sh, sw = attrs.get("strides", [1, 1])
+    n, c, h, w = x.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # [N, C*kh*kw, OH, OW] -> [N*OH*OW, C*kh*kw]
+    out = jnp.transpose(patches, (0, 2, 3, 1)).reshape(n * oh * ow, -1)
+    return {"Out": [out]}
+
+
+@register_op("grid_sampler")
+def _grid_sampler(ctx, ins, attrs):
+    x = ins["X"][0]          # [N, C, H, W]
+    grid = ins["Grid"][0]    # [N, H', W', 2] in [-1, 1]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1) * (w - 1) / 2
+    gy = (grid[..., 1] + 1) * (h - 1) / 2
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx, wy = gx - x0, gy - y0
+
+    def sample(xi, yi):
+        xi = jnp.clip(xi, 0, w - 1)
+        yi = jnp.clip(yi, 0, h - 1)
+        batch_idx = jnp.arange(n)[:, None, None]
+        return x[batch_idx, :, yi, xi]  # [N, H', W', C]
+
+    val = (sample(x0, y0) * ((1 - wx) * (1 - wy))[..., None] +
+           sample(x1, y0) * (wx * (1 - wy))[..., None] +
+           sample(x0, y1) * ((1 - wx) * wy)[..., None] +
+           sample(x1, y1) * (wx * wy)[..., None])
+    return {"Output": [jnp.transpose(val, (0, 3, 1, 2))]}

@@ -1,0 +1,196 @@
+"""Optimizer update ops.
+
+≙ reference operators/{sgd,momentum,adam,adamax,adagrad,decayed_adagrad,
+adadelta,rmsprop,ftrl,proximal_gd,proximal_adagrad}_op.cc — each optimizer is
+an op consuming Param/Grad/accumulators and emitting updated values
+(functional on TPU: the executor writes outputs back to the scope, with buffer
+donation making the update in-place on device).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+@register_op("sgd")
+def _sgd(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    lr = ins["LearningRate"][0]
+    return {"ParamOut": [p - lr * g.astype(p.dtype)]}
+
+
+@register_op("momentum")
+def _momentum(ctx, ins, attrs):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    lr = ins["LearningRate"][0]
+    mu = attrs["mu"]
+    v_out = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": [p_out], "VelocityOut": [v_out]}
+
+
+@register_op("adam")
+def _adam(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, v = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    lr = ins["LearningRate"][0]
+    b1, b2, eps = attrs["beta1"], attrs["beta2"], attrs["epsilon"]
+    m_out = b1 * m + (1 - b1) * g
+    v_out = b2 * v + (1 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_out = p - lr_t * m_out / (jnp.sqrt(v_out) + eps)
+    return {"ParamOut": [p_out], "Moment1Out": [m_out], "Moment2Out": [v_out],
+            "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2]}
+
+
+@register_op("adamax")
+def _adamax(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, inf = ins["Moment"][0], ins["InfNorm"][0]
+    b1p = ins["Beta1Pow"][0]
+    lr = ins["LearningRate"][0]
+    b1, b2, eps = attrs["beta1"], attrs["beta2"], attrs["epsilon"]
+    m_out = b1 * m + (1 - b1) * g
+    inf_out = jnp.maximum(b2 * inf, jnp.abs(g))
+    p_out = p - (lr / (1 - b1p)) * (m_out / (inf_out + eps))
+    return {"ParamOut": [p_out], "MomentOut": [m_out], "InfNormOut": [inf_out],
+            "Beta1PowOut": [b1p * b1]}
+
+
+@register_op("adagrad")
+def _adagrad(ctx, ins, attrs):
+    p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = ins["LearningRate"][0]
+    eps = attrs.get("epsilon", 1e-6)
+    mom_out = mom + jnp.square(g)
+    p_out = p - lr * g / (jnp.sqrt(mom_out) + eps)
+    return {"ParamOut": [p_out], "MomentOut": [mom_out]}
+
+
+@register_op("decayed_adagrad")
+def _decayed_adagrad(ctx, ins, attrs):
+    p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = ins["LearningRate"][0]
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    mom_out = decay * mom + (1 - decay) * jnp.square(g)
+    p_out = p - lr * g / (jnp.sqrt(mom_out) + eps)
+    return {"ParamOut": [p_out], "MomentOut": [mom_out]}
+
+
+@register_op("adadelta")
+def _adadelta(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    avg_sq_g, avg_sq_u = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    g2 = rho * avg_sq_g + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((avg_sq_u + eps) / (g2 + eps)) * g
+    u2 = rho * avg_sq_u + (1 - rho) * jnp.square(update)
+    return {"ParamOut": [p + update], "AvgSquaredGradOut": [g2],
+            "AvgSquaredUpdateOut": [u2]}
+
+
+@register_op("rmsprop")
+def _rmsprop(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    ms, mom = ins["MeanSquare"][0], ins["Moment"][0]
+    lr = ins["LearningRate"][0]
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    mu = attrs.get("momentum", 0.0)
+    centered = attrs.get("centered", False)
+    ms_out = rho * ms + (1 - rho) * jnp.square(g)
+    if centered:
+        mg = ins["MeanGrad"][0]
+        mg_out = rho * mg + (1 - rho) * g
+        denom = ms_out - jnp.square(mg_out) + eps
+    else:
+        mg_out = None
+        denom = ms_out + eps
+    mom_out = mu * mom + lr * g / jnp.sqrt(denom)
+    out = {"ParamOut": [p - mom_out], "MeanSquareOut": [ms_out],
+           "MomentOut": [mom_out]}
+    if centered:
+        out["MeanGradOut"] = [mg_out]
+    return out
+
+
+@register_op("ftrl")
+def _ftrl(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
+    lr = ins["LearningRate"][0]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    power = attrs.get("lr_power", -0.5)
+    new_sq = sq + jnp.square(g)
+    if power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -power) - jnp.power(sq, -power)) / lr
+    lin_out = lin + g - sigma * p
+    if power == -0.5:
+        denom = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        denom = jnp.power(new_sq, -power) / lr + 2 * l2
+    pre = jnp.clip(lin_out, -l1, l1) - lin_out
+    p_out = pre / denom
+    return {"ParamOut": [p_out], "SquaredAccumOut": [new_sq],
+            "LinearAccumOut": [lin_out]}
+
+
+@register_op("proximal_gd")
+def _proximal_gd(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    lr = ins["LearningRate"][0]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    prox = p - lr * g
+    p_out = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+             / (1.0 + lr * l2))
+    return {"ParamOut": [p_out]}
+
+
+@register_op("proximal_adagrad")
+def _proximal_adagrad(ctx, ins, attrs):
+    p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = ins["LearningRate"][0]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    mom_out = mom + jnp.square(g)
+    adapted_lr = lr / jnp.sqrt(mom_out)
+    prox = p - adapted_lr * g
+    p_out = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - adapted_lr * l1, 0.0)
+             / (1.0 + adapted_lr * l2))
+    return {"ParamOut": [p_out], "MomentOut": [mom_out]}
+
+
+@register_op("lamb")
+def _lamb(ctx, ins, attrs):
+    """LAMB — TPU-era large-batch optimizer (new capability beyond the
+    reference's 2018 set; used for big-batch ResNet/BERT runs)."""
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, v = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    lr = ins["LearningRate"][0]
+    b1, b2, eps = attrs["beta1"], attrs["beta2"], attrs["epsilon"]
+    wd = attrs.get("weight_decay", 0.0)
+    m_out = b1 * m + (1 - b1) * g
+    v_out = b2 * v + (1 - b2) * jnp.square(g)
+    m_hat = m_out / (1 - b1p)
+    v_hat = v_out / (1 - b2p)
+    update = m_hat / (jnp.sqrt(v_hat) + eps) + wd * p
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    u_norm = jnp.sqrt(jnp.sum(jnp.square(update)))
+    trust = jnp.where(jnp.logical_and(p_norm > 0, u_norm > 0),
+                      p_norm / u_norm, 1.0)
+    return {"ParamOut": [p - lr * trust * update], "Moment1Out": [m_out],
+            "Moment2Out": [v_out], "Beta1PowOut": [b1p * b1],
+            "Beta2PowOut": [b2p * b2]}

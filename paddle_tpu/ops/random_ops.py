@@ -1,0 +1,99 @@
+"""Random op lowerings.
+
+≙ reference operators/{uniform_random,gaussian_random,random_crop,sampling_id,
+dropout}_op.cc. Keys derive from the per-step LowerCtx PRNG (fold_in per op),
+so runs are reproducible given the program seed — replacing the reference's
+per-op `seed` attr + global generator.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtypes import convert_dtype
+from ..framework.registry import register_op
+
+
+@register_op("uniform_random", stop_gradient=True)
+def _uniform_random(ctx, ins, attrs):
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    shape = attrs["shape"]
+    lo = attrs.get("min", -1.0)
+    hi = attrs.get("max", 1.0)
+    key = (jax.random.PRNGKey(attrs["seed"]) if attrs.get("seed")
+           else ctx.next_key())
+    return {"Out": [jax.random.uniform(key, shape, dtype=jnp.float32,
+                                       minval=lo, maxval=hi).astype(dtype)]}
+
+
+@register_op("gaussian_random", stop_gradient=True)
+def _gaussian_random(ctx, ins, attrs):
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    shape = attrs["shape"]
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    key = (jax.random.PRNGKey(attrs["seed"]) if attrs.get("seed")
+           else ctx.next_key())
+    return {"Out": [(mean + std * jax.random.normal(key, shape,
+                                                    dtype=jnp.float32))
+                    .astype(dtype)]}
+
+
+@register_op("truncated_gaussian_random", stop_gradient=True)
+def _truncated_gaussian_random(ctx, ins, attrs):
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    shape = attrs["shape"]
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    key = (jax.random.PRNGKey(attrs["seed"]) if attrs.get("seed")
+           else ctx.next_key())
+    out = jax.random.truncated_normal(key, -2.0, 2.0, shape,
+                                      dtype=jnp.float32)
+    return {"Out": [(mean + std * out).astype(dtype)]}
+
+
+@register_op("dropout")
+def _dropout(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs.get("dropout_prob", 0.5)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        # ≙ dropout_op.cc infer path
+        if impl == "upscale_in_train":
+            return {"Out": [x], "Mask": [jnp.ones_like(x)]}
+        return {"Out": [x * (1.0 - p)], "Mask": [jnp.ones_like(x)]}
+    key = (jax.random.PRNGKey(attrs["seed"]) if attrs.get("seed")
+           else ctx.next_key())
+    mask = jax.random.bernoulli(key, 1.0 - p, x.shape).astype(x.dtype)
+    if impl == "upscale_in_train":
+        out = x * mask / jnp.maximum(1.0 - p, 1e-8)
+    else:
+        out = x * mask
+    return {"Out": [out], "Mask": [mask]}
+
+
+@register_op("sampling_id", stop_gradient=True)
+def _sampling_id(ctx, ins, attrs):
+    x = ins["X"][0]  # [batch, n] probabilities
+    key = ctx.next_key()
+    return {"Out": [jax.random.categorical(key, jnp.log(x + 1e-20), axis=-1)
+                    .astype(jnp.int64)]}
+
+
+@register_op("random_crop", stop_gradient=True)
+def _random_crop(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = attrs["shape"]  # crop shape for trailing dims
+    key = ctx.next_key()
+    lead = x.ndim - len(shape)
+    starts = []
+    for i, s in enumerate(shape):
+        limit = x.shape[lead + i] - s
+        k = jax.random.fold_in(key, i)
+        starts.append(jax.random.randint(k, (), 0, max(limit, 0) + 1))
+    start_idx = [0] * lead + [int(0)] * len(shape)
+    slices = [jnp.asarray(0)] * lead + starts
+    sizes = list(x.shape[:lead]) + list(shape)
+    return {"Out": [jax.lax.dynamic_slice(x, slices, sizes)]}

@@ -1,0 +1,104 @@
+"""Reduction / argmax / topk / sum op lowerings.
+
+≙ reference operators/reduce_op.cc (sum/mean/max/min/prod), mean_op.cc,
+sum_op.cc (multi-input add_n incl. SelectedRows mixing), arg_max/min, top_k,
+argsort, cos_sim.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+def _reduce(fn):
+    def lower(ctx, ins, attrs):
+        x = ins["X"][0]
+        dim = attrs.get("dim")
+        keep = attrs.get("keep_dim", False)
+        if attrs.get("reduce_all", False) or dim is None:
+            axis = None
+        else:
+            axis = tuple(dim) if isinstance(dim, (list, tuple)) else (dim,)
+        return {"Out": [fn(x, axis=axis, keepdims=keep)]}
+    return lower
+
+
+register_op("reduce_sum")(_reduce(jnp.sum))
+register_op("reduce_mean")(_reduce(jnp.mean))
+register_op("reduce_max")(_reduce(jnp.max))
+register_op("reduce_min")(_reduce(jnp.min))
+register_op("reduce_prod")(_reduce(jnp.prod))
+
+
+@register_op("mean")
+def _mean(ctx, ins, attrs):
+    return {"Out": [jnp.mean(ins["X"][0])]}
+
+
+@register_op("sum")
+def _sum(ctx, ins, attrs):
+    # ≙ sum_op.cc add_n over N inputs
+    out = ins["X"][0]
+    for x in ins["X"][1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+@register_op("arg_max", stop_gradient=True)
+def _arg_max(ctx, ins, attrs):
+    return {"Out": [jnp.argmax(ins["X"][0], axis=attrs.get("axis", -1))
+                    .astype(jnp.int64)]}
+
+
+@register_op("arg_min", stop_gradient=True)
+def _arg_min(ctx, ins, attrs):
+    return {"Out": [jnp.argmin(ins["X"][0], axis=attrs.get("axis", -1))
+                    .astype(jnp.int64)]}
+
+
+@register_op("top_k", stop_gradient=True)
+def _top_k(ctx, ins, attrs):
+    vals, idx = jax.lax.top_k(ins["X"][0], attrs["k"])
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("argsort", stop_gradient=True)
+def _argsort(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    idx = jnp.argsort(x, axis=axis)
+    return {"Out": [jnp.sort(x, axis=axis)], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("cos_sim")
+def _cos_sim(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / jnp.maximum(xn * yn, 1e-12)
+    return {"Out": [out], "XNorm": [xn], "YNorm": [yn]}
+
+
+@register_op("squared_l2_norm")
+def _squared_l2_norm(ctx, ins, attrs):
+    return {"Out": [jnp.sum(jnp.square(ins["X"][0]))[None]]}
+
+
+@register_op("squared_l2_distance")
+def _squared_l2_distance(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    sub = x - y
+    return {"Out": [jnp.sum(jnp.square(sub), axis=-1, keepdims=True)],
+            "sub_result": [sub]}
+
+
+@register_op("norm")
+def _norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": [x / norm], "Norm": [norm]}

@@ -1,0 +1,294 @@
+"""Sequence-family op lowerings (static-shape translation of LoD).
+
+≙ reference sequence ops (SURVEY §2.2 "Sequence/LoD" family) and the recurrent
+lstm/gru ops (operators/lstm_op.cc, gru_op.cc with the sequence2batch trick,
+operators/math/sequence2batch.h).
+
+TPU-native representation: a "sequence" variable is a dense padded array
+[batch, max_len, ...] plus a companion int32 length vector [batch] (slot
+"SeqLen"), replacing the reference's LoD ragged offsets (lod_tensor.h:58).
+Masked/segmented lowerings keep XLA shapes static; recurrences use lax.scan
+over the time dimension — the compiler-friendly control flow replacing the
+reference's block-based RecurrentOp/WhileOp interpretation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+def _mask(x, seqlen):
+    """[B, T] validity mask broadcastable to x: [B, T, ...]."""
+    b, t = x.shape[0], x.shape[1]
+    m = jnp.arange(t)[None, :] < seqlen[:, None]
+    return m.reshape((b, t) + (1,) * (x.ndim - 2))
+
+
+@register_op("sequence_pool")
+def _sequence_pool(ctx, ins, attrs):
+    x = ins["X"][0]            # [B, T, D]
+    seqlen = ins["SeqLen"][0]  # [B]
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    m = _mask(x, seqlen)
+    mf = m.astype(x.dtype)
+    if ptype == "SUM":
+        out = jnp.sum(x * mf, axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(x * mf, axis=1) / jnp.maximum(
+            seqlen.astype(x.dtype), 1).reshape((-1,) + (1,) * (x.ndim - 2))
+    elif ptype == "SQRT":
+        out = jnp.sum(x * mf, axis=1) / jnp.sqrt(jnp.maximum(
+            seqlen.astype(x.dtype), 1)).reshape((-1,) + (1,) * (x.ndim - 2))
+    elif ptype == "MAX":
+        neg = jnp.finfo(x.dtype).min
+        out = jnp.max(jnp.where(m, x, neg), axis=1)
+    elif ptype == "LAST":
+        idx = jnp.maximum(seqlen - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1)
+        out = jnp.squeeze(out, axis=1)
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError(f"unknown pooltype {ptype}")
+    return {"Out": [out]}
+
+
+@register_op("sequence_softmax")
+def _sequence_softmax(ctx, ins, attrs):
+    x = ins["X"][0]            # [B, T]
+    seqlen = ins["SeqLen"][0]
+    m = jnp.arange(x.shape[1])[None, :] < seqlen[:, None]
+    neg = jnp.finfo(x.dtype).min
+    out = jax.nn.softmax(jnp.where(m, x, neg), axis=1)
+    return {"Out": [out * m.astype(x.dtype)]}
+
+
+@register_op("sequence_first_step")
+def _sequence_first_step(ctx, ins, attrs):
+    return {"Out": [ins["X"][0][:, 0]]}
+
+
+@register_op("sequence_last_step")
+def _sequence_last_step(ctx, ins, attrs):
+    x = ins["X"][0]
+    seqlen = ins["SeqLen"][0]
+    idx = jnp.maximum(seqlen - 1, 0)
+    out = jnp.take_along_axis(
+        x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1)
+    return {"Out": [jnp.squeeze(out, axis=1)]}
+
+
+@register_op("sequence_reverse")
+def _sequence_reverse(ctx, ins, attrs):
+    x = ins["X"][0]
+    seqlen = ins["SeqLen"][0]
+    t = x.shape[1]
+    # reverse only the valid prefix: index i -> len-1-i for i < len else i
+    ar = jnp.arange(t)[None, :]
+    idx = jnp.where(ar < seqlen[:, None], seqlen[:, None] - 1 - ar, ar)
+    return {"Y": [jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)]}
+
+
+@register_op("sequence_expand")
+def _sequence_expand(ctx, ins, attrs):
+    # broadcast per-sequence vector over time (simplified ref semantics)
+    x = ins["X"][0]      # [B, D]
+    y = ins["Y"][0]      # [B, T, ...] provides target length
+    t = y.shape[1]
+    return {"Out": [jnp.repeat(x[:, None], t, axis=1)]}
+
+
+@register_op("sequence_concat")
+def _sequence_concat(ctx, ins, attrs):
+    return {"Out": [jnp.concatenate(ins["X"], axis=-1)]}
+
+
+@register_op("sequence_slice")
+def _sequence_slice(ctx, ins, attrs):
+    x = ins["X"][0]
+    offset = ins["Offset"][0].reshape(-1)
+    length = attrs.get("length", None)
+    # static-length slice per batch element
+    t = int(length) if length is not None else x.shape[1]
+    idx = offset[:, None] + jnp.arange(t)[None, :]
+    return {"Out": [jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)]}
+
+
+@register_op("sequence_mask", stop_gradient=True)
+def _sequence_mask(ctx, ins, attrs):
+    seqlen = ins["X"][0].reshape(-1)
+    maxlen = attrs.get("maxlen", -1)
+    if maxlen is None or maxlen < 0:
+        raise ValueError("sequence_mask requires static maxlen on TPU")
+    m = jnp.arange(maxlen)[None, :] < seqlen[:, None]
+    return {"Y": [m.astype(jnp.float32)]}
+
+
+@register_op("sequence_pad")
+def _sequence_pad(ctx, ins, attrs):
+    # already-padded representation: identity + emit lengths
+    return {"Out": [ins["X"][0]], "Length": [ins["SeqLen"][0]]}
+
+
+@register_op("sequence_erase", stop_gradient=True)
+def _sequence_erase(ctx, ins, attrs):
+    # mark erased tokens invalid via mask rather than compaction (static shape)
+    x = ins["X"][0]
+    tokens = jnp.asarray(attrs["tokens"])
+    keep = jnp.all(x[..., None] != tokens.reshape((1,) * x.ndim + (-1,)),
+                   axis=-1)
+    return {"Out": [jnp.where(keep, x, 0)], "Mask": [keep.astype(jnp.int32)]}
+
+
+# ---- recurrent cells over time via lax.scan (≙ lstm_op.cc / gru_op.cc) ----
+
+def _lstm_scan(x_proj, h0, c0, w_h, seqlen, gate_act, cell_act, cand_act,
+               reverse=False):
+    """x_proj: [B, T, 4H] input projections (i, f, c, o gate order as the
+    reference's lstm_compute), w_h: [H, 4H]."""
+    b, t, h4 = x_proj.shape
+    h = h4 // 4
+    steps = jnp.arange(t)
+    if reverse:
+        x_proj = jnp.flip(x_proj, axis=1)
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        xt, it = inp  # xt: [B, 4H], it: scalar time index
+        gates = xt + jnp.dot(h_prev, w_h)
+        i, f, c_hat, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = gate_act(i), gate_act(f), gate_act(o)
+        c_hat = cand_act(c_hat)
+        c_new = f * c_prev + i * c_hat
+        h_new = o * cell_act(c_new)
+        # freeze state for finished sequences (≙ shrink_rnn_memory)
+        tpos = it if not reverse else (t - 1 - it)
+        valid = (tpos < seqlen)[:, None]
+        h_new = jnp.where(valid, h_new, h_prev)
+        c_new = jnp.where(valid, c_new, c_prev)
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = jax.lax.scan(
+        step, (h0, c0), (jnp.swapaxes(x_proj, 0, 1), steps))
+    hs = jnp.swapaxes(hs, 0, 1)  # [B, T, H]
+    cs = jnp.swapaxes(cs, 0, 1)
+    if reverse:
+        hs, cs = jnp.flip(hs, axis=1), jnp.flip(cs, axis=1)
+    return hs, cs
+
+
+_ACTS = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh, "relu": jax.nn.relu,
+         "identity": lambda x: x}
+
+
+@register_op("dynamic_lstm")
+def _dynamic_lstm(ctx, ins, attrs):
+    """≙ lstm_op.cc: Input is the pre-projected [B, T, 4H] sequence (the fc
+    is done by the layer, as in the reference where fc precedes dynamic_lstm).
+    Weight: [H, 4H] hidden-to-hidden; Bias: [4H] (+[3H] peepholes if
+    use_peepholes — peepholes folded into gates here)."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    seqlen = ins["SeqLen"][0]
+    h = w.shape[0]
+    b = x.shape[0]
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    if bias is not None:
+        x = x + bias.reshape(1, 1, -1)[:, :, :4 * h]
+    gate_act = _ACTS[attrs.get("gate_activation", "sigmoid")]
+    cell_act = _ACTS[attrs.get("cell_activation", "tanh")]
+    cand_act = _ACTS[attrs.get("candidate_activation", "tanh")]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((b, h), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((b, h), x.dtype)
+    hs, cs = _lstm_scan(x, h0, c0, w, seqlen, gate_act, cell_act, cand_act,
+                        reverse=attrs.get("is_reverse", False))
+    return {"Hidden": [hs], "Cell": [cs]}
+
+
+@register_op("dynamic_gru")
+def _dynamic_gru(ctx, ins, attrs):
+    """≙ gru_op.cc: Input [B, T, 3H] pre-projected; Weight packs
+    [H, 2H] update/reset and [H, H] candidate."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]  # [H, 3H]
+    seqlen = ins["SeqLen"][0]
+    h = w.shape[0]
+    b = x.shape[0]
+    if ins.get("Bias"):
+        x = x + ins["Bias"][0].reshape(1, 1, -1)
+    w_rz = w[:, :2 * h]
+    w_c = w[:, 2 * h:]
+    gate_act = _ACTS[attrs.get("gate_activation", "sigmoid")]
+    cand_act = _ACTS[attrs.get("activation", "tanh")]
+    reverse = attrs.get("is_reverse", False)
+    if reverse:
+        x = jnp.flip(x, axis=1)
+    t = x.shape[1]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((b, h), x.dtype)
+
+    def step(h_prev, inp):
+        xt, it = inp
+        x_rz, x_c = xt[:, :2 * h], xt[:, 2 * h:]
+        rz = gate_act(x_rz + jnp.dot(h_prev, w_rz))
+        r, z = jnp.split(rz, 2, axis=-1)
+        c = cand_act(x_c + jnp.dot(r * h_prev, w_c))
+        h_new = z * h_prev + (1 - z) * c
+        tpos = it if not reverse else (t - 1 - it)
+        valid = (tpos < seqlen)[:, None]
+        h_new = jnp.where(valid, h_new, h_prev)
+        return h_new, h_new
+
+    _, hs = jax.lax.scan(step, h0, (jnp.swapaxes(x, 0, 1), jnp.arange(t)))
+    hs = jnp.swapaxes(hs, 0, 1)
+    if reverse:
+        hs = jnp.flip(hs, axis=1)
+    return {"Hidden": [hs]}
+
+
+@register_op("edit_distance", stop_gradient=True)
+def _edit_distance(ctx, ins, attrs):
+    """Levenshtein distance per batch pair via dynamic programming with
+    lax.scan over one string (≙ edit_distance_op.cc)."""
+    hyp = ins["Hyps"][0]       # [B, Th]
+    ref = ins["Refs"][0]       # [B, Tr]
+    hyp_len = ins["HypsLen"][0]
+    ref_len = ins["RefsLen"][0]
+    b, th = hyp.shape
+    tr = ref.shape[1]
+
+    def per_pair(h, r, hl, rl):
+        row0 = jnp.arange(tr + 1, dtype=jnp.float32)
+
+        def step(prev_row, i):
+            ch = h[i]
+            sub_cost = (r != ch).astype(jnp.float32)
+
+            def inner(carry, j):
+                left = carry
+                dele = prev_row[j + 1] + 1
+                ins_ = left + 1
+                sub = prev_row[j] + sub_cost[j]
+                val = jnp.minimum(jnp.minimum(dele, ins_), sub)
+                return val, val
+
+            first = prev_row[0] + 1
+            _, rest = jax.lax.scan(inner, first, jnp.arange(tr))
+            new_row = jnp.concatenate([first[None], rest])
+            # only advance while i < hl
+            new_row = jnp.where(i < hl, new_row, prev_row)
+            return new_row, None
+
+        final, _ = jax.lax.scan(step, row0, jnp.arange(th))
+        return final[rl]
+
+    dist = jax.vmap(per_pair)(hyp, ref, hyp_len, ref_len)
+    if attrs.get("normalized", False):
+        dist = dist / jnp.maximum(ref_len.astype(jnp.float32), 1)
+    return {"Out": [dist[:, None]],
+            "SequenceNum": [jnp.asarray(b, dtype=jnp.int64)]}

@@ -1,0 +1,275 @@
+"""Tensor-manipulation op lowerings.
+
+≙ reference paddle/fluid/operators/{reshape,transpose,concat,split,slice,
+gather,scatter,stack,squeeze,unsqueeze,flatten,expand,pad,one_hot,cast,
+fill_constant,fill_zeros_like,assign,shape,reverse,multiplex,crop,
+label_smooth,lookup_table}_op.cc (SURVEY §2.2 tensor-manip family).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtypes import convert_dtype
+from ..framework.registry import register_op
+
+
+@register_op("reshape")
+def _reshape(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = list(attrs["shape"])
+    # reference reshape semantics: 0 means copy dim from input, -1 inferred
+    for i, d in enumerate(shape):
+        if d == 0:
+            shape[i] = x.shape[i]
+    return {"Out": [jnp.reshape(x, shape)]}
+
+
+@register_op("transpose")
+def _transpose(ctx, ins, attrs):
+    return {"Out": [jnp.transpose(ins["X"][0], attrs["axis"])]}
+
+
+@register_op("concat")
+def _concat(ctx, ins, attrs):
+    return {"Out": [jnp.concatenate(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register_op("split")
+def _split(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    if attrs.get("sections"):
+        idx = np.cumsum(attrs["sections"])[:-1]
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, attrs["num"], axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("slice")
+def _slice(ctx, ins, attrs):
+    x = ins["X"][0]
+    axes, starts, ends = attrs["axes"], attrs["starts"], attrs["ends"]
+    idx = [slice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[ax] = slice(s, e)
+    return {"Out": [x[tuple(idx)]]}
+
+
+@register_op("gather")
+def _gather(ctx, ins, attrs):
+    return {"Out": [jnp.take(ins["X"][0], ins["Index"][0], axis=0)]}
+
+
+@register_op("scatter")
+def _scatter(ctx, ins, attrs):
+    x, index, updates = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    if attrs.get("overwrite", True):
+        return {"Out": [x.at[index].set(updates)]}
+    return {"Out": [x.at[index].add(updates)]}
+
+
+@register_op("stack")
+def _stack(ctx, ins, attrs):
+    return {"Y": [jnp.stack(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register_op("unstack")
+def _unstack(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    n = x.shape[axis]
+    return {"Y": [jnp.squeeze(s, axis=axis)
+                  for s in jnp.split(x, n, axis=axis)]}
+
+
+@register_op("squeeze")
+def _squeeze(ctx, ins, attrs):
+    axes = attrs.get("axes") or None
+    return {"Out": [jnp.squeeze(ins["X"][0],
+                                axis=tuple(axes) if axes else None)]}
+
+
+@register_op("unsqueeze")
+def _unsqueeze(ctx, ins, attrs):
+    return {"Out": [jnp.expand_dims(ins["X"][0], axis=tuple(attrs["axes"]))]}
+
+
+@register_op("flatten")
+def _flatten(ctx, ins, attrs):
+    x = ins["X"][0]
+    ax = attrs.get("axis", 1)
+    lead = int(np.prod(x.shape[:ax])) if ax > 0 else 1
+    return {"Out": [jnp.reshape(x, (lead, -1))]}
+
+
+@register_op("expand")
+def _expand(ctx, ins, attrs):
+    x = ins["X"][0]
+    times = attrs["expand_times"]
+    return {"Out": [jnp.tile(x, times)]}
+
+
+@register_op("expand_as")
+def _expand_as(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.broadcast_to(x, y.shape)]}
+
+
+@register_op("pad")
+def _pad(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs["paddings"]  # flat [before0, after0, before1, after1, ...]
+    pads = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": [jnp.pad(x, pads, constant_values=attrs.get("pad_value", 0.0))]}
+
+
+@register_op("pad_constant_like")
+def _pad_constant_like(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    pads = [(0, xd - yd) for xd, yd in zip(x.shape, y.shape)]
+    return {"Out": [jnp.pad(y, pads, constant_values=attrs.get("pad_value", 0.0))]}
+
+
+@register_op("one_hot", stop_gradient=True)
+def _one_hot(ctx, ins, attrs):
+    x = ins["X"][0]
+    depth = attrs["depth"]
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = jnp.squeeze(x, axis=-1)
+    return {"Out": [jax.nn.one_hot(x, depth, dtype=jnp.float32)]}
+
+
+@register_op("cast")
+def _cast(ctx, ins, attrs):
+    dtype = convert_dtype(attrs["out_dtype"])
+    return {"Out": [ins["X"][0].astype(dtype)]}
+
+
+@register_op("fill_constant", stop_gradient=True)
+def _fill_constant(ctx, ins, attrs):
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    shape = attrs["shape"]
+    return {"Out": [jnp.full(shape, attrs["value"], dtype=dtype)]}
+
+
+@register_op("fill_constant_batch_size_like", stop_gradient=True)
+def _fill_constant_bsl(ctx, ins, attrs):
+    ref = ins["Input"][0]
+    shape = list(attrs["shape"])
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.full(shape, attrs["value"], dtype=dtype)]}
+
+
+@register_op("fill_zeros_like", stop_gradient=True)
+def _fill_zeros_like(ctx, ins, attrs):
+    return {"Out": [jnp.zeros_like(ins["X"][0])]}
+
+
+@register_op("assign")
+def _assign(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("assign_value", stop_gradient=True)
+def _assign_value(ctx, ins, attrs):
+    values = np.asarray(attrs["values"], dtype=convert_dtype(attrs["dtype"]))
+    return {"Out": [jnp.asarray(values.reshape(attrs["shape"]))]}
+
+
+@register_op("shape", stop_gradient=True)
+def _shape(ctx, ins, attrs):
+    return {"Out": [jnp.asarray(ins["Input"][0].shape, dtype=jnp.int64)]}
+
+
+@register_op("reverse")
+def _reverse(ctx, ins, attrs):
+    return {"Out": [jnp.flip(ins["X"][0], axis=tuple(attrs["axis"]))]}
+
+
+@register_op("multiplex")
+def _multiplex(ctx, ins, attrs):
+    ids = ins["Ids"][0].reshape(-1)
+    stacked = jnp.stack(ins["X"], axis=0)  # [n_candidates, batch, ...]
+    return {"Out": [stacked[ids, jnp.arange(stacked.shape[1])]]}
+
+
+@register_op("crop")
+def _crop(ctx, ins, attrs):
+    x = ins["X"][0]
+    offsets = attrs["offsets"]
+    shape = attrs["shape"]
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return {"Out": [x[idx]]}
+
+
+@register_op("label_smooth")
+def _label_smooth(ctx, ins, attrs):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 0.0)
+    if "PriorDist" in ins and ins["PriorDist"]:
+        prior = ins["PriorDist"][0]
+        return {"Out": [(1 - eps) * x + eps * prior]}
+    return {"Out": [(1 - eps) * x + eps / x.shape[-1]]}
+
+
+@register_op("lookup_table")
+def _lookup_table(ctx, ins, attrs):
+    """Embedding lookup (≙ lookup_table_op.cc:21). `is_sparse`/`is_distributed`
+    attrs are accepted for parity; on TPU the table is a dense sharded array
+    and sparse gradient aggregation is handled by XLA scatter-add in the VJP."""
+    w = ins["W"][0]
+    ids = ins["Ids"][0]
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, axis=-1)
+    padding_idx = attrs.get("padding_idx", None)
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None:
+        if padding_idx < 0:  # negative indexes from the end, as in reference
+            padding_idx += w.shape[0]
+        mask = (ids != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return {"Out": [out]}
+
+
+@register_op("increment")
+def _increment(ctx, ins, attrs):
+    return {"Out": [ins["X"][0] + attrs.get("step", 1.0)]}
+
+
+@register_op("print", stop_gradient=True)
+def _print(ctx, ins, attrs):
+    # ≙ print_op (debug tensor dump, reference layers/control_flow.py:147)
+    x = ins["In"][0]
+    jax.debug.print(attrs.get("message", "print_op") + ": {}", x)
+    return {"Out": [x]}
+
+
+@register_op("arange", stop_gradient=True)
+def _arange(ctx, ins, attrs):
+    return {"Out": [jnp.arange(attrs["start"], attrs["end"], attrs["step"],
+                               dtype=convert_dtype(attrs.get("dtype", "int64")))]}
+
+
+@register_op("cumsum")
+def _cumsum(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    if attrs.get("reverse", False):
+        x = jnp.flip(x, axis=axis)
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        pad = [(0, 0)] * x.ndim
+        pad[axis] = (1, 0)
+        sl = [slice(None)] * x.ndim
+        sl[axis] = slice(0, x.shape[axis])
+        out = jnp.pad(out, pad)[tuple(sl)]
+    if attrs.get("reverse", False):
+        out = jnp.flip(out, axis=axis)
+    return {"Out": [out]}

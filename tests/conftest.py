@@ -1,0 +1,46 @@
+"""Test configuration: run on a virtual 8-device CPU mesh.
+
+Multi-chip hardware is not available in CI; sharding correctness is validated
+on XLA's host platform with 8 virtual devices (the driver separately dry-runs
+the multi-chip path via __graft_entry__.dryrun_multichip).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags +
+                               " --xla_force_host_platform_device_count=8")
+
+# Drop any TPU-tunnel backend factory (e.g. the axon PJRT plugin registered by
+# sitecustomize): CPU-only tests must never block on remote-device client
+# creation, and the plugin's get_backend hook initializes it even under
+# JAX_PLATFORMS=cpu.
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+for _plugin in ("axon", "tpu"):
+    _xb._backend_factories.pop(_plugin, None)
+# the plugin's register() may have pinned jax_platforms=axon in jax.config
+# before this conftest ran — force CPU for the test session.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    """Each test gets fresh default programs / scope / name generator."""
+    import paddle_tpu as pt
+    from paddle_tpu.core import unique_name
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    with unique_name.guard():
+        yield
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

@@ -1,0 +1,79 @@
+"""End-to-end slice: MNIST-style MLP trains and loss decreases.
+
+≙ reference tests/book/test_recognize_digits.py (train briefly, check loss
+drops) — the SURVEY §7 stage-3 "one model" milestone.
+"""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _synthetic_mnist(rng, n=512):
+    x = rng.rand(n, 784).astype(np.float32)
+    # learnable structure: label depends on input
+    w = rng.rand(784, 10).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int64).reshape(-1, 1)
+    return x, y
+
+
+def build_mlp():
+    img = layers.data(name="img", shape=[784])
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    h = layers.fc(img, size=128, act="relu")
+    h = layers.fc(h, size=64, act="relu")
+    logits = layers.fc(h, size=10)
+    loss = layers.softmax_with_cross_entropy(logits, label)
+    avg_loss = layers.mean(loss)
+    acc = layers.accuracy(logits, label)
+    return avg_loss, acc
+
+
+def test_mnist_mlp_trains(rng):
+    avg_loss, acc = build_mlp()
+    opt = pt.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(avg_loss)
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+
+    x, y = _synthetic_mnist(rng)
+    losses = []
+    for step in range(30):
+        lo, ac = exe.run(pt.default_main_program(),
+                         feed={"img": x[:64], "label": y[:64]},
+                         fetch_list=[avg_loss, acc])
+        losses.append(float(lo))
+    assert losses[-1] < losses[0] * 0.9, f"loss did not drop: {losses}"
+
+
+def test_mnist_adam_trains(rng):
+    avg_loss, acc = build_mlp()
+    opt = pt.optimizer.Adam(learning_rate=0.01)
+    opt.minimize(avg_loss)
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    x, y = _synthetic_mnist(rng)
+    first = None
+    last = None
+    for step in range(30):
+        lo, = exe.run(feed={"img": x[:64], "label": y[:64]},
+                      fetch_list=[avg_loss])
+        first = first if first is not None else float(lo)
+        last = float(lo)
+    assert last < first * 0.7, f"adam loss did not drop: {first} -> {last}"
+
+
+def test_executor_caches_compilation(rng):
+    avg_loss, _ = build_mlp()
+    pt.optimizer.SGD(learning_rate=0.1).minimize(avg_loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    x, y = _synthetic_mnist(rng, n=128)
+    exe.run(feed={"img": x[:64], "label": y[:64]}, fetch_list=[avg_loss])
+    assert len(exe._cache) == 2  # startup + train step
+    exe.run(feed={"img": x[64:128], "label": y[64:128]},
+            fetch_list=[avg_loss])
+    assert len(exe._cache) == 2  # same signature -> cache hit

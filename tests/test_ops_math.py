@@ -1,0 +1,188 @@
+"""Per-op checks: elementwise / activations / reductions / tensor manip.
+
+≙ reference tests/unittests/test_elementwise_*_op.py, test_activation_op.py,
+test_reduce_op.py, test_reshape_op.py etc. — forward vs numpy + numeric grad.
+"""
+
+import numpy as np
+import pytest
+
+from op_test import check_grad, check_output, run_op
+
+
+class TestElementwise:
+    def test_add_forward_and_grad(self, rng):
+        x = rng.rand(3, 4).astype(np.float32)
+        y = rng.rand(3, 4).astype(np.float32)
+        check_output("elementwise_add", {"X": x, "Y": y}, {"Out": x + y})
+        check_grad("elementwise_add", {"X": x, "Y": y}, ["X", "Y"])
+
+    def test_add_broadcast_axis(self, rng):
+        x = rng.rand(2, 3, 4, 5).astype(np.float32)
+        y = rng.rand(3, 4).astype(np.float32)
+        out = run_op("elementwise_add", {"X": x, "Y": y}, {"axis": 1})
+        np.testing.assert_allclose(out["Out"][0],
+                                   x + y.reshape(1, 3, 4, 1), rtol=1e-6)
+
+    def test_sub_mul_div(self, rng):
+        x = rng.rand(4, 5).astype(np.float32) + 1.0
+        y = rng.rand(4, 5).astype(np.float32) + 1.0
+        check_output("elementwise_sub", {"X": x, "Y": y}, {"Out": x - y})
+        check_output("elementwise_mul", {"X": x, "Y": y}, {"Out": x * y})
+        check_output("elementwise_div", {"X": x, "Y": y}, {"Out": x / y},
+                     rtol=1e-5)
+        check_grad("elementwise_div", {"X": x, "Y": y}, ["X", "Y"])
+
+    def test_max_min_pow(self, rng):
+        x = rng.rand(3, 4).astype(np.float32) + 0.5
+        y = rng.rand(3, 4).astype(np.float32) + 0.5
+        check_output("elementwise_max", {"X": x, "Y": y},
+                     {"Out": np.maximum(x, y)})
+        check_output("elementwise_min", {"X": x, "Y": y},
+                     {"Out": np.minimum(x, y)})
+        check_output("elementwise_pow", {"X": x, "Y": y},
+                     {"Out": np.power(x, y)}, rtol=1e-4)
+
+    def test_scale(self, rng):
+        x = rng.rand(3, 4).astype(np.float32)
+        check_output("scale", {"X": x}, {"Out": 2.5 * x + 1.0},
+                     attrs={"scale": 2.5, "bias": 1.0})
+        check_grad("scale", {"X": x}, ["X"], attrs={"scale": 2.5, "bias": 1.0})
+
+    def test_clip(self, rng):
+        x = (rng.rand(5, 5).astype(np.float32) - 0.5) * 4
+        check_output("clip", {"X": x}, {"Out": np.clip(x, -1, 1)},
+                     attrs={"min": -1.0, "max": 1.0})
+
+
+class TestActivations:
+    @pytest.mark.parametrize("op,ref", [
+        ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+        ("tanh", np.tanh),
+        ("relu", lambda x: np.maximum(x, 0)),
+        ("exp", np.exp),
+        ("square", np.square),
+        ("softsign", lambda x: x / (1 + np.abs(x))),
+        ("abs", np.abs),
+    ])
+    def test_forward(self, rng, op, ref):
+        x = (rng.rand(4, 6).astype(np.float32) - 0.5) * 2
+        check_output(op, {"X": x}, {"Out": ref(x)}, rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("op", ["sigmoid", "tanh", "softplus", "gelu"])
+    def test_grad(self, rng, op):
+        x = (rng.rand(3, 4).astype(np.float32) - 0.5) * 2
+        check_grad(op, {"X": x}, ["X"])
+
+    def test_leaky_relu(self, rng):
+        x = (rng.rand(4, 4).astype(np.float32) - 0.5) * 2
+        check_output("leaky_relu", {"X": x},
+                     {"Out": np.where(x >= 0, x, 0.1 * x)},
+                     attrs={"alpha": 0.1})
+
+    def test_log_sqrt_positive(self, rng):
+        x = rng.rand(4, 4).astype(np.float32) + 0.5
+        check_output("log", {"X": x}, {"Out": np.log(x)}, rtol=1e-5)
+        check_output("sqrt", {"X": x}, {"Out": np.sqrt(x)}, rtol=1e-5)
+        check_grad("log", {"X": x}, ["X"])
+
+
+class TestReduce:
+    def test_reduce_sum(self, rng):
+        x = rng.rand(3, 4, 5).astype(np.float32)
+        check_output("reduce_sum", {"X": x}, {"Out": x.sum(axis=1)},
+                     attrs={"dim": [1]}, rtol=1e-5)
+        check_output("reduce_sum", {"X": x}, {"Out": x.sum()},
+                     attrs={"reduce_all": True}, rtol=1e-5)
+        check_grad("reduce_sum", {"X": x}, ["X"], attrs={"dim": [1]})
+
+    def test_reduce_mean_keepdim(self, rng):
+        x = rng.rand(3, 4).astype(np.float32)
+        check_output("reduce_mean", {"X": x},
+                     {"Out": x.mean(axis=0, keepdims=True)},
+                     attrs={"dim": [0], "keep_dim": True}, rtol=1e-5)
+
+    def test_mean_sum(self, rng):
+        x = rng.rand(3, 4).astype(np.float32)
+        y = rng.rand(3, 4).astype(np.float32)
+        check_output("mean", {"X": x}, {"Out": x.mean()}, rtol=1e-5)
+        check_output("sum", {"X": [x, y]}, {"Out": x + y})
+        check_grad("mean", {"X": x}, ["X"])
+
+    def test_topk_argmax(self, rng):
+        x = rng.rand(4, 10).astype(np.float32)
+        out = run_op("top_k", {"X": x}, {"k": 3})
+        ref = np.sort(x, axis=1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(out["Out"][0], ref, rtol=1e-6)
+        out = run_op("arg_max", {"X": x}, {"axis": 1})
+        np.testing.assert_array_equal(out["Out"][0], x.argmax(axis=1))
+
+
+class TestManip:
+    def test_reshape_zero_dim(self, rng):
+        x = rng.rand(2, 3, 4).astype(np.float32)
+        out = run_op("reshape", {"X": x}, {"shape": [0, 12]})
+        assert out["Out"][0].shape == (2, 12)
+        out = run_op("reshape", {"X": x}, {"shape": [-1, 6]})
+        assert out["Out"][0].shape == (4, 6)
+
+    def test_transpose_concat_split(self, rng):
+        x = rng.rand(2, 3, 4).astype(np.float32)
+        out = run_op("transpose", {"X": x}, {"axis": [2, 0, 1]})
+        np.testing.assert_allclose(out["Out"][0], x.transpose(2, 0, 1))
+        y = rng.rand(2, 3, 4).astype(np.float32)
+        out = run_op("concat", {"X": [x, y]}, {"axis": 1})
+        np.testing.assert_allclose(out["Out"][0],
+                                   np.concatenate([x, y], axis=1))
+        out = run_op("split", {"X": x}, {"num": 2, "axis": 2, "sections": []})
+        assert len(out["Out"]) == 2 and out["Out"][0].shape == (2, 3, 2)
+
+    def test_gather_scatter(self, rng):
+        x = rng.rand(10, 4).astype(np.float32)
+        idx = np.array([0, 3, 5], dtype=np.int32)
+        out = run_op("gather", {"X": x, "Index": idx}, {})
+        np.testing.assert_allclose(out["Out"][0], x[idx])
+        upd = rng.rand(3, 4).astype(np.float32)
+        out = run_op("scatter", {"X": x, "Ids": idx, "Updates": upd}, {})
+        ref = x.copy()
+        ref[idx] = upd
+        np.testing.assert_allclose(out["Out"][0], ref)
+
+    def test_one_hot_cast_fill(self, rng):
+        ids = np.array([[1], [3], [0]], dtype=np.int32)
+        out = run_op("one_hot", {"X": ids}, {"depth": 4})
+        assert out["Out"][0].shape == (3, 4)
+        assert out["Out"][0][1, 3] == 1.0
+        x = rng.rand(3, 3).astype(np.float32)
+        out = run_op("cast", {"X": x}, {"out_dtype": "int32"})
+        assert out["Out"][0].dtype == np.int32
+        out = run_op("fill_constant", {}, {"shape": [2, 3], "value": 7.0,
+                                           "dtype": "float32"})
+        np.testing.assert_allclose(out["Out"][0], np.full((2, 3), 7.0))
+
+    def test_pad_slice_expand(self, rng):
+        x = rng.rand(2, 3).astype(np.float32)
+        out = run_op("pad", {"X": x}, {"paddings": [0, 1, 2, 0],
+                                       "pad_value": 9.0})
+        assert out["Out"][0].shape == (3, 5)
+        assert out["Out"][0][2, 0] == 9.0
+        out = run_op("slice", {"X": x}, {"axes": [1], "starts": [1],
+                                         "ends": [3]})
+        np.testing.assert_allclose(out["Out"][0], x[:, 1:3])
+        out = run_op("expand", {"X": x}, {"expand_times": [2, 1]})
+        assert out["Out"][0].shape == (4, 3)
+
+    def test_cumsum(self, rng):
+        x = rng.rand(3, 4).astype(np.float32)
+        out = run_op("cumsum", {"X": x}, {"axis": 1})
+        np.testing.assert_allclose(out["Out"][0], np.cumsum(x, axis=1),
+                                   rtol=1e-5)
+        out = run_op("cumsum", {"X": x}, {"axis": 1, "reverse": True})
+        ref = np.flip(np.cumsum(np.flip(x, 1), axis=1), 1)
+        np.testing.assert_allclose(out["Out"][0], ref, rtol=1e-5)
+
+    def test_lookup_table(self, rng):
+        w = rng.rand(20, 8).astype(np.float32)
+        ids = np.array([[1], [5], [19]], dtype=np.int32)
+        out = run_op("lookup_table", {"W": w, "Ids": ids}, {})
+        np.testing.assert_allclose(out["Out"][0], w[[1, 5, 19]])

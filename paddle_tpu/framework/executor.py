@@ -89,11 +89,11 @@ class Executor:
         return ro, rw, out_only
 
     def _compile(self, program: Program, scope: Scope, feed_names, fetch_names,
-                 in_shardings=None, out_shardings=None):
+                 in_shardings=None, out_shardings=None, analysis=None):
         block = program.global_block()
         plan = build_plan(block)
-        ro, rw, out_only = self._analyze_state(program, scope, feed_names,
-                                               fetch_names)
+        ro, rw, out_only = analysis or self._analyze_state(
+            program, scope, feed_names, fetch_names)
         state_out_names = sorted(set(rw) | set(out_only))
         fetch_names = list(fetch_names)
         feed_names = list(feed_names)

@@ -67,6 +67,9 @@ class Optimizer:
         var = main_block.create_var(name=var_name, shape=shape, dtype=dtype,
                                     persistable=True)
         var.stop_gradient = True
+        # marker consumed by ParallelExecutor's Reduce (ZeRO-1) strategy:
+        # optimizer state may be sharded across the data axis.
+        var.is_optimizer_state = True
         sb = default_startup_program().global_block()
         sv = sb.create_var(name=var_name, shape=shape, dtype=dtype,
                            persistable=True)

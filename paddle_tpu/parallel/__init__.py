@@ -1,0 +1,22 @@
+"""Parallelism: SPMD execution over a device mesh.
+
+Capability equivalent of the reference's multi-device stack — ParallelExecutor
++ MultiDevSSAGraphBuilder + NCCL op handles (reference
+paddle/fluid/framework/parallel_executor.cc:119,
+framework/details/multi_devices_graph_pass.cc:320,
+details/all_reduce_op_handle.cc) — re-designed TPU-first: instead of
+replicating the program per device and inserting collective *ops*, the whole
+training step is compiled once under `jax.jit` with `jax.sharding`
+annotations over a `Mesh`; XLA partitions the computation and inserts ICI
+collectives (all-reduce / reduce-scatter / all-gather) itself.
+"""
+
+from .mesh import (DeviceMesh, get_default_mesh, set_default_mesh,  # noqa: F401
+                   make_mesh)
+from .strategy import BuildStrategy, ExecutionStrategy, ReduceStrategy  # noqa: F401
+from .parallel_executor import ParallelExecutor  # noqa: F401
+from . import collective  # noqa: F401
+from . import tensor_parallel  # noqa: F401
+from . import pipeline  # noqa: F401
+from . import ring_attention  # noqa: F401
+from . import sharded_embedding  # noqa: F401

@@ -1,0 +1,90 @@
+"""Collective primitives over mesh axes.
+
+≙ reference operators/nccl_op.cc:24-93 (raw AllReduce/Reduce/Bcast ops) and
+platform/nccl_helper.h — except on TPU these are *compiled into* the program
+as XLA HLO collectives riding the ICI, not runtime library calls. These
+wrappers exist so higher layers (tensor_parallel, pipeline, ring_attention)
+speak one vocabulary; inside `shard_map` they lower to psum/all_gather/
+ppermute HLOs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from .mesh import DeviceMesh
+
+
+def all_reduce(x, axis_name: str):
+    """Sum across an axis (≙ ncclAllReduce, all_reduce_op_handle.cc)."""
+    return jax.lax.psum(x, axis_name)
+
+
+def all_reduce_mean(x, axis_name: str):
+    return jax.lax.pmean(x, axis_name)
+
+
+def reduce_scatter(x, axis_name: str, scatter_dim: int = 0):
+    """≙ the Reduce-to-owner half of ReduceOpHandle (reduce_op_handle.h:34),
+    generalized: every shard owns a slice of the reduction."""
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dim,
+                                tiled=True)
+
+
+def all_gather(x, axis_name: str, gather_dim: int = 0):
+    """≙ BroadcastOpHandle capability (broadcast_op_handle.h:35)."""
+    return jax.lax.all_gather(x, axis_name, axis=gather_dim, tiled=True)
+
+
+def all_to_all(x, axis_name: str, split_dim: int, concat_dim: int):
+    return jax.lax.all_to_all(x, axis_name, split_axis=split_dim,
+                              concat_axis=concat_dim, tiled=True)
+
+
+def ppermute(x, axis_name: str, perm: Sequence[tuple]):
+    return jax.lax.ppermute(x, axis_name, perm=perm)
+
+
+def ring_perm(axis_size: int) -> list:
+    """The forward ring permutation shard i -> (i+1) % n — the one schedule
+    shared by ring attention and the pipeline."""
+    return [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+
+def shift_right(x, axis_name: str, axis_size: int):
+    """Ring shift: shard i -> shard (i+1) % n. Building block for ring
+    attention and pipelining."""
+    return jax.lax.ppermute(x, axis_name, perm=ring_perm(axis_size))
+
+
+def shift_left(x, axis_name: str, axis_size: int):
+    perm = [((i + 1) % axis_size, i) for i in range(axis_size)]
+    return jax.lax.ppermute(x, axis_name, perm=perm)
+
+
+def axis_index(axis_name: str):
+    return jax.lax.axis_index(axis_name)
+
+
+def sharded(mesh: DeviceMesh, in_specs, out_specs,
+            check_rep: bool = False) -> Callable:
+    """Decorator: run fn as per-shard SPMD code over `mesh` (shard_map).
+
+    This is the escape hatch from the "annotate & let XLA partition" world
+    into explicit per-device code — used where the collective schedule IS the
+    algorithm (ring attention, pipeline), mirroring how the reference drops
+    from graph building into hand-written op handles.
+    """
+    def deco(fn):
+        smapped = shard_map(fn, mesh=mesh.jax_mesh, in_specs=in_specs,
+                            out_specs=out_specs, check_rep=check_rep)
+        return functools.wraps(fn)(smapped)
+    return deco
+
+

@@ -1,0 +1,107 @@
+"""Pipeline parallelism: GPipe-style microbatched stage pipeline over the
+`pp` mesh axis.
+
+NEW capability with no reference analogue (SURVEY.md §2.3: the reference has
+no pipeline schedule). Design: stage parameters are stacked with a leading
+[num_stages] dim sharded over `pp`; inside `shard_map` each device holds one
+stage and the schedule is a scan over num_microbatches + num_stages - 1
+ticks, rotating activations along the ring with `ppermute`. Differentiable:
+reverse-mode AD re-runs the ring backwards, which is exactly the 1F1B-ish
+backward wave.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+from jax.tree_util import tree_map
+
+from .collective import ring_perm
+from .mesh import PIPELINE_AXIS, DeviceMesh
+
+
+def _pipeline_body(stage_fn: Callable, axis_name: str):
+    """Returns the per-device pipeline function: (stage_params, x) -> y where
+    stage_params is this device's stage (leading stacked dim already split
+    away by shard_map), x: [M, mb, ...] microbatched input (replicated)."""
+
+    def body(params, x):
+        params = tree_map(lambda p: p[0], params)  # drop the stage dim slice
+        n = jax.lax.psum(1, axis_name)
+        idx = jax.lax.axis_index(axis_name)
+        m = x.shape[0]
+        ticks = m + n - 1
+        perm = ring_perm(n)
+
+        state = jnp.zeros(x.shape[1:], x.dtype)       # in-flight activation
+        y = jnp.zeros(x.shape, x.dtype)               # outputs (last stage)
+        # the scan carry is device-varying (each stage holds different
+        # activations) — mark the initial zeros as such for shard_map's
+        # varying-axis type system
+        state = jax.lax.pvary(state, (axis_name,))
+        y = jax.lax.pvary(y, (axis_name,))
+
+        def tick(carry, t):
+            state, y = carry
+            # stage 0 ingests microbatch t (if any); others take the ring
+            feed = x[jnp.clip(t, 0, m - 1)]
+            inp = jnp.where(idx == 0, feed, state)
+            out = stage_fn(params, inp)
+            # last stage emits microbatch t-(n-1)
+            ot = jnp.clip(t - (n - 1), 0, m - 1)
+            emit = (idx == n - 1) & (t >= n - 1)
+            y = jnp.where(emit, y.at[ot].set(out), y)
+            state = jax.lax.ppermute(out, axis_name, perm)
+            return (state, y), None
+
+        (state, y), _ = jax.lax.scan(tick, (state, y), jnp.arange(ticks))
+        # only the last device holds real outputs; share them over the ring
+        y = jax.lax.psum(jnp.where(idx == n - 1, y, jnp.zeros_like(y)),
+                         axis_name)
+        return y
+
+    return body
+
+
+def pipeline_apply(mesh: DeviceMesh, stage_fn: Callable, stacked_params, x,
+                   num_microbatches: int, axis_name: str = PIPELINE_AXIS):
+    """Run `stage_fn(params_i, x) -> y` as a pipeline over the pp axis.
+
+    stacked_params: pytree whose leaves have leading dim == pp axis size.
+    x: [B, ...] global batch; it is reshaped to [M, B/M, ...] microbatches.
+    Returns y: [B, ...] (same trailing shape as stage output).
+    """
+    n = mesh.axis_size(axis_name)
+    b = x.shape[0]
+    assert b % num_microbatches == 0, (
+        f"batch {b} not divisible by num_microbatches {num_microbatches}")
+    xm = x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
+
+    # The ring buffer requires stage output shape/dtype == input (activation
+    # flows through identical stages). Fail fast with a clear message.
+    import jax as _jax
+    from jax.tree_util import tree_map as _tm
+    probe_params = _tm(lambda p: jax.ShapeDtypeStruct(p.shape[1:], p.dtype),
+                      stacked_params)
+    probe_x = jax.ShapeDtypeStruct(xm.shape[1:], xm.dtype)
+    out_shape = _jax.eval_shape(lambda p, h: stage_fn(p, h), probe_params,
+                                probe_x)
+    if (out_shape.shape, out_shape.dtype) != (probe_x.shape, probe_x.dtype):
+        raise ValueError(
+            f"pipeline stage must map activations to the same shape/dtype "
+            f"(got {probe_x.shape}/{probe_x.dtype} -> "
+            f"{out_shape.shape}/{out_shape.dtype}); wrap shape-changing "
+            f"layers into the first/last stage outside the pipeline")
+
+    param_specs = tree_map(
+        lambda p: P(*([axis_name] + [None] * (p.ndim - 1))), stacked_params)
+    body = _pipeline_body(stage_fn, axis_name)
+    f = shard_map(body, mesh=mesh.jax_mesh,
+                  in_specs=(param_specs, P()), out_specs=P(),
+                  )
+    ym = f(stacked_params, xm)
+    return ym.reshape((b,) + ym.shape[2:])

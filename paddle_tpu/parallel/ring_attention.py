@@ -1,0 +1,131 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+NEW capability with no reference analogue (SURVEY.md §5 "long context": the
+reference's story is LoD ragged batching, not sequence sharding). Design is
+the ring/flash formulation: Q,K,V are sharded along the sequence dim over the
+`sp` mesh axis; each device computes blockwise attention against its local KV
+block while rotating KV blocks around the ICI ring with `ppermute`,
+accumulating the softmax online (running max + running denominator), so the
+full [T, T] score matrix never materializes and comm overlaps compute.
+
+Cost: n_ring steps of [B, T/n, T/n] matmuls + (n-1) KV ppermutes — exact, not
+approximate, attention.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from .mesh import DATA_AXIS, SEQUENCE_AXIS, DeviceMesh
+
+_NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, bias, m_prev, l_prev, o_prev, scale):
+    """One online-softmax block update.
+
+    q: [B, Tq, H, D]; k,v: [B, Tk, H, D]; bias: [B, 1|H, Tq, Tk] additive
+    mask (0 / -inf); m,l,o running max / denom / numerator.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if bias is not None:
+        s = s + bias
+    m_cur = jnp.max(s, axis=-1)                      # [B, H, Tq]
+    m_new = jnp.maximum(m_prev, m_cur)
+    # guard fully-masked rows: keep exp finite
+    p = jnp.exp(s - m_new[..., None])                # [B, H, Tq, Tk]
+    l_cur = jnp.sum(p, axis=-1)
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_prev * corr + l_cur
+    o_cur = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    o_new = o_prev * corr.transpose(0, 2, 1)[..., None] + o_cur
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, *, axis_name: str = SEQUENCE_AXIS,
+                   causal: bool = False, scale: Optional[float] = None,
+                   segment_ids=None):
+    """Per-shard ring attention body. Must run inside shard_map with q/k/v
+    sequence-sharded: q,k,v: [B, T_local, H, D].
+
+    segment_ids: optional [B, T_local] int array (packed-batch masking — the
+    static-shape translation of the reference's LoD batches, SURVEY.md §5).
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, t_local, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+
+    q_pos = idx * t_local + jnp.arange(t_local)          # global positions
+
+    m0 = jnp.full((B, H, t_local), _NEG_INF, q.dtype)
+    l0 = jnp.zeros((B, H, t_local), q.dtype)
+    o0 = jnp.zeros_like(q)
+
+    from .collective import ring_perm
+    perm = ring_perm(n)
+
+    def ring_step(r, carry):
+        m, l, o, k_blk, v_blk, seg_blk = carry
+        # KV block currently held came from shard (idx - r) mod n
+        src = (idx - r) % n
+        k_pos = src * t_local + jnp.arange(t_local)
+        bias = jnp.zeros((1, 1, t_local, t_local), q.dtype)
+        if causal:
+            causal_mask = q_pos[:, None] >= k_pos[None, :]
+            bias = jnp.where(causal_mask[None, None], 0.0, _NEG_INF)
+        if seg_blk is not None and segment_ids is not None:
+            same = (segment_ids[:, :, None] == seg_blk[:, None, :])
+            seg_bias = jnp.where(same[:, None], 0.0, _NEG_INF)
+            bias = bias + seg_bias
+        m, l, o = _block_attn(q, k_blk, v_blk, bias, m, l, o, scale)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        if seg_blk is not None:
+            seg_blk = jax.lax.ppermute(seg_blk, axis_name, perm)
+        return m, l, o, k_blk, v_blk, seg_blk
+
+    # The ring is unrolled in Python: n (the mesh axis size) is a trace-time
+    # constant, the unroll length equals the number of ICI hops, and unrolling
+    # keeps reverse-mode AD through ppermute straightforward.
+    m, l, o, k_blk, v_blk, seg_blk = m0, l0, o0, k, v, segment_ids
+    for r in range(n):
+        m, l, o, k_blk, v_blk, seg_blk = ring_step(
+            r, (m, l, o, k_blk, v_blk, seg_blk))
+    l = jnp.maximum(l, 1e-20)
+    return o / l.transpose(0, 2, 1)[..., None]
+
+
+def ring_attention_sharded(mesh: DeviceMesh, q, k, v, *, causal=False,
+                           scale=None, segment_ids=None):
+    """Entry point from the annotate-and-partition world: q,k,v [B, T, H, D]
+    (any sharding); returns attention output with T sharded over sp."""
+    if SEQUENCE_AXIS not in mesh.axes:
+        raise ValueError(
+            f"ring attention requires a {SEQUENCE_AXIS!r} axis in the mesh "
+            f"(got axes {tuple(mesh.axes)}); for unsharded sequences use "
+            f"plain attention")
+    in_spec = mesh.pspec(DATA_AXIS, SEQUENCE_AXIS, None, None)
+    seg_spec = mesh.pspec(DATA_AXIS, SEQUENCE_AXIS)
+
+    if segment_ids is None:
+        def body(q, k, v):
+            return ring_attention(q, k, v, causal=causal, scale=scale)
+        f = shard_map(body, mesh=mesh.jax_mesh,
+                      in_specs=(in_spec, in_spec, in_spec),
+                      out_specs=in_spec)
+        return f(q, k, v)
+
+    def body(q, k, v, seg):
+        return ring_attention(q, k, v, causal=causal, scale=scale,
+                              segment_ids=seg)
+    f = shard_map(body, mesh=mesh.jax_mesh,
+                  in_specs=(in_spec, in_spec, in_spec, seg_spec),
+                  out_specs=in_spec)
+    return f(q, k, v, segment_ids)

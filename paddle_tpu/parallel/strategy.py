@@ -1,0 +1,62 @@
+"""Execution/build strategy knobs for the parallel executor.
+
+≙ reference framework/details/execution_strategy.h:83 +
+build_strategy.h:23-60. On TPU most of the reference's knobs (thread counts,
+op-delay heuristics) are moot — XLA schedules — so the surviving knobs are the
+ones that change the compiled program: reduce strategy (allreduce vs sharded
+optimizer state, ≙ ReduceStrategy::kAllReduce/kReduce), gradient scale, and
+debug dumps.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+class ReduceStrategy(enum.Enum):
+    """≙ BuildStrategy::ReduceStrategy (reference build_strategy.h:44).
+
+    AllReduce: gradients all-reduced, every device runs the full optimizer on
+    replicated state (reference multi_devices_graph_pass.cc:419-425).
+    Reduce: ZeRO-1 style — optimizer state sharded across the data axis;
+    XLA lowers the parameter update to reduce-scatter(grad) + sharded update +
+    all-gather(param) (the TPU-native form of the reference's reduce-to-owner
+    + broadcast, multi_devices_graph_pass.cc:412-418,445-453).
+    """
+    AllReduce = 0
+    Reduce = 1
+
+
+class GradientScaleStrategy(enum.Enum):
+    """≙ BuildStrategy::GradientScaleStrategy. CoeffNumDevice divides loss
+    grad by device count (reference scale_loss_grad_op_handle); under SPMD a
+    global `mean` already averages over the full global batch, so One is the
+    default and CoeffNumDevice is only for parity with programs that sum."""
+    CoeffNumDevice = 0
+    One = 1
+
+
+@dataclass
+class BuildStrategy:
+    reduce_strategy: ReduceStrategy = ReduceStrategy.AllReduce
+    # CoeffNumDevice is rejected at ParallelExecutor construction (the SPMD
+    # global-batch mean makes it unnecessary); One is the only implemented
+    # mode.
+    gradient_scale_strategy: GradientScaleStrategy = GradientScaleStrategy.One
+    # RESERVED (accepted, not yet consumed): debug program dumps and
+    # remat-based memory optimization land with the observability layer.
+    debug_graphviz_path: str = ""
+    memory_optimize: bool = False
+    enable_sequence_parallel: bool = False
+
+
+@dataclass
+class ExecutionStrategy:
+    # ≙ num_iteration_per_drop_scope (scope_buffered_ssa_graph_executor.h:37):
+    # how many steps between host syncs/scope cleanups. Under jit this only
+    # controls how often we block_until_ready for error surfacing.
+    num_iteration_per_drop_scope: int = 100
+    use_experimental_executor: bool = False
+    num_threads: int = 0               # accepted for API parity; XLA schedules

@@ -1,0 +1,76 @@
+"""Tensor (model) parallelism helpers.
+
+NEW first-class capability with no reference analogue (SURVEY.md §2.3: the
+reference has no tensor-sharded matmul). Design is the standard TPU/Megatron
+formulation expressed the XLA-SPMD way: parameters carry shardings over the
+model axis and activations carry `with_sharding_constraint` annotations; the
+partitioner inserts the all-reduce/all-gather on ICI.
+
+Column-parallel: W [in, out] sharded on `out` → local matmul, activations
+sharded on feature dim, no comm. Row-parallel: W [in, out] sharded on `in` →
+local partial matmul + all-reduce (psum) on the output. A column→row pair
+(e.g. MLP up/down proj, attention qkv/out proj) costs exactly one all-reduce
+per direction — the Megatron recipe.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .mesh import MODEL_AXIS, SEQUENCE_AXIS
+
+
+def shard(x, *spec):
+    """Annotate an activation with a PartitionSpec (axis names not present in
+    the ambient mesh are dropped by jax automatically only for AUTO axes, so
+    callers should build specs against the mesh in use; `DeviceMesh.sharding`
+    handles filtering for explicit shardings)."""
+    return jax.lax.with_sharding_constraint(x, P(*spec))
+
+
+def column_parallel_spec(ndim: int):
+    """Sharding spec for a weight whose LAST dim is split over the model
+    axis (qkv proj, MLP up-proj)."""
+    return P(*([None] * (ndim - 1) + [MODEL_AXIS]))
+
+
+def row_parallel_spec(ndim: int):
+    """Sharding spec for a weight whose FIRST-of-last-two dim is split over
+    the model axis (out proj, MLP down-proj)."""
+    assert ndim >= 2
+    return P(*([None] * (ndim - 2) + [MODEL_AXIS, None]))
+
+
+def column_parallel_matmul(x, w, b: Optional[jnp.ndarray] = None):
+    """y = x @ w with w sharded on its output dim. Output activations are
+    feature-sharded; no collective."""
+    w = jax.lax.with_sharding_constraint(w, column_parallel_spec(w.ndim))
+    y = jnp.matmul(x, w)
+    y = shard(y, *([None] * (y.ndim - 1)), MODEL_AXIS)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def row_parallel_matmul(x, w, b: Optional[jnp.ndarray] = None):
+    """y = x @ w with w sharded on its input dim; x arrives feature-sharded
+    from a preceding column-parallel layer. XLA inserts the psum."""
+    w = jax.lax.with_sharding_constraint(w, row_parallel_spec(w.ndim))
+    x = shard(x, *([None] * (x.ndim - 1)), MODEL_AXIS)
+    y = jnp.matmul(x, w)
+    y = shard(y, *([None] * y.ndim))  # replicated feature dim (post-psum)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def sequence_shard(x, batch_axis_spec="dp"):
+    """Sequence-parallel activation layout [B, T, D] with T split over the
+    sequence axis — used between transformer blocks so layernorm/dropout/
+    elementwise work is also divided (Megatron-SP). Attention/MLP regions
+    re-gather via their own shardings."""
+    return shard(x, batch_axis_spec, SEQUENCE_AXIS, None)

@@ -1,0 +1,275 @@
+"""Parallelism tests on the virtual 8-device CPU mesh.
+
+≙ reference test_parallel_executor_*.py (SURVEY.md §4: run real models via PE
+over N devices and compare against single-device results).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.parallel import (BuildStrategy, DeviceMesh, ParallelExecutor,
+                                 ReduceStrategy, make_mesh)
+from paddle_tpu.parallel.pipeline import pipeline_apply
+from paddle_tpu.parallel.ring_attention import ring_attention_sharded
+from paddle_tpu.parallel.sharded_embedding import sharded_embedding_lookup
+
+
+def _build_mlp():
+    img = layers.data(name="img", shape=[16], dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    h = layers.fc(img, size=32, act="relu")
+    logits = layers.fc(h, size=10)
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(logits, label))
+    return loss
+
+
+def _run_startup(scope=None):
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), scope=scope)
+    return exe
+
+
+class TestParallelExecutor:
+    def _train(self, build_strategy, rng, steps=4):
+        loss = _build_mlp()
+        opt = pt.optimizer.AdamOptimizer(learning_rate=1e-2)
+        opt.minimize(loss)
+        _run_startup()
+        pe = ParallelExecutor(loss_name=loss.name,
+                              build_strategy=build_strategy)
+        assert pe.device_count == 8
+        losses = []
+        x = rng.rand(32, 16).astype("float32")
+        y = rng.randint(0, 10, (32, 1)).astype("int64")
+        for _ in range(steps):
+            out, = pe.run(fetch_list=[loss], feed={"img": x, "label": y})
+            losses.append(float(out))
+        return losses
+
+    def test_allreduce_trains(self, rng):
+        losses = self._train(BuildStrategy(), rng)
+        assert losses[-1] < losses[0]
+
+    def test_reduce_zero1_trains(self, rng):
+        bs = BuildStrategy(reduce_strategy=ReduceStrategy.Reduce)
+        losses = self._train(bs, rng)
+        assert losses[-1] < losses[0]
+
+    def test_matches_single_device(self, rng):
+        """PE over 8 devices must produce the same loss trajectory as the
+        plain Executor (global-batch semantics — ≙ the reference's
+        PE-vs-single-device comparison tests)."""
+        x = rng.rand(16, 16).astype("float32")
+        y = rng.randint(0, 10, (16, 1)).astype("int64")
+
+        def run(use_pe):
+            pt.reset_default_programs()
+            pt.reset_global_scope()
+            from paddle_tpu.core import unique_name
+            with unique_name.guard():
+                loss = _build_mlp()
+                opt = pt.optimizer.SGDOptimizer(learning_rate=0.1)
+                opt.minimize(loss)
+                _run_startup()
+                exe = (ParallelExecutor(loss_name=loss.name) if use_pe
+                       else pt.Executor())
+                out = []
+                for _ in range(3):
+                    if use_pe:
+                        r, = exe.run(fetch_list=[loss],
+                                     feed={"img": x, "label": y})
+                    else:
+                        r, = exe.run(feed={"img": x, "label": y},
+                                     fetch_list=[loss])
+                    out.append(float(r))
+                return out
+
+        single = run(False)
+        multi = run(True)
+        np.testing.assert_allclose(single, multi, rtol=2e-4)
+
+    def test_indivisible_batch_raises(self, rng):
+        loss = _build_mlp()
+        pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        _run_startup()
+        pe = ParallelExecutor(loss_name=loss.name)
+        with pytest.raises(Exception, match="not divisible"):
+            pe.run(fetch_list=[loss],
+                   feed={"img": rng.rand(9, 16).astype("float32"),
+                         "label": rng.randint(0, 10, (9, 1)).astype("int64")})
+
+
+class TestMesh:
+    def test_mesh_axes(self):
+        m = make_mesh({"dp": 2, "tp": 4})
+        assert m.num_devices == 8
+        assert m.axis_size("dp") == 2
+        assert m.axis_size("pp") == 1
+
+    def test_sharding_filters_unknown_axes(self):
+        m = make_mesh({"dp": 8})
+        s = m.sharding("dp", "tp", None)  # tp not in mesh -> replicated dim
+        assert s is not None
+
+
+class TestRingAttention:
+    def _reference_attn(self, q, k, v, causal):
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        if causal:
+            t = q.shape[1]
+            mask = np.tril(np.ones((t, t), bool))
+            s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention(self, rng, causal):
+        mesh = make_mesh({"dp": 2, "sp": 4})
+        b, t, h, d = 2, 32, 2, 8
+        q = rng.randn(b, t, h, d).astype("float32")
+        k = rng.randn(b, t, h, d).astype("float32")
+        v = rng.randn(b, t, h, d).astype("float32")
+        out = ring_attention_sharded(mesh, q, k, v, causal=causal)
+        ref = self._reference_attn(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_segment_mask(self, rng):
+        mesh = make_mesh({"dp": 2, "sp": 4})
+        b, t, h, d = 2, 16, 1, 4
+        q = rng.randn(b, t, h, d).astype("float32")
+        k = rng.randn(b, t, h, d).astype("float32")
+        v = rng.randn(b, t, h, d).astype("float32")
+        seg = np.repeat(np.arange(4), 4)[None, :].repeat(b, 0)
+        out = ring_attention_sharded(mesh, q, k, v,
+                                     segment_ids=jnp.asarray(seg))
+        # manual block-diagonal reference
+        scale = 1.0 / np.sqrt(d)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        same = seg[:, :, None] == seg[:, None, :]
+        s = jnp.where(same[:, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        ref = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_gradients_flow(self, rng):
+        mesh = make_mesh({"sp": 8})
+        q = jnp.asarray(rng.randn(1, 16, 1, 4).astype("float32"))
+
+        def f(q):
+            return ring_attention_sharded(mesh, q, q, q, causal=True).sum()
+
+        g = jax.grad(f)(q)
+        assert np.isfinite(np.asarray(g)).all()
+        assert np.abs(np.asarray(g)).sum() > 0
+
+
+class TestPipeline:
+    def test_pipeline_matches_sequential(self, rng):
+        mesh = make_mesh({"pp": 8})
+        n_stage, d = 8, 16
+        ws = jnp.asarray(rng.randn(n_stage, d, d).astype("float32") * 0.1)
+        x = jnp.asarray(rng.randn(32, d).astype("float32"))
+
+        def stage(p, h):
+            return jnp.tanh(h @ p["w"])
+
+        y = pipeline_apply(mesh, stage, {"w": ws}, x, num_microbatches=4)
+        ref = x
+        for i in range(n_stage):
+            ref = stage({"w": ws[i]}, ref)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_pipeline_differentiable(self, rng):
+        mesh = make_mesh({"pp": 4, "dp": 2})
+        ws = jnp.asarray(rng.randn(4, 8, 8).astype("float32") * 0.1)
+        x = jnp.asarray(rng.randn(8, 8).astype("float32"))
+
+        def stage(p, h):
+            return jnp.tanh(h @ p["w"])
+
+        def loss(ws):
+            y = pipeline_apply(mesh, stage, {"w": ws}, x,
+                               num_microbatches=2)
+            return (y ** 2).sum()
+
+        g = jax.grad(loss)(ws)
+        assert np.isfinite(np.asarray(g)).all()
+        assert np.abs(np.asarray(g)).sum() > 0
+
+
+class TestShardedEmbedding:
+    def test_lookup_matches_dense(self, rng):
+        mesh = make_mesh({"dp": 2, "tp": 4})
+        table = jnp.asarray(rng.randn(64, 8).astype("float32"))
+        ids = jnp.asarray(rng.randint(0, 64, (4, 7)))
+        out = sharded_embedding_lookup(mesh, table, ids, axis_name="tp")
+        ref = jnp.take(table, ids, axis=0)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-6)
+
+    def test_lookup_gradient_sparse(self, rng):
+        mesh = make_mesh({"tp": 8})
+        table = jnp.asarray(rng.randn(16, 4).astype("float32"))
+        ids = jnp.asarray([0, 3, 3, 15])
+
+        def f(t):
+            return sharded_embedding_lookup(mesh, t, ids, axis_name="tp").sum()
+
+        g = np.asarray(jax.grad(f)(table))
+        assert g[0].sum() == pytest.approx(4.0)
+        assert g[3].sum() == pytest.approx(8.0)   # id 3 twice
+        assert g[1].sum() == 0.0
+
+
+class TestTensorParallel:
+    def test_column_row_pair_matches_dense(self, rng):
+        from paddle_tpu.parallel import tensor_parallel as tp
+        mesh = make_mesh({"dp": 2, "tp": 4})
+        x = jnp.asarray(rng.randn(8, 16).astype("float32"))
+        w1 = jnp.asarray(rng.randn(16, 32).astype("float32") * 0.1)
+        b1 = jnp.asarray(rng.randn(32).astype("float32") * 0.1)
+        w2 = jnp.asarray(rng.randn(32, 16).astype("float32") * 0.1)
+
+        @jax.jit
+        def mlp(x, w1, b1, w2):
+            with mesh.jax_mesh:
+                h = tp.column_parallel_matmul(x, w1, b1)
+                h = jax.nn.relu(h)
+                return tp.row_parallel_matmul(h, w2)
+
+        with mesh.jax_mesh:
+            y = mlp(x, w1, b1, w2)
+        ref = jax.nn.relu(x @ w1 + b1) @ w2
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_specs(self):
+        from paddle_tpu.parallel import tensor_parallel as tp
+        assert tp.column_parallel_spec(2)[-1] == "tp"
+        assert tp.row_parallel_spec(2)[0] == "tp"
+
+
+class TestPipelineShapeCheck:
+    def test_shape_changing_stage_raises(self, rng):
+        mesh = make_mesh({"pp": 8})
+        ws = jnp.asarray(rng.randn(8, 16, 8).astype("float32"))
+        x = jnp.asarray(rng.randn(16, 16).astype("float32"))
+        with pytest.raises(ValueError, match="same shape/dtype"):
+            pipeline_apply(mesh, lambda p, h: h @ p["w"], {"w": ws}, x, 4)
+
+
+class TestRingAttentionPrecondition:
+    def test_missing_sp_axis_raises(self, rng):
+        mesh = make_mesh({"dp": 8})
+        q = jnp.asarray(rng.randn(2, 8, 1, 4).astype("float32"))
+        with pytest.raises(ValueError, match="requires a 'sp' axis"):
+            ring_attention_sharded(mesh, q, q, q)

@@ -1,0 +1,69 @@
+"""Benchmark: ResNet-50 synthetic-ImageNet training throughput on one chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline compares against the reference's best published in-repo ResNet-50
+training number (84.08 images/sec, 2-socket Xeon 6148 MKL-DNN bs=256 —
+reference benchmark/IntelOptimizedPaddle.md:39-45; the reference publishes no
+Fluid-GPU tables, see BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMGS_PER_SEC = 84.08
+
+
+def main():
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu import models
+
+    platform = jax.devices()[0].platform
+    # TPU: full-size config; CPU fallback (no tunnel): tiny shapes so the
+    # script stays runnable anywhere.
+    on_accel = platform not in ("cpu",)
+    batch = 128 if on_accel else 8
+    depth = 50
+
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    loss, acc, _ = models.resnet.resnet_imagenet(
+        depth=depth, is_test=False, data_format="NHWC", use_bf16=True)
+    opt = pt.optimizer.MomentumOptimizer(learning_rate=0.1, momentum=0.9)
+    opt.minimize(loss)
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+
+    rng = np.random.RandomState(0)
+    img = rng.rand(batch, 224, 224, 3).astype("float32")
+    label = rng.randint(0, 1000, (batch, 1)).astype("int64")
+    feed = {"img": img, "label": label}
+
+    # warmup (compile + 2 steady steps)
+    for _ in range(3):
+        out = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
+    jax.block_until_ready(out)
+
+    iters = 20 if on_accel else 3
+    t0 = time.time()
+    for _ in range(iters):
+        out = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
+    jax.block_until_ready(out)
+    dt = time.time() - t0
+
+    imgs_per_sec = batch * iters / dt
+    print(json.dumps({
+        "metric": f"resnet50_train_images_per_sec_bs{batch}_{platform}",
+        "value": round(imgs_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -20,5 +20,12 @@ from .framework.program import (Program, Variable, default_main_program,  # noqa
 from .framework.registry import registered_ops  # noqa: F401
 from .framework.scope import Scope, global_scope, reset_global_scope  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
+from . import nets  # noqa: F401,E402
+from . import models  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from .inferencer import Inferencer, Predictor  # noqa: F401,E402
+from .io import (load_inference_model, load_params,  # noqa: F401,E402
+                 load_persistables, load_vars, save_inference_model,
+                 save_params, save_persistables, save_vars)
 
 __version__ = "0.1.0"

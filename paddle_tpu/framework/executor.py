@@ -99,7 +99,8 @@ class Executor:
         feed_names = list(feed_names)
 
         def step(feed_vals, ro_vals, rw_vals, seed):
-            ctx = LowerCtx(rng_key=jax.random.PRNGKey(seed))
+            ctx = LowerCtx(rng_key=jax.random.PRNGKey(seed),
+                           extras={"program": program})
             env: Dict[str, Any] = {}
             env.update(zip(ro, ro_vals))
             env.update(zip(rw, rw_vals))

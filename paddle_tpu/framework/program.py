@@ -294,6 +294,10 @@ class Program:
                                   persistable=v.persistable,
                                   stop_gradient=v.stop_gradient,
                                   lod_level=v.lod_level, is_data=v.is_data)
+                for extra in ("sharding_spec", "is_optimizer_state",
+                              "optimize_attr"):
+                    if hasattr(v, extra):
+                        setattr(nv, extra, getattr(v, extra))
                 nb.vars[name] = nv
             for op in b.ops:
                 attrs = dict(op.attrs)
@@ -318,6 +322,14 @@ class Program:
         keep: List[int] = []
         for i in range(len(block.ops) - 1, -1, -1):
             op = block.ops[i]
+            # backward/optimize ops never survive pruning-to-targets: the
+            # forward pass reads the parameter's *incoming* value, so the
+            # update op that also "produces" the param name is not a true
+            # producer for inference (≙ reference prune.cc + op roles
+            # kBackward/kOptimize, op_proto_maker.h:25-31).
+            if (op.type == "vjp_region"
+                    or op.attrs.get("op_role") in ("optimize", "backward")):
+                continue
             if needed & set(op.output_names()):
                 keep.append(i)
                 needed |= set(op.input_names())
@@ -355,6 +367,10 @@ class Program:
                     "lod_level": v.lod_level, "is_data": v.is_data,
                     "is_parameter": isinstance(v, Parameter),
                     "trainable": v.trainable,
+                    "sharding_spec": list(getattr(v, "sharding_spec", None))
+                    if getattr(v, "sharding_spec", None) is not None else None,
+                    "is_optimizer_state": getattr(v, "is_optimizer_state",
+                                                  False),
                 } for v in b.vars.values()],
                 "ops": [{
                     "type": op.type, "inputs": op.inputs,
@@ -388,6 +404,10 @@ class Program:
                                  stop_gradient=vd["stop_gradient"],
                                  lod_level=vd.get("lod_level", 0),
                                  is_data=vd.get("is_data", False))
+                if vd.get("sharding_spec") is not None:
+                    v.sharding_spec = tuple(vd["sharding_spec"])
+                if vd.get("is_optimizer_state"):
+                    v.is_optimizer_state = True
                 b.vars[v.name] = v
             for od in bd["ops"]:
                 op = Operator(b, od["type"], {}, {},

@@ -1,0 +1,75 @@
+"""Inference API.
+
+≙ reference python/paddle/fluid/inferencer.py (Inferencer:113 area) and the
+C++ predictor interface (api/paddle_inference_api.h PaddlePredictor,
+api/api_impl.cc:126 NativePaddlePredictor::Run). The TPU predictor wraps a
+loaded inference program + scope in an Executor whose compiled step is
+cached — repeated `infer` calls with same shapes hit the XLA executable
+cache, which is the analogue of the reference cloning one Executor per
+predictor thread.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from . import io as pio
+from .core.enforce import InvalidArgumentError, enforce
+from .framework.executor import Executor
+from .framework.program import Program
+from .framework.scope import Scope
+
+
+class Predictor:
+    """Load-and-run predictor (≙ NativePaddlePredictor)."""
+
+    def __init__(self, model_dir: str, place=None,
+                 scope: Optional[Scope] = None):
+        self.scope = scope or Scope()
+        self.executor = Executor(place)
+        self.program, self.feed_names, self.fetch_names = \
+            pio.load_inference_model(model_dir, executor=self.executor,
+                                     scope=self.scope)
+
+    def run(self, feed: Dict[str, Any],
+            fetch_names: Optional[Sequence[str]] = None,
+            return_numpy: bool = True) -> List[Any]:
+        missing = set(self.feed_names) - set(feed)
+        extra = {k for k in feed
+                 if k not in self.feed_names and
+                 not k.endswith("@SEQLEN")}
+        enforce(not missing, f"missing feeds: {sorted(missing)}",
+                exc=InvalidArgumentError)
+        enforce(not extra, f"unexpected feeds: {sorted(extra)}",
+                exc=InvalidArgumentError)
+        return self.executor.run(program=self.program, feed=feed,
+                                 fetch_list=list(fetch_names or
+                                                 self.fetch_names),
+                                 scope=self.scope,
+                                 return_numpy=return_numpy)
+
+    def clone(self) -> "Predictor":
+        """≙ PaddlePredictor::Clone — share weights (scope), fresh executor
+        caches for another thread/stream of requests."""
+        p = object.__new__(Predictor)
+        p.scope = self.scope
+        p.executor = Executor(self.executor.place)
+        p.program = self.program
+        p.feed_names = list(self.feed_names)
+        p.fetch_names = list(self.fetch_names)
+        return p
+
+
+class Inferencer:
+    """≙ fluid.Inferencer — high-level wrapper over Predictor."""
+
+    def __init__(self, param_path: str, place=None,
+                 scope: Optional[Scope] = None):
+        self._predictor = Predictor(param_path, place=place, scope=scope)
+
+    @property
+    def program(self) -> Program:
+        return self._predictor.program
+
+    def infer(self, inputs: Dict[str, Any], return_numpy: bool = True):
+        return self._predictor.run(inputs, return_numpy=return_numpy)

@@ -1,0 +1,250 @@
+"""Model persistence: save/load variables, parameters, persistables, and
+inference models.
+
+≙ reference python/paddle/fluid/io.py (save/load_vars:89, save/load_params,
+save/load_persistables:252,464, save_inference_model:561,
+load_inference_model:677) + save_op.cc:66 / load_op.cc:24 /
+save_combine_op / load_combine_op.
+
+TPU-first format choices: variables are host numpy arrays saved as one .npy
+per var (≙ save_op one-file-per-var) or a single .npz (≙ save_combine);
+programs serialize to JSON (paddle_tpu programs are small — the heavy
+artifact is XLA's compiled executable, cached by the runtime). A
+`save_as_bf16` flag mirrors the reference's `save_as_fp16` attr
+(save_op.cc supports fp16 conversion on save).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .core.enforce import InvalidArgumentError, NotFoundError, enforce
+from .framework.executor import Executor, as_numpy
+from .framework.program import (Parameter, Program, Variable,
+                                default_main_program)
+from .framework.scope import Scope, global_scope
+
+INFERENCE_PROGRAM_FILE = "__model__"
+PARAMS_COMBINED_FILE = "__params__.npz"
+
+
+def _is_parameter(var: Variable) -> bool:
+    return isinstance(var, Parameter)
+
+
+def _is_persistable(var: Variable) -> bool:
+    return bool(var.persistable)
+
+
+def _select_vars(program: Program, predicate) -> List[Variable]:
+    out = []
+    seen = set()
+    for b in program.blocks:
+        for v in b.vars.values():
+            if v.name not in seen and predicate(v):
+                seen.add(v.name)
+                out.append(v)
+    return sorted(out, key=lambda v: v.name)
+
+
+BF16_TAG = "@BF16"
+
+
+def _maybe_bf16(arr: np.ndarray, save_as_bf16: bool) -> np.ndarray:
+    if save_as_bf16 and arr.dtype == np.float32:
+        import jax.numpy as jnp
+        return np.asarray(jnp.asarray(arr).astype(jnp.bfloat16))
+    return arr
+
+
+def _encode_for_npy(name: str, arr: np.ndarray):
+    """numpy cannot round-trip bfloat16 through .npy/.npz (comes back as
+    raw void) — store the bit pattern as uint16 under a tagged name."""
+    if arr.dtype.name == "bfloat16":
+        return name + BF16_TAG, arr.view(np.uint16)
+    return name, arr
+
+
+def _decode_from_store(name: str, store) -> np.ndarray:
+    if name in store:
+        return store[name]
+    tagged = name + BF16_TAG
+    if tagged in store:
+        import ml_dtypes
+        return store[tagged].view(ml_dtypes.bfloat16)
+    raise NotFoundError(f"{name!r} missing from saved store")
+
+
+def save_vars(executor: Optional[Executor], dirname: str,
+              main_program: Optional[Program] = None,
+              vars: Optional[Sequence[Variable]] = None,
+              predicate=None, filename: Optional[str] = None,
+              scope: Optional[Scope] = None,
+              save_as_bf16: bool = False):
+    """≙ fluid.io.save_vars (reference io.py:89). Values come from the scope
+    (device arrays are fetched to host)."""
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    if vars is None:
+        enforce(predicate is not None, "need vars or predicate",
+                exc=InvalidArgumentError)
+        vars = _select_vars(program, predicate)
+    os.makedirs(dirname, exist_ok=True)
+    arrays: Dict[str, np.ndarray] = {}
+    for v in vars:
+        if not scope.has_var(v.name):
+            raise NotFoundError(
+                f"variable {v.name!r} not found in scope — run the startup "
+                f"program before saving")
+        arrays[v.name] = _maybe_bf16(as_numpy(scope.get(v.name)),
+                                     save_as_bf16)
+    encoded = dict(_encode_for_npy(n, a) for n, a in arrays.items())
+    if filename is None:
+        for name, arr in encoded.items():
+            np.save(os.path.join(dirname, name + ".npy"), arr)
+    else:
+        np.savez(os.path.join(dirname, filename), **encoded)
+    return sorted(arrays)
+
+
+def load_vars(executor: Optional[Executor], dirname: str,
+              main_program: Optional[Program] = None,
+              vars: Optional[Sequence[Variable]] = None,
+              predicate=None, filename: Optional[str] = None,
+              scope: Optional[Scope] = None):
+    """≙ fluid.io.load_vars (reference io.py:317)."""
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    if vars is None:
+        enforce(predicate is not None, "need vars or predicate",
+                exc=InvalidArgumentError)
+        vars = _select_vars(program, predicate)
+    if filename is not None:
+        path = os.path.join(dirname, filename)
+        with np.load(path) as data:
+            store = {k: data[k] for k in data.files}
+    else:
+        store = None
+    import jax.numpy as jnp
+    loaded = []
+    for v in vars:
+        if store is not None:
+            arr = _decode_from_store(v.name, store)
+        else:
+            path = os.path.join(dirname, v.name + ".npy")
+            tagged = os.path.join(dirname, v.name + BF16_TAG + ".npy")
+            if os.path.exists(path):
+                arr = np.load(path)
+            elif os.path.exists(tagged):
+                import ml_dtypes
+                arr = np.load(tagged).view(ml_dtypes.bfloat16)
+            else:
+                raise NotFoundError(f"{path} does not exist")
+        if v.shape is not None and -1 not in v.shape:
+            enforce(tuple(arr.shape) == tuple(v.shape),
+                    f"shape mismatch loading {v.name!r}: file {arr.shape} "
+                    f"vs var {v.shape}", exc=InvalidArgumentError)
+        target_dtype = np.dtype(v.dtype) if not hasattr(v.dtype, "name") \
+            else v.dtype
+        val = jnp.asarray(arr)
+        if str(val.dtype) != str(np.dtype(target_dtype)):
+            val = val.astype(target_dtype)
+        scope.set_var(v.name, val)
+        loaded.append(v.name)
+    return sorted(loaded)
+
+
+def save_params(executor=None, dirname: str = "", main_program=None,
+                filename=None, scope=None, save_as_bf16=False):
+    """≙ fluid.io.save_params — trainable parameters only."""
+    return save_vars(executor, dirname, main_program=main_program,
+                     predicate=_is_parameter, filename=filename, scope=scope,
+                     save_as_bf16=save_as_bf16)
+
+
+def load_params(executor=None, dirname: str = "", main_program=None,
+                filename=None, scope=None):
+    return load_vars(executor, dirname, main_program=main_program,
+                     predicate=_is_parameter, filename=filename, scope=scope)
+
+
+def save_persistables(executor=None, dirname: str = "", main_program=None,
+                      filename=None, scope=None, save_as_bf16=False):
+    """≙ fluid.io.save_persistables (reference io.py:252) — parameters AND
+    optimizer state/moving stats, i.e. everything needed to resume."""
+    return save_vars(executor, dirname, main_program=main_program,
+                     predicate=_is_persistable, filename=filename,
+                     scope=scope, save_as_bf16=save_as_bf16)
+
+
+def load_persistables(executor=None, dirname: str = "", main_program=None,
+                      filename=None, scope=None):
+    return load_vars(executor, dirname, main_program=main_program,
+                     predicate=_is_persistable, filename=filename,
+                     scope=scope)
+
+
+def save_inference_model(dirname: str,
+                         feeded_var_names: Sequence[str],
+                         target_vars: Sequence[Variable],
+                         executor: Optional[Executor] = None,
+                         main_program: Optional[Program] = None,
+                         model_filename: Optional[str] = None,
+                         params_filename: Optional[str] = None,
+                         scope: Optional[Scope] = None,
+                         save_as_bf16: bool = False):
+    """≙ fluid.io.save_inference_model (reference io.py:561): prune the
+    program to the fetch targets, switch to test mode, serialize program +
+    parameters."""
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    target_names = [t.name if isinstance(t, Variable) else t
+                    for t in target_vars]
+    inference_program = program.clone(for_test=True).prune(target_names)
+    blk = inference_program.global_block()
+    for name in feeded_var_names:
+        enforce(blk.has_var(name),
+                f"feeded var {name!r} not present in pruned program "
+                f"(not on the path to targets?)", exc=InvalidArgumentError)
+
+    os.makedirs(dirname, exist_ok=True)
+    meta = {
+        "program": json.loads(inference_program.to_json()),
+        "feed_names": list(feeded_var_names),
+        "fetch_names": target_names,
+    }
+    with open(os.path.join(dirname, model_filename or
+                           INFERENCE_PROGRAM_FILE), "w") as f:
+        json.dump(meta, f)
+
+    persistables = _select_vars(inference_program, _is_persistable)
+    save_vars(executor, dirname, main_program=inference_program,
+              vars=persistables,
+              filename=params_filename or PARAMS_COMBINED_FILE, scope=scope,
+              save_as_bf16=save_as_bf16)
+    return target_names
+
+
+def load_inference_model(dirname: str,
+                         executor: Optional[Executor] = None,
+                         model_filename: Optional[str] = None,
+                         params_filename: Optional[str] = None,
+                         scope: Optional[Scope] = None):
+    """≙ fluid.io.load_inference_model (reference io.py:677).
+    Returns (program, feed_names, fetch_names); parameters are loaded into
+    the scope."""
+    scope = scope or global_scope()
+    path = os.path.join(dirname, model_filename or INFERENCE_PROGRAM_FILE)
+    if not os.path.exists(path):
+        raise NotFoundError(f"no inference model at {path}")
+    with open(path) as f:
+        meta = json.load(f)
+    program = Program.from_json(json.dumps(meta["program"]))
+    persistables = _select_vars(program, _is_persistable)
+    load_vars(executor, dirname, main_program=program, vars=persistables,
+              filename=params_filename or PARAMS_COMBINED_FILE, scope=scope)
+    return program, list(meta["feed_names"]), list(meta["fetch_names"])

@@ -57,6 +57,8 @@ class LayerHelper:
             trainable=attr.trainable, regularizer=attr.regularizer,
             gradient_clip=attr.gradient_clip)
         p.optimize_attr = {"learning_rate": attr.learning_rate}
+        if attr.sharding_spec is not None:
+            p.sharding_spec = tuple(attr.sharding_spec)
         # mirror into startup program with its initializer op
         sb = self.startup_program.global_block()
         if name not in sb.vars:

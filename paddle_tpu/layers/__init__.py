@@ -1,7 +1,16 @@
 """fluid.layers-equivalent namespace (≙ reference python/paddle/fluid/layers/)."""
 
-from . import io, math_ops, nn, ops, tensor  # noqa: F401
+from . import control_flow, io, math_ops, nn, ops, sequence, tensor  # noqa: F401
+from .control_flow import (DynamicRNN, IfElse, StaticRNN, Switch,  # noqa: F401
+                           While, cond, equal, greater_equal, greater_than,
+                           increment, less_equal, less_than, not_equal)
 from .io import data  # noqa: F401
+from .sequence import (dynamic_gru, dynamic_lstm, sequence_concat,  # noqa: F401
+                       sequence_conv, sequence_erase, sequence_expand,
+                       sequence_first_step, sequence_last_step, sequence_mask,
+                       sequence_pad,
+                       sequence_pool, sequence_reverse, sequence_slice,
+                       sequence_softmax)
 from .math_ops import scale  # noqa: F401
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403

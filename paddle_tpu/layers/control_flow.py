@@ -1,0 +1,490 @@
+"""Control-flow layers: While, StaticRNN, DynamicRNN, IfElse, Switch, cond.
+
+≙ reference python/paddle/fluid/layers/control_flow.py (While:655,
+StaticRNN:430, DynamicRNN:1542, IfElse:1412, Switch:1286,
+ConditionalBlock:1204). The builders create real sub-blocks in the program
+(≙ the BLOCK attr in framework.proto); lowering maps them onto lax.scan /
+lax.while_loop / lax.cond / masked-select (see ops/control_ops.py) instead of
+the reference's sub-block-interpreting C++ ops.
+
+TPU notes:
+- StaticRNN/DynamicRNN are lax.scan: differentiable, compiler-scheduled.
+- While is lax.while_loop: forward-only (XLA while has no reverse-mode);
+  use the RNN classes for trainable recurrences.
+- IfElse runs both branches and mask-merges (static shapes) — the
+  TPU translation of the reference's split-batch-by-condition gather.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional, Sequence
+
+from ..core.dtypes import dtype_name
+from ..core.enforce import InvalidArgumentError, enforce
+from ..framework.program import Variable, default_main_program
+from ..layer_helper import LayerHelper
+
+
+def _analyze_sub_block(block, exclude_inner: Sequence[str] = ()):
+    """(reads-from-outside, writes) of a sub-block."""
+    produced = set(exclude_inner)
+    reads: List[str] = []
+    writes: List[str] = []
+    for op in block.ops:
+        for n in op.input_names():
+            if n not in produced and n not in reads:
+                reads.append(n)
+        for n in op.output_names():
+            produced.add(n)
+            if n not in writes:
+                writes.append(n)
+    return reads, writes
+
+
+class While:
+    """≙ fluid.layers.While (reference control_flow.py:655).
+
+    cond: scalar bool variable. Vars assigned in the body that pre-exist
+    outside become loop-carried state (their post-loop values are visible
+    after the loop). Forward-only on TPU (see module docstring).
+
+        i = layers.fill_constant([1], "int64", 0)
+        n = layers.fill_constant([1], "int64", 10)
+        cond = layers.less_than(i, n)
+        w = While(cond)
+        with w.block():
+            ... body ops, must re-assign `cond` ...
+    """
+
+    def __init__(self, cond: Variable, name: Optional[str] = None):
+        enforce(cond.dtype is not None, "cond must be a bool variable",
+                exc=InvalidArgumentError)
+        self.cond = cond
+        self.helper = LayerHelper("while", name=name)
+        self.program = default_main_program()
+
+    @contextlib.contextmanager
+    def block(self):
+        parent = self.program.current_block()
+        sub = self.program._create_block()
+        try:
+            yield
+        finally:
+            self.program._rollback()
+        reads, writes = _analyze_sub_block(sub)
+        # loop-carried: cond + every var written in the body that exists
+        # outside the body (same-name update semantics, ≙ while_op's
+        # in-place scope vars)
+        carry = [self.cond.name]
+        for n in writes:
+            if n != self.cond.name and parent.has_var(n) and n not in carry:
+                carry.append(n)
+        captures = [n for n in reads
+                    if n not in carry and parent.has_var(n)]
+        parent.append_op(
+            type="while",
+            inputs={"Carry": list(carry), "Captures": captures},
+            outputs={"Out": list(carry)},
+            attrs={"sub_block": sub.idx, "carry_names": list(carry),
+                   "capture_names": captures, "cond_name": self.cond.name})
+
+
+class StaticRNN:
+    """≙ fluid.layers.StaticRNN (reference control_flow.py:430): explicit
+    per-step block over a fixed-length (padded) time dimension, lowered to
+    one lax.scan."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self.program = default_main_program()
+        self._step_inputs: List[Variable] = []   # outer [B,T,...] vars
+        self._step_vars: List[Variable] = []     # inner per-step views
+        self._memories: List[Variable] = []      # inner pre-state vars
+        self._init_mems: List[Variable] = []     # outer init values
+        self._mem_updates: Dict[str, str] = {}   # pre name -> new name
+        self._step_outputs: List[Variable] = []  # inner step outputs
+        self._outer_outputs: List[Variable] = []
+        self._seq_lens: Optional[Variable] = None
+        self._sub = None
+        self._parent = None
+        self._reverse = False
+
+    @contextlib.contextmanager
+    def step(self):
+        self._parent = self.program.current_block()
+        self._sub = self.program._create_block()
+        try:
+            yield
+        finally:
+            self.program._rollback()
+            self._finalize()
+
+    # -- inside-step API --------------------------------------------------
+    def step_input(self, x: Variable) -> Variable:
+        """Register [B, T, ...] sequence; returns the per-step [B, ...]
+        view usable inside the block."""
+        enforce(self._sub is not None and
+                self.program.current_block() is self._sub,
+                "step_input must be called inside rnn.step()",
+                exc=InvalidArgumentError)
+        v = self._sub.create_var(
+            shape=[x.shape[0]] + list(x.shape[2:]),
+            dtype=dtype_name(x.dtype))
+        self._step_inputs.append(x)
+        self._step_vars.append(v)
+        return v
+
+    def memory(self, init: Variable) -> Variable:
+        """Loop-carried state initialized from `init` [B, ...]."""
+        enforce(self.program.current_block() is self._sub,
+                "memory must be called inside rnn.step()",
+                exc=InvalidArgumentError)
+        v = self._sub.create_var(shape=list(init.shape),
+                                 dtype=dtype_name(init.dtype))
+        self._memories.append(v)
+        self._init_mems.append(init)
+        return v
+
+    def update_memory(self, mem: Variable, new: Variable):
+        self._mem_updates[mem.name] = new.name
+
+    def step_output(self, out: Variable):
+        self._step_outputs.append(out)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def set_sequence_lengths(self, seq_lens: Variable):
+        """DynamicRNN behavior: freeze memories & zero outputs past each
+        sequence's length."""
+        self._seq_lens = seq_lens
+
+    # -- finalize ---------------------------------------------------------
+    def _finalize(self):
+        enforce(self._step_inputs, "StaticRNN needs at least one step_input",
+                exc=InvalidArgumentError)
+        enforce(set(self._mem_updates) == {m.name for m in self._memories},
+                "every memory needs update_memory", exc=InvalidArgumentError)
+        pre_names = [m.name for m in self._memories]
+        new_names = [self._mem_updates[n] for n in pre_names]
+        inner_defined = set(n for v in self._step_vars for n in [v.name])
+        inner_defined |= set(pre_names)
+        reads, _ = _analyze_sub_block(self._sub, exclude_inner=inner_defined)
+        captures = [n for n in reads if self._parent.has_var(n)]
+
+        t = self._step_inputs[0].shape[1]
+        outer_outs = []
+        for so in self._step_outputs:
+            ov = self._parent.create_var(
+                name=None, shape=[so.shape[0], t] + list(so.shape[1:]),
+                dtype=dtype_name(so.dtype))
+            outer_outs.append(ov)
+        final_mems = []
+        for m in self._memories:
+            fv = self._parent.create_var(name=None, shape=list(m.shape),
+                                         dtype=dtype_name(m.dtype))
+            final_mems.append(fv)
+        self._outer_outputs = outer_outs
+        self._final_mems = final_mems
+        inputs = {"StepInputs": [v.name for v in self._step_inputs],
+                  "InitMems": [v.name for v in self._init_mems],
+                  "Captures": captures}
+        if self._seq_lens is not None:
+            inputs["SeqLens"] = [self._seq_lens.name]
+        self._parent.append_op(
+            type="static_rnn",
+            inputs=inputs,
+            outputs={"Out": [v.name for v in outer_outs],
+                     "FinalMems": [v.name for v in final_mems]},
+            attrs={"sub_block": self._sub.idx,
+                   "step_input_names": [v.name for v in self._step_vars],
+                   "pre_mem_names": pre_names,
+                   "new_mem_names": new_names,
+                   "step_output_names": [o.name for o in self._step_outputs],
+                   "capture_names": captures,
+                   "is_reverse": self._reverse})
+
+    def __call__(self):
+        outs = self._outer_outputs
+        return outs[0] if len(outs) == 1 else outs
+
+    def final_memories(self):
+        fm = self._final_mems
+        return fm[0] if len(fm) == 1 else fm
+
+
+class DynamicRNN(StaticRNN):
+    """≙ fluid.layers.DynamicRNN (reference control_flow.py:1542). On TPU the
+    "dynamic" (LoD ragged) batch is the padded+lengths representation: same
+    scan as StaticRNN with per-sequence freezing/masking past each length.
+    """
+
+    def __init__(self, seq_lens: Optional[Variable] = None, name=None):
+        super().__init__(name=name)
+        if seq_lens is not None:
+            self.set_sequence_lengths(seq_lens)
+
+    @contextlib.contextmanager
+    def block(self):
+        with self.step():
+            yield
+
+    def static_input(self, x: Variable) -> Variable:
+        """Non-sequence input visible every step (captured)."""
+        return x
+
+    def step_input(self, x: Variable) -> Variable:
+        if self._seq_lens is None:
+            from .sequence import get_seqlen
+            sl = getattr(x, "seqlen_var", None)
+            if sl is None:
+                try:
+                    sl = get_seqlen(x)
+                except Exception:
+                    sl = None
+            if sl is not None:
+                self.set_sequence_lengths(sl)
+        return super().step_input(x)
+
+
+class IfElse:
+    """≙ fluid.layers.IfElse (reference control_flow.py:1412): batched
+    two-branch conditional. Both branches compute on the full batch and
+    results merge elementwise by the [B, 1] bool condition."""
+
+    def __init__(self, cond: Variable, name: Optional[str] = None):
+        self.cond = cond
+        self.program = default_main_program()
+        self._blocks = {}          # True/False -> block
+        self._outs = {True: [], False: []}
+        self._parent = None
+
+    @contextlib.contextmanager
+    def true_block(self):
+        with self._branch(True):
+            yield
+
+    @contextlib.contextmanager
+    def false_block(self):
+        with self._branch(False):
+            yield
+
+    @contextlib.contextmanager
+    def _branch(self, is_true: bool):
+        self._parent = self.program.current_block()
+        sub = self.program._create_block()
+        self._blocks[is_true] = sub
+        self._in_branch = is_true
+        try:
+            yield
+        finally:
+            self.program._rollback()
+            self._in_branch = None
+
+    def input(self, x: Variable) -> Variable:
+        """In the reference this gathers the branch's subset; here the full
+        batch flows through both branches (mask-merge at output)."""
+        return x
+
+    def output(self, *outs):
+        enforce(self._in_branch is not None,
+                "IfElse.output must be called inside a branch block",
+                exc=InvalidArgumentError)
+        self._outs[self._in_branch].extend(outs)
+
+    def __call__(self):
+        enforce(True in self._blocks and False in self._blocks,
+                "both true_block and false_block are required",
+                exc=InvalidArgumentError)
+        t_outs = self._outs[True]
+        f_outs = self._outs[False]
+        enforce(len(t_outs) == len(f_outs) and t_outs,
+                "branches must produce the same number of outputs",
+                exc=InvalidArgumentError)
+        t_reads, _ = _analyze_sub_block(self._blocks[True])
+        f_reads, _ = _analyze_sub_block(self._blocks[False])
+        captures = []
+        for n in t_reads + f_reads:
+            if n not in captures and self._parent.has_var(n):
+                captures.append(n)
+        merged = []
+        for tv in t_outs:
+            merged.append(self._parent.create_var(
+                shape=list(tv.shape), dtype=dtype_name(tv.dtype)))
+        self._parent.append_op(
+            type="cond_block",
+            inputs={"Cond": [self.cond.name], "Captures": captures},
+            outputs={"Out": [v.name for v in merged]},
+            attrs={"true_block": self._blocks[True].idx,
+                   "false_block": self._blocks[False].idx,
+                   "capture_names": captures,
+                   "true_out_names": [v.name for v in t_outs],
+                   "false_out_names": [v.name for v in f_outs]})
+        return merged  # always a list, like the reference IfElse()()
+
+
+def cond(pred: Variable, true_fn, false_fn):
+    """Functional scalar conditional (lax.cond — one branch executes).
+    true_fn/false_fn build ops and return a Variable (or list)."""
+    program = default_main_program()
+    parent = program.current_block()
+
+    def build(fn):
+        sub = program._create_block()
+        try:
+            out = fn()
+        finally:
+            program._rollback()
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return sub, list(outs)
+
+    t_sub, t_outs = build(true_fn)
+    f_sub, f_outs = build(false_fn)
+    enforce(len(t_outs) == len(f_outs),
+            "cond branches must return the same number of outputs",
+            exc=InvalidArgumentError)
+    t_reads, _ = _analyze_sub_block(t_sub)
+    f_reads, _ = _analyze_sub_block(f_sub)
+    captures = []
+    for n in t_reads + f_reads:
+        if n not in captures and parent.has_var(n):
+            captures.append(n)
+    merged = [parent.create_var(shape=list(tv.shape),
+                                dtype=dtype_name(tv.dtype))
+              for tv in t_outs]
+    parent.append_op(
+        type="lazy_cond",
+        inputs={"Cond": [pred.name], "Captures": captures},
+        outputs={"Out": [v.name for v in merged]},
+        attrs={"true_block": t_sub.idx, "false_block": f_sub.idx,
+               "capture_names": captures,
+               "true_out_names": [v.name for v in t_outs],
+               "false_out_names": [v.name for v in f_outs]})
+    return merged[0] if len(merged) == 1 else merged
+
+
+class Switch:
+    """≙ fluid.layers.Switch (reference control_flow.py:1286) — the lr
+    scheduler's piecewise construct. Each case block assigns a value to a
+    target variable; first true condition wins, default block otherwise."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.program = default_main_program()
+        self._conds: List[Variable] = []
+        self._case_blocks = []
+        self._case_out_names: List[str] = []
+        self._parent = None
+        self._target: Optional[Variable] = None
+
+    @contextlib.contextmanager
+    def case(self, condition: Variable):
+        self._conds.append(condition)
+        with self._case_ctx():
+            yield
+
+    @contextlib.contextmanager
+    def default(self):
+        with self._case_ctx():
+            yield
+
+    @contextlib.contextmanager
+    def _case_ctx(self):
+        if self._parent is None:
+            self._parent = self.program.current_block()
+        sub = self.program._create_block()
+        try:
+            yield
+        finally:
+            self.program._rollback()
+        enforce(sub.ops, "empty Switch case", exc=InvalidArgumentError)
+        last = sub.ops[-1]
+        out_names = last.output_names()
+        enforce(len(out_names) >= 1, "case block must produce a value",
+                exc=InvalidArgumentError)
+        self._case_blocks.append(sub)
+        self._case_out_names.append(out_names[0])
+        # target var: by convention all cases assign the same outer var
+        if self._target is None and self._parent.has_var(out_names[0]):
+            self._target = self._parent.var(out_names[0])
+
+    def finish(self, out: Optional[Variable] = None) -> Variable:
+        """Merge cases. If the cases assigned an outer var (reference
+        `assign` style) the merged value lands back in it."""
+        parent = self._parent
+        captures = []
+        for b in self._case_blocks:
+            reads, _ = _analyze_sub_block(b)
+            for n in reads:
+                if n not in captures and parent.has_var(n):
+                    captures.append(n)
+        target = out or self._target
+        inputs = {"Conds": [c.name for c in self._conds],
+                  "Captures": captures}
+        if target is None:
+            first = self._case_blocks[0]
+            proto = first.var(self._case_out_names[0])
+            target = parent.create_var(shape=list(proto.shape),
+                                      dtype=dtype_name(proto.dtype))
+        elif target.op is not None or target.is_data:
+            # no-default fallback: keep the target's pre-switch value
+            inputs["Prev"] = [target.name]
+        parent.append_op(
+            type="switch_case",
+            inputs=inputs,
+            outputs={"Out": [target.name]},
+            attrs={"case_blocks": [b.idx for b in self._case_blocks],
+                   "case_out_names": list(self._case_out_names),
+                   "capture_names": captures})
+        return target
+
+
+# ---- scalar/compare/step helper layers (≙ reference control_flow.py
+#      increment:?, less_than, array ops region :741-1148) ----------------
+
+def increment(x: Variable, value: float = 1.0, in_place: bool = False,
+              name: Optional[str] = None) -> Variable:
+    helper = LayerHelper("increment", name=name)
+    if in_place:
+        out = x
+    else:
+        out = helper.create_tmp_variable(dtype=dtype_name(x.dtype),
+                                         shape=x.shape)
+    helper.append_op(type="increment", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"step": float(value)})
+    return out
+
+
+def _compare(op_type, x, y, cond=None):
+    helper = LayerHelper(op_type)
+    if cond is None:
+        cond = helper.create_tmp_variable(dtype="bool", shape=x.shape,
+                                          stop_gradient=True)
+    helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [cond]})
+    return cond
+
+
+def less_than(x, y, cond=None):
+    return _compare("less_than", x, y, cond)
+
+
+def less_equal(x, y, cond=None):
+    return _compare("less_equal", x, y, cond)
+
+
+def greater_than(x, y, cond=None):
+    return _compare("greater_than", x, y, cond)
+
+
+def greater_equal(x, y, cond=None):
+    return _compare("greater_equal", x, y, cond)
+
+
+def equal(x, y, cond=None):
+    return _compare("equal", x, y, cond)
+
+
+def not_equal(x, y, cond=None):
+    return _compare("not_equal", x, y, cond)

@@ -756,3 +756,23 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
     helper.append_op(type="label_smooth", inputs=inputs,
                      outputs={"Out": [out]}, attrs={"epsilon": epsilon})
     return out
+
+
+def slice(input, axes, starts, ends, name=None):
+    """≙ reference slice_op.cc — static slice."""
+    helper = LayerHelper("slice", name=name)
+    out_shape = list(input.shape)
+    for ax, s, e in zip(axes, starts, ends):
+        if out_shape[ax] is not None and out_shape[ax] >= 0:
+            dim = out_shape[ax]
+            # python slice clamping semantics, matching the runtime x[s:e]
+            s2 = min(max(s if s >= 0 else dim + s, 0), dim)
+            e2 = min(max(e if e >= 0 else dim + e, 0), dim)
+            out_shape[ax] = max(e2 - s2, 0)
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=out_shape)
+    helper.append_op(type="slice", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"axes": list(axes), "starts": list(starts),
+                            "ends": list(ends)})
+    return out

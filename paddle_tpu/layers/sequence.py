@@ -1,0 +1,262 @@
+"""Sequence-family layer wrappers (≙ reference layers/nn.py sequence_* +
+dynamic_lstm:290 / dynamic_gru).
+
+A "sequence" variable here is a dense padded [B, T, ...] array plus a
+companion int32 length vector (the static-shape translation of the
+reference's LoD, see paddle_tpu/ops/sequence_ops.py). Layers locate the
+companion via ``var.seqlen_var`` (propagated through sequence layers) or the
+``<name>@SEQLEN`` block variable created by ``layers.data(lod_level>0)``.
+"""
+
+from __future__ import annotations
+
+from ..core.dtypes import dtype_name
+from ..core.enforce import InvalidArgumentError, NotFoundError, enforce
+from ..layer_helper import LayerHelper
+
+
+def get_seqlen(var):
+    """Resolve the companion sequence-length variable of a padded sequence."""
+    sl = getattr(var, "seqlen_var", None)
+    if sl is not None:
+        return sl
+    name = var.name + "@SEQLEN"
+    v = var.block.find_var_recursive(name) if hasattr(
+        var.block, "find_var_recursive") else var.block.vars.get(name)
+    enforce(v is not None,
+            f"variable {var.name!r} has no sequence-length companion; "
+            f"declare it with layers.data(..., lod_level=1) or propagate "
+            f"seqlen_var", exc=NotFoundError)
+    return v
+
+
+def tag_sequence(out, seqlen):
+    """Mark `out` as a sequence sharing `seqlen`. Returns out."""
+    out.seqlen_var = seqlen
+    return out
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None):
+    """≙ reference layers/nn.py:290 (dynamic_lstm). `input` is the
+    pre-projected [B, T, 4H] sequence (apply fc first, as the reference
+    requires); size = 4 * hidden. Returns (hidden, cell), both [B, T, H]
+    sequences."""
+    enforce(size % 4 == 0, "dynamic_lstm size must be 4*hidden",
+            exc=InvalidArgumentError)
+    helper = LayerHelper("dynamic_lstm", name=name)
+    hidden_size = size // 4
+    seqlen = get_seqlen(input)
+    weight = helper.create_parameter(param_attr,
+                                     shape=[hidden_size, 4 * hidden_size],
+                                     dtype=dtype)
+    bias = helper.create_parameter(
+        bias_attr, shape=[7 * hidden_size if use_peepholes
+                          else 4 * hidden_size],
+        dtype=dtype, is_bias=True)
+    b, t = input.shape[0], input.shape[1]
+    hidden = helper.create_tmp_variable(dtype=dtype,
+                                        shape=[b, t, hidden_size])
+    cell = helper.create_tmp_variable(dtype=dtype, shape=[b, t, hidden_size])
+    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias],
+              "SeqLen": [seqlen]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if c_0 is not None:
+        inputs["C0"] = [c_0]
+    helper.append_op(type="dynamic_lstm", inputs=inputs,
+                     outputs={"Hidden": [hidden], "Cell": [cell]},
+                     attrs={"use_peepholes": use_peepholes,
+                            "is_reverse": is_reverse,
+                            "gate_activation": gate_activation,
+                            "cell_activation": cell_activation,
+                            "candidate_activation": candidate_activation})
+    return tag_sequence(hidden, seqlen), tag_sequence(cell, seqlen)
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, name=None):
+    """≙ reference layers/nn.py dynamic_gru. `input` is pre-projected
+    [B, T, 3H]; size = hidden. Returns hidden sequence [B, T, H]."""
+    helper = LayerHelper("dynamic_gru", name=name)
+    seqlen = get_seqlen(input)
+    dtype = dtype_name(input.dtype)
+    weight = helper.create_parameter(param_attr, shape=[size, 3 * size],
+                                     dtype=dtype)
+    bias = helper.create_parameter(bias_attr, shape=[3 * size], dtype=dtype,
+                                   is_bias=True)
+    b, t = input.shape[0], input.shape[1]
+    hidden = helper.create_tmp_variable(dtype=dtype, shape=[b, t, size])
+    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias],
+              "SeqLen": [seqlen]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    helper.append_op(type="dynamic_gru", inputs=inputs,
+                     outputs={"Hidden": [hidden]},
+                     attrs={"is_reverse": is_reverse,
+                            "gate_activation": gate_activation,
+                            "activation": candidate_activation})
+    return tag_sequence(hidden, seqlen)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, bias_attr=None, param_attr=None, act=None,
+                  name=None):
+    """≙ reference layers/nn.py sequence_conv (context-window conv)."""
+    helper = LayerHelper("sequence_conv", name=name, act=act,
+                         bias_attr=bias_attr)
+    seqlen = get_seqlen(input)
+    dtype = dtype_name(input.dtype)
+    d = input.shape[-1]
+    filter_shape = [filter_size * d, num_filters]
+    filter_param = helper.create_parameter(param_attr, shape=filter_shape,
+                                           dtype=dtype)
+    b, t = input.shape[0], input.shape[1]
+    out = helper.create_tmp_variable(dtype=dtype, shape=[b, t, num_filters])
+    helper.append_op(type="sequence_conv",
+                     inputs={"X": [input], "Filter": [filter_param],
+                             "SeqLen": [seqlen]},
+                     outputs={"Out": [out]},
+                     attrs={"contextLength": filter_size,
+                            "contextStart": -(filter_size // 2),
+                            "contextStride": filter_stride})
+    out = helper.append_bias_op(out)
+    return tag_sequence(helper.append_activation(out), seqlen)
+
+
+def sequence_pool(input, pool_type="average", name=None):
+    """≙ reference layers/nn.py sequence_pool. Pools [B, T, D] -> [B, D]
+    over valid timesteps."""
+    helper = LayerHelper("sequence_pool", name=name)
+    seqlen = get_seqlen(input)
+    dtype = dtype_name(input.dtype)
+    out_shape = [input.shape[0]] + list(input.shape[2:])
+    out = helper.create_tmp_variable(dtype=dtype, shape=out_shape)
+    helper.append_op(type="sequence_pool",
+                     inputs={"X": [input], "SeqLen": [seqlen]},
+                     outputs={"Out": [out]},
+                     attrs={"pooltype": pool_type.upper()})
+    return out
+
+
+def sequence_softmax(input, name=None):
+    helper = LayerHelper("sequence_softmax", name=name)
+    seqlen = get_seqlen(input)
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=input.shape)
+    helper.append_op(type="sequence_softmax",
+                     inputs={"X": [input], "SeqLen": [seqlen]},
+                     outputs={"Out": [out]})
+    return tag_sequence(out, seqlen)
+
+
+def sequence_first_step(input, name=None):
+    helper = LayerHelper("sequence_first_step", name=name)
+    seqlen = get_seqlen(input)
+    out_shape = [input.shape[0]] + list(input.shape[2:])
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=out_shape)
+    helper.append_op(type="sequence_first_step",
+                     inputs={"X": [input], "SeqLen": [seqlen]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_last_step(input, name=None):
+    helper = LayerHelper("sequence_last_step", name=name)
+    seqlen = get_seqlen(input)
+    out_shape = [input.shape[0]] + list(input.shape[2:])
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=out_shape)
+    helper.append_op(type="sequence_last_step",
+                     inputs={"X": [input], "SeqLen": [seqlen]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_reverse(x, name=None):
+    helper = LayerHelper("sequence_reverse", name=name)
+    seqlen = get_seqlen(x)
+    out = helper.create_tmp_variable(dtype=dtype_name(x.dtype), shape=x.shape)
+    helper.append_op(type="sequence_reverse",
+                     inputs={"X": [x], "SeqLen": [seqlen]},
+                     outputs={"Y": [out]})
+    return tag_sequence(out, seqlen)
+
+
+def sequence_expand(x, y, name=None):
+    """Broadcast per-sequence vector x [B, D] over y's time dim."""
+    helper = LayerHelper("sequence_expand", name=name)
+    seqlen = get_seqlen(y)
+    out = helper.create_tmp_variable(
+        dtype=dtype_name(x.dtype),
+        shape=[x.shape[0], y.shape[1]] + list(x.shape[1:]))
+    helper.append_op(type="sequence_expand",
+                     inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]})
+    return tag_sequence(out, seqlen)
+
+
+def sequence_concat(input, name=None):
+    """Concatenate sequences along the feature dim."""
+    helper = LayerHelper("sequence_concat", name=name)
+    xs = input if isinstance(input, (list, tuple)) else [input]
+    seqlen = get_seqlen(xs[0])
+    feat = sum(x.shape[-1] for x in xs)
+    out = helper.create_tmp_variable(dtype=dtype_name(xs[0].dtype),
+                                     shape=list(xs[0].shape[:-1]) + [feat])
+    helper.append_op(type="sequence_concat", inputs={"X": list(xs)},
+                     outputs={"Out": [out]})
+    return tag_sequence(out, seqlen)
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice", name=name)
+    seqlen = get_seqlen(input)
+    out = helper.create_tmp_variable(
+        dtype=dtype_name(input.dtype),
+        shape=[input.shape[0], int(length)] + list(input.shape[2:]))
+    helper.append_op(type="sequence_slice",
+                     inputs={"X": [input], "Offset": [offset]},
+                     outputs={"Out": [out]}, attrs={"length": int(length)})
+    return tag_sequence(out, seqlen)
+
+
+def sequence_pad(x, pad_value=None, maxlen=None, name=None):
+    """Already-padded representation: identity + lengths (API parity with
+    reference sequence_pad)."""
+    helper = LayerHelper("sequence_pad", name=name)
+    seqlen = get_seqlen(x)
+    out = helper.create_tmp_variable(dtype=dtype_name(x.dtype), shape=x.shape)
+    length = helper.create_tmp_variable(dtype="int32",
+                                        shape=[x.shape[0]])
+    helper.append_op(type="sequence_pad",
+                     inputs={"X": [x], "SeqLen": [seqlen]},
+                     outputs={"Out": [out], "Length": [length]})
+    return out, length
+
+
+def sequence_erase(input, tokens, name=None):
+    helper = LayerHelper("sequence_erase", name=name)
+    seqlen = get_seqlen(input)
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=input.shape)
+    mask = helper.create_tmp_variable(dtype="int32", shape=input.shape)
+    helper.append_op(type="sequence_erase",
+                     inputs={"X": [input]},
+                     outputs={"Out": [out], "Mask": [mask]},
+                     attrs={"tokens": list(tokens)})
+    return tag_sequence(out, seqlen)
+
+
+def sequence_mask(x, maxlen, dtype="float32", name=None):
+    """[B] lengths -> [B, maxlen] 0/1 mask (≙ reference sequence_mask).
+    maxlen must be static on TPU."""
+    helper = LayerHelper("sequence_mask", name=name)
+    out = helper.create_tmp_variable(dtype=dtype,
+                                     shape=[x.shape[0], int(maxlen)])
+    helper.append_op(type="sequence_mask", inputs={"X": [x]},
+                     outputs={"Y": [out]}, attrs={"maxlen": int(maxlen)})
+    return out

@@ -1,0 +1,11 @@
+"""Model zoo mirroring the reference's benchmark models
+(≙ reference benchmark/fluid/models/: mnist, resnet, vgg,
+stacked_dynamic_lstm, machine_translation) plus the CTR model family the
+pserver/sparse path served (DeepFM — driver config #5).
+
+Each builder appends to the default main/startup programs via the layers API
+and returns the loss (and aux outputs), exactly as the reference model files
+build programs for fluid_benchmark.py.
+"""
+
+from . import deepfm, mnist, resnet, stacked_lstm, transformer, vgg  # noqa: F401

@@ -1,0 +1,85 @@
+"""DeepFM / Wide&Deep CTR models (driver config #5 — the capability slot of
+the reference's sparse/pserver path: distributed lookup table +
+SelectedRows-style sparse embedding, reference lookup_table_op.cc:21 with
+is_sparse/is_distributed and transpiler distributed_lookup_table).
+
+TPU-first: the embedding table is a dense shardable array; at scale it is
+sharded over the mesh via paddle_tpu.parallel.sharded_embedding (all-to-all
+gather — the EP analogue)."""
+
+from __future__ import annotations
+
+from .. import layers
+
+
+def deepfm(feat_ids=None, feat_vals=None, label=None, num_fields=39,
+           vocab_size=100000, embed_dim=16, fc_sizes=(400, 400, 400)):
+    """DeepFM: linear term + FM second-order term + DNN over concatenated
+    field embeddings.
+
+    feat_ids: [B, num_fields] int64; feat_vals: [B, num_fields] float32;
+    label: [B, 1] float32 in {0, 1}.
+    """
+    if feat_ids is None:
+        feat_ids = layers.data(name="feat_ids", shape=[num_fields],
+                               dtype="int64")
+    if feat_vals is None:
+        feat_vals = layers.data(name="feat_vals", shape=[num_fields])
+    if label is None:
+        label = layers.data(name="label", shape=[1])
+
+    # first-order: per-feature scalar weight
+    w1 = layers.embedding(input=feat_ids, size=[vocab_size, 1])       # [B,F,1]
+    vals3 = layers.unsqueeze(feat_vals, axes=[2])                     # [B,F,1]
+    first = layers.reduce_sum(layers.elementwise_mul(w1, vals3), dim=[1])
+
+    # second-order FM: 0.5 * ((sum v)^2 - sum v^2)
+    emb = layers.embedding(input=feat_ids, size=[vocab_size, embed_dim])
+    emb = layers.elementwise_mul(emb, vals3)                          # [B,F,E]
+    sum_v = layers.reduce_sum(emb, dim=[1])                           # [B,E]
+    sum_sq = layers.elementwise_mul(sum_v, sum_v)
+    sq_sum = layers.reduce_sum(layers.elementwise_mul(emb, emb), dim=[1])
+    fm = layers.scale(layers.reduce_sum(
+        layers.elementwise_sub(sum_sq, sq_sum), dim=[1], keep_dim=True),
+        scale=0.5)
+
+    # deep part
+    b, f = feat_ids.shape[0], num_fields
+    deep = layers.reshape(emb, shape=[b, f * embed_dim])
+    for size in fc_sizes:
+        deep = layers.fc(deep, size=size, act="relu")
+    deep_out = layers.fc(deep, size=1)
+
+    logit = layers.elementwise_add(layers.elementwise_add(first, fm),
+                                   deep_out)
+    loss_vec = layers.sigmoid_cross_entropy_with_logits(logit, label)
+    loss = layers.mean(loss_vec)
+    pred = layers.sigmoid(logit)
+    return loss, pred
+
+
+def wide_and_deep(wide_ids=None, deep_ids=None, label=None, wide_fields=10,
+                  deep_fields=26, wide_vocab=100000, deep_vocab=100000,
+                  embed_dim=8, fc_sizes=(256, 128)):
+    """Wide&Deep: linear wide part over sparse ids + DNN over embeddings."""
+    if wide_ids is None:
+        wide_ids = layers.data(name="wide_ids", shape=[wide_fields],
+                               dtype="int64")
+    if deep_ids is None:
+        deep_ids = layers.data(name="deep_ids", shape=[deep_fields],
+                               dtype="int64")
+    if label is None:
+        label = layers.data(name="label", shape=[1])
+    wide_w = layers.embedding(input=wide_ids, size=[wide_vocab, 1])
+    wide_out = layers.reduce_sum(wide_w, dim=[1])
+    emb = layers.embedding(input=deep_ids, size=[deep_vocab, embed_dim])
+    b = deep_ids.shape[0]
+    deep = layers.reshape(emb, shape=[b, deep_fields * embed_dim])
+    for size in fc_sizes:
+        deep = layers.fc(deep, size=size, act="relu")
+    deep_out = layers.fc(deep, size=1)
+    logit = layers.elementwise_add(wide_out, deep_out)
+    loss = layers.mean(
+        layers.sigmoid_cross_entropy_with_logits(logit, label))
+    pred = layers.sigmoid(logit)
+    return loss, pred

@@ -1,0 +1,198 @@
+"""Transformer NMT (≙ reference benchmark/fluid/models/machine_translation.py
+capability slot + nets.py:332 scaled_dot_product_attention — driver config #4).
+
+The reference era predates a full in-repo Transformer; its attention exists
+only as the composite in nets.py. Here the full encoder-decoder is first-class
+because it is the TPU flagship: bf16 matmuls on the MXU, static shapes, and
+parallelism-friendly structure (qkv/ffn weights laid out for tp sharding, the
+sequence dim for sp/ring attention, batch for dp — see
+paddle_tpu/parallel/tensor_parallel.py and __graft_entry__.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers
+from ..initializer import NormalInitializer
+from ..param_attr import ParamAttr
+
+
+def positional_encoding_table(max_len, d_model):
+    pos = np.arange(max_len)[:, None].astype("float32")
+    i = np.arange(d_model)[None, :].astype("float32")
+    angle = pos / np.power(10000.0, 2 * (i // 2) / d_model)
+    table = np.zeros((max_len, d_model), dtype="float32")
+    table[:, 0::2] = np.sin(angle[:, 0::2])
+    table[:, 1::2] = np.cos(angle[:, 1::2])
+    return table
+
+
+def multi_head_attention(q_in, k_in, v_in, d_model, num_heads, dropout=0.0,
+                         is_test=False, causal=False, name=None):
+    """Multi-head attention with explicit head split (≙ nets.py:332 composite
+    generalized with masking). All projections are single fused matmuls so
+    XLA maps them onto the MXU as large GEMMs; head dim stays last for lane
+    alignment."""
+    b, t_q = q_in.shape[0], q_in.shape[1]
+    t_k = k_in.shape[1]
+    d_head = d_model // num_heads
+    q = layers.fc(q_in, size=d_model, num_flatten_dims=2, bias_attr=False,
+                  use_bf16=True, name=name and name + "_q")
+    k = layers.fc(k_in, size=d_model, num_flatten_dims=2, bias_attr=False,
+                  use_bf16=True, name=name and name + "_k")
+    v = layers.fc(v_in, size=d_model, num_flatten_dims=2, bias_attr=False,
+                  use_bf16=True, name=name and name + "_v")
+
+    def split_heads(x, t):
+        x = layers.reshape(x, shape=[b, t, num_heads, d_head])
+        return layers.transpose(x, perm=[0, 2, 1, 3])
+
+    q = split_heads(q, t_q)
+    k = split_heads(k, t_k)
+    v = split_heads(v, t_k)
+    q = layers.scale(q, scale=float(d_head) ** -0.5)
+    scores = layers.matmul(q, k, transpose_y=True, use_bf16=True)
+    if causal:
+        mask_np = np.triu(np.full((t_q, t_k), -1e9, dtype="float32"), k=1)
+        mask = layers.assign(mask_np.reshape(1, 1, t_q, t_k))
+        scores = layers.elementwise_add(scores, mask)
+    weights = layers.softmax(scores)
+    if dropout:
+        weights = layers.dropout(weights, dropout_prob=dropout,
+                                 is_test=is_test)
+    ctx = layers.matmul(weights, v, use_bf16=True)
+    ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
+    ctx = layers.reshape(ctx, shape=[b, t_q, d_model])
+    return layers.fc(ctx, size=d_model, num_flatten_dims=2, bias_attr=False,
+                     use_bf16=True, name=name and name + "_o")
+
+
+def ffn(x, d_model, d_inner, dropout=0.0, is_test=False, name=None):
+    h = layers.fc(x, size=d_inner, num_flatten_dims=2, act="relu",
+                  use_bf16=True, name=name and name + "_fc1")
+    if dropout:
+        h = layers.dropout(h, dropout_prob=dropout, is_test=is_test)
+    return layers.fc(h, size=d_model, num_flatten_dims=2, use_bf16=True,
+                     name=name and name + "_fc2")
+
+
+def _add_norm(x, residual, dropout=0.0, is_test=False):
+    if dropout:
+        x = layers.dropout(x, dropout_prob=dropout, is_test=is_test)
+    return layers.layer_norm(layers.elementwise_add(x, residual),
+                             begin_norm_axis=2)
+
+
+def encoder_layer(x, d_model, num_heads, d_inner, dropout, is_test, name):
+    attn = multi_head_attention(x, x, x, d_model, num_heads, dropout,
+                                is_test, name=name + "_attn")
+    x = _add_norm(attn, x, dropout, is_test)
+    f = ffn(x, d_model, d_inner, dropout, is_test, name=name + "_ffn")
+    return _add_norm(f, x, dropout, is_test)
+
+
+def decoder_layer(x, enc_out, d_model, num_heads, d_inner, dropout, is_test,
+                  name):
+    self_attn = multi_head_attention(x, x, x, d_model, num_heads, dropout,
+                                     is_test, causal=True,
+                                     name=name + "_self")
+    x = _add_norm(self_attn, x, dropout, is_test)
+    cross = multi_head_attention(x, enc_out, enc_out, d_model, num_heads,
+                                 dropout, is_test, name=name + "_cross")
+    x = _add_norm(cross, x, dropout, is_test)
+    f = ffn(x, d_model, d_inner, dropout, is_test, name=name + "_ffn")
+    return _add_norm(f, x, dropout, is_test)
+
+
+def _embed(tokens, vocab_size, d_model, max_len, name):
+    emb = layers.embedding(
+        input=tokens, size=[vocab_size, d_model],
+        param_attr=ParamAttr(name=name + "_emb",
+                             initializer=NormalInitializer(0., d_model ** -0.5)))
+    emb = layers.scale(emb, scale=float(d_model) ** 0.5)
+    pos = layers.assign(
+        positional_encoding_table(max_len, d_model)[None, :, :])
+    return layers.elementwise_add(emb, pos)
+
+
+def transformer(src=None, tgt=None, label=None, src_vocab=30000,
+                tgt_vocab=30000, max_len=64, d_model=512, d_inner=2048,
+                num_heads=8, num_layers=6, dropout=0.1, is_test=False,
+                label_smooth=0.1):
+    """Transformer-base encoder-decoder; returns (loss, logits).
+
+    src/tgt: [B, T] int64 padded token ids (lod_level=1 data vars with
+    companion lengths); label: [B, T] next-token targets.
+    """
+    if src is None:
+        src = layers.data(name="src", shape=[max_len], dtype="int64",
+                          lod_level=1)
+    if tgt is None:
+        tgt = layers.data(name="tgt", shape=[max_len], dtype="int64",
+                          lod_level=1)
+    if label is None:
+        label = layers.data(name="lbl", shape=[max_len], dtype="int64")
+    src_len = layers.sequence.get_seqlen(src)
+    tgt_len = layers.sequence.get_seqlen(tgt)
+
+    enc = _embed(src, src_vocab, d_model, max_len, "src")
+    if dropout:
+        enc = layers.dropout(enc, dropout_prob=dropout, is_test=is_test)
+    for i in range(num_layers):
+        enc = encoder_layer(enc, d_model, num_heads, d_inner, dropout,
+                            is_test, f"enc{i}")
+
+    dec = _embed(tgt, tgt_vocab, d_model, max_len, "tgt")
+    if dropout:
+        dec = layers.dropout(dec, dropout_prob=dropout, is_test=is_test)
+    for i in range(num_layers):
+        dec = decoder_layer(dec, enc, d_model, num_heads, d_inner, dropout,
+                            is_test, f"dec{i}")
+
+    logits = layers.fc(dec, size=tgt_vocab, num_flatten_dims=2,
+                       use_bf16=True, name="proj")
+    label3 = layers.unsqueeze(label, axes=[2])
+    if label_smooth:
+        oh = layers.one_hot(label3, depth=tgt_vocab)
+        soft = layers.label_smooth(oh, epsilon=label_smooth)
+        token_loss = layers.softmax_with_cross_entropy(logits, soft,
+                                                       soft_label=True)
+    else:
+        token_loss = layers.softmax_with_cross_entropy(logits, label3)
+    mask = layers.sequence_mask(tgt_len, maxlen=max_len)
+    mask = layers.unsqueeze(mask, axes=[2])
+    masked = layers.elementwise_mul(token_loss, mask)
+    loss = layers.reduce_sum(masked) / layers.reduce_sum(mask)
+    return loss, logits
+
+
+def transformer_lm(tokens=None, label=None, vocab=32000, max_len=128,
+                   d_model=512, d_inner=2048, num_heads=8, num_layers=6,
+                   dropout=0.0, is_test=False):
+    """Decoder-only causal LM — the flagship config used by
+    __graft_entry__ (simplest shape that exercises dp/tp/sp sharding)."""
+    if tokens is None:
+        tokens = layers.data(name="tokens", shape=[max_len], dtype="int64",
+                             lod_level=1)
+    if label is None:
+        label = layers.data(name="targets", shape=[max_len], dtype="int64")
+    seqlen = layers.sequence.get_seqlen(tokens)
+    x = _embed(tokens, vocab, d_model, max_len, "tok")
+    if dropout:
+        x = layers.dropout(x, dropout_prob=dropout, is_test=is_test)
+    for i in range(num_layers):
+        attn = multi_head_attention(x, x, x, d_model, num_heads, dropout,
+                                    is_test, causal=True, name=f"l{i}_attn")
+        x = _add_norm(attn, x, dropout, is_test)
+        f = ffn(x, d_model, d_inner, dropout, is_test, name=f"l{i}_ffn")
+        x = _add_norm(f, x, dropout, is_test)
+    logits = layers.fc(x, size=vocab, num_flatten_dims=2, use_bf16=True,
+                       name="lm_head")
+    label3 = layers.unsqueeze(label, axes=[2])
+    token_loss = layers.softmax_with_cross_entropy(logits, label3)
+    mask = layers.sequence_mask(seqlen, maxlen=max_len)
+    mask = layers.unsqueeze(mask, axes=[2])
+    masked = layers.elementwise_mul(token_loss, mask)
+    loss = layers.reduce_sum(masked) / layers.reduce_sum(mask)
+    return loss, logits

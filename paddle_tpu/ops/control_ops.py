@@ -60,3 +60,195 @@ def _fake_quantize_moving_avg(ctx, ins, attrs):
     q = jnp.round(x * inv_s) / inv_s
     out = x + jax.lax.stop_gradient(q - x)
     return {"Out": [out], "OutScale": [s]}
+
+
+# ---- structured control flow over sub-blocks ------------------------------
+# Sub-blocks are real program blocks (≙ the BLOCK attr type in the reference
+# proto, framework.proto:35); lowering runs their plan inside lax control-flow
+# primitives instead of a sub-Executor (reference while_op.cc:36,
+# conditional_block_op.cc, recurrent_op.cc:222).
+
+def _sub_block_plan(ctx, attrs, key="sub_block"):
+    from ..framework.lowering import build_plan
+    program = ctx.extras.get("program")
+    if program is None:
+        raise RuntimeError(
+            "control-flow op needs LowerCtx.extras['program'] (set by the "
+            "executor); direct op invocation cannot resolve sub-blocks")
+    block = program.blocks[attrs[key]]
+    return block, build_plan(block)
+
+
+@register_op("while")
+def _while(ctx, ins, attrs):
+    """≙ while_op.cc:36. Forward-only on TPU: lax.while_loop is not
+    reverse-differentiable — use StaticRNN/DynamicRNN (lax.scan) for
+    differentiable recurrences."""
+    from ..framework.lowering import run_plan
+    block, plan = _sub_block_plan(ctx, attrs)
+    carry_names = list(attrs["carry_names"])
+    capture_names = list(attrs["capture_names"])
+    cond_name = attrs["cond_name"]
+    cond_idx = carry_names.index(cond_name)
+    captures = dict(zip(capture_names, ins.get("Captures", [])))
+
+    def cond_fn(carry):
+        return jnp.reshape(carry[cond_idx], ()).astype(bool)
+
+    def body_fn(carry):
+        env = dict(captures)
+        env.update(zip(carry_names, carry))
+        run_plan(plan, env, block, ctx)
+        return tuple(env[n] for n in carry_names)
+
+    out = jax.lax.while_loop(cond_fn, body_fn, tuple(ins["Carry"]))
+    return {"Out": list(out)}
+
+
+@register_op("static_rnn")
+def _static_rnn(ctx, ins, attrs):
+    """≙ recurrent_op.cc:222 (StaticRNN) — lax.scan over the time dim.
+    Fully differentiable; XLA unrolls/fuses the step body.
+
+    With `seq_lens` provided (DynamicRNN), memories freeze and outputs
+    zero-mask past each sequence's length (≙ shrink_rnn_memory +
+    lod_rank_table machinery, reference layers/control_flow.py:741-1148)."""
+    from ..framework.lowering import run_plan
+    block, plan = _sub_block_plan(ctx, attrs)
+    step_in_names = list(attrs["step_input_names"])
+    pre_names = list(attrs["pre_mem_names"])
+    new_names = list(attrs["new_mem_names"])
+    out_names = list(attrs["step_output_names"])
+    capture_names = list(attrs["capture_names"])
+    reverse = attrs.get("is_reverse", False)
+    captures = dict(zip(capture_names, ins.get("Captures", [])))
+    init_mems = tuple(ins.get("InitMems", []))
+    step_inputs = [jnp.swapaxes(x, 0, 1) for x in ins["StepInputs"]]
+    t = step_inputs[0].shape[0]
+    seq_lens = ins.get("SeqLens", [None])[0]
+    if reverse:
+        step_inputs = [jnp.flip(x, axis=0) for x in step_inputs]
+
+    def body(carry, xt_and_t):
+        xts, tpos = xt_and_t
+        env = dict(captures)
+        env.update(zip(pre_names, carry))
+        env.update(zip(step_in_names, xts))
+        run_plan(plan, env, block, ctx)
+        new_carry = tuple(env[n] for n in new_names)
+        if seq_lens is not None:
+            pos = (t - 1 - tpos) if reverse else tpos
+            valid = pos < seq_lens  # [B]
+            def keep(new, old):
+                v = valid.reshape((-1,) + (1,) * (new.ndim - 1))
+                return jnp.where(v, new, old)
+            new_carry = tuple(keep(n, o) for n, o in zip(new_carry, carry))
+        outs = tuple(env[n] for n in out_names)
+        if seq_lens is not None:
+            pos = (t - 1 - tpos) if reverse else tpos
+            valid = pos < seq_lens
+            outs = tuple(o * valid.reshape(
+                (-1,) + (1,) * (o.ndim - 1)).astype(o.dtype) for o in outs)
+        return new_carry, outs
+
+    final, ys = jax.lax.scan(body, init_mems,
+                             (tuple(step_inputs), jnp.arange(t)))
+    ys = [jnp.swapaxes(y, 0, 1) for y in ys]
+    if reverse:
+        ys = [jnp.flip(y, axis=1) for y in ys]
+    return {"Out": ys, "FinalMems": list(final)}
+
+
+@register_op("cond_block")
+def _cond_block(ctx, ins, attrs):
+    """Batched IfElse (≙ conditional_block_op.cc + layers IfElse:1412).
+    TPU-first translation: the reference gathers the true/false subsets of
+    the batch and runs each branch on its subset (dynamic shapes); here BOTH
+    branches run on the full batch and outputs merge by jnp.where mask —
+    static shapes, XLA-friendly, differentiable."""
+    from ..framework.lowering import run_plan
+    cond = ins["Cond"][0]
+    t_block, t_plan = _sub_block_plan(ctx, attrs, "true_block")
+    f_block, f_plan = _sub_block_plan(ctx, attrs, "false_block")
+    captures = dict(zip(attrs["capture_names"], ins.get("Captures", [])))
+    t_names = list(attrs["true_out_names"])
+    f_names = list(attrs["false_out_names"])
+
+    env_t = dict(captures)
+    run_plan(t_plan, env_t, t_block, ctx)
+    env_f = dict(captures)
+    run_plan(f_plan, env_f, f_block, ctx)
+    outs = []
+    for tn, fn in zip(t_names, f_names):
+        tv, fv = env_t[tn], env_f[fn]
+        c = cond
+        if c.ndim < tv.ndim:
+            c = c.reshape(c.shape + (1,) * (tv.ndim - c.ndim))
+        elif c.ndim > tv.ndim:
+            # [B, 1] cond vs rank-1 [B] branch output: drop trailing
+            # singleton dims so where() broadcasts per-row, not [B, B]
+            while c.ndim > tv.ndim and c.shape[-1] == 1:
+                c = c.reshape(c.shape[:-1])
+        outs.append(jnp.where(c, tv, fv))
+    return {"Out": outs}
+
+
+@register_op("lazy_cond")
+def _lazy_cond(ctx, ins, attrs):
+    """Scalar-predicate conditional via lax.cond — only ONE branch executes
+    (≙ the functional `layers.cond`). Differentiable."""
+    from ..framework.lowering import run_plan
+    pred = jnp.reshape(ins["Cond"][0], ()).astype(bool)
+    t_block, t_plan = _sub_block_plan(ctx, attrs, "true_block")
+    f_block, f_plan = _sub_block_plan(ctx, attrs, "false_block")
+    captures = tuple(ins.get("Captures", []))
+    capture_names = list(attrs["capture_names"])
+    t_names = list(attrs["true_out_names"])
+    f_names = list(attrs["false_out_names"])
+
+    def t_fn(caps):
+        env = dict(zip(capture_names, caps))
+        run_plan(t_plan, env, t_block, ctx)
+        return tuple(env[n] for n in t_names)
+
+    def f_fn(caps):
+        env = dict(zip(capture_names, caps))
+        run_plan(f_plan, env, f_block, ctx)
+        return tuple(env[n] for n in f_names)
+
+    outs = jax.lax.cond(pred, t_fn, f_fn, captures)
+    return {"Out": list(outs)}
+
+
+@register_op("switch_case")
+def _switch_case(ctx, ins, attrs):
+    """≙ layers.Switch (reference control_flow.py:1286): first case whose
+    scalar condition holds wins; the default block runs otherwise. All case
+    blocks execute (they are tiny — lr schedules); selection is a chain of
+    jnp.where."""
+    from ..framework.lowering import run_plan
+    conds = ins["Conds"]  # scalar bools, one per case
+    captures = dict(zip(attrs["capture_names"], ins.get("Captures", [])))
+    case_blocks = attrs["case_blocks"]
+    case_out_names = attrs["case_out_names"]
+
+    vals = []
+    for bidx, out_name in zip(case_blocks, case_out_names):
+        block, plan = _sub_block_plan(ctx, {"sub_block": bidx})
+        env = dict(captures)
+        run_plan(plan, env, block, ctx)
+        vals.append(env[out_name])
+
+    # default = last entry when len(case_blocks) == len(conds) + 1; with no
+    # default block the target keeps its pre-switch value (reference Switch
+    # semantics: the assigned var is simply left untouched)
+    if len(vals) > len(conds):
+        result = vals[-1]
+    elif ins.get("Prev"):
+        result = ins["Prev"][0]
+    else:
+        result = jnp.zeros_like(vals[0])
+    for c, v in zip(reversed(conds), reversed(vals[:len(conds)])):
+        pred = jnp.reshape(c, ()).astype(bool)
+        result = jnp.where(pred, v, result)
+    return {"Out": [result]}

@@ -79,11 +79,14 @@ def _conv2d(ctx, ins, attrs):
         w = jnp.transpose(w, (2, 3, 1, 0))
     padding = [(p, p) for p in pads]
     x, w = _maybe_bf16(x, attrs), _maybe_bf16(w, attrs)
+    # No preferred_element_type here: a f32-upcast output makes the conv vjp
+    # see a f32 cotangent against bf16 operands, which lax.conv rejects. The
+    # MXU accumulates bf16 convs in fp32 internally regardless; the explicit
+    # astype below restores the program dtype.
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=strides, padding=padding,
         rhs_dilation=dilations, dimension_numbers=dn,
-        feature_group_count=groups,
-        preferred_element_type=jnp.float32)
+        feature_group_count=groups)
     return {"Output": [out.astype(ins["Input"][0].dtype)]}
 
 

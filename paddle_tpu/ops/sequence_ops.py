@@ -292,3 +292,31 @@ def _edit_distance(ctx, ins, attrs):
         dist = dist / jnp.maximum(ref_len.astype(jnp.float32), 1)
     return {"Out": [dist[:, None]],
             "SequenceNum": [jnp.asarray(b, dtype=jnp.int64)]}
+
+
+@register_op("sequence_conv")
+def _sequence_conv(ctx, ins, attrs):
+    """Context-window convolution over time (≙ sequence_conv_op.cc +
+    operators/math/context_project.h): for each timestep gather a
+    [context_length] window of features, flatten, matmul with the filter
+    [context_length * D, num_filters]. Out-of-sequence context rows are zero.
+    """
+    x = ins["X"][0]              # [B, T, D]
+    w = ins["Filter"][0]         # [ctx_len * D, M]
+    seqlen = ins["SeqLen"][0]
+    b, t, d = x.shape
+    ctx_len = int(attrs.get("contextLength", 3))
+    ctx_start = int(attrs.get("contextStart", -(ctx_len // 2)))
+    m = _mask(x, seqlen).astype(x.dtype)
+    xm = x * m
+    cols = []
+    for k in range(ctx_len):
+        off = ctx_start + k
+        shifted = jnp.roll(xm, -off, axis=1)
+        ar = jnp.arange(t)
+        valid = ((ar + off >= 0) & (ar + off < t))[None, :, None]
+        cols.append(jnp.where(valid, shifted, 0))
+    ctx_mat = jnp.concatenate(cols, axis=-1)       # [B, T, ctx_len*D]
+    out = jnp.matmul(ctx_mat, w, preferred_element_type=jnp.float32)
+    out = out.astype(x.dtype) * m
+    return {"Out": [out]}

@@ -240,7 +240,10 @@ def _lookup_table(ctx, ins, attrs):
 
 @register_op("increment")
 def _increment(ctx, ins, attrs):
-    return {"Out": [ins["X"][0] + attrs.get("step", 1.0)]}
+    x = ins["X"][0]
+    # keep x's dtype: int counters must not promote to float (the carry of a
+    # lax.while_loop requires stable dtypes)
+    return {"Out": [x + jnp.asarray(attrs.get("step", 1.0), x.dtype)]}
 
 
 @register_op("print", stop_gradient=True)

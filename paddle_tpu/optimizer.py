@@ -70,6 +70,11 @@ class Optimizer:
         # marker consumed by ParallelExecutor's Reduce (ZeRO-1) strategy:
         # optimizer state may be sharded across the data axis.
         var.is_optimizer_state = True
+        # same-shaped accumulators of a TP/EP-sharded parameter live with
+        # the same layout as the parameter.
+        pspec = getattr(param, "sharding_spec", None)
+        if pspec is not None and list(shape) == list(param.shape):
+            var.sharding_spec = pspec
         sb = default_startup_program().global_block()
         sv = sb.create_var(name=var_name, shape=shape, dtype=dtype,
                            persistable=True)
@@ -95,11 +100,16 @@ class Optimizer:
     def _create_optimization_pass(self, params_grads, loss,
                                   startup_program=None):
         block = loss.block
+        start = len(block.ops)
         self._create_global_learning_rate()
         self._create_accumulators(block, [p for p, _ in params_grads])
         for pg in params_grads:
             self._append_optimize_op(block, pg)
         self._finish_update(block, params_grads)
+        # role marker (≙ OpRole::kOptimize, reference op_proto_maker.h:25-31):
+        # lets clone(for_test)/prune strip the update ops for inference.
+        for op in block.ops[start:]:
+            op.attrs.setdefault("op_role", "optimize")
         return []
 
     def minimize(self, loss: Variable, startup_program: Optional[Program] = None,

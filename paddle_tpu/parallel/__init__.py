@@ -20,3 +20,5 @@ from . import tensor_parallel  # noqa: F401
 from . import pipeline  # noqa: F401
 from . import ring_attention  # noqa: F401
 from . import sharded_embedding  # noqa: F401
+from . import auto_shard  # noqa: F401
+from .auto_shard import annotate_tp  # noqa: F401

@@ -32,7 +32,7 @@ from ..core.enforce import InvalidArgumentError, enforce
 from ..framework.executor import Executor
 from ..framework.program import Program, Variable, default_main_program
 from ..framework.scope import Scope, global_scope
-from .mesh import DATA_AXIS, DeviceMesh, get_default_mesh
+from .mesh import DATA_AXIS, SEQUENCE_AXIS, DeviceMesh, get_default_mesh
 from .strategy import (BuildStrategy, ExecutionStrategy,
                        GradientScaleStrategy, ReduceStrategy)
 
@@ -79,6 +79,12 @@ class ParallelExecutor(Executor):
 
     def _state_sharding(self, program: Program, name: str) -> NamedSharding:
         v = self._find_var(program, name)
+        spec = getattr(v, "sharding_spec", None) if v is not None else None
+        if spec is not None:
+            # explicit TP/EP placement from ParamAttr(sharding_spec=...) or
+            # parallel.auto_shard annotation; mesh.sharding drops axis names
+            # not present in this mesh (replicated there).
+            return self.mesh.sharding(*spec)
         if (self.build_strategy.reduce_strategy == ReduceStrategy.Reduce
                 and v is not None
                 and getattr(v, "is_optimizer_state", False)
@@ -93,6 +99,14 @@ class ParallelExecutor(Executor):
                        shape) -> NamedSharding:
         if not shape:  # scalar feed
             return self.mesh.replicated()
+        if (self.build_strategy.enable_sequence_parallel and len(shape) >= 2):
+            v = self._find_var(program, name)
+            if v is not None and getattr(v, "lod_level", 0) > 0:
+                # sequence feed [B, T, ...]: split T over the sequence axis
+                # too (context parallelism; ring attention consumes this
+                # layout — parallel/ring_attention.py).
+                return self.mesh.sharding(DATA_AXIS, SEQUENCE_AXIS,
+                                          *([None] * (len(shape) - 2)))
         return self.mesh.sharding(DATA_AXIS, *([None] * (len(shape) - 1)))
 
     # -- compile with shardings ------------------------------------------

@@ -11,13 +11,18 @@ from typing import Optional
 class ParamAttr:
     def __init__(self, name: Optional[str] = None, initializer=None,
                  learning_rate: float = 1.0, regularizer=None,
-                 trainable: bool = True, gradient_clip=None):
+                 trainable: bool = True, gradient_clip=None,
+                 sharding_spec=None):
         self.name = name
         self.initializer = initializer
         self.learning_rate = learning_rate
         self.regularizer = regularizer
         self.trainable = trainable
         self.gradient_clip = gradient_clip
+        # PartitionSpec-style tuple of mesh axis names (or None) per dim —
+        # consumed by ParallelExecutor to place this parameter sharded
+        # (TP/EP; NEW capability, no reference analogue — SURVEY §2.3).
+        self.sharding_spec = sharding_spec
 
     @staticmethod
     def _to_attr(arg) -> Optional["ParamAttr"]:

@@ -1,0 +1,148 @@
+"""Control-flow tests (≙ reference test_while_op.py, test_recurrent_op.py,
+test_dyn_rnn.py, conditional-block tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.layers.control_flow import (DynamicRNN, IfElse, StaticRNN,
+                                            Switch, While, cond)
+
+
+def _run(fetch, feed=None):
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    return exe.run(feed=feed or {}, fetch_list=fetch)
+
+
+class TestWhile:
+    def test_counts_to_ten(self):
+        i = layers.fill_constant([1], "int64", 0)
+        n = layers.fill_constant([1], "int64", 10)
+        total = layers.fill_constant([1], "float32", 0.0)
+        c = layers.less_than(i, n)
+        w = While(c)
+        with w.block():
+            t2 = layers.elementwise_add(total,
+                                        layers.cast(i, "float32"))
+            layers.assign(t2, output=total)
+            i2 = layers.increment(i, value=1)
+            layers.assign(i2, output=i)
+            layers.less_than(i, n, cond=c)
+        out, iv = _run([total, i])
+        assert float(out) == sum(range(10))
+        assert int(iv) == 10
+
+
+class TestStaticRNN:
+    def test_cumsum_scan(self, rng):
+        x = layers.data(name="x", shape=[6, 4])  # [B, T=6, D=4]
+        zero = layers.fill_constant_batch_size_like(x, [-1, 4], "float32", 0.0)
+        rnn = StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            acc = rnn.memory(init=zero)
+            s = layers.elementwise_add(acc, xt)
+            rnn.update_memory(acc, s)
+            rnn.step_output(s)
+        out = rnn()
+        xv = rng.rand(3, 6, 4).astype("float32")
+        res, = _run([out], feed={"x": xv})
+        np.testing.assert_allclose(res, np.cumsum(xv, axis=1), rtol=1e-5)
+
+    def test_rnn_with_fc_trains(self, rng):
+        """A trainable RNN built from StaticRNN: gradients flow through
+        lax.scan."""
+        x = layers.data(name="x", shape=[5, 8])
+        y = layers.data(name="y", shape=[1])
+        h0 = layers.fill_constant_batch_size_like(x, [-1, 8], "float32", 0.0)
+        rnn = StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            h = rnn.memory(init=h0)
+            nh = layers.fc([xt, h], size=8, act="tanh")
+            rnn.update_memory(h, nh)
+            rnn.step_output(nh)
+        seq = rnn()
+        last = layers.slice(seq, axes=[1], starts=[4], ends=[5])
+        last = layers.reshape(last, shape=[-1, 8])
+        pred = layers.fc(last, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        pt.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        xv = rng.rand(8, 5, 8).astype("float32")
+        yv = rng.rand(8, 1).astype("float32")
+        losses = [float(exe.run(feed={"x": xv, "y": yv},
+                                fetch_list=[loss])[0]) for _ in range(10)]
+        assert losses[-1] < losses[0]
+
+
+class TestDynamicRNN:
+    def test_respects_lengths(self, rng):
+        x = layers.data(name="x", shape=[6, 4], lod_level=1)
+        zero = layers.fill_constant_batch_size_like(x, [-1, 4], "float32", 0.0)
+        drnn = DynamicRNN()
+        with drnn.block():
+            xt = drnn.step_input(x)
+            acc = drnn.memory(init=zero)
+            s = layers.elementwise_add(acc, xt)
+            drnn.update_memory(acc, s)
+            drnn.step_output(s)
+        out = drnn()
+        final = drnn.final_memories()
+        xv = rng.rand(2, 6, 4).astype("float32")
+        sl = np.array([3, 6], dtype="int32")
+        res, fin = _run([out, final], feed={"x": xv, "x@SEQLEN": sl})
+        # sequence 0 freezes after t=3: final == cumsum of first 3 steps
+        np.testing.assert_allclose(fin[0], xv[0, :3].sum(0), rtol=1e-5)
+        np.testing.assert_allclose(fin[1], xv[1].sum(0), rtol=1e-5)
+        # outputs past the length are zero-masked
+        assert np.all(res[0, 3:] == 0)
+
+
+class TestCond:
+    def test_ifelse_mask_merge(self, rng):
+        x = layers.data(name="x", shape=[4])
+        flag = layers.data(name="flag", shape=[1], dtype="bool")
+        ie = IfElse(flag)
+        with ie.true_block():
+            ie.output(layers.scale(x, scale=2.0))
+        with ie.false_block():
+            ie.output(layers.scale(x, scale=-1.0))
+        out, = ie()
+        xv = rng.rand(6, 4).astype("float32")
+        fv = np.array([[1], [0], [1], [0], [1], [0]], dtype=bool)
+        res, = _run([out], feed={"x": xv, "flag": fv})
+        exp = np.where(fv, xv * 2.0, -xv)
+        np.testing.assert_allclose(res, exp, rtol=1e-6)
+
+    def test_lazy_cond_scalar(self):
+        pred = layers.fill_constant([1], "bool", True)
+        a = layers.fill_constant([2], "float32", 3.0)
+        b = layers.fill_constant([2], "float32", 5.0)
+        out = cond(pred,
+                   lambda: layers.elementwise_add(a, b),
+                   lambda: layers.elementwise_sub(a, b))
+        res, = _run([out])
+        np.testing.assert_allclose(res, [8.0, 8.0])
+
+    def test_switch_piecewise(self):
+        step = layers.fill_constant([1], "float32", 7.0)
+        b1 = layers.fill_constant([1], "float32", 5.0)
+        b2 = layers.fill_constant([1], "float32", 10.0)
+        lr = layers.create_tensor("float32", name="lr_value")
+        sw = Switch()
+        with sw.case(layers.less_than(step, b1)):
+            layers.assign(layers.fill_constant([1], "float32", 0.1),
+                          output=lr)
+        with sw.case(layers.less_than(step, b2)):
+            layers.assign(layers.fill_constant([1], "float32", 0.01),
+                          output=lr)
+        with sw.default():
+            layers.assign(layers.fill_constant([1], "float32", 0.001),
+                          output=lr)
+        out = sw.finish(lr)
+        res, = _run([out])
+        np.testing.assert_allclose(res, [0.01])

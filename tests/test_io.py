@@ -1,0 +1,112 @@
+"""Persistence tests (≙ reference tests/book/* train->save->load->infer loop
++ test_io unit coverage of save/load_vars/params/persistables)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, models
+
+
+def _train_mlp(rng, steps=15):
+    loss, acc, logits = models.mnist.mlp(hidden_sizes=(32,), class_num=10)
+    pt.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    x = rng.rand(64, 784).astype("float32")
+    y = rng.randint(0, 10, (64, 1)).astype("int64")
+    for _ in range(steps):
+        exe.run(feed={"img": x, "label": y}, fetch_list=[loss])
+    return exe, loss, logits, x, y
+
+
+def test_save_load_params_roundtrip(tmp_path, rng):
+    exe, loss, logits, x, y = _train_mlp(rng)
+    saved = pt.save_params(exe, str(tmp_path / "params"))
+    assert len(saved) >= 4  # 2 fc layers x (w, b)
+    before = {n: np.asarray(pt.global_scope().get(n)) for n in saved}
+
+    # clobber parameters, reload, verify restored
+    for n in saved:
+        pt.global_scope().set_var(n, np.zeros_like(before[n]))
+    loaded = pt.load_params(exe, str(tmp_path / "params"))
+    assert loaded == saved
+    for n in saved:
+        np.testing.assert_array_equal(np.asarray(pt.global_scope().get(n)),
+                                      before[n])
+
+
+def test_save_load_persistables_resume(tmp_path, rng):
+    """Saving persistables captures optimizer state: training resumes
+    identically (≙ checkpoint/resume semantics, reference trainer.py:641)."""
+    exe, loss, logits, x, y = _train_mlp(rng, steps=5)
+    pt.save_persistables(exe, str(tmp_path / "ckpt"), filename="all.npz")
+    ref1, = exe.run(feed={"img": x, "label": y}, fetch_list=[loss])
+
+    # new scope, reload, re-run same step
+    pt.reset_global_scope()
+    pt.load_persistables(exe, str(tmp_path / "ckpt"), filename="all.npz")
+    exe2 = pt.Executor()
+    ref2, = exe2.run(feed={"img": x, "label": y}, fetch_list=[loss])
+    np.testing.assert_allclose(ref1, ref2, rtol=1e-5)
+
+
+def test_save_load_inference_model(tmp_path, rng):
+    exe, loss, logits, x, y = _train_mlp(rng)
+    pt.save_inference_model(str(tmp_path / "model"), ["img"], [logits], exe)
+
+    # independent numpy forward from the saved params (fc-relu-fc)
+    with np.load(str(tmp_path / "model" / "__params__.npz")) as d:
+        params = {k: d[k] for k in d.files}
+    ws = sorted([v for v in params.values() if v.ndim == 2],
+                key=lambda a: -a.shape[0])  # (784,32) then (32,10)
+    bs_ = {v.shape[0]: v for v in params.values() if v.ndim == 1}
+    h = np.maximum(x[:8] @ ws[0] + bs_[ws[0].shape[1]], 0)
+    expected = h @ ws[1] + bs_[ws[1].shape[1]]
+
+    pt.reset_global_scope()
+    pt.reset_default_programs()
+    predictor = pt.Predictor(str(tmp_path / "model"))
+    assert predictor.feed_names == ["img"]
+    out, = predictor.run({"img": x[:8]})
+    np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+
+    # pruning dropped the label path and optimizer ops
+    optypes = [op.type for op in predictor.program.global_block().ops]
+    assert "adam" not in optypes
+    assert "softmax_with_cross_entropy" not in optypes
+
+
+def test_inferencer_and_clone(tmp_path, rng):
+    exe, loss, logits, x, y = _train_mlp(rng, steps=3)
+    pt.save_inference_model(str(tmp_path / "m"), ["img"], [logits], exe)
+    inf = pt.Inferencer(str(tmp_path / "m"))
+    out, = inf.infer({"img": x[:4]})
+    assert out.shape == (4, 10)
+    p2 = inf._predictor.clone()
+    out2, = p2.run({"img": x[:4]})
+    np.testing.assert_allclose(out, out2, rtol=1e-6)
+
+
+def test_predictor_rejects_bad_feed(tmp_path, rng):
+    exe, loss, logits, x, y = _train_mlp(rng, steps=1)
+    pt.save_inference_model(str(tmp_path / "m"), ["img"], [logits], exe)
+    predictor = pt.Predictor(str(tmp_path / "m"))
+    with pytest.raises(Exception):
+        predictor.run({"wrong": x[:4]})
+
+
+def test_save_as_bf16(tmp_path, rng):
+    """≙ save_op save_as_fp16 attr — bf16 variant."""
+    exe, loss, logits, x, y = _train_mlp(rng, steps=1)
+    saved = pt.save_params(exe, str(tmp_path / "p16"), filename="p.npz",
+                           save_as_bf16=True)
+    with np.load(str(tmp_path / "p16" / "p.npz")) as data:
+        # bf16 bit patterns stored as tagged uint16 (npz can't carry bf16)
+        assert all(k.endswith("@BF16") and data[k].dtype == np.uint16
+                   for k in data.files)
+    loaded = pt.load_params(exe, str(tmp_path / "p16"), filename="p.npz")
+    assert loaded == saved
+    # loaded back as float32 per var dtype
+    w = np.asarray(pt.global_scope().get(saved[0]))
+    assert w.dtype == np.float32

@@ -24,6 +24,12 @@ from . import nets  # noqa: F401,E402
 from . import models  # noqa: F401,E402
 from . import io  # noqa: F401,E402
 from .inferencer import Inferencer, Predictor  # noqa: F401,E402
+from . import metrics  # noqa: F401,E402
+from . import profiler  # noqa: F401,E402
+from . import debugger  # noqa: F401,E402
+from .trainer import (BeginEpochEvent, BeginStepEvent,  # noqa: F401,E402
+                      CheckpointConfig, EndEpochEvent, EndStepEvent, Trainer,
+                      load_checkpoint, save_checkpoint)
 from .io import (load_inference_model, load_params,  # noqa: F401,E402
                  load_persistables, load_vars, save_inference_model,
                  save_params, save_persistables, save_vars)

@@ -1,0 +1,140 @@
+"""Program inspection: pretty printing + graphviz dumps.
+
+≙ reference python/paddle/fluid/debugger.py (pprint_program_codes :275,
+draw_block_graphviz) and net_drawer.py / the ir graph_viz_pass.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .framework.program import Block, Program
+
+
+def _var_brief(block: Block, name: str) -> str:
+    if block.has_var(name):
+        v = block.var(name)
+        shape = list(v.shape) if v.shape is not None else "?"
+        tag = "P" if getattr(v, "is_parameter", False) or \
+            v.__class__.__name__ == "Parameter" else \
+            ("s" if v.persistable else "t")
+        return f"{name}[{tag}:{v.dtype}:{shape}]"
+    return name
+
+
+def pprint_block_codes(block: Block, show_backward: bool = True) -> str:
+    """Render a block as pseudo-code, one op per line."""
+    lines = []
+    for i, op in enumerate(block.ops):
+        outs = ", ".join(_var_brief(block, n) for n in op.output_names())
+        ins = ", ".join(_var_brief(block, n) for n in op.input_names())
+        attrs = {k: v for k, v in op.attrs.items()
+                 if not k.startswith("_") and not callable(v)}
+        attr_s = ""
+        if attrs:
+            short = ", ".join(f"{k}={v!r}" for k, v in sorted(attrs.items())
+                              if not hasattr(v, "ops"))[:120]
+            if short:
+                attr_s = f"  # {short}"
+        lines.append(f"  {i:>4}: {outs} = {op.type}({ins}){attr_s}")
+    return "\n".join(lines)
+
+
+def pprint_program_codes(program: Program) -> str:
+    """≙ debugger.pprint_program_codes — dump every block."""
+    parts = []
+    for bi, block in enumerate(program.blocks):
+        parts.append(f"block {bi} {{")
+        parts.append(pprint_block_codes(block))
+        parts.append("}")
+    return "\n".join(parts)
+
+
+def draw_block_graphviz(block: Block, path: str,
+                        highlights: Optional[set] = None) -> str:
+    """Write a graphviz .dot file of the block's op/var dataflow
+    (≙ debugger.draw_block_graphviz / graph_viz_pass)."""
+    highlights = highlights or set()
+    lines = ["digraph G {", '  rankdir="TB";',
+             '  node [fontsize=10];']
+    seen_vars = set()
+
+    def var_node(name):
+        nid = f"var_{name}".replace(".", "_").replace("@", "_")
+        if name not in seen_vars:
+            seen_vars.add(name)
+            color = ', style=filled, fillcolor="#ffcccc"' \
+                if name in highlights else ""
+            shape = "ellipse"
+            if block.has_var(name) and block.var(name).persistable:
+                shape = "box3d"
+            lines.append(
+                f'  {nid} [label="{_var_brief(block, name)}", '
+                f'shape={shape}{color}];')
+        return nid
+
+    for i, op in enumerate(block.ops):
+        onid = f"op_{i}"
+        lines.append(f'  {onid} [label="{op.type}", shape=box, '
+                     f'style=filled, fillcolor="#ccccff"];')
+        for n in op.input_names():
+            lines.append(f"  {var_node(n)} -> {onid};")
+        for n in op.output_names():
+            lines.append(f"  {onid} -> {var_node(n)};")
+    lines.append("}")
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+    return path
+
+
+def dump_hlo(program: Program, feed_shapes: dict, path: Optional[str] = None,
+             fetch_list=None) -> str:
+    """Lower the program's global block to StableHLO text — the compiled-IR
+    dump the reference never had (its nearest analogue is the ProgramDesc
+    protobuf dump). Useful for verifying fusion / sharding decisions."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .framework.lowering import LowerCtx, build_plan, run_plan
+
+    block = program.global_block()
+    plan = build_plan(block)
+    fetch_names = [getattr(f, "name", f) for f in (fetch_list or [])]
+    if not fetch_names:
+        produced = [n for op in block.ops for n in op.output_names()]
+        fetch_names = produced[-1:]
+    feed_names = sorted(feed_shapes)
+
+    # zero-fill every block-declared var the program reads but doesn't feed
+    # (parameters etc.) so lowering sees fully-defined inputs
+    read = set()
+    produced = set()
+    for op in block.ops:
+        read |= set(op.input_names())
+        produced |= set(op.output_names())
+    implicit = sorted(n for n in read - produced - set(feed_names)
+                      if block.has_var(n) and block.var(n).shape is not None
+                      and -1 not in block.var(n).shape)
+
+    def fn(*feed_vals):
+        env = dict(zip(feed_names, feed_vals))
+        for n in implicit:
+            v = block.var(n)
+            env[n] = jnp.zeros(tuple(v.shape), dtype=v.dtype)
+        ctx = LowerCtx(rng_key=jax.random.PRNGKey(0))
+        run_plan(plan, env, block, ctx)
+        return tuple(env[n] for n in fetch_names)
+
+    args = [jnp.zeros(s, dtype=np.float32) if not isinstance(s, tuple) or
+            len(s) != 2 or not isinstance(s[1], str)
+            else jnp.zeros(s[0], dtype=s[1]) for s in
+            (feed_shapes[n] for n in feed_names)]
+    text = jax.jit(fn).lower(*args).as_text()
+    if path:
+        with open(path, "w") as f:
+            f.write(text)
+    return text

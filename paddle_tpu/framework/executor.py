@@ -152,10 +152,12 @@ class Executor:
         avail_key = self._scope_avail_key(program, scope)
         key = (id(program), program._version, _feed_signature(feed),
                tuple(fetch_names), id(scope), avail_key)
+        from .. import profiler as _prof
         compiled = self._cache.get(key)
         if compiled is None:
-            compiled = self._compile(program, scope, list(feed.keys()),
-                                     fetch_names)
+            with _prof.RecordEvent("executor/trace_and_compile"):
+                compiled = self._compile(program, scope, list(feed.keys()),
+                                         fetch_names)
             self._cache[key] = compiled
 
         feed_vals = tuple(jnp.asarray(feed[n]) for n in compiled.feed_names)
@@ -166,7 +168,10 @@ class Executor:
                          % (2 ** 31))
 
         t0 = time.time()
-        fetches, new_state = compiled.fn(feed_vals, ro_vals, rw_vals, seed)
+        with _prof.RecordEvent("executor/run"):
+            fetches, new_state = compiled.fn(feed_vals, ro_vals, rw_vals, seed)
+            if _prof.profiler_enabled():
+                jax.block_until_ready(fetches)
         for name, val in zip(compiled.state_out_names, new_state):
             scope.set_var(name, val)
         if flags.get_flag("benchmark"):

@@ -1,0 +1,205 @@
+"""Profiler: host-event timing + device trace capture.
+
+Capability equivalent of the reference profiler stack (reference:
+paddle/fluid/platform/profiler.h:73-121 RecordEvent/EnableProfiler,
+platform/device_tracer.h:49 CUPTI tracer, tools/timeline.py Chrome-trace
+export, python/paddle/fluid/profiler.py context managers).
+
+TPU-first mapping: per-op host interpretation doesn't exist (whole programs
+are XLA-compiled), so host events time the phases that exist here — trace,
+compile, execute, feed/fetch — while *device*-side op-level detail comes from
+jax.profiler's XPlane trace (viewable in TensorBoard / Perfetto), the XLA
+analogue of the CUPTI device tracer. Host events still support user-scoped
+`RecordEvent` annotation and export to Chrome trace format.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+from .core.enforce import InvalidArgumentError, enforce
+
+_enabled = False
+_events_lock = threading.Lock()
+_completed: List["_Event"] = []
+_trace_dir: Optional[str] = None
+
+
+class _Event:
+    __slots__ = ("name", "thread_id", "start", "end")
+
+    def __init__(self, name, thread_id, start, end):
+        self.name = name
+        self.thread_id = thread_id
+        self.start = start
+        self.end = end
+
+    @property
+    def duration_ms(self):
+        return (self.end - self.start) * 1e3
+
+
+class RecordEvent:
+    """RAII scope annotation (≙ platform::RecordEvent, profiler.h:73).
+    Nesting shows up in the Chrome trace via overlapping ts/dur spans."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._start = None
+
+    def __enter__(self):
+        if _enabled:
+            self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._start is not None:
+            ev = _Event(self.name, threading.get_ident(), self._start,
+                        time.perf_counter())
+            self._start = None
+            with _events_lock:
+                _completed.append(ev)
+        return False
+
+
+record_event = RecordEvent  # snake_case alias used by layers/executor
+
+
+def reset_profiler():
+    """≙ fluid.profiler.reset_profiler — drop all recorded events."""
+    with _events_lock:
+        _completed.clear()
+
+
+def start_profiler(state: str = "All", tracer_option: Optional[str] = None):
+    """Enable host-event recording; state 'All' additionally starts a
+    jax.profiler device trace when a trace dir was configured via
+    `profiler(..., output=dir)` or PTPU_TRACE_DIR env.
+
+    ≙ EnableProfiler (reference profiler.h:116; states CPU/GPU/All map to
+    host-only vs host+device here).
+    """
+    global _enabled, _trace_dir
+    enforce(state in ("CPU", "GPU", "All", "TPU"),
+            f"invalid profiler state {state!r}", exc=InvalidArgumentError)
+    _enabled = True
+    if state in ("GPU", "All", "TPU"):
+        trace_dir = _trace_dir or os.environ.get("PTPU_TRACE_DIR")
+        if trace_dir:
+            import jax
+            try:
+                jax.profiler.start_trace(trace_dir)
+            except RuntimeError:
+                pass  # already tracing
+
+
+def stop_profiler(sorted_key: Optional[str] = None,
+                  profile_path: Optional[str] = None):
+    """Disable recording, print the per-event summary table, optionally dump
+    a Chrome trace JSON to profile_path (≙ DisableProfiler profiler.h:119 +
+    tools/timeline.py)."""
+    global _enabled
+    if not _enabled:
+        return
+    _enabled = False
+    import jax
+    try:
+        jax.profiler.stop_trace()
+    except RuntimeError:
+        pass
+    if profile_path:
+        export_chrome_tracing(profile_path)
+    print_profiler_summary(sorted_key or "default")
+
+
+def print_profiler_summary(sorted_key: str = "default"):
+    """Aggregate events by name: calls, total/min/max/avg ms (≙ the
+    reference's sorted profiling report, profiler.cc PrintProfiler)."""
+    enforce(sorted_key in ("default", "calls", "total", "max", "min", "ave"),
+            f"invalid sorted_key {sorted_key!r}", exc=InvalidArgumentError)
+    with _events_lock:
+        events = list(_completed)
+    if not events:
+        print("[profiler] no events recorded")
+        return
+    agg: Dict[str, List[float]] = {}
+    for ev in events:
+        agg.setdefault(ev.name, []).append(ev.duration_ms)
+    rows = []
+    for name, durs in agg.items():
+        rows.append((name, len(durs), sum(durs), max(durs), min(durs),
+                     sum(durs) / len(durs)))
+    key_idx = {"default": 2, "calls": 1, "total": 2, "max": 3, "min": 4,
+               "ave": 5}[sorted_key]
+    rows.sort(key=lambda r: -r[key_idx])
+    hdr = f"{'Event':<44} {'Calls':>7} {'Total(ms)':>11} {'Max':>9} " \
+          f"{'Min':>9} {'Ave':>9}"
+    print("-" * len(hdr))
+    print(hdr)
+    print("-" * len(hdr))
+    for name, calls, tot, mx, mn, ave in rows:
+        print(f"{name[:44]:<44} {calls:>7} {tot:>11.3f} {mx:>9.3f} "
+              f"{mn:>9.3f} {ave:>9.3f}")
+    print("-" * len(hdr))
+
+
+def export_chrome_tracing(path: str):
+    """Write recorded host events as a Chrome trace (catapult) JSON —
+    the host-side half of tools/timeline.py (device side comes from the
+    jax.profiler XPlane dump)."""
+    with _events_lock:
+        events = list(_completed)
+    trace = {"traceEvents": [], "displayTimeUnit": "ms"}
+    for ev in events:
+        trace["traceEvents"].append({
+            "name": ev.name, "cat": "host", "ph": "X",
+            "ts": ev.start * 1e6, "dur": (ev.end - ev.start) * 1e6,
+            "pid": 0, "tid": ev.thread_id,
+        })
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return path
+
+
+@contextmanager
+def profiler(state: str = "All", sorted_key: str = "default",
+             profile_path: Optional[str] = None,
+             trace_dir: Optional[str] = None):
+    """Context manager (≙ fluid.profiler.profiler, profiler.py:221):
+
+        with profiler('All', sorted_key='total', profile_path='/tmp/t.json'):
+            for batch in data:
+                exe.run(...)
+    """
+    global _trace_dir
+    _trace_dir = trace_dir
+    reset_profiler()
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key=sorted_key, profile_path=profile_path)
+        _trace_dir = None
+
+
+@contextmanager
+def device_tracer(log_dir: str):
+    """Capture a device (XPlane) trace to log_dir for TensorBoard — the
+    TPU analogue of the CUPTI DeviceTracer (device_tracer.h:49)."""
+    import jax
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def profiler_enabled() -> bool:
+    return _enabled

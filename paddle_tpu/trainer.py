@@ -1,0 +1,298 @@
+"""High-level event-driven training loop with checkpoint/resume.
+
+≙ reference python/paddle/fluid/trainer.py: Trainer (:169) with
+Begin/EndEpoch + Begin/EndStep events (:40-99), CheckpointConfig (:100),
+serial-numbered checkpoint dirs with retention (_scroll_delete :1168),
+trainer-args persistence, `_SUCCESS` markers (:1190), and resume-on-init
+(load_checkpoint :741). The reference's pserver/dist-transpile branch maps to
+the SPMD ParallelExecutor path here (parallel strategies compile into the
+step; no separate server processes on TPU).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Callable, List, Optional, Sequence
+
+from . import io as _io
+from . import optimizer as _optimizer_mod
+from .core.enforce import InvalidArgumentError, enforce
+from .data.feeder import DataFeeder
+from .framework.executor import Executor
+from .framework.program import (Program, Variable, program_guard)
+from .framework.scope import Scope, global_scope
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id: int):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id: int):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id: int, step_id: int):
+        self.epoch = epoch_id
+        self.step = step_id
+        #: set True by a handler to get metrics fetched this step
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id: int, step_id: int, metrics: list):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig:
+    """≙ trainer.CheckpointConfig (reference trainer.py:100)."""
+
+    def __init__(self, checkpoint_dir: Optional[str] = None,
+                 max_num_checkpoints: int = 3,
+                 epoch_interval: int = 1,
+                 step_interval: int = 10):
+        self.checkpoint_dir = checkpoint_dir or \
+            os.path.join(os.getcwd(), "checkpoint")
+        enforce(epoch_interval >= 1 and step_interval >= 1,
+                "checkpoint intervals must be >= 1",
+                exc=InvalidArgumentError)
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = epoch_interval
+        self.step_interval = step_interval
+        self.epoch_id = 0
+        self.step_id = 0
+        self.load_serial: Optional[int] = None
+
+
+CHECKPOINT_PREFIX = "checkpoint"
+TRAINER_ARGS_FILE = "trainer_args.json"
+SUCCESS_MARKER = "_SUCCESS"
+
+
+def _serial_dir(root: str, serial: int) -> str:
+    return os.path.join(root, f"{CHECKPOINT_PREFIX}_{serial}")
+
+
+def _list_serials(root: str) -> List[int]:
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        if not name.startswith(CHECKPOINT_PREFIX + "_"):
+            continue
+        suffix = name[len(CHECKPOINT_PREFIX) + 1:]
+        if suffix.isdigit() and os.path.exists(
+                os.path.join(root, name, SUCCESS_MARKER)):
+            out.append(int(suffix))
+    return sorted(out)
+
+
+def get_latest_checkpoint_serial(root: str) -> int:
+    """Latest *complete* (marker present) checkpoint serial, or -1."""
+    serials = _list_serials(root)
+    return serials[-1] if serials else -1
+
+
+def save_checkpoint(executor: Executor, checkpoint_dir: str,
+                    main_program: Program,
+                    trainer_args: Optional[dict] = None,
+                    max_num_checkpoints: int = 3,
+                    scope: Optional[Scope] = None) -> int:
+    """Write persistables + trainer args into the next serial dir; commit via
+    the `_SUCCESS` marker only after all state hit disk (crash-safe: readers
+    ignore marker-less dirs); then scroll-delete old serials
+    (≙ trainer.save_checkpoint :641 + _scroll_delete :1168)."""
+    serial = get_latest_checkpoint_serial(checkpoint_dir) + 1
+    cur = _serial_dir(checkpoint_dir, serial)
+    if os.path.isdir(cur):
+        shutil.rmtree(cur)  # incomplete leftovers from a preempted run
+    os.makedirs(cur, exist_ok=True)
+    _io.save_persistables(executor, cur, main_program=main_program,
+                          scope=scope)
+    if trainer_args is not None:
+        with open(os.path.join(cur, TRAINER_ARGS_FILE), "w") as f:
+            json.dump(trainer_args, f)
+    with open(os.path.join(cur, SUCCESS_MARKER), "w") as f:
+        f.write("")
+    # retention
+    serials = _list_serials(checkpoint_dir)
+    for old in serials[:-max_num_checkpoints]:
+        shutil.rmtree(_serial_dir(checkpoint_dir, old), ignore_errors=True)
+    return serial
+
+
+def load_checkpoint(executor: Executor, checkpoint_dir: str,
+                    main_program: Program,
+                    serial: Optional[int] = None,
+                    scope: Optional[Scope] = None) -> Optional[dict]:
+    """Restore persistables from the given (default: latest complete)
+    serial; returns the saved trainer args or None if no checkpoint."""
+    if serial is None:
+        serial = get_latest_checkpoint_serial(checkpoint_dir)
+    if serial < 0:
+        return None
+    cur = _serial_dir(checkpoint_dir, serial)
+    _io.load_persistables(executor, cur, main_program=main_program,
+                          scope=scope)
+    args_path = os.path.join(cur, TRAINER_ARGS_FILE)
+    if os.path.exists(args_path):
+        with open(args_path) as f:
+            return json.load(f)
+    return {}
+
+
+class Trainer:
+    """≙ fluid.Trainer (reference trainer.py:169).
+
+    train_func: () -> loss Variable (or [loss, metric, ...]); builds the
+    forward program when called under our program guard.
+    optimizer_func: () -> Optimizer.
+    """
+
+    def __init__(self, train_func: Callable,
+                 optimizer_func: Callable[[], "_optimizer_mod.Optimizer"],
+                 place=None,
+                 parallel: bool = False,
+                 checkpoint_config: Optional[CheckpointConfig] = None,
+                 mesh=None):
+        self.checkpoint_cfg = checkpoint_config
+        self.place = place
+        self.parallel = parallel
+        self.mesh = mesh
+        self.scope = Scope()
+        self.startup_program = Program()
+        self.train_program = Program()
+        self.stop_flag = False
+
+        with program_guard(self.train_program, self.startup_program):
+            outs = train_func()
+            if isinstance(outs, (list, tuple)):
+                self.loss = outs[0]
+                self.metrics = list(outs)
+            else:
+                self.loss = outs
+                self.metrics = [outs]
+            # forward-only clone BEFORE optimizer ops are appended, so
+            # test() cannot touch parameters (≙ main.clone(for_test=True))
+            self.test_program = self.train_program.clone(for_test=True)
+            opt = optimizer_func()
+            enforce(isinstance(opt, _optimizer_mod.Optimizer),
+                    "optimizer_func must return an Optimizer",
+                    exc=InvalidArgumentError)
+            opt.minimize(self.loss)
+
+        self.exe = Executor(place)
+        self.exe.run(self.startup_program, scope=self.scope)
+        self._pe = None
+        if parallel:
+            from .parallel import DeviceMesh, ParallelExecutor
+            mesh = mesh or DeviceMesh.default_data_parallel()
+            self._pe = ParallelExecutor(loss_name=self.loss.name, mesh=mesh,
+                                        main_program=self.train_program,
+                                        scope=self.scope)
+
+        if self.checkpoint_cfg:
+            args = load_checkpoint(self.exe,
+                                   self.checkpoint_cfg.checkpoint_dir,
+                                   self.train_program, scope=self.scope)
+            if args:
+                self.checkpoint_cfg.epoch_id = int(args.get("epoch_id", 0))
+                self.checkpoint_cfg.step_id = int(args.get("step_id", 0))
+                self.checkpoint_cfg.load_serial = \
+                    get_latest_checkpoint_serial(
+                        self.checkpoint_cfg.checkpoint_dir)
+
+    def stop(self):
+        """Ask train() to exit after the current step (callable from the
+        event handler — ≙ trainer.stop)."""
+        self.stop_flag = True
+
+    def train(self, num_epochs: int, event_handler: Callable,
+              reader: Callable, feed_order: Sequence[str]):
+        """Saved trainer args are the NEXT work item (resume_epoch,
+        resume_step): a resumed run skips everything already trained —
+        including the whole run when it had completed."""
+        feeder = DataFeeder(feed_list=[
+            self.train_program.global_block().var(n) for n in feed_order])
+        start_epoch = (self.checkpoint_cfg.epoch_id
+                       if self.checkpoint_cfg else 0)
+        skip_steps = (self.checkpoint_cfg.step_id
+                      if self.checkpoint_cfg else 0)
+        for epoch_id in range(start_epoch, num_epochs):
+            event_handler(BeginEpochEvent(epoch_id))
+            for step_id, batch in enumerate(reader()):
+                if epoch_id == start_epoch and step_id < skip_steps:
+                    continue  # already trained before preemption
+                if self.stop_flag:
+                    if self.checkpoint_cfg:
+                        self._save_checkpoint(epoch_id, step_id)
+                    return
+                begin = BeginStepEvent(epoch_id, step_id)
+                event_handler(begin)
+                fetch = [m.name for m in self.metrics] \
+                    if begin.fetch_metrics else []
+                feed = feeder.feed(batch)
+                if self._pe is not None:
+                    metrics = self._pe.run(feed=feed, fetch_list=fetch)
+                else:
+                    metrics = self.exe.run(self.train_program, feed=feed,
+                                           fetch_list=fetch,
+                                           scope=self.scope)
+                event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                if (self.checkpoint_cfg and
+                        (step_id + 1) % self.checkpoint_cfg.step_interval
+                        == 0):
+                    self._save_checkpoint(epoch_id, step_id + 1)
+            event_handler(EndEpochEvent(epoch_id))
+            if (self.checkpoint_cfg and
+                    (epoch_id + 1) % self.checkpoint_cfg.epoch_interval == 0):
+                self._save_checkpoint(epoch_id + 1, 0)
+        if self.checkpoint_cfg:
+            self._save_checkpoint(num_epochs, 0)
+
+    def test(self, reader: Callable, feed_order: Sequence[str]):
+        """Average the metric values over the reader, on the forward-only
+        test program (no backward/optimize ops — parameters are not
+        touched)."""
+        feeder = DataFeeder(feed_list=[
+            self.test_program.global_block().var(n) for n in feed_order])
+        import numpy as np
+        totals = None
+        count = 0
+        for batch in reader():
+            feed = feeder.feed(batch)
+            vals = self.exe.run(self.test_program, feed=feed,
+                                fetch_list=[m.name for m in self.metrics],
+                                scope=self.scope)
+            vals = [np.mean(np.asarray(v)) for v in vals]
+            totals = vals if totals is None else \
+                [t + v for t, v in zip(totals, vals)]
+            count += 1
+        enforce(count > 0, "test reader yielded no batches",
+                exc=InvalidArgumentError)
+        return [t / count for t in totals]
+
+    def save_params(self, param_path: str):
+        _io.save_params(self.exe, param_path,
+                        main_program=self.train_program, scope=self.scope)
+
+    def save_inference_model(self, param_path: str,
+                             feeded_var_names: Sequence[str],
+                             target_vars: Sequence[Variable]):
+        _io.save_inference_model(param_path, feeded_var_names, target_vars,
+                                 executor=self.exe,
+                                 main_program=self.train_program,
+                                 scope=self.scope)
+
+    def _save_checkpoint(self, resume_epoch: int, resume_step: int):
+        save_checkpoint(
+            self.exe, self.checkpoint_cfg.checkpoint_dir, self.train_program,
+            trainer_args={"epoch_id": resume_epoch, "step_id": resume_step},
+            max_num_checkpoints=self.checkpoint_cfg.max_num_checkpoints,
+            scope=self.scope)

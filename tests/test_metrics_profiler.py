@@ -1,0 +1,241 @@
+"""Tests: host-side metrics accumulators, profiler, debugger, Trainer +
+checkpoint/resume (≙ reference test_metrics.py / test_profiler.py /
+trainer checkpoint tests)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import metrics, profiler
+
+
+class TestMetrics:
+    def test_accuracy(self):
+        m = metrics.Accuracy()
+        m.update(0.5, 10)
+        m.update(1.0, 10)
+        assert abs(m.eval() - 0.75) < 1e-9
+        m.reset()
+        with pytest.raises(Exception):
+            m.eval()
+
+    def test_precision_recall(self):
+        preds = np.array([1, 1, 0, 1, 0])
+        labels = np.array([1, 0, 0, 1, 1])
+        p = metrics.Precision()
+        p.update(preds, labels)
+        assert abs(p.eval() - 2 / 3) < 1e-9
+        r = metrics.Recall()
+        r.update(preds, labels)
+        assert abs(r.eval() - 2 / 3) < 1e-9
+
+    def test_composite(self):
+        c = metrics.CompositeMetric()
+        c.add_metric(metrics.Precision())
+        c.add_metric(metrics.Recall())
+        c.update(np.array([1, 0]), np.array([1, 1]))
+        p, r = c.eval()
+        assert p == 1.0 and r == 0.5
+
+    def test_auc_perfect_and_random(self):
+        auc = metrics.Auc(num_thresholds=1023)
+        scores = np.concatenate([np.full(50, 0.9), np.full(50, 0.1)])
+        labels = np.concatenate([np.ones(50), np.zeros(50)])
+        auc.update(scores, labels)
+        assert auc.eval() > 0.99
+        auc2 = metrics.Auc(num_thresholds=1023)
+        rng = np.random.RandomState(0)
+        auc2.update(rng.rand(2000), rng.randint(0, 2, 2000))
+        assert 0.45 < auc2.eval() < 0.55
+        auc2.reset()
+        auc2.update(scores, labels)
+        assert auc2.eval() > 0.99  # reset really cleared the buckets
+
+    def test_edit_distance(self):
+        m = metrics.EditDistance()
+        m.update(np.array([[0.0], [2.0], [1.0]]), 3)
+        avg, err = m.eval()
+        assert abs(avg - 1.0) < 1e-9 and abs(err - 2 / 3) < 1e-9
+
+    def test_chunk_evaluator(self):
+        m = metrics.ChunkEvaluator()
+        m.update(10, 8, 4)
+        p, r, f1 = m.eval()
+        assert abs(p - 0.4) < 1e-9 and abs(r - 0.5) < 1e-9
+        assert abs(f1 - 2 * 0.4 * 0.5 / 0.9) < 1e-9
+
+    def test_detection_map_perfect(self):
+        m = metrics.DetectionMAP()
+        # one image, one class, one perfectly-matching detection
+        dets = np.array([[0, 0.9, 0.1, 0.1, 0.5, 0.5]])
+        gts = np.array([[0, 0.1, 0.1, 0.5, 0.5]])
+        m.update(dets, [1], gts, [1])
+        assert m.eval() == pytest.approx(1.0)
+
+    def test_detection_map_miss(self):
+        m = metrics.DetectionMAP()
+        dets = np.array([[0, 0.9, 0.6, 0.6, 0.9, 0.9]])  # no overlap
+        gts = np.array([[0, 0.1, 0.1, 0.5, 0.5]])
+        m.update(dets, [1], gts, [1])
+        assert m.eval() == pytest.approx(0.0)
+
+
+class TestProfiler:
+    def test_record_and_summary(self, tmp_path, capsys):
+        trace = str(tmp_path / "t.json")
+        with profiler.profiler("CPU", sorted_key="total",
+                               profile_path=trace):
+            with profiler.RecordEvent("outer"):
+                with profiler.RecordEvent("inner"):
+                    pass
+        out = capsys.readouterr().out
+        assert "outer" in out and "inner" in out
+        with open(trace) as f:
+            data = json.load(f)
+        names = {e["name"] for e in data["traceEvents"]}
+        assert {"outer", "inner"} <= names
+
+    def test_executor_events_recorded(self, capsys):
+        x = pt.layers.data("x", shape=[4], dtype="float32")
+        y = pt.layers.fc(x, size=2)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        with profiler.profiler("CPU"):
+            exe.run(feed={"x": np.ones((2, 4), "float32")},
+                    fetch_list=[y])
+        out = capsys.readouterr().out
+        assert "executor/run" in out
+
+
+class TestDebugger:
+    def _build(self):
+        x = pt.layers.data("x", shape=[4], dtype="float32")
+        y = pt.layers.fc(x, size=2, act="relu")
+        return x, y
+
+    def test_pprint(self):
+        self._build()
+        text = pt.debugger.pprint_program_codes(pt.default_main_program())
+        assert "matmul" in text or "fc" in text or "mul" in text
+        assert "block 0" in text
+
+    def test_graphviz(self, tmp_path):
+        self._build()
+        path = pt.debugger.draw_block_graphviz(
+            pt.default_main_program().global_block(),
+            str(tmp_path / "g.dot"))
+        content = open(path).read()
+        assert content.startswith("digraph") and "->" in content
+
+    def test_dump_hlo(self):
+        x, y = self._build()
+        text = pt.debugger.dump_hlo(pt.default_main_program(),
+                                    {"x": ((2, 4), "float32")},
+                                    fetch_list=[y])
+        assert "stablehlo" in text or "mhlo" in text or "func" in text
+
+
+def _reader(n=8, batch=4, seed=0):
+    def r():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            yield [(rng.rand(4).astype("float32"),
+                    np.array([rng.randint(2)], dtype="int64"))
+                   for _ in range(batch)]
+    return r
+
+
+def _train_func():
+    x = pt.layers.data("x", shape=[4], dtype="float32")
+    label = pt.layers.data("label", shape=[1], dtype="int64")
+    logits = pt.layers.fc(x, size=2)
+    loss = pt.layers.mean(
+        pt.layers.softmax_with_cross_entropy(logits, label))
+    return loss
+
+
+class TestTrainer:
+    def test_train_events_and_test(self):
+        events = []
+
+        def handler(ev):
+            events.append(type(ev).__name__)
+
+        t = pt.Trainer(train_func=_train_func,
+                       optimizer_func=lambda:
+                       pt.optimizer.SGDOptimizer(learning_rate=0.1))
+        t.train(num_epochs=2, event_handler=handler, reader=_reader(),
+                feed_order=["x", "label"])
+        assert events.count("BeginEpochEvent") == 2
+        assert events.count("EndStepEvent") == 16
+        w_name = [v.name for v in
+                  t.train_program.global_block().vars.values()
+                  if getattr(v, "trainable", False)][0]
+        before = np.asarray(t.scope.get(w_name)).copy()
+        vals = t.test(reader=_reader(), feed_order=["x", "label"])
+        assert np.isfinite(vals[0])
+        # evaluation must not touch parameters
+        np.testing.assert_array_equal(before, np.asarray(t.scope.get(w_name)))
+
+    def test_checkpoint_save_resume(self, tmp_path):
+        ckpt_dir = str(tmp_path / "ckpt")
+        cfg = pt.CheckpointConfig(checkpoint_dir=ckpt_dir,
+                                  max_num_checkpoints=2, step_interval=3)
+        t = pt.Trainer(train_func=_train_func,
+                       optimizer_func=lambda:
+                       pt.optimizer.SGDOptimizer(learning_rate=0.1),
+                       checkpoint_config=cfg)
+        t.train(num_epochs=1, event_handler=lambda ev: None,
+                reader=_reader(), feed_order=["x", "label"])
+        serials = [d for d in os.listdir(ckpt_dir)
+                   if d.startswith("checkpoint_")]
+        assert 1 <= len(serials) <= 2  # retention enforced
+        for d in serials:
+            assert os.path.exists(os.path.join(ckpt_dir, d, "_SUCCESS"))
+
+        # resume: a fresh process rebuilds the same program (names restart);
+        # emulate with a fresh unique-name scope
+        from paddle_tpu.core import unique_name
+        pt.reset_default_programs()
+        pt.reset_global_scope()
+        cfg2 = pt.CheckpointConfig(checkpoint_dir=ckpt_dir)
+        with unique_name.guard():
+            t2 = pt.Trainer(train_func=_train_func,
+                            optimizer_func=lambda:
+                            pt.optimizer.SGDOptimizer(learning_rate=0.1),
+                            checkpoint_config=cfg2)
+        assert cfg2.load_serial is not None and cfg2.load_serial >= 0
+        w_name = [v.name for v in
+                  t2.train_program.global_block().vars.values()
+                  if getattr(v, "trainable", False)][0]
+        np.testing.assert_allclose(
+            np.asarray(t2.scope.get(w_name)),
+            np.asarray(t.scope.get(w_name)))
+        # the first run COMPLETED num_epochs=1, so resuming train(1) must be
+        # a no-op (no re-training of finished epochs)
+        steps = []
+        t2.train(num_epochs=1,
+                 event_handler=lambda ev: steps.append(ev)
+                 if isinstance(ev, pt.EndStepEvent) else None,
+                 reader=_reader(), feed_order=["x", "label"])
+        assert steps == []
+
+    def test_incomplete_checkpoint_ignored(self, tmp_path):
+        ckpt_dir = str(tmp_path / "ckpt")
+        os.makedirs(os.path.join(ckpt_dir, "checkpoint_7"))  # no _SUCCESS
+        from paddle_tpu.trainer import get_latest_checkpoint_serial
+        assert get_latest_checkpoint_serial(ckpt_dir) == -1
+
+    def test_stop(self):
+        def handler(ev):
+            if isinstance(ev, pt.EndStepEvent) and ev.step == 1:
+                t.stop()
+
+        t = pt.Trainer(train_func=_train_func,
+                       optimizer_func=lambda:
+                       pt.optimizer.SGDOptimizer(learning_rate=0.1))
+        t.train(num_epochs=5, event_handler=handler, reader=_reader(),
+                feed_order=["x", "label"])

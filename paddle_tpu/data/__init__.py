@@ -10,3 +10,6 @@ from .decorator import (batch, buffered, chain, compose, firstn,  # noqa: F401
                         map_readers, shuffle, xmap_readers)
 from .feeder import DataFeeder  # noqa: F401
 from .prefetch import DevicePrefetcher  # noqa: F401
+from .recordio import (ParallelRecordLoader, RecordIOScanner,  # noqa: F401
+                       RecordIOWriter, read_numpy_records,
+                       write_numpy_records)

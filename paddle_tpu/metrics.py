@@ -68,6 +68,10 @@ class CompositeMetric(MetricBase):
                 exc=InvalidArgumentError)
         self._metrics.append(metric)
 
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
     def update(self, preds, labels):
         for m in self._metrics:
             m.update(preds, labels)

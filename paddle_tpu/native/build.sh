@@ -1,0 +1,6 @@
+#!/bin/sh
+# Build the native runtime shared library. Invoked automatically on first
+# import (paddle_tpu/data/recordio.py) when the .so is missing or stale.
+set -e
+cd "$(dirname "$0")"
+g++ -O2 -std=c++17 -fPIC -shared -o libptpu_native.so recordio.cc -lz -lpthread

@@ -1,6 +1,12 @@
 """fluid.layers-equivalent namespace (≙ reference python/paddle/fluid/layers/)."""
 
-from . import control_flow, io, math_ops, nn, ops, sequence, tensor  # noqa: F401
+from . import (control_flow, io, learning_rate_scheduler, math_ops,  # noqa: F401
+               nn, ops, sequence, tensor)
+from .learning_rate_scheduler import (autoincreased_step_counter,  # noqa: F401
+                                      cosine_decay, exponential_decay,
+                                      inverse_time_decay, natural_exp_decay,
+                                      noam_decay, piecewise_decay,
+                                      polynomial_decay)
 from .control_flow import (DynamicRNN, IfElse, StaticRNN, Switch,  # noqa: F401
                            While, cond, equal, greater_equal, greater_than,
                            increment, less_equal, less_than, not_equal)

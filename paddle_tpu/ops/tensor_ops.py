@@ -276,3 +276,14 @@ def _cumsum(ctx, ins, attrs):
     if attrs.get("reverse", False):
         out = jnp.flip(out, axis=axis)
     return {"Out": [out]}
+
+
+@register_op("piecewise_decay", stop_gradient=True)
+def _piecewise_decay(ctx, ins, attrs):
+    # branch-free piecewise-constant LR lookup (≙ reference
+    # learning_rate_scheduler.py piecewise_decay's Switch construct)
+    step = ins["Step"][0]
+    boundaries = jnp.asarray(attrs["boundaries"], dtype=step.dtype)
+    values = jnp.asarray(attrs["values"], dtype=jnp.float32)
+    idx = jnp.searchsorted(boundaries, step.reshape(()), side="right")
+    return {"Out": [values[idx].reshape(1)]}

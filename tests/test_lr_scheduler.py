@@ -1,0 +1,118 @@
+"""LR decay schedules computed in-graph match numpy references.
+
+≙ reference tests/unittests/test_learning_rate_scheduler.py (each decay fn
+vs a python reference over successive steps).
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _run_schedule(lr_var, steps):
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    out = []
+    for _ in range(steps):
+        (v,) = exe.run(pt.default_main_program(), feed={},
+                       fetch_list=[lr_var])
+        out.append(float(np.asarray(v).reshape(())))
+    return out
+
+
+def test_exponential_decay():
+    lr = layers.exponential_decay(0.1, decay_steps=10, decay_rate=0.5)
+    got = _run_schedule(lr, 5)
+    want = [0.1 * 0.5 ** (s / 10.0) for s in range(5)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_exponential_decay_staircase():
+    lr = layers.exponential_decay(0.1, decay_steps=3, decay_rate=0.5,
+                                  staircase=True)
+    got = _run_schedule(lr, 7)
+    want = [0.1 * 0.5 ** (s // 3) for s in range(7)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_natural_exp_decay():
+    lr = layers.natural_exp_decay(0.1, decay_steps=10, decay_rate=0.5)
+    got = _run_schedule(lr, 5)
+    want = [0.1 * math.exp(-0.5 * s / 10.0) for s in range(5)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_inverse_time_decay():
+    lr = layers.inverse_time_decay(0.1, decay_steps=10, decay_rate=0.5)
+    got = _run_schedule(lr, 5)
+    want = [0.1 / (1.0 + 0.5 * s / 10.0) for s in range(5)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_noam_decay():
+    d_model, warmup = 64, 4
+    lr = layers.noam_decay(d_model, warmup)
+    got = _run_schedule(lr, 8)
+    want = [d_model ** -0.5 * min(s ** -0.5, s * warmup ** -1.5)
+            for s in range(1, 9)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+@pytest.mark.parametrize("cycle", [False, True])
+def test_polynomial_decay(cycle):
+    lr = layers.polynomial_decay(0.1, decay_steps=5, end_learning_rate=0.01,
+                                 power=2.0, cycle=cycle)
+    got = _run_schedule(lr, 12)
+    want = []
+    for s in range(12):
+        if cycle:
+            div = max(1.0, math.ceil(s / 5.0))
+            steps = 5.0 * div
+            frac = s / steps
+        else:
+            frac = min(float(s), 5.0) / 5.0
+        want.append((0.1 - 0.01) * (1 - frac) ** 2.0 + 0.01)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_piecewise_decay():
+    lr = layers.piecewise_decay(boundaries=[3, 6], values=[0.1, 0.05, 0.01])
+    got = _run_schedule(lr, 9)
+    want = [0.1 if s < 3 else (0.05 if s < 6 else 0.01) for s in range(9)]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_cosine_decay():
+    lr = layers.cosine_decay(0.1, step_each_epoch=2, epochs=4)
+    got = _run_schedule(lr, 8)
+    want = [0.1 * 0.5 * (math.cos((s // 2) * math.pi / 4) + 1)
+            for s in range(8)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_scheduler_drives_optimizer():
+    """A scheduler var feeds Optimizer(learning_rate=Variable) and the
+    effective step size shrinks accordingly."""
+    x = layers.data(name="x", shape=[4])
+    y = layers.data(name="y", shape=[1])
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    lr = layers.exponential_decay(0.1, decay_steps=1, decay_rate=0.5)
+    opt = pt.optimizer.SGDOptimizer(learning_rate=lr)
+    opt.minimize(loss)
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(8, 4).astype(np.float32),
+            "y": rng.rand(8, 1).astype(np.float32)}
+    vals = []
+    for _ in range(3):
+        (v,) = exe.run(pt.default_main_program(), feed=feed,
+                       fetch_list=[lr])
+        vals.append(float(np.asarray(v).reshape(())))
+    np.testing.assert_allclose(vals, [0.1, 0.05, 0.025], rtol=1e-5)

@@ -59,6 +59,10 @@ def _load() -> ctypes.CDLL:
     lib.rio_loader_next.restype = ctypes.POINTER(ctypes.c_char)
     lib.rio_loader_next.argtypes = [ctypes.c_void_p,
                                     ctypes.POINTER(ctypes.c_uint32)]
+    lib.rio_loader_failed_files.restype = ctypes.c_uint32
+    lib.rio_loader_failed_files.argtypes = [ctypes.c_void_p]
+    lib.rio_loader_skipped.restype = ctypes.c_uint32
+    lib.rio_loader_skipped.argtypes = [ctypes.c_void_p]
     lib.rio_loader_close.argtypes = [ctypes.c_void_p]
     _lib = lib
     return lib
@@ -97,6 +101,12 @@ class RecordIOWriter:
         if self._h:
             self._lib.rio_writer_close(self._h)
             self._h = None
+
+    def __del__(self):  # flush the buffered tail chunk if never closed
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def __enter__(self):
         return self
@@ -138,6 +148,12 @@ class RecordIOScanner:
             self._lib.rio_scanner_close(self._h)
             self._h = None
 
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
     def __enter__(self):
         return self
 
@@ -154,6 +170,9 @@ class ParallelRecordLoader:
                  queue_capacity: int = 256):
         enforce(len(paths) > 0, "need at least one file",
                 exc=InvalidArgumentError)
+        missing = [p for p in paths if not os.path.exists(p)]
+        enforce(not missing, f"recordio files not found: {missing}",
+                exc=NotFoundError)
         lib = _load()
         self._lib = lib
         arr = (ctypes.c_char_p * len(paths))(*[p.encode() for p in paths])
@@ -167,13 +186,32 @@ class ParallelRecordLoader:
             enforce(self._h, "loader is closed", exc=InvalidArgumentError)
             p = self._lib.rio_loader_next(self._h, ctypes.byref(n))
             if not p:
+                # workers are done; a file that raced past the ctor
+                # existence check (deleted/unreadable) must not pass as
+                # silent data loss
+                failed = self._lib.rio_loader_failed_files(self._h)
+                if failed:
+                    raise IOError(f"{failed} recordio file(s) could not "
+                                  f"be opened by the loader")
                 return
             yield ctypes.string_at(p, n.value)
+
+    @property
+    def skipped_chunks(self) -> int:
+        """Corrupt chunks skipped (summed over finished files)."""
+        enforce(self._h, "loader is closed", exc=InvalidArgumentError)
+        return self._lib.rio_loader_skipped(self._h)
 
     def close(self):
         if self._h:
             self._lib.rio_loader_close(self._h)
             self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def __enter__(self):
         return self

@@ -149,8 +149,14 @@ class Scanner {
       }
       std::string payload(h.comp_len, '\0');
       if (h.comp_len &&
-          std::fread(&payload[0], h.comp_len, 1, f_) != 1)
-        return false;
+          std::fread(&payload[0], h.comp_len, 1, f_) != 1) {
+        // short read: corrupt length header or truncated file — count it
+        // and resync instead of silently ending the scan
+        ++skipped_;
+        std::fseek(f_, pos + 1, SEEK_SET);
+        if (!Resync()) return false;
+        continue;
+      }
       if (Crc(payload.data(), payload.size()) != h.crc) {
         ++skipped_;
         std::fseek(f_, pos + 1, SEEK_SET);
@@ -270,6 +276,10 @@ class Loader {
   Loader(const std::vector<std::string>& files, int num_threads,
          size_t queue_cap)
       : queue_(queue_cap) {
+    if (files.empty()) {  // no workers will ever close the queue
+      queue_.Close();
+      return;
+    }
     if (num_threads <= 0) num_threads = 1;
     if (num_threads > static_cast<int>(files.size()))
       num_threads = static_cast<int>(files.size());
@@ -294,16 +304,23 @@ class Loader {
 
   ~Loader() { Shutdown(); }
 
+  uint32_t failed_files() const { return failed_files_.load(); }
+  uint32_t skipped_chunks() const { return skipped_chunks_.load(); }
+
  private:
   void Work(const std::vector<std::string>& files) {
     for (const auto& path : files) {
       Scanner s(path.c_str());
-      if (!s.ok()) continue;
+      if (!s.ok()) {
+        ++failed_files_;  // surfaced via rio_loader_failed_files
+        continue;
+      }
       uint32_t len;
       const char* p;
       while ((p = s.Next(&len)) != nullptr) {
         if (!queue_.Push(std::string(p, len))) return;  // closed
       }
+      skipped_chunks_ += s.skipped_chunks();
     }
     if (--pending_workers_ == 0) queue_.Close();  // EOF for consumers
   }
@@ -311,6 +328,8 @@ class Loader {
   BlockingQueue queue_;
   std::vector<std::thread> workers_;
   std::atomic<int> pending_workers_{0};
+  std::atomic<uint32_t> failed_files_{0};
+  std::atomic<uint32_t> skipped_chunks_{0};
 };
 
 thread_local std::string g_last;  // holds Pop/Next result for the C ABI
@@ -358,9 +377,9 @@ void* rio_scanner_open(const char* path) {
 // from the same thread) or nullptr at EOF
 const char* rio_scanner_next(void* h, uint32_t* len) {
   const char* p = static_cast<Scanner*>(h)->Next(len);
-  if (!p) return nullptr;
-  g_last.assign(p, *len);
-  return g_last.data();
+  // Scanner::Next's pointer stays valid until the next call on this
+  // scanner — no defensive copy needed.
+  return p;
 }
 
 uint32_t rio_scanner_skipped(void* h) {
@@ -379,6 +398,14 @@ const char* rio_loader_next(void* h, uint32_t* len) {
   if (!static_cast<Loader*>(h)->Next(&g_last)) return nullptr;
   *len = static_cast<uint32_t>(g_last.size());
   return g_last.data();
+}
+
+uint32_t rio_loader_failed_files(void* h) {
+  return static_cast<Loader*>(h)->failed_files();
+}
+
+uint32_t rio_loader_skipped(void* h) {
+  return static_cast<Loader*>(h)->skipped_chunks();
 }
 
 void rio_loader_close(void* h) { delete static_cast<Loader*>(h); }

@@ -99,3 +99,52 @@ class TestNumpyRecords:
     def test_missing_file(self):
         with pytest.raises(Exception):
             RecordIOScanner("/nonexistent/file.rio")
+
+
+class TestFailureModes:
+    def test_truncated_file_counts_skipped(self, tmp_path):
+        """A file truncated mid-chunk loses only the tail; the short read is
+        counted as a skipped chunk, not reported as clean EOF."""
+        path = str(tmp_path / "t.rio")
+        recs = [bytes([i]) * 512 for i in range(64)]
+        _write(path, recs, max_chunk_bytes=2048)
+        data = open(path, "rb").read()
+        open(path, "wb").write(data[:len(data) - 100])
+        with RecordIOScanner(path) as s:
+            got = list(s)
+            assert s.skipped_chunks >= 1
+        assert 0 < len(got) < len(recs)
+        assert all(g in recs for g in got)
+
+    def test_corrupt_length_header_resyncs(self, tmp_path):
+        """Inflating a chunk's comp_len header must not silently drop the
+        rest of the file — scanner resyncs on the next chunk magic."""
+        import struct
+        path = str(tmp_path / "h.rio")
+        recs = [bytes([i]) * 512 for i in range(64)]
+        _write(path, recs, max_chunk_bytes=2048)
+        data = bytearray(open(path, "rb").read())
+        # first chunk header: magic(4) num_records(4) raw_len(4) comp_len(4)
+        data[12:16] = struct.pack("<I", len(data) * 2)
+        open(path, "wb").write(bytes(data))
+        with RecordIOScanner(path) as s:
+            got = list(s)
+            assert s.skipped_chunks >= 1
+        assert len(got) > 0  # later chunks recovered
+        assert all(g in recs for g in got)
+
+    def test_loader_missing_file_raises(self, tmp_path):
+        path = str(tmp_path / "ok.rio")
+        _write(path, [b"x"])
+        with pytest.raises(Exception):
+            ParallelRecordLoader([path, str(tmp_path / "nope.rio")])
+
+    def test_writer_del_flushes(self, tmp_path):
+        path = str(tmp_path / "d.rio")
+        w = RecordIOWriter(path)
+        w.write(b"tail-record")
+        del w
+        import gc
+        gc.collect()
+        with RecordIOScanner(path) as s:
+            assert list(s) == [b"tail-record"]

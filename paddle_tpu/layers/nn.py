@@ -776,3 +776,181 @@ def slice(input, axes, starts, ends, name=None):
                      attrs={"axes": list(axes), "starts": list(starts),
                             "ends": list(ends)})
     return out
+
+
+# ---------------------------------------------------------------- losses
+# ≙ reference nn.py / operators "Losses" family (SURVEY §2.2)
+
+
+def rank_loss(label, left, right, name=None):
+    """Pairwise RankNet loss (≙ rank_loss_op.cc)."""
+    helper = LayerHelper("rank_loss", name=name)
+    out = helper.create_tmp_variable(dtype=dtype_name(left.dtype),
+                                     shape=left.shape)
+    helper.append_op(type="rank_loss",
+                     inputs={"Label": [label], "Left": [left],
+                             "Right": [right]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    """≙ margin_rank_loss_op.cc: max(0, -label*(left-right) + margin)."""
+    helper = LayerHelper("margin_rank_loss", name=name)
+    out = helper.create_tmp_variable(dtype=dtype_name(left.dtype),
+                                     shape=left.shape)
+    act = helper.create_tmp_variable(dtype=dtype_name(left.dtype),
+                                     shape=left.shape, stop_gradient=True)
+    helper.append_op(type="margin_rank_loss",
+                     inputs={"Label": [label], "X1": [left], "X2": [right]},
+                     outputs={"Out": [out], "Activated": [act]},
+                     attrs={"margin": float(margin)})
+    return out
+
+
+def hinge_loss(input, label, name=None):
+    """≙ hinge_loss_op.cc: max(0, 1 - input*(2*label-1))."""
+    helper = LayerHelper("hinge_loss", name=name)
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=input.shape)
+    helper.append_op(type="hinge_loss",
+                     inputs={"Logits": [input], "Labels": [label]},
+                     outputs={"Loss": [out]})
+    return out
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """≙ log_loss_op.cc: binary CE on probabilities."""
+    helper = LayerHelper("log_loss", name=name)
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=input.shape)
+    helper.append_op(type="log_loss",
+                     inputs={"Predicted": [input], "Labels": [label]},
+                     outputs={"Loss": [out]},
+                     attrs={"epsilon": float(epsilon)})
+    return out
+
+
+def cos_sim(X, Y, name=None):
+    """Row-wise cosine similarity; Y may be one row (≙ cos_sim_op.cc)."""
+    helper = LayerHelper("cos_sim", name=name)
+    out = helper.create_tmp_variable(dtype=dtype_name(X.dtype),
+                                     shape=[X.shape[0], 1])
+    xn = helper.create_tmp_variable(dtype=dtype_name(X.dtype),
+                                    shape=[X.shape[0], 1],
+                                    stop_gradient=True)
+    yn = helper.create_tmp_variable(dtype=dtype_name(X.dtype),
+                                    shape=[Y.shape[0], 1],
+                                    stop_gradient=True)
+    helper.append_op(type="cos_sim", inputs={"X": [X], "Y": [Y]},
+                     outputs={"Out": [out], "XNorm": [xn], "YNorm": [yn]})
+    return out
+
+
+def squared_l2_norm(x, name=None):
+    """sum(x**2) (≙ squared_l2_norm_op.cc)."""
+    helper = LayerHelper("squared_l2_norm", name=name)
+    out = helper.create_tmp_variable(dtype=dtype_name(x.dtype), shape=[1])
+    helper.append_op(type="squared_l2_norm", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def squared_l2_distance(x, y, name=None):
+    """Row-wise ||x-y||^2 (≙ squared_l2_distance_op.cc)."""
+    helper = LayerHelper("squared_l2_distance", name=name)
+    out = helper.create_tmp_variable(dtype=dtype_name(x.dtype),
+                                     shape=[x.shape[0], 1])
+    sub = helper.create_tmp_variable(dtype=dtype_name(x.dtype),
+                                     shape=x.shape, stop_gradient=True)
+    helper.append_op(type="squared_l2_distance",
+                     inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out], "sub_result": [sub]})
+    return out
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """out[n,k] = x[n] @ W_k @ y[n]^T (≙ bilinear_tensor_product_op.cc)."""
+    helper = LayerHelper("bilinear_tensor_product", name=name, act=act,
+                         param_attr=param_attr, bias_attr=bias_attr)
+    dx, dy = x.shape[1], y.shape[1]
+    w = helper.create_parameter(attr=param_attr, shape=[size, dx, dy],
+                                dtype=dtype_name(x.dtype))
+    inputs = {"X": [x], "Y": [y], "Weight": [w]}
+    if bias_attr is not False:
+        bias = helper.create_parameter(attr=bias_attr, shape=[1, size],
+                                       dtype=dtype_name(x.dtype),
+                                       is_bias=True)
+        inputs["Bias"] = [bias]
+    out = helper.create_tmp_variable(dtype=dtype_name(x.dtype),
+                                     shape=[x.shape[0], size])
+    helper.append_op(type="bilinear_tensor_product", inputs=inputs,
+                     outputs={"Out": [out]})
+    return helper.append_activation(out)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None):
+    """NCE loss with a uniform negative sampler (≙ nce_op.cc + layers/nn.py
+    nce). Returns per-example cost [N, 1]."""
+    helper = LayerHelper("nce", name=name, param_attr=param_attr,
+                         bias_attr=bias_attr)
+    dim = input.shape[1]
+    w = helper.create_parameter(attr=param_attr,
+                                shape=[num_total_classes, dim],
+                                dtype=dtype_name(input.dtype))
+    inputs = {"Input": [input], "Label": [label], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=bias_attr,
+                                    shape=[num_total_classes],
+                                    dtype=dtype_name(input.dtype),
+                                    is_bias=True)
+        inputs["Bias"] = [b]
+    if sample_weight is not None:
+        inputs["SampleWeight"] = [sample_weight]
+    n = input.shape[0]
+    cost = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                      shape=[n, 1])
+    slog = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                      shape=[n, num_neg_samples + 1],
+                                      stop_gradient=True)
+    slab = helper.create_tmp_variable(dtype="int64",
+                                      shape=[n, num_neg_samples + 1],
+                                      stop_gradient=True)
+    helper.append_op(type="nce", inputs=inputs,
+                     outputs={"Cost": [cost], "SampleLogits": [slog],
+                              "SampleLabels": [slab]},
+                     attrs={"num_total_classes": int(num_total_classes),
+                            "num_neg_samples": int(num_neg_samples)})
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None):
+    """Hierarchical sigmoid over a complete binary tree
+    (≙ hsigmoid_op.cc + math/matrix_bit_code.h). Returns cost [N, 1]."""
+    helper = LayerHelper("hierarchical_sigmoid", name=name,
+                         param_attr=param_attr, bias_attr=bias_attr)
+    dim = input.shape[1]
+    import math as _math
+    max_len = int(_math.ceil(_math.log2(num_classes))) + 1
+    w = helper.create_parameter(attr=param_attr,
+                                shape=[num_classes - 1, dim],
+                                dtype=dtype_name(input.dtype))
+    inputs = {"X": [input], "Label": [label], "W": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=bias_attr,
+                                    shape=[num_classes - 1, 1],
+                                    dtype=dtype_name(input.dtype),
+                                    is_bias=True)
+        inputs["Bias"] = [b]
+    n = input.shape[0]
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=[n, 1])
+    pre = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=[n, max_len], stop_gradient=True)
+    helper.append_op(type="hierarchical_sigmoid", inputs=inputs,
+                     outputs={"Out": [out], "PreOut": [pre]},
+                     attrs={"num_classes": int(num_classes)})
+    return out

@@ -114,7 +114,13 @@ class Writer {
 // --------------------------------------------------------------- scanner
 class Scanner {
  public:
-  explicit Scanner(const char* path) : f_(std::fopen(path, "rb")) {}
+  explicit Scanner(const char* path) : f_(std::fopen(path, "rb")) {
+    if (f_) {
+      std::fseek(f_, 0, SEEK_END);
+      file_size_ = std::ftell(f_);
+      std::fseek(f_, 0, SEEK_SET);
+    }
+  }
   bool ok() const { return f_ != nullptr; }
 
   // Returns pointer/len valid until the next call; nullptr at EOF.
@@ -142,6 +148,17 @@ class Scanner {
       if (std::fread(&h, sizeof(h), 1, f_) != 1) return false;
       if (h.magic != kMagic) {
         // resync: advance one byte past `pos` and scan for magic
+        ++skipped_;
+        std::fseek(f_, pos + 1, SEEK_SET);
+        if (!Resync()) return false;
+        continue;
+      }
+      // bound the untrusted length by the bytes actually left in the file
+      // BEFORE allocating — a corrupt comp_len must become a skipped chunk,
+      // not a std::bad_alloc escaping the C ABI
+      long here = std::ftell(f_);
+      if (here < 0 ||
+          static_cast<long>(h.comp_len) > file_size_ - here) {
         ++skipped_;
         std::fseek(f_, pos + 1, SEEK_SET);
         if (!Resync()) return false;
@@ -220,6 +237,7 @@ class Scanner {
   std::vector<std::string> records_;
   size_t idx_ = 0;
   uint32_t skipped_ = 0;
+  long file_size_ = 0;
 };
 
 // ------------------------------------------------- bounded blocking queue

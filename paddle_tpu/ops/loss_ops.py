@@ -1,0 +1,82 @@
+"""Sampled / hierarchical loss ops: NCE and hierarchical sigmoid.
+
+≙ reference operators/nce_op.cc and operators/hsigmoid_op.cc (+
+operators/math/matrix_bit_code.h). The rest of the loss family
+(rank/margin_rank/hinge/log/cos_sim/bilinear/squared_l2*) lives in
+nn_ops.py / reduce_ops.py. Gradients come from the executor's vjp region.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+@register_op("nce")
+def _nce(ctx, ins, attrs):
+    """Noise-contrastive estimation with a uniform negative sampler
+    (≙ nce_op.cc with sampler=uniform). Negatives are drawn per step from
+    ctx's PRNG; the logit correction log(S/C) makes the objective a
+    consistent estimator of softmax CE."""
+    x = ins["Input"][0]                     # [N, D]
+    label = ins["Label"][0].reshape(-1)     # [N]
+    w = ins["Weight"][0]                    # [C, D]
+    num_total = attrs["num_total_classes"]
+    num_neg = attrs.get("num_neg_samples", 10)
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+
+    key = ctx.next_key()
+    neg = jax.random.randint(key, (x.shape[0], num_neg), 0, num_total)
+
+    def logit(cls):  # cls: [...] int → [...] logits
+        lg = jnp.einsum("nd,n...d->n...", x, w[cls])
+        if bias is not None:
+            lg = lg + bias.reshape(-1)[cls].reshape(lg.shape)
+        return lg
+
+    pos_logit = logit(label)                            # [N]
+    neg_logit = logit(neg)                              # [N, num_neg]
+    corr = math.log(num_neg / num_total)                # log expected count
+    pos_cost = jax.nn.softplus(-(pos_logit - corr))
+    neg_cost = jnp.sum(jax.nn.softplus(neg_logit - corr), axis=-1)
+    cost = (pos_cost + neg_cost).reshape(-1, 1)
+    if ins.get("SampleWeight"):
+        cost = cost * ins["SampleWeight"][0].reshape(-1, 1)
+    return {"Cost": [cost],
+            "SampleLogits": [jnp.concatenate(
+                [pos_logit[:, None], neg_logit], axis=1)],
+            "SampleLabels": [jnp.concatenate(
+                [label[:, None], neg], axis=1)]}
+
+
+@register_op("hierarchical_sigmoid")
+def _hsigmoid(ctx, ins, attrs):
+    """SimpleCodeTable semantics of the reference
+    (operators/math/matrix_bit_code.h): label's path code is
+    label + num_classes in a complete binary tree; bit j (LSB-up) targets
+    internal node (code >> (j+1)) - 1, with sigmoid-CE target bit j's value.
+    Vectorized over a fixed max path length with masking — static shapes
+    for XLA."""
+    x = ins["X"][0]                          # [N, D]
+    label = ins["Label"][0].reshape(-1)      # [N]
+    w = ins["W"][0]                          # [C-1, D]
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    num_classes = attrs["num_classes"]
+    max_len = int(math.ceil(math.log2(num_classes))) + 1
+
+    code = label + num_classes               # [N]
+    js = jnp.arange(max_len)                 # [L]
+    node = (code[:, None] >> (js[None, :] + 1)) - 1        # [N, L]
+    bit = (code[:, None] >> js[None, :]) & 1               # [N, L]
+    valid = node >= 0
+    node_c = jnp.where(valid, node, 0)
+    logits = jnp.einsum("nd,nld->nl", x, w[node_c])        # [N, L]
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[node_c]
+    ce = jax.nn.softplus(logits) - bit.astype(x.dtype) * logits
+    cost = jnp.sum(jnp.where(valid, ce, 0.0), axis=1, keepdims=True)
+    return {"Out": [cost], "PreOut": [logits]}

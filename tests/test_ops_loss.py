@@ -1,0 +1,204 @@
+"""Loss-family op checks vs numpy references + numeric grads.
+
+≙ reference tests/unittests/test_{rank_loss,margin_rank_loss,hinge_loss,
+log_loss,cos_sim,bilinear_tensor_product,squared_l2_norm,
+squared_l2_distance,nce,hsigmoid}_op.py.
+"""
+
+import math
+
+import numpy as np
+
+from op_test import check_grad, check_output, run_op
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+class TestPairwiseLosses:
+    def test_rank_loss(self, rng):
+        label = rng.randint(0, 2, (8, 1)).astype(np.float32)
+        left = rng.randn(8, 1).astype(np.float32)
+        right = rng.randn(8, 1).astype(np.float32)
+        o = left - right
+        want = np.log(1.0 + np.exp(o)) - label * o
+        check_output("rank_loss", {"Label": label, "Left": left,
+                                   "Right": right}, {"Out": want}, rtol=1e-4)
+        check_grad("rank_loss", {"Label": label, "Left": left,
+                                 "Right": right}, ["Left", "Right"])
+
+    def test_margin_rank_loss(self, rng):
+        label = (rng.randint(0, 2, (8, 1)) * 2 - 1).astype(np.float32)
+        x1 = rng.randn(8, 1).astype(np.float32)
+        x2 = rng.randn(8, 1).astype(np.float32)
+        want = np.maximum(0.0, -label * (x1 - x2) + 0.1)
+        check_output("margin_rank_loss", {"Label": label, "X1": x1, "X2": x2},
+                     {"Out": want}, attrs={"margin": 0.1})
+
+    def test_hinge_loss(self, rng):
+        pred = rng.randn(10, 1).astype(np.float32)
+        label = rng.randint(0, 2, (10, 1)).astype(np.float32)
+        want = np.maximum(0.0, 1.0 - pred * (2 * label - 1))
+        check_output("hinge_loss", {"Logits": pred, "Labels": label},
+                     {"Loss": want})
+
+    def test_log_loss(self, rng):
+        pred = rng.uniform(0.05, 0.95, (10, 1)).astype(np.float32)
+        label = rng.randint(0, 2, (10, 1)).astype(np.float32)
+        eps = 1e-4
+        want = (-label * np.log(pred + eps)
+                - (1 - label) * np.log(1 - pred + eps))
+        check_output("log_loss", {"Predicted": pred, "Labels": label},
+                     {"Loss": want}, attrs={"epsilon": eps}, rtol=1e-4)
+        check_grad("log_loss", {"Predicted": pred, "Labels": label},
+                   ["Predicted"], out_slot="Loss", attrs={"epsilon": eps})
+
+
+class TestSimilarity:
+    def test_cos_sim(self, rng):
+        x = rng.randn(6, 8).astype(np.float32)
+        y = rng.randn(6, 8).astype(np.float32)
+        want = (np.sum(x * y, 1) /
+                (np.linalg.norm(x, axis=1) *
+                 np.linalg.norm(y, axis=1)))[:, None]
+        check_output("cos_sim", {"X": x, "Y": y}, {"Out": want}, rtol=1e-4)
+        check_grad("cos_sim", {"X": x, "Y": y}, ["X", "Y"])
+
+    def test_cos_sim_broadcast(self, rng):
+        x = rng.randn(6, 8).astype(np.float32)
+        y = rng.randn(1, 8).astype(np.float32)
+        want = (np.sum(x * y, 1) /
+                (np.linalg.norm(x, axis=1) * np.linalg.norm(y)))[:, None]
+        check_output("cos_sim", {"X": x, "Y": y}, {"Out": want}, rtol=1e-4)
+
+    def test_squared_l2_norm(self, rng):
+        x = rng.randn(4, 5).astype(np.float32)
+        check_output("squared_l2_norm", {"X": x},
+                     {"Out": np.array([np.sum(x ** 2)])}, rtol=1e-4)
+        check_grad("squared_l2_norm", {"X": x}, ["X"])
+
+    def test_squared_l2_distance(self, rng):
+        x = rng.randn(4, 5).astype(np.float32)
+        y = rng.randn(4, 5).astype(np.float32)
+        want = np.sum((x - y) ** 2, axis=1, keepdims=True)
+        check_output("squared_l2_distance", {"X": x, "Y": y}, {"Out": want},
+                     rtol=1e-4)
+        check_grad("squared_l2_distance", {"X": x, "Y": y}, ["X"])
+
+    def test_bilinear_tensor_product(self, rng):
+        x = rng.randn(3, 4).astype(np.float32)
+        y = rng.randn(3, 5).astype(np.float32)
+        w = rng.randn(2, 4, 5).astype(np.float32)
+        b = rng.randn(1, 2).astype(np.float32)
+        want = np.einsum("nd,kde,ne->nk", x, w, y) + b
+        check_output("bilinear_tensor_product",
+                     {"X": x, "Y": y, "Weight": w, "Bias": b},
+                     {"Out": want}, rtol=1e-4)
+        check_grad("bilinear_tensor_product",
+                   {"X": x, "Y": y, "Weight": w, "Bias": b},
+                   ["X", "Y", "Weight"])
+
+
+class TestNCE:
+    def test_nce_shapes_and_grad_flow(self, rng):
+        n, d, c, k = 6, 8, 20, 5
+        x = rng.randn(n, d).astype(np.float32)
+        label = rng.randint(0, c, (n, 1)).astype(np.int64)
+        w = rng.randn(c, d).astype(np.float32) * 0.1
+        b = rng.randn(c).astype(np.float32) * 0.1
+        out = run_op("nce", {"Input": x, "Label": label, "Weight": w,
+                             "Bias": b},
+                     {"num_total_classes": c, "num_neg_samples": k})
+        assert out["Cost"][0].shape == (n, 1)
+        assert np.all(out["Cost"][0] > 0)
+        assert out["SampleLogits"][0].shape == (n, k + 1)
+        assert out["SampleLabels"][0].shape == (n, k + 1)
+        # positive column holds the true label
+        np.testing.assert_array_equal(out["SampleLabels"][0][:, 0],
+                                      label.reshape(-1))
+        # sampling is deterministic per seed: same seed → same cost
+        out2 = run_op("nce", {"Input": x, "Label": label, "Weight": w,
+                              "Bias": b},
+                      {"num_total_classes": c, "num_neg_samples": k})
+        np.testing.assert_allclose(out["Cost"][0], out2["Cost"][0])
+        check_grad("nce", {"Input": x, "Label": label, "Weight": w,
+                           "Bias": b},
+                   ["Input", "Weight"], out_slot="Cost",
+                   attrs={"num_total_classes": c, "num_neg_samples": k})
+
+    def test_nce_learns(self, rng):
+        """Training with NCE pulls the true class logit above others."""
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        n, d, c = 32, 16, 10
+        x_np = rng.randn(n, d).astype(np.float32)
+        wtrue = rng.randn(d, c).astype(np.float32)
+        y_np = np.argmax(x_np @ wtrue, 1).astype(np.int64)[:, None]
+
+        inp = layers.data(name="x", shape=[d])
+        lab = layers.data(name="y", shape=[1], dtype="int64")
+        cost = layers.nce(inp, lab, num_total_classes=c, num_neg_samples=5)
+        loss = layers.mean(cost)
+        pt.optimizer.SGDOptimizer(learning_rate=0.5).minimize(loss)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        losses = []
+        for _ in range(40):
+            (lo,) = exe.run(pt.default_main_program(),
+                            feed={"x": x_np, "y": y_np}, fetch_list=[loss])
+            losses.append(float(lo))
+        assert losses[-1] < losses[0] * 0.8, losses[::10]
+
+
+class TestHSigmoid:
+    @staticmethod
+    def _ref_hsigmoid(x, label, w, b, num_classes):
+        n = x.shape[0]
+        cost = np.zeros((n, 1), dtype=np.float64)
+        for i in range(n):
+            c = int(label[i]) + num_classes
+            length = c.bit_length() - 1
+            for j in range(length):
+                node = (c >> (j + 1)) - 1
+                bit = (c >> j) & 1
+                pre = float(x[i] @ w[node]) + float(b[node, 0])
+                cost[i, 0] += math.log1p(math.exp(pre)) - bit * pre
+        return cost
+
+    def test_hsigmoid_matches_bitcode_reference(self, rng):
+        n, d, c = 5, 6, 7
+        x = rng.randn(n, d).astype(np.float32) * 0.5
+        label = rng.randint(0, c, (n, 1)).astype(np.int64)
+        w = rng.randn(c - 1, d).astype(np.float32) * 0.5
+        b = rng.randn(c - 1, 1).astype(np.float32) * 0.5
+        want = self._ref_hsigmoid(x, label, w, b, c)
+        check_output("hierarchical_sigmoid",
+                     {"X": x, "Label": label, "W": w, "Bias": b},
+                     {"Out": want.astype(np.float32)},
+                     attrs={"num_classes": c}, rtol=1e-3, atol=1e-4)
+        check_grad("hierarchical_sigmoid",
+                   {"X": x, "Label": label, "W": w, "Bias": b},
+                   ["X", "W"], attrs={"num_classes": c})
+
+    def test_hsigmoid_layer_trains(self, rng):
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        n, d, c = 32, 12, 8
+        x_np = rng.randn(n, d).astype(np.float32)
+        wtrue = rng.randn(d, c).astype(np.float32)
+        y_np = np.argmax(x_np @ wtrue, 1).astype(np.int64)[:, None]
+
+        inp = layers.data(name="x", shape=[d])
+        lab = layers.data(name="y", shape=[1], dtype="int64")
+        cost = layers.hsigmoid(inp, lab, num_classes=c)
+        loss = layers.mean(cost)
+        pt.optimizer.SGDOptimizer(learning_rate=0.5).minimize(loss)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        losses = []
+        for _ in range(40):
+            (lo,) = exe.run(pt.default_main_program(),
+                            feed={"x": x_np, "y": y_np}, fetch_list=[loss])
+            losses.append(float(lo))
+        assert losses[-1] < losses[0] * 0.7, losses[::10]

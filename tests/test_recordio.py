@@ -148,3 +148,18 @@ class TestFailureModes:
         gc.collect()
         with RecordIOScanner(path) as s:
             assert list(s) == [b"tail-record"]
+
+    def test_huge_comp_len_header_no_abort(self, tmp_path):
+        """comp_len corrupted to ~4GB must be bounded by remaining file size
+        (skipped chunk), never a std::bad_alloc aborting the process."""
+        import struct
+        path = str(tmp_path / "big.rio")
+        recs = [bytes([i]) * 512 for i in range(64)]
+        _write(path, recs, max_chunk_bytes=2048)
+        data = bytearray(open(path, "rb").read())
+        data[12:16] = struct.pack("<I", 0xFFFFFFF0)
+        open(path, "wb").write(bytes(data))
+        with RecordIOScanner(path) as s:
+            got = list(s)
+            assert s.skipped_chunks >= 1
+        assert len(got) > 0

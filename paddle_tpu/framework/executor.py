@@ -99,8 +99,11 @@ class Executor:
         feed_names = list(feed_names)
 
         def step(feed_vals, ro_vals, rw_vals, seed):
+            # fetch_names ride along so live-out-narrowed vjp regions
+            # (transpiler.memory_optimize) never drop a fetch target
             ctx = LowerCtx(rng_key=jax.random.PRNGKey(seed),
-                           extras={"program": program})
+                           extras={"program": program,
+                                   "fetch_names": tuple(fetch_names)})
             env: Dict[str, Any] = {}
             env.update(zip(ro, ro_vals))
             env.update(zip(rw, rw_vals))

@@ -152,6 +152,14 @@ def run_vjp_region(region_op: Operator, seg_indices: Sequence[int],
             if n not in produced:
                 produced.append(n)
 
+    # memory_optimize (transpiler/memory_optimization.py) narrows the forward
+    # vars published out of the region to the live-out set it computed, plus
+    # whatever this run actually fetches (liveness can't see fetch lists).
+    live_out = attrs.get("live_out")
+    if live_out is not None:
+        live = set(live_out) | set(ctx.extras.get("fetch_names", ()))
+        produced = [n for n in produced if n in live]
+
     # Snapshot of everything the segment may read, minus the diff targets.
     base_env = {k: v for k, v in env.items()}
 
@@ -163,6 +171,15 @@ def run_vjp_region(region_op: Operator, seg_indices: Sequence[int],
         loss = env2[loss_name]
         aux = tuple(env2[n] for n in produced)
         return loss, aux
+
+    # Rematerialization (set by transpiler.memory_optimize ≙ the reference's
+    # memory_optimization_transpiler): trade FLOPs for HBM by recomputing the
+    # forward in the backward pass under the chosen checkpoint policy.
+    if attrs.get("remat"):
+        policy_name = attrs.get("remat_policy")
+        policy = (getattr(jax.checkpoint_policies, policy_name)
+                  if policy_name else None)
+        fwd = jax.checkpoint(fwd, policy=policy)
 
     target_vals = tuple(env[n] for n in target_names)
     loss_val, vjp_fn, aux = jax.vjp(fwd, target_vals, has_aux=True)

@@ -933,8 +933,8 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
     helper = LayerHelper("hierarchical_sigmoid", name=name,
                          param_attr=param_attr, bias_attr=bias_attr)
     dim = input.shape[1]
-    import math as _math
-    max_len = int(_math.ceil(_math.log2(num_classes))) + 1
+    from ..ops.loss_ops import hsigmoid_code_length
+    max_len = hsigmoid_code_length(num_classes)
     w = helper.create_parameter(attr=param_attr,
                                 shape=[num_classes - 1, dim],
                                 dtype=dtype_name(input.dtype))

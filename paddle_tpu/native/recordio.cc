@@ -144,7 +144,7 @@ class Scanner {
   bool LoadChunk() {
     Header h;
     for (;;) {
-      long pos = std::ftell(f_);
+      long long pos = std::ftell(f_);
       if (std::fread(&h, sizeof(h), 1, f_) != 1) return false;
       if (h.magic != kMagic) {
         // resync: advance one byte past `pos` and scan for magic
@@ -156,9 +156,9 @@ class Scanner {
       // bound the untrusted length by the bytes actually left in the file
       // BEFORE allocating — a corrupt comp_len must become a skipped chunk,
       // not a std::bad_alloc escaping the C ABI
-      long here = std::ftell(f_);
+      long long here = std::ftell(f_);
       if (here < 0 ||
-          static_cast<long>(h.comp_len) > file_size_ - here) {
+          static_cast<long long>(h.comp_len) > file_size_ - here) {
         ++skipped_;
         std::fseek(f_, pos + 1, SEEK_SET);
         if (!Resync()) return false;
@@ -237,7 +237,7 @@ class Scanner {
   std::vector<std::string> records_;
   size_t idx_ = 0;
   uint32_t skipped_ = 0;
-  long file_size_ = 0;
+  long long file_size_ = 0;
 };
 
 // ------------------------------------------------- bounded blocking queue

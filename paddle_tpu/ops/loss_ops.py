@@ -16,6 +16,13 @@ import jax.numpy as jnp
 from ..framework.registry import register_op
 
 
+def hsigmoid_code_length(num_classes: int) -> int:
+    """Max root-to-leaf path length of the complete binary tree used by
+    hierarchical_sigmoid (shared by the op lowering and the layer wrapper
+    so declared shapes can't drift from produced shapes)."""
+    return int(math.ceil(math.log2(num_classes))) + 1
+
+
 @register_op("nce")
 def _nce(ctx, ins, attrs):
     """Noise-contrastive estimation with a uniform negative sampler
@@ -66,7 +73,7 @@ def _hsigmoid(ctx, ins, attrs):
     w = ins["W"][0]                          # [C-1, D]
     bias = ins["Bias"][0] if ins.get("Bias") else None
     num_classes = attrs["num_classes"]
-    max_len = int(math.ceil(math.log2(num_classes))) + 1
+    max_len = hsigmoid_code_length(num_classes)
 
     code = label + num_classes               # [N]
     js = jnp.arange(max_len)                 # [L]

@@ -1,0 +1,28 @@
+"""Program-rewrite layer (≙ reference python/paddle/fluid/transpiler/).
+
+The reference rewrites ProgramDescs before execution:
+- memory_optimization_transpiler.py:381  — liveness-based var reuse
+- inference_transpiler.py:24             — fold BN into conv weights
+- distribute_transpiler.py:131           — split program into trainer/pserver
+- ps_dispatcher.py                       — shard→endpoint dispatch policies
+
+TPU translation: XLA already does buffer reuse and fusion, so the memory
+transpiler becomes (a) rematerialization policy on the autodiff region and
+(b) live-out narrowing of published forward vars; the inference transpiler
+is a real program+scope rewrite (constant folding BN into conv); the
+distribute transpiler becomes a sharding *planner* over a device mesh rather
+than an RPC program splitter (SURVEY.md §2.3), while keeping the reference's
+API surface so programs written against it keep working.
+"""
+
+from .memory_optimization import memory_optimize, release_memory
+from .inference_transpiler import InferenceTranspiler
+from .quantize_transpiler import QuantizeTranspiler
+from .distribute_transpiler import DistributeTranspiler, slice_variable
+from .ps_dispatcher import HashName, PSDispatcher, RoundRobin
+
+__all__ = [
+    "memory_optimize", "release_memory", "InferenceTranspiler",
+    "QuantizeTranspiler", "DistributeTranspiler", "slice_variable",
+    "PSDispatcher", "RoundRobin", "HashName",
+]

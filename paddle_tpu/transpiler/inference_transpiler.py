@@ -1,0 +1,139 @@
+"""Inference transpiler: fold batch-norm into the preceding conv/fc.
+
+≙ reference python/paddle/fluid/transpiler/inference_transpiler.py:24, which
+rewrites an inference program so that `conv2d → batch_norm` (optionally with a
+bias elementwise_add in between) becomes a single conv with adjusted weights:
+
+    W' = W * (scale / sqrt(var + eps))          (per output channel)
+    b' = (b - mean) * scale / sqrt(var + eps) + offset
+
+The arithmetic is identical here; what differs is the mechanics — the rewrite
+mutates the in-memory Program and the Scope holding parameter values (no
+protobuf round-trip), and XLA recompiles the smaller program on next run.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.enforce import InvalidArgumentError, enforce
+from ..framework.program import Program
+from ..framework.scope import Scope, global_scope
+
+
+def _as_np(x):
+    return np.asarray(x)
+
+
+class InferenceTranspiler:
+    """≙ reference InferenceTranspiler (inference_transpiler.py:24)."""
+
+    def transpile(self, program: Program, place=None, scope: Scope = None):
+        """Fuse batch_norm into conv2d/depthwise_conv2d/mul producers,
+        in place. `place` is accepted for API parity and ignored (XLA owns
+        placement)."""
+        enforce(isinstance(program, Program),
+                InvalidArgumentError, "program must be a Program")
+        scope = scope or global_scope()
+        block = program.global_block()
+        self._fuse_batch_norms(block, scope)
+        program._bump()
+        return program
+
+    # -- internals ---------------------------------------------------------
+
+    def _producer(self, block, name, upto):
+        """Last op before index `upto` writing `name`."""
+        for j in range(upto - 1, -1, -1):
+            if name in block.ops[j].output_names():
+                return j
+        return None
+
+    def _n_readers(self, block, name):
+        return sum(name in op.input_names() for op in block.ops)
+
+    def _fuse_batch_norms(self, block, scope):
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            if op.type != "batch_norm" or not op.attrs.get("is_test"):
+                i += 1
+                continue
+            x_name = op.inputs["X"][0]
+            prod_idx = self._producer(block, x_name, i)
+            if prod_idx is None:
+                i += 1
+                continue
+
+            # walk back through a bias elementwise_add to the conv
+            add_idx = None
+            conv_idx = prod_idx
+            if block.ops[prod_idx].type == "elementwise_add":
+                add_idx = prod_idx
+                conv_in = block.ops[add_idx].inputs["X"][0]
+                conv_idx = self._producer(block, conv_in, add_idx)
+                if conv_idx is None:
+                    i += 1
+                    continue
+            conv = block.ops[conv_idx]
+            if conv.type not in ("conv2d", "depthwise_conv2d", "mul"):
+                i += 1
+                continue
+            # BN input must not feed anything else (rewrite would change it)
+            if self._n_readers(block, x_name) != 1:
+                i += 1
+                continue
+
+            scale = _as_np(scope.get(op.inputs["Scale"][0]))
+            offset = _as_np(scope.get(op.inputs["Bias"][0]))
+            mean = _as_np(scope.get(op.inputs["Mean"][0]))
+            var = _as_np(scope.get(op.inputs["Variance"][0]))
+            eps = op.attrs.get("epsilon", 1e-5)
+            factor = scale / np.sqrt(var + eps)  # [C_out]
+
+            # fold into the producer's weights
+            if conv.type == "mul":
+                w_name = conv.inputs["Y"][0]
+                w = _as_np(scope.get(w_name)).astype(np.float64)
+                w = w * factor[None, :]
+            else:
+                w_name = conv.inputs["Filter"][0]
+                w = _as_np(scope.get(w_name)).astype(np.float64)
+                w = w * factor[:, None, None, None]   # OIHW: out-channel axis 0
+            orig_dtype = _as_np(scope.get(w_name)).dtype
+            scope.set_var(w_name, w.astype(orig_dtype))
+
+            # fold into (possibly existing) bias
+            if add_idx is not None:
+                b_name = block.ops[add_idx].inputs["Y"][0]
+                b = _as_np(scope.get(b_name)).astype(np.float64)
+                b_new = (b - mean) * factor + offset
+                scope.set_var(b_name, b_new.astype(orig_dtype))
+                # batch_norm becomes identity: retarget the add's output name
+                bn_out = op.outputs["Y"][0]
+                block.ops[add_idx].outputs["Out"] = [bn_out]
+                del block.ops[i]
+            else:
+                # no existing bias: turn the batch_norm op itself into the
+                # bias add (keeps op count/positions stable)
+                b_new = (offset - mean * factor).astype(orig_dtype)
+                b_name = op.inputs["Bias"][0] + ".fused"
+                if not block.has_var(b_name):
+                    data_format = conv.attrs.get("data_format", "NCHW")
+                    block.create_var(name=b_name, shape=list(b_new.shape),
+                                     dtype=str(orig_dtype), persistable=True)
+                scope.set_var(b_name, b_new)
+                # axis of the channel dim in the BN input
+                bn_layout = op.attrs.get("data_layout", "NCHW")
+                x_var = block.vars.get(x_name)
+                ndim = len(x_var.shape) if x_var is not None else 4
+                axis = 1 if bn_layout == "NCHW" and ndim == 4 else ndim - 1
+                bn_out = op.outputs["Y"][0]
+                op.type = "elementwise_add"
+                op.inputs = {"X": [x_name], "Y": [b_name]}
+                op.outputs = {"Out": [bn_out]}
+                op.attrs = {"axis": axis, "op_role": op.attrs.get("op_role")}
+                i += 1
+                continue
+            # do not advance: current index now holds the next op
+        return block

@@ -1,0 +1,146 @@
+"""Quantization-aware-training transpiler.
+
+≙ reference fake_quantize_op.cc / fake_dequantize_op.cc (SURVEY.md §2.2
+"Quantization") plus the program-rewrite pattern of the reference's
+transpilers: insert fake-quant (quantize→dequantize with a straight-through
+estimator) on the activation and weight inputs of matmul-bearing ops so
+training observes int8 rounding while gradients flow.
+
+On TPU the quantized *execution* path is XLA int8 matmul; this transpiler
+provides the QAT graph rewrite and a `freeze_program` step that bakes weight
+scales in, mirroring the reference's train→freeze flow.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..framework.program import Program
+from ..framework.scope import Scope, global_scope
+
+_QUANTIZABLE = ("conv2d", "depthwise_conv2d", "mul", "matmul")
+# slot holding the weight operand per op type
+_WEIGHT_SLOT = {"conv2d": "Filter", "depthwise_conv2d": "Filter",
+                "mul": "Y", "matmul": "Y"}
+_ACT_SLOT = {"conv2d": "Input", "depthwise_conv2d": "Input",
+             "mul": "X", "matmul": "X"}
+
+
+class QuantizeTranspiler:
+    """Insert fake-quant ops for QAT; freeze for inference.
+
+    ≙ the reference's fake_quantize/fake_dequantize op pair wired by a
+    program rewrite (quantization hooks, SURVEY.md §7 stage 10).
+    """
+
+    def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
+                 activation_quantize_type: str = "abs_max",
+                 weight_quantize_type: str = "abs_max",
+                 moving_rate: float = 0.9):
+        if activation_quantize_type not in ("abs_max",
+                                            "moving_average_abs_max"):
+            raise ValueError(
+                f"unsupported activation_quantize_type "
+                f"{activation_quantize_type!r}")
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.activation_quantize_type = activation_quantize_type
+        self.weight_quantize_type = weight_quantize_type
+        self.moving_rate = moving_rate
+
+    # -- QAT rewrite -------------------------------------------------------
+
+    def training_transpile(self, program: Optional[Program] = None,
+                           startup_program: Optional[Program] = None):
+        """Rewrite `program` in place: every quantizable op's activation and
+        weight inputs go through a fake_quantize op first."""
+        from ..framework.program import default_main_program
+        program = program or default_main_program()
+        if any(op.type == "vjp_region"
+               for b in program.blocks for op in b.ops):
+            raise RuntimeError(
+                "training_transpile must run BEFORE optimizer.minimize()/"
+                "append_backward — inserting quant ops after autodiff would "
+                "invalidate the recorded forward segment")
+        return self._rewrite_clean(program)
+
+    def _rewrite_clean(self, program: Program) -> Program:
+        from ..framework.program import Operator
+        block = program.global_block()
+        new_ops = []
+        quantized: dict = {}
+        for op in block.ops:
+            if op.type in _QUANTIZABLE and not op.attrs.get("skip_quant") \
+                    and not op.attrs.get("quantized"):
+                for slot, bits, kind in (
+                        (_ACT_SLOT[op.type], self.activation_bits,
+                         self.activation_quantize_type),
+                        (_WEIGHT_SLOT[op.type], self.weight_bits,
+                         self.weight_quantize_type)):
+                    name = op.inputs[slot][0]
+                    key = (name, bits, kind)
+                    if key not in quantized:
+                        src = block.vars.get(name)
+                        qname = name + ".quantized"
+                        sname = name + ".quant_scale"
+                        if not block.has_var(qname):
+                            block.create_var(
+                                name=qname,
+                                shape=None if src is None else src.shape,
+                                dtype="float32" if src is None else src.dtype)
+                            block.create_var(name=sname, shape=[],
+                                             dtype="float32",
+                                             stop_gradient=True)
+                        qtype = ("fake_quantize_abs_max"
+                                 if kind == "abs_max" else
+                                 "fake_quantize_moving_average_abs_max")
+                        qop = Operator(
+                            block, qtype,
+                            inputs={"X": [name]},
+                            outputs={"Out": [qname], "OutScale": [sname]},
+                            attrs={"bit_length": bits,
+                                   "moving_rate": self.moving_rate,
+                                   "op_role": op.attrs.get("op_role")})
+                        if qtype == "fake_quantize_moving_average_abs_max":
+                            qop.inputs["InScale"] = [sname + ".state"]
+                            state = sname + ".state"
+                            if not block.has_var(state):
+                                block.create_var(name=state, shape=[],
+                                                 dtype="float32",
+                                                 persistable=True,
+                                                 stop_gradient=True)
+                        new_ops.append(qop)
+                        quantized[key] = qname
+                    op.inputs[slot] = [quantized[key]]
+                op.attrs["quantized"] = True
+            new_ops.append(op)
+        block.ops[:] = new_ops
+        program._bump()
+        return program
+
+    # -- freeze ------------------------------------------------------------
+
+    def freeze_program(self, program: Program, place=None,
+                       scope: Scope = None) -> Program:
+        """Bake weight quantization into stored weights for inference
+        (≙ the reference freeze flow: weights become their rounded values,
+        activation fake-quant stays as calibrated scale ops)."""
+        scope = scope or global_scope()
+        block = program.global_block()
+        bnt = (1 << (self.weight_bits - 1)) - 1
+        for op in block.ops:
+            if op.type != "fake_quantize_abs_max":
+                continue
+            name = op.inputs["X"][0]
+            if not scope.has_var(name):
+                continue  # activation, not a stored weight
+            w = np.asarray(scope.get(name)).astype(np.float64)
+            s = np.abs(w).max()
+            inv = bnt / max(s, 1e-12)
+            scope.set_var(name, (np.round(w * inv) / inv).astype(np.float32))
+            scope.set_var(op.outputs["OutScale"][0],
+                          np.asarray(s, dtype=np.float32))
+        program._bump()
+        return program

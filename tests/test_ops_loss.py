@@ -157,7 +157,7 @@ class TestHSigmoid:
         n = x.shape[0]
         cost = np.zeros((n, 1), dtype=np.float64)
         for i in range(n):
-            c = int(label[i]) + num_classes
+            c = int(label[i, 0]) + num_classes
             length = c.bit_length() - 1
             for j in range(length):
                 node = (c >> (j + 1)) - 1

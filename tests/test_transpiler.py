@@ -1,0 +1,269 @@
+"""Transpiler layer tests.
+
+≙ reference tests: test_memory_optimization_transpiler.py,
+test_inference_transpiler (BN-fold numerics), test_dist_transpiler.py
+(transpiled program structure asserted without running servers).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.transpiler import (DistributeTranspiler, HashName,
+                                   InferenceTranspiler, QuantizeTranspiler,
+                                   RoundRobin, memory_optimize, release_memory,
+                                   slice_variable)
+
+
+def _mlp():
+    img = layers.data("img", shape=[16], dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+    h = layers.fc(img, size=32, act="relu")
+    logits = layers.fc(h, size=10)
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(logits, label))
+    return img, label, logits, loss
+
+
+class TestMemoryOptimize:
+    def test_remat_same_loss_and_grads(self, rng):
+        img, label, logits, loss = _mlp()
+        opt = pt.optimizer.SGDOptimizer(learning_rate=0.0)  # lr=0: no drift
+        opt.minimize(loss)
+
+        feed = {"img": rng.rand(8, 16).astype("float32"),
+                "label": rng.randint(0, 10, (8, 1)).astype("int64")}
+
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        base = exe.run(feed=feed, fetch_list=[loss])[0]
+
+        memory_optimize(pt.default_main_program(), level=1)
+        opt_loss = exe.run(feed=feed, fetch_list=[loss])[0]
+        np.testing.assert_allclose(base, opt_loss, rtol=1e-5)
+
+    def test_level0_policy_set(self):
+        _, _, _, loss = _mlp()
+        pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        prog = memory_optimize(pt.default_main_program(), level=0)
+        regions = [op for op in prog.global_block().ops
+                   if op.type == "vjp_region"]
+        assert regions and all(op.attrs["remat"] for op in regions)
+        assert regions[0].attrs["remat_policy"] == \
+            "dots_with_no_batch_dims_saveable"
+        assert "live_out" in regions[0].attrs
+
+    def test_release_memory_keeps_fetchable_loss(self, rng):
+        img, label, logits, loss = _mlp()
+        pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        release_memory(pt.default_main_program())
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        feed = {"img": rng.rand(8, 16).astype("float32"),
+                "label": rng.randint(0, 10, (8, 1)).astype("int64")}
+        out = exe.run(feed=feed, fetch_list=[loss])[0]
+        assert np.isfinite(out).all()
+
+    def test_bad_level_raises(self):
+        with pytest.raises(ValueError):
+            memory_optimize(pt.default_main_program(), level=7)
+
+    def test_fetch_of_narrowed_intermediate_still_works(self, rng):
+        # liveness can't see fetch lists — the executor must keep a fetched
+        # forward var alive even after live-out narrowing dropped it
+        img, label, logits, loss = _mlp()
+        hidden = logits.block.ops[0]  # first op's output is an intermediate
+        pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        memory_optimize(pt.default_main_program(), level=1)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        feed = {"img": rng.rand(8, 16).astype("float32"),
+                "label": rng.randint(0, 10, (8, 1)).astype("int64")}
+        mid_name = hidden.output_names()[0]
+        vals = exe.run(feed=feed, fetch_list=[loss, mid_name])
+        assert np.isfinite(vals[0]).all()
+        assert np.asarray(vals[1]).size > 0
+
+
+class TestInferenceTranspiler:
+    def test_conv_bn_fold_matches(self, rng):
+        img = layers.data("img", shape=[3, 8, 8], dtype="float32")
+        conv = layers.conv2d(img, num_filters=4, filter_size=3,
+                             bias_attr=False)
+        out = layers.batch_norm(conv, is_test=True)
+        prog = pt.default_main_program().clone(for_test=True)
+
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        scope = pt.global_scope()
+        # non-trivial BN stats
+        bn_ops = [op for op in prog.global_block().ops
+                  if op.type == "batch_norm"]
+        assert len(bn_ops) == 1
+        bn = bn_ops[0]
+        scope.set_var(bn.inputs["Mean"][0],
+                      rng.rand(4).astype("float32"))
+        scope.set_var(bn.inputs["Variance"][0],
+                      (rng.rand(4) + 0.5).astype("float32"))
+        scope.set_var(bn.inputs["Scale"][0],
+                      (rng.rand(4) + 0.5).astype("float32"))
+        scope.set_var(bn.inputs["Bias"][0], rng.rand(4).astype("float32"))
+
+        feed = {"img": rng.rand(2, 3, 8, 8).astype("float32")}
+        base = exe.run(prog, feed=feed, fetch_list=[out])[0]
+
+        InferenceTranspiler().transpile(prog, scope=scope)
+        types = [op.type for op in prog.global_block().ops]
+        assert "batch_norm" not in types
+        fused = exe.run(prog, feed=feed, fetch_list=[out])[0]
+        np.testing.assert_allclose(base, fused, atol=1e-4, rtol=1e-4)
+
+    def test_conv_bias_bn_fold_matches(self, rng):
+        img = layers.data("img", shape=[3, 8, 8], dtype="float32")
+        conv = layers.conv2d(img, num_filters=4, filter_size=3)  # with bias
+        out = layers.batch_norm(conv, is_test=True)
+        prog = pt.default_main_program().clone(for_test=True)
+
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        scope = pt.global_scope()
+        bn = [op for op in prog.global_block().ops
+              if op.type == "batch_norm"][0]
+        scope.set_var(bn.inputs["Mean"][0], rng.rand(4).astype("float32"))
+        scope.set_var(bn.inputs["Variance"][0],
+                      (rng.rand(4) + 0.5).astype("float32"))
+
+        feed = {"img": rng.rand(2, 3, 8, 8).astype("float32")}
+        base = exe.run(prog, feed=feed, fetch_list=[out])[0]
+        InferenceTranspiler().transpile(prog, scope=scope)
+        assert "batch_norm" not in [o.type for o in prog.global_block().ops]
+        fused = exe.run(prog, feed=feed, fetch_list=[out])[0]
+        np.testing.assert_allclose(base, fused, atol=1e-4, rtol=1e-4)
+
+
+class TestQuantizeTranspiler:
+    def test_qat_inserts_fake_quant_and_runs(self, rng):
+        img, label, logits, loss_pre = None, None, None, None
+        img = layers.data("img", shape=[16], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(img, size=32, act="relu")
+        logits = layers.fc(h, size=10)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+
+        t = QuantizeTranspiler(weight_bits=8, activation_bits=8)
+        t.training_transpile(pt.default_main_program())
+        types = [op.type for op in pt.default_main_program().global_block().ops]
+        assert types.count("fake_quantize_abs_max") >= 4  # 2 acts + 2 weights
+
+        pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        feed = {"img": rng.rand(8, 16).astype("float32"),
+                "label": rng.randint(0, 10, (8, 1)).astype("int64")}
+        l0 = exe.run(feed=feed, fetch_list=[loss])[0]
+        for _ in range(5):
+            l1 = exe.run(feed=feed, fetch_list=[loss])[0]
+        assert np.isfinite(l1).all() and l1 < l0  # QAT still trains
+
+    def test_transpile_after_minimize_raises(self):
+        img = layers.data("img", shape=[8], dtype="float32")
+        h = layers.fc(img, size=4)
+        loss = layers.mean(h)
+        pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        with pytest.raises(RuntimeError):
+            QuantizeTranspiler().training_transpile(pt.default_main_program())
+
+    def test_freeze_rounds_weights(self, rng):
+        img = layers.data("img", shape=[8], dtype="float32")
+        out = layers.fc(img, size=4)
+        QuantizeTranspiler().training_transpile(pt.default_main_program())
+        prog = pt.default_main_program()
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        scope = pt.global_scope()
+        wname = [op.inputs["Y"][0].replace(".quantized", "")
+                 for op in prog.global_block().ops if op.type == "mul"][0]
+        before = np.asarray(scope.get(wname)).copy()
+        QuantizeTranspiler().freeze_program(prog, scope=scope)
+        after = np.asarray(scope.get(wname))
+        # weights now lie exactly on the int8 grid
+        s = np.abs(before).max()
+        grid = (np.round(before * 127 / s) * s / 127)
+        np.testing.assert_allclose(after, grid, atol=1e-6)
+
+
+class TestDistTranspiler:
+    def test_slice_variable_balanced(self):
+        img = layers.data("img", shape=[8], dtype="float32")
+        w = pt.default_main_program().global_block().create_parameter(
+            name="w_big", shape=[1000, 64], dtype="float32")
+        blocks = slice_variable([w], slice_count=4, min_block_size=1024)[0]
+        assert len(blocks) == 4
+        assert sum(b.size for b in blocks) == 1000 * 64
+        # row-aligned shards
+        assert all(b.size % 64 == 0 for b in blocks[:-1])
+
+    def test_transpile_structure(self, rng):
+        img = layers.data("img", shape=[16], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(img, size=64, act="relu")
+        logits = layers.fc(h, size=10)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+
+        eps = "ps0:6174,ps1:6174"
+        t = DistributeTranspiler()
+        t.transpile(trainer_id=0, program=pt.default_main_program(),
+                    pservers=eps, trainers=2)
+        plan = t.get_shard_plan()
+        # every trainable param fully covered by shards
+        for p in pt.default_main_program().all_parameters():
+            if not p.trainable:
+                continue
+            total = sum(vb.size for vb, _ in plan.by_var[p.name])
+            numel = int(np.prod(p.shape))
+            assert total == numel
+
+        # pserver programs contain sgd ops on shards (≙ test_dist_transpiler)
+        seen_sgd = 0
+        for ep in eps.split(","):
+            psprog = t.get_pserver_program(ep)
+            ops = psprog.global_block().ops
+            seen_sgd += sum(op.type == "sgd" for op in ops)
+            startup = t.get_startup_program(ep, psprog)
+            exe = pt.Executor()
+            scope = pt.Scope()
+            exe.run(startup, scope=scope)
+        assert seen_sgd >= 2  # at least weight shards carry optimizers
+
+    def test_pserver_program_runs_shard_update(self, rng):
+        img = layers.data("img", shape=[16], dtype="float32")
+        h = layers.fc(img, size=8, bias_attr=False)
+        loss = layers.mean(h)
+        pt.optimizer.SGDOptimizer(learning_rate=0.5).minimize(loss)
+        t = DistributeTranspiler()
+        t.transpile(0, pt.default_main_program(), pservers="a:1", trainers=1)
+        psprog = t.get_pserver_program("a:1")
+        sgd = [op for op in psprog.global_block().ops if op.type == "sgd"]
+        assert sgd
+        pname = sgd[0].inputs["Param"][0]
+        gname = sgd[0].inputs["Grad"][0]
+        lr = sgd[0].inputs["LearningRate"][0]
+        size = psprog.global_block().vars[pname].shape[0]
+
+        scope = pt.Scope()
+        exe = pt.Executor()
+        exe.run(t.get_startup_program("a:1", psprog), scope=scope)
+        scope.set_var(lr, np.asarray(0.5, dtype="float32"))
+        g = rng.rand(size).astype("float32")
+        exe.run(psprog, feed={gname: g}, fetch_list=[pname], scope=scope)
+        updated = np.asarray(scope.get(pname))
+        np.testing.assert_allclose(updated, -0.5 * g, atol=1e-6)
+
+    def test_dispatchers(self):
+        rr = RoundRobin(["a", "b"])
+        assert rr.dispatch([1, 2, 3]) == ["a", "b", "a"]
+        hn = HashName(["a", "b", "c"])
+        d1 = hn.dispatch(["w1", "w2", "w1"])
+        assert d1[0] == d1[2]  # stable by name

@@ -11,12 +11,14 @@ from .control_flow import (DynamicRNN, IfElse, StaticRNN, Switch,  # noqa: F401
                            While, cond, equal, greater_equal, greater_than,
                            increment, less_equal, less_than, not_equal)
 from .io import data  # noqa: F401
-from .sequence import (dynamic_gru, dynamic_lstm, sequence_concat,  # noqa: F401
+from .sequence import (chunk_eval, crf_decoding,  # noqa: F401
+                       ctc_greedy_decoder, dynamic_gru, dynamic_lstm,
+                       linear_chain_crf, sequence_concat,
                        sequence_conv, sequence_erase, sequence_expand,
                        sequence_first_step, sequence_last_step, sequence_mask,
                        sequence_pad,
                        sequence_pool, sequence_reverse, sequence_slice,
-                       sequence_softmax)
+                       sequence_softmax, warpctc)
 from .math_ops import scale  # noqa: F401
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403

@@ -260,3 +260,131 @@ def sequence_mask(x, maxlen, dtype="float32", name=None):
     helper.append_op(type="sequence_mask", inputs={"X": [x]},
                      outputs={"Y": [out]}, attrs={"maxlen": int(maxlen)})
     return out
+
+
+# ---------------------------------------------------------------------------
+# Sequence labeling: CTC, CRF, chunk evaluation
+# (≙ reference layers/nn.py warpctc, linear_chain_crf, crf_decoding and
+#  layers ctc_greedy_decoder / chunk_eval)
+# ---------------------------------------------------------------------------
+
+def warpctc(input, label, input_length, label_length, blank=0,
+            norm_by_times=False, name=None):
+    """CTC loss (≙ reference layers/nn.py warpctc / operators/warpctc_op.cc).
+
+    input: [B, T, C] unnormalized logits; label: [B, L] int;
+    input_length/label_length: [B]. Returns Loss [B, 1].
+    """
+    helper = LayerHelper("warpctc", name=name)
+    loss = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                      shape=[input.shape[0], 1])
+    helper.append_op(type="warpctc",
+                     inputs={"Logits": [input], "Label": [label],
+                             "LogitsLength": [input_length],
+                             "LabelLength": [label_length]},
+                     outputs={"Loss": [loss]},
+                     attrs={"blank": int(blank),
+                            "norm_by_times": bool(norm_by_times)})
+    return loss
+
+
+def ctc_greedy_decoder(input, blank, input_length, name=None):
+    """Greedy (best-path) CTC decode: per-step argmax then merge-repeats +
+    drop-blanks (≙ reference ctc_greedy_decoder = top_k + ctc_align).
+
+    input: [B, T, C] probabilities/logits. Returns (decoded [B, T],
+    decoded_length [B, 1])."""
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    best = helper.create_tmp_variable(dtype="int64",
+                                      shape=list(input.shape[:2]))
+    helper.append_op(type="arg_max", inputs={"X": [input]},
+                     outputs={"Out": [best]}, attrs={"axis": -1})
+    out = helper.create_tmp_variable(dtype="int64",
+                                     shape=list(input.shape[:2]))
+    out_len = helper.create_tmp_variable(dtype="int64",
+                                         shape=[input.shape[0], 1])
+    helper.append_op(type="ctc_align",
+                     inputs={"Input": [best],
+                             "InputLength": [input_length]},
+                     outputs={"Output": [out], "OutputLength": [out_len]},
+                     attrs={"blank": int(blank), "padding_value": 0})
+    return out, out_len
+
+
+def linear_chain_crf(input, label, length, param_attr=None, name=None):
+    """Linear-chain CRF negative log-likelihood
+    (≙ reference layers/nn.py linear_chain_crf / linear_chain_crf_op.cc).
+
+    input: [B, T, D] emissions; label: [B, T] int; length: [B].
+    Creates the [D+2, D] transition parameter (row 0 start, row 1 end,
+    rows 2.. transitions) and returns Loss [B, 1]."""
+    helper = LayerHelper("linear_chain_crf", name=name,
+                         param_attr=param_attr)
+    ntags = input.shape[-1]
+    transition = helper.create_parameter(attr=param_attr,
+                                         shape=[ntags + 2, ntags],
+                                         dtype=dtype_name(input.dtype))
+    ll = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                    shape=[input.shape[0], 1])
+    alpha = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                       shape=[input.shape[0], ntags])
+    e_exp = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                       shape=list(input.shape))
+    t_exp = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                       shape=[ntags + 2, ntags])
+    helper.append_op(type="linear_chain_crf",
+                     inputs={"Emission": [input], "Transition": [transition],
+                             "Label": [label], "Length": [length]},
+                     outputs={"LogLikelihood": [ll], "Alpha": [alpha],
+                              "EmissionExps": [e_exp],
+                              "TransitionExps": [t_exp]})
+    return ll
+
+
+def crf_decoding(input, length, param_attr=None, label=None, name=None):
+    """Viterbi decode against a trained CRF transition parameter
+    (≙ reference layers/nn.py crf_decoding / crf_decoding_op.cc). The
+    transition param is resolved by name from param_attr (share it with the
+    linear_chain_crf layer). With `label`, returns the 1/0 correctness mask
+    the reference emits instead of the path."""
+    helper = LayerHelper("crf_decoding", name=name, param_attr=param_attr)
+    ntags = input.shape[-1]
+    transition = helper.create_parameter(attr=param_attr,
+                                         shape=[ntags + 2, ntags],
+                                         dtype=dtype_name(input.dtype))
+    path = helper.create_tmp_variable(dtype="int64",
+                                      shape=list(input.shape[:2]))
+    inputs = {"Emission": [input], "Transition": [transition],
+              "Length": [length]}
+    if label is not None:
+        inputs["Label"] = [label]
+    helper.append_op(type="crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": [path]})
+    return path
+
+
+def chunk_eval(input, label, length, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, name=None):
+    """Chunk-level precision/recall/F1 (≙ reference layers chunk_eval /
+    chunk_eval_op.cc). Returns (precision, recall, f1, num_infer_chunks,
+    num_label_chunks, num_correct_chunks)."""
+    helper = LayerHelper("chunk_eval", name=name)
+    mk = helper.create_tmp_variable
+    precision = mk(dtype="float32", shape=[1])
+    recall = mk(dtype="float32", shape=[1])
+    f1 = mk(dtype="float32", shape=[1])
+    n_inf = mk(dtype="int64", shape=[1])
+    n_lab = mk(dtype="int64", shape=[1])
+    n_cor = mk(dtype="int64", shape=[1])
+    helper.append_op(type="chunk_eval",
+                     inputs={"Inference": [input], "Label": [label],
+                             "Length": [length]},
+                     outputs={"Precision": [precision], "Recall": [recall],
+                              "F1-Score": [f1], "NumInferChunks": [n_inf],
+                              "NumLabelChunks": [n_lab],
+                              "NumCorrectChunks": [n_cor]},
+                     attrs={"chunk_scheme": chunk_scheme,
+                            "num_chunk_types": int(num_chunk_types),
+                            "excluded_chunk_types":
+                                list(excluded_chunk_types or [])})
+    return precision, recall, f1, n_inf, n_lab, n_cor

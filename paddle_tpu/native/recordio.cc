@@ -117,7 +117,7 @@ class Scanner {
   explicit Scanner(const char* path) : f_(std::fopen(path, "rb")) {
     if (f_) {
       std::fseek(f_, 0, SEEK_END);
-      file_size_ = std::ftell(f_);
+      file_size_ = ftello(f_);
       std::fseek(f_, 0, SEEK_SET);
     }
   }
@@ -144,23 +144,23 @@ class Scanner {
   bool LoadChunk() {
     Header h;
     for (;;) {
-      long long pos = std::ftell(f_);
+      long long pos = ftello(f_);
       if (std::fread(&h, sizeof(h), 1, f_) != 1) return false;
       if (h.magic != kMagic) {
         // resync: advance one byte past `pos` and scan for magic
         ++skipped_;
-        std::fseek(f_, pos + 1, SEEK_SET);
+        fseeko(f_, pos + 1, SEEK_SET);
         if (!Resync()) return false;
         continue;
       }
       // bound the untrusted length by the bytes actually left in the file
       // BEFORE allocating — a corrupt comp_len must become a skipped chunk,
       // not a std::bad_alloc escaping the C ABI
-      long long here = std::ftell(f_);
+      long long here = ftello(f_);
       if (here < 0 ||
           static_cast<long long>(h.comp_len) > file_size_ - here) {
         ++skipped_;
-        std::fseek(f_, pos + 1, SEEK_SET);
+        fseeko(f_, pos + 1, SEEK_SET);
         if (!Resync()) return false;
         continue;
       }
@@ -170,13 +170,13 @@ class Scanner {
         // short read: corrupt length header or truncated file — count it
         // and resync instead of silently ending the scan
         ++skipped_;
-        std::fseek(f_, pos + 1, SEEK_SET);
+        fseeko(f_, pos + 1, SEEK_SET);
         if (!Resync()) return false;
         continue;
       }
       if (Crc(payload.data(), payload.size()) != h.crc) {
         ++skipped_;
-        std::fseek(f_, pos + 1, SEEK_SET);
+        fseeko(f_, pos + 1, SEEK_SET);
         if (!Resync()) return false;
         continue;
       }
@@ -189,7 +189,7 @@ class Scanner {
                        payload.size()) != Z_OK ||
             dlen != h.raw_len) {
           ++skipped_;
-          std::fseek(f_, pos + 1, SEEK_SET);
+          fseeko(f_, pos + 1, SEEK_SET);
           if (!Resync()) return false;
           continue;
         }

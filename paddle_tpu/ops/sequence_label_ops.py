@@ -1,0 +1,361 @@
+"""Sequence-labeling ops: CTC loss/decode, linear-chain CRF, chunk eval.
+
+≙ reference operators/warpctc_op.* (CTC loss via libwarpctc),
+ctc_align_op.*, linear_chain_crf_op.*, crf_decoding_op.*, chunk_eval_op.*
+(SURVEY.md §2.2 "Sequence/LoD" family). The reference represents ragged
+batches as LoDTensors and calls hand-written CPU/CUDA DP kernels; here the
+batch is dense-padded with explicit length vectors (the framework's LoD
+translation) and the dynamic programs are lax.scan recurrences, so XLA
+fuses them and jax autodiff provides exact gradients (the reference ships
+hand-derived backward kernels).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+_NEG_INF = -1e30
+
+
+def _logsumexp2(a, b):
+    m = jnp.maximum(a, b)
+    dead = m <= _NEG_INF / 2
+    m_safe = jnp.where(dead, 0.0, m)
+    s = jnp.exp(a - m_safe) + jnp.exp(b - m_safe)
+    # double-where: the dead branch must never see log(0), whose grad is
+    # inf*0=NaN even though `where` discards the value
+    out = m_safe + jnp.log(jnp.where(dead, 1.0, s))
+    return jnp.where(dead, _NEG_INF, out)
+
+
+def _logsumexp3(a, b, c):
+    return _logsumexp2(_logsumexp2(a, b), c)
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+
+@register_op("warpctc")
+def _warpctc(ctx, ins, attrs):
+    """CTC loss (≙ warpctc_op.cc, which wraps libwarpctc).
+
+    Inputs: Logits [B, T, C] unnormalized; Label [B, L] int; LogitsLength [B];
+    LabelLength [B]. attr blank (default 0), norm_by_times.
+    Output Loss [B, 1] = -log p(label | logits). The log-space forward
+    algorithm runs as a lax.scan over time; jax.grad of it reproduces the
+    soft-alignment gradient warpctc computes by hand.
+    """
+    logits = ins["Logits"][0]                    # [B, T, C]
+    label = ins["Label"][0].astype(jnp.int32)    # [B, L]
+    logit_len = ins["LogitsLength"][0].reshape(-1).astype(jnp.int32)
+    label_len = ins["LabelLength"][0].reshape(-1).astype(jnp.int32)
+    blank = attrs.get("blank", 0)
+
+    B, T, C = logits.shape
+    L = label.shape[1]
+    S = 2 * L + 1
+
+    logp = jax.nn.log_softmax(logits, axis=-1)   # [B, T, C]
+
+    # extended label sequence: blank, l1, blank, l2, ..., lL, blank
+    ext = jnp.full((B, S), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(label)             # [B, S]
+    s_idx = jnp.arange(S)
+    # skip transition allowed into odd (label) states differing from s-2
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=blank)[:, :S]
+    allow_skip = (s_idx[None, :] >= 2) & (ext != blank) & (ext != ext_m2)
+
+    def emit(t):
+        return jnp.take_along_axis(logp[:, t, :], ext, axis=1)  # [B, S]
+
+    alpha0 = jnp.full((B, S), _NEG_INF)
+    e0 = emit(0)
+    alpha0 = alpha0.at[:, 0].set(e0[:, 0])
+    if S > 1:
+        alpha0 = alpha0.at[:, 1].set(jnp.where(label_len > 0, e0[:, 1],
+                                               _NEG_INF))
+
+    def step(alpha, t):
+        a1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                     constant_values=_NEG_INF)[:, :S]
+        a2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                     constant_values=_NEG_INF)[:, :S]
+        a2 = jnp.where(allow_skip, a2, _NEG_INF)
+        new = _logsumexp3(alpha, a1, a2) + emit(t)
+        active = (t < logit_len)[:, None]
+        return jnp.where(active, new, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+
+    s_end = 2 * label_len                        # index of final blank
+    last_blank = jnp.take_along_axis(alpha, s_end[:, None], axis=1)[:, 0]
+    lbl_idx = jnp.maximum(s_end - 1, 0)[:, None]
+    last_label = jnp.where(
+        label_len > 0,
+        jnp.take_along_axis(alpha, lbl_idx, axis=1)[:, 0], _NEG_INF)
+    loglik = _logsumexp2(last_blank, last_label)
+    loss = -loglik
+    if attrs.get("norm_by_times"):
+        loss = loss / jnp.maximum(logit_len.astype(loss.dtype), 1)
+    return {"Loss": [loss.reshape(-1, 1)]}
+
+
+@register_op("ctc_align", stop_gradient=True)
+def _ctc_align(ctx, ins, attrs):
+    """≙ ctc_align_op.cc: merge repeated tokens then drop blanks.
+
+    Input [B, T] int + InputLength [B]; outputs Output [B, T] left-packed and
+    padded with `padding_value`, and OutputLength [B].
+    """
+    x = ins["Input"][0].astype(jnp.int32)        # [B, T]
+    xlen = ins["InputLength"][0].reshape(-1).astype(jnp.int32)
+    blank = attrs.get("blank", 0)
+    pad_val = attrs.get("padding_value", 0)
+    B, T = x.shape
+
+    t_idx = jnp.arange(T)[None, :]
+    valid = t_idx < xlen[:, None]
+    prev = jnp.pad(x, ((0, 0), (1, 0)), constant_values=-1)[:, :T]
+    keep = valid & (x != blank) & (x != prev)
+    # left-pack kept tokens: target position = cumsum(keep) - 1
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    out_len = jnp.where(keep, pos + 1, 0).max(axis=1)
+    # scatter each kept token to its packed slot (dump dropped ones to T)
+    scatter_pos = jnp.where(keep, pos, T)
+    b_idx = jnp.arange(B)[:, None].repeat(T, 1)
+    out = jnp.zeros((B, T + 1), dtype=x.dtype).at[
+        b_idx.reshape(-1), scatter_pos.reshape(-1)].set(x.reshape(-1))[:, :T]
+    out = jnp.where(jnp.arange(T)[None, :] < out_len[:, None], out, pad_val)
+    return {"Output": [out.astype(ins["Input"][0].dtype)],
+            "OutputLength": [out_len.astype(jnp.int64).reshape(-1, 1)]}
+
+
+# ---------------------------------------------------------------------------
+# Linear-chain CRF
+# ---------------------------------------------------------------------------
+
+def _crf_unpack(transition):
+    """Reference layout (linear_chain_crf_op.h): row 0 = start weights,
+    row 1 = end weights, rows 2..D+1 = transition matrix [D, D]."""
+    return transition[0], transition[1], transition[2:]
+
+
+@register_op("linear_chain_crf")
+def _linear_chain_crf(ctx, ins, attrs):
+    """≙ linear_chain_crf_op.cc. Emission [B, T, D], Transition [D+2, D],
+    Label [B, T], Length [B]. Output LogLikelihood [B, 1] = logZ - score
+    (the negative log-likelihood the reference minimizes directly).
+    """
+    emission = ins["Emission"][0]                # [B, T, D]
+    transition = ins["Transition"][0]            # [D+2, D]
+    label = ins["Label"][0]
+    if label.ndim == 3:
+        label = label[..., 0]
+    label = label.astype(jnp.int32)              # [B, T]
+    length = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    B, T, D = emission.shape
+    start_w, end_w, trans = _crf_unpack(transition)
+
+    # --- partition function: forward algorithm over time -----------------
+    alpha0 = start_w[None, :] + emission[:, 0, :]          # [B, D]
+
+    def fwd(alpha, t):
+        # alpha[b, i] + trans[i, j] -> logsumexp over i, + emission[t, j]
+        scores = alpha[:, :, None] + trans[None, :, :]     # [B, D, D]
+        new = jax.nn.logsumexp(scores, axis=1) + emission[:, t, :]
+        active = (t < length)[:, None]
+        return jnp.where(active, new, alpha), None
+
+    alpha, _ = jax.lax.scan(fwd, alpha0, jnp.arange(1, T))
+    logz = jax.nn.logsumexp(alpha + end_w[None, :], axis=1)  # [B]
+
+    # --- gold path score -------------------------------------------------
+    t_idx = jnp.arange(T)[None, :]
+    in_seq = t_idx < length[:, None]                       # [B, T]
+    emit_scores = jnp.take_along_axis(
+        emission, label[:, :, None], axis=2)[:, :, 0]      # [B, T]
+    emit_sum = jnp.sum(jnp.where(in_seq, emit_scores, 0.0), axis=1)
+    prev_lbl = label[:, :-1]
+    next_lbl = label[:, 1:]
+    trans_scores = trans[prev_lbl, next_lbl]               # [B, T-1]
+    trans_mask = (t_idx[:, 1:] < length[:, None])
+    trans_sum = jnp.sum(jnp.where(trans_mask, trans_scores, 0.0), axis=1)
+    first = label[:, 0]
+    last = jnp.take_along_axis(
+        label, jnp.maximum(length - 1, 0)[:, None], axis=1)[:, 0]
+    score = start_w[first] + emit_sum + trans_sum + end_w[last]
+
+    nll = (logz - score).reshape(-1, 1)
+    return {"LogLikelihood": [nll], "Alpha": [alpha],
+            "EmissionExps": [jnp.exp(emission)],
+            "TransitionExps": [jnp.exp(transition)]}
+
+
+@register_op("crf_decoding", stop_gradient=True)
+def _crf_decoding(ctx, ins, attrs):
+    """≙ crf_decoding_op.cc: Viterbi decode. With Input(Label) given, the
+    output marks positions where the decoded tag equals the label (1/0),
+    as in the reference kernel (crf_decoding_op.h).
+    """
+    emission = ins["Emission"][0]                # [B, T, D]
+    transition = ins["Transition"][0]
+    length = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    B, T, D = emission.shape
+    start_w, end_w, trans = _crf_unpack(transition)
+
+    v0 = start_w[None, :] + emission[:, 0, :]              # [B, D]
+
+    def fwd(v, t):
+        scores = v[:, :, None] + trans[None, :, :]         # [B, D, D]
+        best_prev = jnp.argmax(scores, axis=1)             # [B, D]
+        new = jnp.max(scores, axis=1) + emission[:, t, :]
+        active = (t < length)[:, None]
+        v_out = jnp.where(active, new, v)
+        # inactive steps record identity backpointers
+        bp = jnp.where(active, best_prev,
+                       jnp.arange(D)[None, :].repeat(B, 0))
+        return v_out, bp
+
+    v, bps = jax.lax.scan(fwd, v0, jnp.arange(1, T))       # bps [T-1, B, D]
+    last_tag = jnp.argmax(v + end_w[None, :], axis=1)      # [B]
+
+    def back(tag, bp):
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    first_tag, path_rev = jax.lax.scan(back, last_tag, bps, reverse=True)
+    # path_rev[t] is the tag at time t+1; the final carry is the t=0 tag
+    path = jnp.concatenate([first_tag[None, :], path_rev], axis=0).T  # [B, T]
+    t_idx = jnp.arange(T)[None, :]
+    path = jnp.where(t_idx < length[:, None], path, 0)
+
+    if ins.get("Label"):
+        label = ins["Label"][0]
+        if label.ndim == 3:
+            label = label[..., 0]
+        ok = (path == label.astype(path.dtype)) & (t_idx < length[:, None])
+        return {"ViterbiPath": [ok.astype(jnp.int64)]}
+    return {"ViterbiPath": [path.astype(jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# Chunk evaluation
+# ---------------------------------------------------------------------------
+
+_SCHEMES = {
+    # scheme: (num_tag_types, begin, inside, end, single); -1 = absent
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, 0),
+}
+
+
+def _chunk_bounds(tag, typ, is_other, scheme, num_chunk_types):
+    """is_begin[b,t] / is_end[b,t] per the reference's ChunkBegin/ChunkEnd
+    (chunk_eval_op.h). Sentinel positions outside the sequence are 'other'."""
+    num_tag, t_begin, t_inside, t_end, t_single = _SCHEMES[scheme]
+
+    def shift_prev(a, fill):
+        return jnp.pad(a, ((0, 0), (1, 0)), constant_values=fill)[:, :-1]
+
+    def shift_next(a, fill):
+        return jnp.pad(a, ((0, 0), (0, 1)), constant_values=fill)[:, 1:]
+
+    prev_tag = shift_prev(tag, -1)
+    prev_typ = shift_prev(typ, -1)
+    prev_other = shift_prev(is_other, True)
+    next_tag = shift_next(tag, -1)
+    next_typ = shift_next(typ, -1)
+    next_other = shift_next(is_other, True)
+
+    # ChunkBegin(prev, cur): cur not other AND (prev other, or type change,
+    # or cur tag is B/S, or prev tag was E/S)
+    begin = (~is_other) & (
+        prev_other | (typ != prev_typ) |
+        (tag == t_begin) | (tag == t_single) |
+        ((prev_tag == t_end) & ~prev_other) |
+        ((prev_tag == t_single) & ~prev_other))
+    # ChunkEnd(cur, next): cur not other AND (next other, or type change,
+    # or cur tag is E/S, or next tag is B/S)
+    end = (~is_other) & (
+        next_other | (typ != next_typ) |
+        (tag == t_end) | (tag == t_single) |
+        ((next_tag == t_begin) & ~next_other) |
+        ((next_tag == t_single) & ~next_other))
+    return begin, end
+
+
+def _next_end_index(is_end, T):
+    """next_end[b,t] = smallest t' >= t with is_end[b,t'] (else T)."""
+    idx = jnp.where(is_end, jnp.arange(T)[None, :], T)
+    return jax.lax.associative_scan(jnp.minimum, idx, axis=1, reverse=True)
+
+
+@register_op("chunk_eval", stop_gradient=True)
+def _chunk_eval(ctx, ins, attrs):
+    """≙ chunk_eval_op.cc: precision/recall/F1 of chunk detection.
+
+    Inference [B, T], Label [B, T], Length [B]. attrs: num_chunk_types,
+    chunk_scheme (IOB/IOE/IOBES/plain), excluded_chunk_types. Tag encoding
+    matches the reference: tag = chunk_type * num_tag_types + tag_type;
+    anything outside [0, num_chunk_types*num_tag_types) is 'other' (O).
+    """
+    inference = ins["Inference"][0]
+    label = ins["Label"][0]
+    if inference.ndim == 3:
+        inference = inference[..., 0]
+    if label.ndim == 3:
+        label = label[..., 0]
+    inference = inference.astype(jnp.int32)
+    label = label.astype(jnp.int32)
+    length = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    scheme = attrs.get("chunk_scheme", "IOB")
+    num_chunk_types = attrs["num_chunk_types"]
+    excluded = tuple(attrs.get("excluded_chunk_types", ()) or ())
+    num_tag = _SCHEMES[scheme][0]
+    B, T = label.shape
+    t_idx = jnp.arange(T)[None, :]
+    in_seq = t_idx < length[:, None]
+
+    def analyze(tags):
+        typ = tags // num_tag
+        tag_type = tags % num_tag
+        other = (~in_seq) | (tags < 0) | (typ >= num_chunk_types)
+        for ex in excluded:
+            other = other | (typ == ex)
+        begin, end = _chunk_bounds(
+            jnp.where(other, -1, tag_type), jnp.where(other, -1, typ),
+            other, scheme, num_chunk_types)
+        return typ, other, begin & in_seq, end & in_seq
+
+    i_typ, i_oth, i_beg, i_end = analyze(inference)
+    l_typ, l_oth, l_beg, l_end = analyze(label)
+
+    num_infer = jnp.sum(i_beg)
+    num_label = jnp.sum(l_beg)
+    i_next_end = _next_end_index(i_end, T)
+    l_next_end = _next_end_index(l_end, T)
+    correct = (i_beg & l_beg & (i_typ == l_typ)
+               & (i_next_end == l_next_end))
+    num_correct = jnp.sum(correct)
+
+    ni = num_infer.astype(jnp.float32)
+    nl = num_label.astype(jnp.float32)
+    nc = num_correct.astype(jnp.float32)
+    precision = jnp.where(ni > 0, nc / jnp.maximum(ni, 1), 0.0)
+    recall = jnp.where(nl > 0, nc / jnp.maximum(nl, 1), 0.0)
+    f1 = jnp.where(nc > 0,
+                   2 * precision * recall /
+                   jnp.maximum(precision + recall, 1e-12), 0.0)
+    as64 = lambda x: x.astype(jnp.int64).reshape(1)  # noqa: E731
+    return {"Precision": [precision.reshape(1)],
+            "Recall": [recall.reshape(1)],
+            "F1-Score": [f1.reshape(1)],
+            "NumInferChunks": [as64(num_infer)],
+            "NumLabelChunks": [as64(num_label)],
+            "NumCorrectChunks": [as64(num_correct)]}

@@ -18,11 +18,13 @@ API surface so programs written against it keep working.
 from .memory_optimization import memory_optimize, release_memory
 from .inference_transpiler import InferenceTranspiler
 from .quantize_transpiler import QuantizeTranspiler
-from .distribute_transpiler import DistributeTranspiler, slice_variable
+from .distribute_transpiler import (DistributeTranspiler,
+                                    DistributeTranspilerConfig, slice_variable)
 from .ps_dispatcher import HashName, PSDispatcher, RoundRobin
 
 __all__ = [
     "memory_optimize", "release_memory", "InferenceTranspiler",
-    "QuantizeTranspiler", "DistributeTranspiler", "slice_variable",
+    "QuantizeTranspiler", "DistributeTranspiler",
+    "DistributeTranspilerConfig", "slice_variable",
     "PSDispatcher", "RoundRobin", "HashName",
 ]

@@ -92,13 +92,48 @@ class ShardPlan:
     sync_mode: bool = True
 
 
+@dataclass
+class DistributeTranspilerConfig:
+    """≙ reference DistributeTranspilerConfig: split_method is a
+    PSDispatcher subclass; min_block_size bounds shard granularity."""
+    split_method: type = RoundRobin
+    min_block_size: int = MIN_BLOCK_SIZE
+    slice_var_up: bool = True
+
+
 class DistributeTranspiler:
     """≙ reference DistributeTranspiler (distribute_transpiler.py:131)."""
 
-    def __init__(self, config=None):
-        self.config = config
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        self.config = config or DistributeTranspilerConfig()
         self._plan: Optional[ShardPlan] = None
         self._program: Optional[Program] = None
+        # pserver-program id -> {var name: non-zero init value}
+        self._init_values: Dict[int, Dict[str, float]] = {}
+
+    def _acc_shape_and_init(self, src_block, src_name: str, pb: VarBlock):
+        """Shard shape + startup init for an optimizer accumulator. A
+        param-shaped accumulator shards to [pb.size]; anything else (scalar
+        beta-power state etc.) keeps its source shape, initialized from the
+        live scope value when available so pserver math matches trainer
+        math."""
+        src_var = src_block.vars.get(src_name)
+        if src_var is not None:
+            numel = 1
+            for d in src_var.shape:
+                numel *= max(int(d), 1)
+            if numel != pb.size or len(src_var.shape) != 1:
+                init = None
+                try:
+                    from ..framework.scope import global_scope
+                    import numpy as _np
+                    init = float(_np.asarray(
+                        global_scope().get(src_name)).reshape(-1)[0])
+                except Exception:
+                    init = None
+                if numel != pb.size:
+                    return list(src_var.shape), init
+        return [pb.size], None
 
     # -- the main entry (reference :179) ----------------------------------
 
@@ -109,7 +144,7 @@ class DistributeTranspiler:
                 "trainer_id must be >= 0")
         program = program or default_main_program()
         eps = pservers.split(",") if isinstance(pservers, str) else list(pservers)
-        dispatcher: PSDispatcher = RoundRobin(eps)
+        dispatcher: PSDispatcher = self.config.split_method(eps)
 
         block = program.global_block()
         params = [p for p in program.all_parameters() if p.trainable]
@@ -120,7 +155,9 @@ class DistributeTranspiler:
                 opt_ops[op.inputs["Param"][0]] = i
 
         plan = ShardPlan(trainers=trainers, sync_mode=sync_mode)
-        grouped = slice_variable(params, len(eps))
+        slice_count = len(eps) if self.config.slice_var_up else 1
+        grouped = slice_variable(params, slice_count,
+                                 self.config.min_block_size)
         for param, pblocks in zip(params, grouped):
             endpoints = dispatcher.dispatch(pblocks)
             for vb, ep in zip(pblocks, endpoints):
@@ -195,11 +232,18 @@ class DistributeTranspiler:
                                        persistable=True)
                     inputs[slot] = [lr]
                 else:
-                    # accumulator (moment etc.) shard
+                    # accumulator shard: param-shaped accumulators (moments)
+                    # shard with the param; scalar state (Adam's Beta1Pow/
+                    # Beta2Pow) keeps its own shape and initial value
                     acc = names[0] + suffix
                     if not blk.has_var(acc):
-                        blk.create_var(name=acc, shape=[pb.size],
+                        shape, init = self._acc_shape_and_init(
+                            src_block, names[0], pb)
+                        blk.create_var(name=acc, shape=shape,
                                        dtype="float32", persistable=True)
+                        if init is not None:
+                            self._init_values.setdefault(id(prog), {})[
+                                acc] = init
                     inputs[slot] = [acc]
             for slot, names in src_op.outputs.items():
                 if slot in ("ParamOut",):
@@ -207,8 +251,13 @@ class DistributeTranspiler:
                 outputs[slot] = [names[0] + suffix]
                 tgt = names[0] + suffix
                 if not blk.has_var(tgt):
-                    blk.create_var(name=tgt, shape=[pb.size],
+                    shape, init = self._acc_shape_and_init(
+                        src_block, names[0], pb)
+                    blk.create_var(name=tgt, shape=shape,
                                    dtype="float32", persistable=True)
+                    if init is not None:
+                        self._init_values.setdefault(id(prog), {})[
+                            tgt] = init
             blk.append_op(type=src_op.type, inputs=inputs, outputs=outputs,
                           attrs={k: v for k, v in src_op.attrs.items()
                                  if k not in ("shard_endpoints",)})
@@ -220,6 +269,7 @@ class DistributeTranspiler:
         (real values arrive via the first checkpoint/push, as in the
         reference where trainers push initial params)."""
         prog = pserver_program or self.get_pserver_program(endpoint)
+        inits = self._init_values.get(id(prog), {})
         startup = Program()
         blk = startup.global_block()
         for name, var in prog.global_block().vars.items():
@@ -231,5 +281,5 @@ class DistributeTranspiler:
                           outputs={"Out": [name]},
                           attrs={"shape": list(var.shape) or [],
                                  "dtype": dtype_name(var.dtype),
-                                 "value": 0.0})
+                                 "value": inits.get(name, 0.0)})
         return startup

@@ -65,10 +65,16 @@ class InferenceTranspiler:
                 i += 1
                 continue
 
-            # walk back through a bias elementwise_add to the conv
+            # walk back through a bias elementwise_add to the conv; a
+            # residual add (Y not a stored 1-D bias) is not foldable
             add_idx = None
             conv_idx = prod_idx
             if block.ops[prod_idx].type == "elementwise_add":
+                y_name = block.ops[prod_idx].inputs["Y"][0]
+                y_val = scope.find_var(y_name)
+                if y_val is None or _as_np(y_val).ndim != 1:
+                    i += 1
+                    continue
                 add_idx = prod_idx
                 conv_in = block.ops[add_idx].inputs["X"][0]
                 conv_idx = self._producer(block, conv_in, add_idx)
@@ -79,8 +85,11 @@ class InferenceTranspiler:
             if conv.type not in ("conv2d", "depthwise_conv2d", "mul"):
                 i += 1
                 continue
-            # BN input must not feed anything else (rewrite would change it)
-            if self._n_readers(block, x_name) != 1:
+            # neither the BN input nor the conv output may feed anything
+            # else — folding rescales the filter all consumers would see
+            conv_out = conv.output_names()[0]
+            if self._n_readers(block, x_name) != 1 or \
+                    self._n_readers(block, conv_out) != 1:
                 i += 1
                 continue
 

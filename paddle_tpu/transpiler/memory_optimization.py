@@ -41,6 +41,14 @@ def _liveness_after_region(block, region_idx: int, seg: Sequence[int],
             continue
         if j > min(seg):  # anything at/after the region's execution point
             live |= set(op.input_names())
+    # persistable vars written inside the region (batch-norm moving stats,
+    # moving quant scales) must survive: the executor writes them back to
+    # the scope even though no later op reads them
+    for j in seg:
+        for name in block.ops[j].output_names():
+            var = block.vars.get(name)
+            if var is not None and getattr(var, "persistable", False):
+                live.add(name)
     return live
 
 

@@ -64,9 +64,10 @@ class QuantizeTranspiler:
                 "training_transpile must run BEFORE optimizer.minimize()/"
                 "append_backward — inserting quant ops after autodiff would "
                 "invalidate the recorded forward segment")
-        return self._rewrite_clean(program)
+        return self._rewrite_clean(program, startup_program)
 
-    def _rewrite_clean(self, program: Program) -> Program:
+    def _rewrite_clean(self, program: Program,
+                       startup_program: Optional[Program] = None) -> Program:
         from ..framework.program import Operator
         block = program.global_block()
         new_ops = []
@@ -90,27 +91,51 @@ class QuantizeTranspiler:
                                 name=qname,
                                 shape=None if src is None else src.shape,
                                 dtype="float32" if src is None else src.dtype)
+                        if not block.has_var(sname):
                             block.create_var(name=sname, shape=[],
                                              dtype="float32",
                                              stop_gradient=True)
                         qtype = ("fake_quantize_abs_max"
                                  if kind == "abs_max" else
                                  "fake_quantize_moving_average_abs_max")
-                        qop = Operator(
-                            block, qtype,
-                            inputs={"X": [name]},
-                            outputs={"Out": [qname], "OutScale": [sname]},
-                            attrs={"bit_length": bits,
-                                   "moving_rate": self.moving_rate,
-                                   "op_role": op.attrs.get("op_role")})
                         if qtype == "fake_quantize_moving_average_abs_max":
-                            qop.inputs["InScale"] = [sname + ".state"]
-                            state = sname + ".state"
-                            if not block.has_var(state):
-                                block.create_var(name=state, shape=[],
+                            # the scale var doubles as the moving-average
+                            # state: same persistable var in and out, so the
+                            # executor's state write-back advances it (same
+                            # pattern as batch-norm moving stats)
+                            if not block.has_var(sname):
+                                block.create_var(name=sname, shape=[],
                                                  dtype="float32",
-                                                 persistable=True,
                                                  stop_gradient=True)
+                            block.vars[sname].persistable = True
+                            qop = Operator(
+                                block, qtype,
+                                inputs={"X": [name], "InScale": [sname]},
+                                outputs={"Out": [qname], "OutScale": [sname]},
+                                attrs={"bit_length": bits,
+                                       "moving_rate": self.moving_rate,
+                                       "op_role": op.attrs.get("op_role")})
+                            from ..framework.program import \
+                                default_startup_program
+                            sp = startup_program or default_startup_program()
+                            spb = sp.global_block()
+                            if not spb.has_var(sname):
+                                spb.create_var(name=sname, shape=[],
+                                               dtype="float32",
+                                               persistable=True)
+                                spb.append_op(
+                                    type="fill_constant", inputs={},
+                                    outputs={"Out": [sname]},
+                                    attrs={"shape": [], "dtype": "float32",
+                                           "value": 0.0})
+                        else:
+                            qop = Operator(
+                                block, qtype,
+                                inputs={"X": [name]},
+                                outputs={"Out": [qname],
+                                         "OutScale": [sname]},
+                                attrs={"bit_length": bits,
+                                       "op_role": op.attrs.get("op_role")})
                         new_ops.append(qop)
                         quantized[key] = qname
                     op.inputs[slot] = [quantized[key]]

@@ -1,0 +1,367 @@
+"""CTC / CRF / chunk-eval op tests against numpy dynamic-programming
+references (≙ reference test_warpctc_op.py, test_linear_chain_crf_op.py,
+test_crf_decoding_op.py, test_chunk_eval_op.py, test_ctc_align.py)."""
+
+import numpy as np
+import pytest
+from scipy.special import logsumexp as np_lse
+
+from op_test import check_grad, check_output, run_op
+
+
+# ---------------------------------------------------------------------------
+# numpy references
+# ---------------------------------------------------------------------------
+
+def np_ctc_loss(logits, labels, logit_lens, label_lens, blank=0):
+    """Log-space CTC forward algorithm, one sequence at a time."""
+    B = logits.shape[0]
+    out = np.zeros((B, 1), dtype=np.float64)
+    for b in range(B):
+        T, L = int(logit_lens[b]), int(label_lens[b])
+        lp = logits[b, :T].astype(np.float64)
+        lp = lp - np_lse(lp, axis=1, keepdims=True)
+        lab = labels[b, :L]
+        ext = [blank]
+        for tok in lab:
+            ext += [int(tok), blank]
+        S = len(ext)
+        alpha = np.full((T, S), -np.inf)
+        alpha[0, 0] = lp[0, ext[0]]
+        if S > 1:
+            alpha[0, 1] = lp[0, ext[1]]
+        for t in range(1, T):
+            for s in range(S):
+                cands = [alpha[t - 1, s]]
+                if s >= 1:
+                    cands.append(alpha[t - 1, s - 1])
+                if s >= 2 and ext[s] != blank and ext[s] != ext[s - 2]:
+                    cands.append(alpha[t - 1, s - 2])
+                alpha[t, s] = np_lse(cands) + lp[t, ext[s]]
+        ll = np_lse([alpha[T - 1, S - 1],
+                     alpha[T - 1, S - 2]] if S > 1 else [alpha[T - 1, 0]])
+        out[b, 0] = -ll
+    return out
+
+
+def np_crf_nll(emission, transition, label, length):
+    start_w, end_w, trans = transition[0], transition[1], transition[2:]
+    B = emission.shape[0]
+    out = np.zeros((B, 1))
+    for b in range(B):
+        n = int(length[b])
+        e = emission[b, :n].astype(np.float64)
+        lab = label[b, :n]
+        alpha = start_w + e[0]
+        for t in range(1, n):
+            alpha = np_lse(alpha[:, None] + trans, axis=0) + e[t]
+        logz = np_lse(alpha + end_w)
+        score = start_w[lab[0]] + e[np.arange(n), lab].sum() + end_w[lab[-1]]
+        for t in range(1, n):
+            score += trans[lab[t - 1], lab[t]]
+        out[b, 0] = logz - score
+    return out
+
+
+def np_viterbi(emission, transition, length):
+    start_w, end_w, trans = transition[0], transition[1], transition[2:]
+    B, T, D = emission.shape
+    paths = np.zeros((B, T), dtype=np.int64)
+    for b in range(B):
+        n = int(length[b])
+        e = emission[b, :n].astype(np.float64)
+        v = start_w + e[0]
+        bp = np.zeros((n, D), dtype=int)
+        for t in range(1, n):
+            scores = v[:, None] + trans
+            bp[t] = np.argmax(scores, axis=0)
+            v = scores.max(axis=0) + e[t]
+        tag = int(np.argmax(v + end_w))
+        seq = [tag]
+        for t in range(n - 1, 0, -1):
+            tag = bp[t][tag]
+            seq.append(tag)
+        paths[b, :n] = seq[::-1]
+    return paths
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+
+class TestWarpCTC:
+    def test_forward_matches_numpy_dp(self, rng):
+        B, T, C, L = 4, 9, 6, 3
+        logits = rng.randn(B, T, C).astype("float32")
+        labels = rng.randint(1, C, (B, L)).astype("int64")
+        logit_lens = np.array([9, 7, 9, 5], dtype="int64")
+        label_lens = np.array([3, 2, 1, 3], dtype="int64")
+        exp = np_ctc_loss(logits, labels, logit_lens, label_lens)
+        check_output("warpctc",
+                     {"Logits": logits, "Label": labels,
+                      "LogitsLength": logit_lens, "LabelLength": label_lens},
+                     {"Loss": exp.astype("float32")}, atol=1e-3, rtol=1e-3)
+
+    def test_norm_by_times(self, rng):
+        B, T, C, L = 2, 6, 5, 2
+        logits = rng.randn(B, T, C).astype("float32")
+        labels = rng.randint(1, C, (B, L)).astype("int64")
+        ll = np.array([6, 4], dtype="int64")
+        tl = np.array([2, 2], dtype="int64")
+        base = run_op("warpctc", {"Logits": logits, "Label": labels,
+                                  "LogitsLength": ll, "LabelLength": tl})
+        norm = run_op("warpctc", {"Logits": logits, "Label": labels,
+                                  "LogitsLength": ll, "LabelLength": tl},
+                      attrs={"norm_by_times": True})
+        np.testing.assert_allclose(
+            norm["Loss"][0][:, 0], base["Loss"][0][:, 0] / ll, rtol=1e-5)
+
+    def test_grad_vs_numeric(self, rng):
+        B, T, C, L = 2, 5, 4, 2
+        logits = rng.randn(B, T, C).astype("float32")
+        labels = rng.randint(1, C, (B, L)).astype("int64")
+        check_grad("warpctc",
+                   {"Logits": logits, "Label": labels,
+                    "LogitsLength": np.array([5, 4], dtype="int64"),
+                    "LabelLength": np.array([2, 1], dtype="int64")},
+                   grad_slots=["Logits"], out_slot="Loss",
+                   atol=5e-2, rtol=5e-2)
+
+    def test_perfect_logits_near_zero_loss(self):
+        # logits massively favoring the exact label path -> loss ~ 0
+        T, C = 5, 4
+        labels = np.array([[1, 2, 3]], dtype="int64")
+        path = [1, 2, 3, 0, 0]  # label then blanks
+        logits = np.full((1, T, C), -20.0, dtype="float32")
+        for t, k in enumerate(path):
+            logits[0, t, k] = 20.0
+        out = run_op("warpctc", {"Logits": logits, "Label": labels,
+                                 "LogitsLength": np.array([5], dtype="int64"),
+                                 "LabelLength": np.array([3], dtype="int64")})
+        assert out["Loss"][0][0, 0] < 1e-3
+
+
+class TestCTCAlign:
+    def test_merge_and_strip(self):
+        x = np.array([[0, 1, 1, 0, 2, 2, 0, 3],
+                      [1, 1, 2, 0, 0, 3, 3, 1]], dtype="int32")
+        lens = np.array([8, 6], dtype="int64")
+        out = run_op("ctc_align", {"Input": x, "InputLength": lens},
+                     attrs={"blank": 0})
+        got, glen = out["Output"][0], out["OutputLength"][0]
+        np.testing.assert_array_equal(got[0, :3], [1, 2, 3])
+        assert glen[0, 0] == 3
+        np.testing.assert_array_equal(got[1, :3], [1, 2, 3])
+        assert glen[1, 0] == 3
+
+    def test_greedy_decoder_layer(self, rng):
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        probs = layers.data("probs", shape=[7, 5], dtype="float32")
+        plen = layers.data("plen", shape=[], dtype="int64")
+        dec, dec_len = layers.ctc_greedy_decoder(probs, blank=0,
+                                                 input_length=plen)
+        exe = pt.Executor()
+        p = rng.rand(2, 7, 5).astype("float32")
+        lens = np.array([7, 5], dtype="int64")
+        got, glen = exe.run(feed={"probs": p, "plen": lens},
+                            fetch_list=[dec, dec_len])
+        # reference: argmax -> merge repeats -> drop blanks
+        for b in range(2):
+            best = p[b, :lens[b]].argmax(-1)
+            ref = [t for i, t in enumerate(best)
+                   if t != 0 and (i == 0 or t != best[i - 1])]
+            np.testing.assert_array_equal(got[b, :len(ref)], ref)
+            assert glen[b, 0] == len(ref)
+
+
+# ---------------------------------------------------------------------------
+# CRF
+# ---------------------------------------------------------------------------
+
+class TestLinearChainCRF:
+    def test_nll_matches_numpy(self, rng):
+        B, T, D = 3, 6, 4
+        emission = rng.randn(B, T, D).astype("float32")
+        transition = (rng.randn(D + 2, D) * 0.5).astype("float32")
+        label = rng.randint(0, D, (B, T)).astype("int64")
+        length = np.array([6, 4, 2], dtype="int64")
+        exp = np_crf_nll(emission, transition, label, length)
+        check_output("linear_chain_crf",
+                     {"Emission": emission, "Transition": transition,
+                      "Label": label, "Length": length},
+                     {"LogLikelihood": exp.astype("float32")},
+                     atol=1e-4, rtol=1e-4)
+
+    def test_grads(self, rng):
+        B, T, D = 2, 4, 3
+        emission = rng.randn(B, T, D).astype("float32")
+        transition = (rng.randn(D + 2, D) * 0.5).astype("float32")
+        label = rng.randint(0, D, (B, T)).astype("int64")
+        length = np.array([4, 3], dtype="int64")
+        check_grad("linear_chain_crf",
+                   {"Emission": emission, "Transition": transition,
+                    "Label": label, "Length": length},
+                   grad_slots=["Emission", "Transition"],
+                   out_slot="LogLikelihood", atol=5e-2, rtol=5e-2)
+
+    def test_nll_nonnegative(self, rng):
+        B, T, D = 4, 5, 6
+        out = run_op("linear_chain_crf",
+                     {"Emission": rng.randn(B, T, D).astype("float32"),
+                      "Transition": rng.randn(D + 2, D).astype("float32"),
+                      "Label": rng.randint(0, D, (B, T)).astype("int64"),
+                      "Length": np.array([5, 5, 3, 1], dtype="int64")})
+        assert (out["LogLikelihood"][0] >= -1e-4).all()
+
+
+class TestCRFDecoding:
+    def test_viterbi_matches_numpy(self, rng):
+        B, T, D = 3, 7, 4
+        emission = rng.randn(B, T, D).astype("float32")
+        transition = (rng.randn(D + 2, D) * 0.5).astype("float32")
+        length = np.array([7, 5, 3], dtype="int64")
+        exp = np_viterbi(emission, transition, length)
+        out = run_op("crf_decoding",
+                     {"Emission": emission, "Transition": transition,
+                      "Length": length})
+        np.testing.assert_array_equal(out["ViterbiPath"][0], exp)
+
+    def test_viterbi_beats_random_paths(self, rng):
+        # decoded path must score >= any random path under the CRF score
+        B, T, D = 1, 6, 5
+        emission = rng.randn(B, T, D).astype("float32")
+        transition = (rng.randn(D + 2, D) * 0.3).astype("float32")
+        length = np.array([6], dtype="int64")
+        path = run_op("crf_decoding",
+                      {"Emission": emission, "Transition": transition,
+                       "Length": length})["ViterbiPath"][0][0]
+
+        def score(p):
+            s = transition[0, p[0]] + transition[1, p[-1]]
+            s += emission[0, np.arange(T), p].sum()
+            s += sum(transition[2 + p[t - 1], p[t]] for t in range(1, T))
+            return s
+
+        best = score(path)
+        for _ in range(50):
+            assert best >= score(rng.randint(0, D, T)) - 1e-4
+
+    def test_label_mode_marks_correct_positions(self, rng):
+        B, T, D = 2, 5, 3
+        emission = rng.randn(B, T, D).astype("float32")
+        transition = (rng.randn(D + 2, D) * 0.5).astype("float32")
+        length = np.array([5, 3], dtype="int64")
+        path = run_op("crf_decoding",
+                      {"Emission": emission, "Transition": transition,
+                       "Length": length})["ViterbiPath"][0]
+        out = run_op("crf_decoding",
+                     {"Emission": emission, "Transition": transition,
+                      "Length": length, "Label": path.astype("int64")})
+        ok = out["ViterbiPath"][0]
+        for b in range(B):
+            np.testing.assert_array_equal(ok[b, :length[b]], 1)
+            np.testing.assert_array_equal(ok[b, length[b]:], 0)
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval
+# ---------------------------------------------------------------------------
+
+class TestChunkEval:
+    def _run(self, inf, lab, length, scheme, nct, excluded=None):
+        return run_op("chunk_eval",
+                      {"Inference": np.asarray(inf, dtype="int64"),
+                       "Label": np.asarray(lab, dtype="int64"),
+                       "Length": np.asarray(length, dtype="int64")},
+                      attrs={"chunk_scheme": scheme,
+                             "num_chunk_types": nct,
+                             "excluded_chunk_types": excluded or []})
+
+    def test_iob_exact_match(self):
+        # IOB, 2 chunk types: tags B0=0 I0=1 B1=2 I1=3 O=4
+        seq = [[0, 1, 4, 2, 3, 3]]
+        out = self._run(seq, seq, [6], "IOB", 2)
+        assert out["NumInferChunks"][0][0] == 2
+        assert out["NumLabelChunks"][0][0] == 2
+        assert out["NumCorrectChunks"][0][0] == 2
+        assert out["F1-Score"][0][0] == pytest.approx(1.0)
+
+    def test_iob_partial_match(self):
+        # infer: chunk [0,1] type0, chunk [3] type1
+        # label: chunk [0,1] type0, chunk [4,5] type1
+        inf = [[0, 1, 4, 2, 4, 4]]
+        lab = [[0, 1, 4, 4, 2, 3]]
+        out = self._run(inf, lab, [6], "IOB", 2)
+        assert out["NumInferChunks"][0][0] == 2
+        assert out["NumLabelChunks"][0][0] == 2
+        assert out["NumCorrectChunks"][0][0] == 1
+        assert out["Precision"][0][0] == pytest.approx(0.5)
+        assert out["Recall"][0][0] == pytest.approx(0.5)
+
+    def test_boundary_mismatch_not_correct(self):
+        # same start, different end -> not a correct chunk
+        inf = [[0, 1, 1, 4]]
+        lab = [[0, 1, 4, 4]]
+        out = self._run(inf, lab, [4], "IOB", 1)
+        assert out["NumCorrectChunks"][0][0] == 0
+
+    def test_plain_scheme(self):
+        # plain, 3 types: every non-O token is its own single-token chunk
+        # (reference chunk_eval_op.h: plain sets tag_single=0)
+        inf = [[0, 0, 1, 3, 2]]   # O tag = 3
+        lab = [[0, 0, 1, 3, 1]]
+        out = self._run(inf, lab, [5], "plain", 3)
+        assert out["NumInferChunks"][0][0] == 4
+        assert out["NumLabelChunks"][0][0] == 4
+        assert out["NumCorrectChunks"][0][0] == 3
+
+    def test_iobes_single(self):
+        # IOBES 1 type: B=0 I=1 E=2 S=3 O=4
+        inf = [[3, 4, 0, 1, 2]]
+        lab = [[3, 4, 0, 1, 2]]
+        out = self._run(inf, lab, [5], "IOBES", 1)
+        assert out["NumInferChunks"][0][0] == 2
+        assert out["NumCorrectChunks"][0][0] == 2
+
+    def test_excluded_types(self):
+        inf = [[0, 1, 4, 2, 3, 3]]
+        out = self._run(inf, inf, [6], "IOB", 2, excluded=[1])
+        assert out["NumInferChunks"][0][0] == 1
+        assert out["NumCorrectChunks"][0][0] == 1
+
+    def test_length_masks_tail(self):
+        seq = [[0, 1, 0, 1, 0, 1]]
+        out = self._run(seq, seq, [2], "IOB", 1)
+        assert out["NumInferChunks"][0][0] == 1  # only [0,1] inside length
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: CRF trains through the layer API
+# ---------------------------------------------------------------------------
+
+def test_crf_layer_trains(rng):
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    B, T, D, V = 8, 6, 4, 20
+    words = layers.data("words", shape=[T], dtype="int64")
+    label = layers.data("label", shape=[T], dtype="int64")
+    length = layers.data("length", shape=[], dtype="int64")
+    emb = layers.embedding(words, size=[V, 16])
+    emission = layers.fc(emb, size=D, num_flatten_dims=2)
+    crf_cost = layers.linear_chain_crf(emission, label, length,
+                                       param_attr=pt.ParamAttr(name="crfw"))
+    avg = layers.mean(crf_cost)
+    pt.optimizer.SGDOptimizer(learning_rate=0.05).minimize(avg)
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    w = rng.randint(0, V, (B, T)).astype("int64")
+    lab = (w % D).astype("int64")  # learnable mapping
+    lens = np.full((B,), T, dtype="int64")
+    feed = {"words": w, "label": lab, "length": lens}
+    first = exe.run(feed=feed, fetch_list=[avg])[0]
+    for _ in range(25):
+        last = exe.run(feed=feed, fetch_list=[avg])[0]
+    assert last < first * 0.8

@@ -166,6 +166,27 @@ class TestQuantizeTranspiler:
             l1 = exe.run(feed=feed, fetch_list=[loss])[0]
         assert np.isfinite(l1).all() and l1 < l0  # QAT still trains
 
+    def test_moving_average_scale_state_advances(self, rng):
+        img = layers.data("img", shape=[16], dtype="float32")
+        h = layers.fc(img, size=8)
+        loss = layers.mean(h)
+        QuantizeTranspiler(
+            activation_quantize_type="moving_average_abs_max"
+        ).training_transpile(pt.default_main_program())
+        pt.optimizer.SGDOptimizer(learning_rate=0.0).minimize(loss)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        scope = pt.global_scope()
+        sname = "img.quant_scale"
+        assert scope.has_var(sname)
+        s0 = float(np.asarray(scope.get(sname)))
+        feed = {"img": rng.rand(4, 16).astype("float32")}
+        exe.run(feed=feed, fetch_list=[loss])
+        s1 = float(np.asarray(scope.get(sname)))
+        exe.run(feed=feed, fetch_list=[loss])
+        s2 = float(np.asarray(scope.get(sname)))
+        assert s1 != s0 and s2 != s1  # the moving average actually moves
+
     def test_transpile_after_minimize_raises(self):
         img = layers.data("img", shape=[8], dtype="float32")
         h = layers.fc(img, size=4)

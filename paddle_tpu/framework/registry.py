@@ -77,7 +77,8 @@ def _ensure_builtin_ops():
     # import for registration side effects
     from ..ops import (elementwise, nn_ops, tensor_ops, reduce_ops,  # noqa: F401
                        optimizer_ops, random_ops, sequence_ops, metric_ops,
-                       control_ops, loss_ops, sequence_label_ops)
+                       control_ops, loss_ops, sequence_label_ops,
+                       beam_search_ops)
 
 
 @dataclass

@@ -130,7 +130,7 @@ class StaticRNN:
                 exc=InvalidArgumentError)
         v = self._sub.create_var(
             shape=[x.shape[0]] + list(x.shape[2:]),
-            dtype=dtype_name(x.dtype))
+            dtype=dtype_name(x.dtype), stop_gradient=False)
         self._step_inputs.append(x)
         self._step_vars.append(v)
         return v
@@ -141,7 +141,8 @@ class StaticRNN:
                 "memory must be called inside rnn.step()",
                 exc=InvalidArgumentError)
         v = self._sub.create_var(shape=list(init.shape),
-                                 dtype=dtype_name(init.dtype))
+                                 dtype=dtype_name(init.dtype),
+                                 stop_gradient=False)
         self._memories.append(v)
         self._init_mems.append(init)
         return v
@@ -179,12 +180,13 @@ class StaticRNN:
         for so in self._step_outputs:
             ov = self._parent.create_var(
                 name=None, shape=[so.shape[0], t] + list(so.shape[1:]),
-                dtype=dtype_name(so.dtype))
+                dtype=dtype_name(so.dtype), stop_gradient=False)
             outer_outs.append(ov)
         final_mems = []
         for m in self._memories:
             fv = self._parent.create_var(name=None, shape=list(m.shape),
-                                         dtype=dtype_name(m.dtype))
+                                         dtype=dtype_name(m.dtype),
+                                         stop_gradient=False)
             final_mems.append(fv)
         self._outer_outputs = outer_outs
         self._final_mems = final_mems
@@ -312,7 +314,8 @@ class IfElse:
         merged = []
         for tv in t_outs:
             merged.append(self._parent.create_var(
-                shape=list(tv.shape), dtype=dtype_name(tv.dtype)))
+                shape=list(tv.shape), dtype=dtype_name(tv.dtype),
+                stop_gradient=False))
         self._parent.append_op(
             type="cond_block",
             inputs={"Cond": [self.cond.name], "Captures": captures},
@@ -352,7 +355,8 @@ def cond(pred: Variable, true_fn, false_fn):
         if n not in captures and parent.has_var(n):
             captures.append(n)
     merged = [parent.create_var(shape=list(tv.shape),
-                                dtype=dtype_name(tv.dtype))
+                                dtype=dtype_name(tv.dtype),
+                                stop_gradient=False)
               for tv in t_outs]
     parent.append_op(
         type="lazy_cond",
@@ -426,7 +430,8 @@ class Switch:
             first = self._case_blocks[0]
             proto = first.var(self._case_out_names[0])
             target = parent.create_var(shape=list(proto.shape),
-                                      dtype=dtype_name(proto.dtype))
+                                      dtype=dtype_name(proto.dtype),
+                                      stop_gradient=False)
         elif target.op is not None or target.is_data:
             # no-default fallback: keep the target's pre-switch value
             inputs["Prev"] = [target.name]

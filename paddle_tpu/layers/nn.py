@@ -954,3 +954,55 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
                      outputs={"Out": [out], "PreOut": [pre]},
                      attrs={"num_classes": int(num_classes)})
     return out
+
+
+def beam_search(pre_ids, pre_scores, scores, beam_size, end_id, name=None):
+    """One beam-search growth step (≙ reference layers/nn.py beam_search:2706
+    / beam_search_op.cc). Static-beam TPU translation: all tensors carry a
+    fixed beam dim K = beam_size.
+
+    pre_ids/pre_scores: [B, K]; scores: [B, K, V] per-step log-probs.
+    Initialize pre_scores to 0 for beam 0 and a large negative (e.g. -1e9)
+    for beams 1..K-1 so the first step expands a single hypothesis.
+    Returns (selected_ids [B, K], selected_scores [B, K], parent_idx [B, K]).
+    """
+    helper = LayerHelper("beam_search", name=name)
+    B = pre_ids.shape[0]
+    sel_ids = helper.create_tmp_variable(dtype="int64", shape=[B, beam_size])
+    sel_scores = helper.create_tmp_variable(dtype=dtype_name(scores.dtype),
+                                            shape=[B, beam_size])
+    parent = helper.create_tmp_variable(dtype="int64", shape=[B, beam_size])
+    helper.append_op(type="beam_search",
+                     inputs={"PreIds": [pre_ids], "PreScores": [pre_scores],
+                             "Scores": [scores]},
+                     outputs={"SelectedIds": [sel_ids],
+                              "SelectedScores": [sel_scores],
+                              "ParentIdx": [parent]},
+                     attrs={"beam_size": int(beam_size),
+                            "end_id": int(end_id)})
+    return sel_ids, sel_scores, parent
+
+
+def beam_search_decode(ids, parents, name=None):
+    """Backtrack per-step beam selections into full sequences
+    (≙ reference beam_search_decode / beam_search_decode_op.cc).
+    ids/parents: [B, T, K] as collected by a decode loop emitting
+    beam_search outputs. Returns sequences [B, T, K]."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    out = helper.create_tmp_variable(dtype="int64", shape=list(ids.shape))
+    helper.append_op(type="gather_tree",
+                     inputs={"Ids": [ids], "Parents": [parents]},
+                     outputs={"Out": [out]})
+    return out
+
+
+gather_tree = beam_search_decode
+
+
+def log_softmax(x, axis=-1, name=None):
+    """≙ log_softmax op (numerically stable log(softmax(x)))."""
+    helper = LayerHelper("log_softmax", name=name)
+    out = helper.create_tmp_variable(dtype=dtype_name(x.dtype), shape=x.shape)
+    helper.append_op(type="log_softmax", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    return out

@@ -8,4 +8,5 @@ and returns the loss (and aux outputs), exactly as the reference model files
 build programs for fluid_benchmark.py.
 """
 
-from . import deepfm, mnist, resnet, stacked_lstm, transformer, vgg  # noqa: F401
+from . import (deepfm, machine_translation, mnist, resnet,  # noqa: F401
+               stacked_lstm, transformer, vgg)

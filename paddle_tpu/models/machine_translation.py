@@ -1,0 +1,166 @@
+"""RNN encoder-decoder machine translation with attention + beam search.
+
+≙ reference benchmark/fluid/models/machine_translation.py and
+tests/book/test_machine_translation.py (GRU seq2seq with the attention
+decoder built from fc/gru building blocks, trained with CE and decoded with
+the beam_search ops). TPU translation: the encoder is one fused dynamic_gru
+scan; the attention decoder is a StaticRNN (one lax.scan); beam decode keeps
+a static [B, K] beam dim and compiles into a single scan as well, finishing
+with gather_tree — no dynamic LoD beam trees.
+"""
+
+from __future__ import annotations
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+__def_cite__ = "reference: benchmark/fluid/models/machine_translation.py:1"
+
+
+def _gru_cell(x, h_prev, hidden_dim, name):
+    """GRU cell from fused fc blocks (≙ the reference decoder's
+    fc + gru_unit composition, machine_translation.py seq_to_seq_net).
+    x: [..., D], h_prev: [..., H] -> h: [..., H]."""
+    nfd = len(x.shape) - 1
+    gates = layers.elementwise_add(
+        layers.fc(x, size=2 * hidden_dim, num_flatten_dims=nfd,
+                  bias_attr=False, name=name + "_xg"),
+        layers.fc(h_prev, size=2 * hidden_dim, num_flatten_dims=nfd,
+                  name=name + "_hg"))
+    gates = layers.sigmoid(gates)
+    u = layers.slice(gates, axes=[nfd], starts=[0], ends=[hidden_dim])
+    r = layers.slice(gates, axes=[nfd], starts=[hidden_dim],
+                     ends=[2 * hidden_dim])
+    cand = layers.tanh(layers.elementwise_add(
+        layers.fc(x, size=hidden_dim, num_flatten_dims=nfd, bias_attr=False,
+                  name=name + "_xc"),
+        layers.fc(layers.elementwise_mul(r, h_prev), size=hidden_dim,
+                  num_flatten_dims=nfd, name=name + "_hc")))
+    one_minus_u = layers.scale(u, scale=-1.0, bias=1.0)
+    return layers.elementwise_add(layers.elementwise_mul(u, h_prev),
+                                  layers.elementwise_mul(one_minus_u, cand))
+
+
+def _attention(state, enc_out, hidden_dim, name):
+    """Dot-product attention of decoder state over encoder outputs
+    (≙ the reference's simple_attention in book machine_translation).
+    state [B, H] (or [B, K, H]), enc_out [B, T, H] -> context like state."""
+    if len(state.shape) == 2:
+        q = layers.unsqueeze(state, axes=[1])          # [B, 1, H]
+    else:
+        q = state                                      # [B, K, H]
+    scores = layers.matmul(q, enc_out, transpose_y=True)  # [B, *, T]
+    weights = layers.softmax(scores)
+    ctx = layers.matmul(weights, enc_out)              # [B, *, H]
+    if len(state.shape) == 2:
+        ctx = layers.squeeze(ctx, axes=[1])
+    return ctx
+
+
+def encoder(src, src_lens, vocab_size, embed_dim, hidden_dim):
+    from ..layers.sequence import tag_sequence
+    emb = layers.embedding(src, size=[vocab_size, embed_dim],
+                           param_attr=ParamAttr(name="src_emb"))
+    proj = layers.fc(emb, size=3 * hidden_dim, num_flatten_dims=2,
+                     bias_attr=False, name="enc_proj")
+    proj = tag_sequence(proj, src_lens)
+    enc = layers.dynamic_gru(proj, size=hidden_dim, name="enc_gru")
+    return enc                                          # [B, T, H]
+
+
+def train_net(src, src_lens, tgt_in, tgt_out, tgt_mask, dict_size=10000,
+              embed_dim=64, hidden_dim=128):
+    """Teacher-forced training graph. src [B, Ts], tgt_in/tgt_out [B, Tt],
+    tgt_mask [B, Tt] float 0/1. Returns (avg_loss, logits)."""
+    enc_out = encoder(src, src_lens, dict_size, embed_dim, hidden_dim)
+    dec_init = layers.fc(layers.sequence_last_step(enc_out),
+                         size=hidden_dim, act="tanh", name="dec_init")
+
+    tgt_emb = layers.embedding(tgt_in, size=[dict_size, embed_dim],
+                               param_attr=ParamAttr(name="tgt_emb"))
+
+    rnn = layers.StaticRNN(name="decoder")
+    with rnn.step():
+        w = rnn.step_input(tgt_emb)                    # [B, E]
+        h_prev = rnn.memory(init=dec_init)             # [B, H]
+        ctx = _attention(h_prev, enc_out, hidden_dim, "att")
+        inp = layers.concat([w, ctx], axis=1)
+        h = _gru_cell(inp, h_prev, hidden_dim, "dec_gru")
+        rnn.update_memory(h_prev, h)
+        rnn.step_output(h)
+    dec_hidden = rnn()                                 # [B, Tt, H]
+
+    logits = layers.fc(dec_hidden, size=dict_size, num_flatten_dims=2,
+                       name="readout")
+    b, t = tgt_out.shape[0], tgt_out.shape[1]
+    flat_logits = layers.reshape(logits, shape=[-1, dict_size])
+    flat_label = layers.reshape(tgt_out, shape=[-1, 1])
+    ce = layers.softmax_with_cross_entropy(flat_logits, flat_label)
+    ce = layers.reshape(ce, shape=[b, t])
+    masked = layers.elementwise_mul(ce, tgt_mask)
+    loss = layers.reduce_sum(masked) / (layers.reduce_sum(tgt_mask) + 1e-6)
+    return loss, logits
+
+
+def infer_net(src, src_lens, dict_size=10000, embed_dim=64, hidden_dim=128,
+              beam_size=4, max_len=16, bos_id=0, eos_id=1):
+    """Beam-search decode graph reusing the trained parameter names.
+    Returns (sequences [B, max_len, K], scores [B, K])."""
+    enc_out = encoder(src, src_lens, dict_size, embed_dim, hidden_dim)
+    dec_init = layers.fc(layers.sequence_last_step(enc_out),
+                         size=hidden_dim, act="tanh", name="dec_init")
+
+    b = src.shape[0]
+    K = beam_size
+    # expand to beams: [B, K, H]
+    state0 = layers.expand(layers.unsqueeze(dec_init, axes=[1]),
+                           expand_times=[1, K, 1])
+    ids0 = layers.fill_constant_batch_size_like(
+        src, shape=[-1, K], dtype="int64", value=bos_id)
+    # beam 0 live, beams 1..K-1 muted so step 1 expands one hypothesis
+    mute = layers.fill_constant_batch_size_like(
+        src, shape=[-1, K], dtype="float32", value=-1e9)
+    live0 = layers.fill_constant_batch_size_like(
+        src, shape=[-1, 1], dtype="float32", value=0.0)
+    scores0 = layers.concat(
+        [live0, layers.slice(mute, axes=[1], starts=[1], ends=[K])], axis=1)
+
+    dummy = layers.fill_constant_batch_size_like(
+        src, shape=[-1, max_len, 1], dtype="float32", value=0.0)
+
+    rnn = layers.StaticRNN(name="beam_decoder")
+    with rnn.step():
+        rnn.step_input(dummy)                          # drives max_len steps
+        h_prev = rnn.memory(init=state0)               # [B, K, H]
+        ids_prev = rnn.memory(init=ids0)               # [B, K]
+        sc_prev = rnn.memory(init=scores0)             # [B, K]
+
+        w = layers.embedding(ids_prev, size=[dict_size, embed_dim],
+                             param_attr=ParamAttr(name="tgt_emb"))  # [B,K,E]
+        ctx = _attention(h_prev, enc_out, hidden_dim, "att")        # [B,K,H]
+        inp = layers.concat([w, ctx], axis=2)
+        h = _gru_cell(inp, h_prev, hidden_dim, "dec_gru")           # [B,K,H]
+        logits = layers.fc(h, size=dict_size, num_flatten_dims=2,
+                           name="readout")
+        logp = layers.log_softmax(logits)              # [B, K, V]
+        sel_ids, sel_scores, parent = layers.beam_search(
+            ids_prev, sc_prev, logp, beam_size=K, end_id=eos_id)
+        # reorder the recurrent state by each survivor's parent beam
+        h_re = _gather_beams(h, parent)
+        rnn.update_memory(h_prev, h_re)
+        rnn.update_memory(ids_prev, sel_ids)
+        rnn.update_memory(sc_prev, sel_scores)
+        rnn.step_output(sel_ids)
+        rnn.step_output(parent)
+    ids_seq, parent_seq = rnn()                        # [B, T, K] each
+    final_scores = rnn._final_mems[2]                  # [B, K]
+    seqs = layers.beam_search_decode(ids_seq, parent_seq)
+    return seqs, final_scores
+
+
+def _gather_beams(x, parent):
+    """Reorder beam-major state x [B, K, ...] by parent indices [B, K]."""
+    # one_hot route keeps it a single batched matmul (MXU-friendly)
+    k = x.shape[1]
+    onehot = layers.one_hot(parent, depth=k)           # [B, K, K]
+    return layers.matmul(onehot, x)                    # [B, K, ...]

@@ -1,0 +1,77 @@
+"""Beam-search decode ops.
+
+≙ reference operators/beam_search_op.* and beam_search_decode_op.* (used by
+layers/nn.py beam_search:2706 and the machine-translation book model). The
+reference grows LoD beam trees dynamically; the TPU translation keeps the
+beam dimension static ([B, K] everywhere) so the whole decode loop compiles
+into one lax.scan, and the final tree backtrack is a reverse scan
+(`gather_tree`, also the TF/XLA idiom for this op).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+_NEG_INF = -1e9
+
+
+@register_op("beam_search", stop_gradient=True)
+def _beam_search(ctx, ins, attrs):
+    """One beam-growth step (≙ beam_search_op.cc).
+
+    Inputs: PreIds [B, K] (tokens selected last step), PreScores [B, K]
+    (accumulated log-probs; initialize beams 1..K-1 to a large negative so
+    the first expansion starts from beam 0 only), Scores [B, K, V] per-step
+    log-probabilities. attr end_id.
+
+    Finished beams (PreIds == end_id) survive unchanged: their only
+    continuation is end_id at the accumulated score.
+    Outputs: SelectedIds [B, K], SelectedScores [B, K], ParentIdx [B, K].
+    """
+    pre_ids = ins["PreIds"][0].astype(jnp.int32)     # [B, K]
+    pre_scores = ins["PreScores"][0]                 # [B, K]
+    scores = ins["Scores"][0]                        # [B, K, V] log-probs
+    end_id = attrs["end_id"]
+    B, K, V = scores.shape
+
+    finished = pre_ids == end_id                     # [B, K]
+    total = pre_scores[:, :, None] + scores          # [B, K, V]
+    # finished beams: only end_id continuation, score frozen
+    onehot_end = jnp.arange(V)[None, None, :] == end_id
+    frozen = jnp.where(onehot_end, pre_scores[:, :, None], _NEG_INF)
+    total = jnp.where(finished[:, :, None], frozen, total)
+
+    flat = total.reshape(B, K * V)
+    top_scores, top_idx = jax.lax.top_k(flat, K)     # [B, K]
+    parent = top_idx // V
+    token = top_idx % V
+    return {"SelectedIds": [token.astype(jnp.int64)],
+            "SelectedScores": [top_scores],
+            "ParentIdx": [parent.astype(jnp.int64)]}
+
+
+@register_op("gather_tree", stop_gradient=True)
+def _gather_tree(ctx, ins, attrs):
+    """Backtrack beam parent pointers into full sequences
+    (≙ beam_search_decode_op.cc building the LoD beam tree; same semantics
+    as XLA/TF gather_tree). Ids/Parents [B, T, K] -> Out [B, T, K] where
+    Out[b, :, k] is the k-th final beam's token sequence."""
+    ids = ins["Ids"][0].astype(jnp.int32)            # [B, T, K]
+    parents = ins["Parents"][0].astype(jnp.int32)    # [B, T, K]
+    B, T, K = ids.shape
+    ids_t = jnp.moveaxis(ids, 1, 0)                  # [T, B, K]
+    par_t = jnp.moveaxis(parents, 1, 0)
+
+    beam = jnp.tile(jnp.arange(K)[None, :], (B, 1))  # beams to follow
+
+    def back(beam, xs):
+        step_ids, step_parents = xs                  # [B, K]
+        tok = jnp.take_along_axis(step_ids, beam, axis=1)
+        prev = jnp.take_along_axis(step_parents, beam, axis=1)
+        return prev, tok
+
+    _, toks = jax.lax.scan(back, beam, (ids_t, par_t), reverse=True)
+    return {"Out": [jnp.moveaxis(toks, 0, 1).astype(jnp.int64)]}

@@ -111,19 +111,33 @@ class DistributeTranspiler:
         # pserver-program id -> {var name: non-zero init value}
         self._init_values: Dict[int, Dict[str, float]] = {}
 
-    def _acc_shape_and_init(self, src_block, src_name: str, pb: VarBlock):
-        """Shard shape + startup init for an optimizer accumulator. A
-        param-shaped accumulator shards to [pb.size]; anything else (scalar
-        beta-power state etc.) keeps its source shape, initialized from the
-        live scope value when available so pserver math matches trainer
-        math."""
+    @staticmethod
+    def _numel(var) -> int:
+        n = 1
+        for d in var.shape:
+            n *= max(int(d), 1)
+        return n
+
+    def _acc_shape_and_init(self, src_block, src_name: str, pb: VarBlock,
+                            src_op=None, slot: str = ""):
+        """Shard shape + startup init for an optimizer accumulator. An
+        accumulator with the PARAM's total numel shards to [pb.size];
+        anything else (scalar beta-power state etc.) keeps its source shape.
+        Beta-power init comes from the optimizer op's own attrs (the exact
+        value the trainer would start from), falling back to the live scope
+        value, so pserver math matches trainer math."""
         src_var = src_block.vars.get(src_name)
-        if src_var is not None:
-            numel = 1
-            for d in src_var.shape:
-                numel *= max(int(d), 1)
-            if numel != pb.size or len(src_var.shape) != 1:
-                init = None
+        param_var = src_block.vars.get(pb.varname)
+        if src_var is not None and param_var is not None and \
+                self._numel(src_var) != self._numel(param_var):
+            init = None
+            if src_op is not None:
+                # adam/adamax beta-power accumulators start at beta^1
+                if slot.startswith("Beta1Pow") and "beta1" in src_op.attrs:
+                    init = float(src_op.attrs["beta1"])
+                elif slot.startswith("Beta2Pow") and "beta2" in src_op.attrs:
+                    init = float(src_op.attrs["beta2"])
+            if init is None:
                 try:
                     from ..framework.scope import global_scope
                     import numpy as _np
@@ -131,8 +145,7 @@ class DistributeTranspiler:
                         global_scope().get(src_name)).reshape(-1)[0])
                 except Exception:
                     init = None
-                if numel != pb.size:
-                    return list(src_var.shape), init
+            return list(src_var.shape), init
         return [pb.size], None
 
     # -- the main entry (reference :179) ----------------------------------
@@ -238,7 +251,7 @@ class DistributeTranspiler:
                     acc = names[0] + suffix
                     if not blk.has_var(acc):
                         shape, init = self._acc_shape_and_init(
-                            src_block, names[0], pb)
+                            src_block, names[0], pb, src_op, slot)
                         blk.create_var(name=acc, shape=shape,
                                        dtype="float32", persistable=True)
                         if init is not None:
@@ -252,7 +265,7 @@ class DistributeTranspiler:
                 tgt = names[0] + suffix
                 if not blk.has_var(tgt):
                     shape, init = self._acc_shape_and_init(
-                        src_block, names[0], pb)
+                        src_block, names[0], pb, src_op, slot)
                     blk.create_var(name=tgt, shape=shape,
                                    dtype="float32", persistable=True)
                     if init is not None:

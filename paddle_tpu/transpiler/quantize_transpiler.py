@@ -103,10 +103,6 @@ class QuantizeTranspiler:
                             # state: same persistable var in and out, so the
                             # executor's state write-back advances it (same
                             # pattern as batch-norm moving stats)
-                            if not block.has_var(sname):
-                                block.create_var(name=sname, shape=[],
-                                                 dtype="float32",
-                                                 stop_gradient=True)
                             block.vars[sname].persistable = True
                             qop = Operator(
                                 block, qtype,

@@ -146,3 +146,29 @@ class TestCond:
         out = sw.finish(lr)
         res, = _run([out])
         np.testing.assert_allclose(res, [0.01])
+
+
+def test_static_rnn_gradients_reach_cell_params(rng):
+    """Regression: static_rnn outputs must not be stop_gradient — the cell's
+    parameters (read via Captures) must receive nonzero gradients."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    x = layers.data("x", shape=[4, 8])
+    h0 = layers.data("h0", shape=[8])
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        xt = rnn.step_input(x)
+        h = rnn.memory(init=h0)
+        nh = layers.fc(layers.concat([xt, h], axis=1), size=8, act="tanh",
+                       name="reg_cell")
+        rnn.update_memory(h, nh)
+        rnn.step_output(nh)
+    loss = layers.mean(rnn())
+    pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    g = exe.run(feed={"x": rng.rand(2, 4, 8).astype("float32"),
+                      "h0": np.zeros((2, 8), "float32")},
+                fetch_list=["reg_cell.w_0@GRAD", "reg_cell.w_1@GRAD"])
+    assert np.abs(g[0]).max() > 0 and np.abs(g[1]).max() > 0

@@ -1,0 +1,163 @@
+"""Beam search ops + seq2seq machine translation book test.
+
+≙ reference tests/book/test_machine_translation.py (train attention seq2seq
+briefly, save, reload, beam-search decode) and test_beam_search_op.py /
+test_beam_search_decode_op.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core import unique_name
+from paddle_tpu.models import machine_translation as mt
+
+from op_test import run_op
+
+
+class TestBeamSearchOp:
+    def test_selects_topk_across_beams(self):
+        # B=1, K=2, V=4; beam 0 score 0, beam 1 score -0.5
+        pre_ids = np.array([[2, 3]], dtype="int64")
+        pre_scores = np.array([[0.0, -0.5]], dtype="float32")
+        logp = np.log(np.array(
+            [[[0.1, 0.2, 0.3, 0.4],
+              [0.25, 0.25, 0.25, 0.25]]], dtype="float32"))
+        out = run_op("beam_search",
+                     {"PreIds": pre_ids, "PreScores": pre_scores,
+                      "Scores": logp}, attrs={"end_id": 99})
+        ids, scores, parent = (out["SelectedIds"][0],
+                               out["SelectedScores"][0],
+                               out["ParentIdx"][0])
+        # best two continuations: beam0/token3 (log .4), beam0/token2 (log .3)
+        assert ids[0, 0] == 3 and parent[0, 0] == 0
+        assert ids[0, 1] == 2 and parent[0, 1] == 0
+        np.testing.assert_allclose(scores[0, 0], np.log(0.4), rtol=1e-5)
+
+    def test_finished_beam_frozen(self):
+        end = 1
+        pre_ids = np.array([[end, 5]], dtype="int64")
+        pre_scores = np.array([[10.0, 0.0]], dtype="float32")
+        logp = np.full((1, 2, 6), np.log(1.0 / 6), dtype="float32")
+        out = run_op("beam_search",
+                     {"PreIds": pre_ids, "PreScores": pre_scores,
+                      "Scores": logp}, attrs={"end_id": end})
+        # finished beam stays: emits end_id at unchanged score, ranked first
+        assert out["SelectedIds"][0][0, 0] == end
+        assert out["ParentIdx"][0][0, 0] == 0
+        np.testing.assert_allclose(out["SelectedScores"][0][0, 0], 10.0)
+
+    def test_gather_tree_backtracks(self):
+        # T=3, K=2: final beam 0 came from path b1 -> b0 -> b0
+        ids = np.array([[[5, 6], [7, 8], [9, 10]]], dtype="int64")  # [1,3,2]
+        parents = np.array([[[0, 0], [1, 0], [0, 1]]], dtype="int64")
+        out = run_op("gather_tree", {"Ids": ids, "Parents": parents})
+        seq = out["Out"][0]
+        # beam 0 at t=2: token 9, parent 0 -> t=1 token 7? parent chain:
+        # t=2 beam0 parent=0 -> t=1 beam0 token 7, its parent=1 -> t=0 token 6
+        np.testing.assert_array_equal(seq[0, :, 0], [6, 7, 9])
+        # beam 1 at t=2: token 10, parent 1 -> t=1 beam1 token 8, parent 0
+        np.testing.assert_array_equal(seq[0, :, 1], [5, 8, 10])
+
+
+def _toy_batch(rng, B, Ts, Tt, V, bos, eos):
+    """Copy-ish task: target = source tokens shifted, ending with eos."""
+    src = rng.randint(4, V, (B, Ts)).astype("int64")
+    tgt = np.concatenate([src[:, :Tt - 1], np.full((B, 1), eos)], 1)
+    tgt_in = np.concatenate([np.full((B, 1), bos), tgt[:, :-1]], 1)
+    mask = np.ones((B, Tt), dtype="float32")
+    return (src, np.full((B,), Ts, dtype="int64"),
+            tgt_in.astype("int64"), tgt.astype("int64"), mask)
+
+
+class TestMachineTranslationBook:
+    def test_train_save_load_beam_infer(self, rng, tmp_path):
+        B, Ts, Tt, V, K = 8, 5, 5, 24, 3
+        BOS, EOS = 0, 1
+
+        with unique_name.guard():
+            src = layers.data("src", shape=[Ts], dtype="int64")
+            src_lens = layers.data("src_lens", shape=[], dtype="int64")
+            tgt_in = layers.data("tgt_in", shape=[Tt], dtype="int64")
+            tgt_out = layers.data("tgt_out", shape=[Tt], dtype="int64")
+            tgt_mask = layers.data("tgt_mask", shape=[Tt], dtype="float32")
+            loss, _ = mt.train_net(src, src_lens, tgt_in, tgt_out, tgt_mask,
+                                   dict_size=V, embed_dim=16, hidden_dim=32)
+            pt.optimizer.AdamOptimizer(learning_rate=5e-3).minimize(loss)
+
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        s, sl, ti, to, m = _toy_batch(rng, B, Ts, Tt, V, BOS, EOS)
+        feed = {"src": s, "src_lens": sl, "tgt_in": ti, "tgt_out": to,
+                "tgt_mask": m}
+        first = float(exe.run(feed=feed, fetch_list=[loss])[0])
+        for _ in range(30):
+            last = float(exe.run(feed=feed, fetch_list=[loss])[0])
+        assert last < first  # attention seq2seq trains
+
+        # save trained params (book flow: save -> fresh build -> load)
+        pt.io.save_params(exe, str(tmp_path / "mt"))
+
+        pt.reset_default_programs()
+        pt.reset_global_scope()
+        with unique_name.guard():
+            src_i = layers.data("src", shape=[Ts], dtype="int64")
+            lens_i = layers.data("src_lens", shape=[], dtype="int64")
+            seqs, scores = mt.infer_net(src_i, lens_i, dict_size=V,
+                                        embed_dim=16, hidden_dim=32,
+                                        beam_size=K, max_len=Tt,
+                                        bos_id=BOS, eos_id=EOS)
+        exe2 = pt.Executor()
+        exe2.run(pt.default_startup_program())
+        pt.io.load_params(exe2, str(tmp_path / "mt"))
+
+        got_seqs, got_scores = exe2.run(
+            feed={"src": s, "src_lens": sl}, fetch_list=[seqs, scores])
+        assert got_seqs.shape == (B, Tt, K)
+        assert np.isfinite(got_scores).all()
+        # beams sorted best-first
+        assert (np.diff(got_scores, axis=1) <= 1e-5).all()
+        # all decoded tokens are valid vocab ids
+        assert ((got_seqs >= 0) & (got_seqs < V)).all()
+
+    def test_beam_decode_prefers_trained_tokens(self, rng):
+        # after training on a constant-target task, beam 0 should decode
+        # mostly that target token
+        B, Ts, Tt, V, K = 4, 4, 4, 12, 2
+        BOS, EOS = 0, 1
+        CONST = 7
+        with unique_name.guard():
+            src = layers.data("src", shape=[Ts], dtype="int64")
+            src_lens = layers.data("src_lens", shape=[], dtype="int64")
+            tgt_in = layers.data("tgt_in", shape=[Tt], dtype="int64")
+            tgt_out = layers.data("tgt_out", shape=[Tt], dtype="int64")
+            tgt_mask = layers.data("tgt_mask", shape=[Tt], dtype="float32")
+            loss, _ = mt.train_net(src, src_lens, tgt_in, tgt_out, tgt_mask,
+                                   dict_size=V, embed_dim=8, hidden_dim=16)
+            pt.optimizer.AdamOptimizer(learning_rate=1e-2).minimize(loss)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        s = rng.randint(2, V, (B, Ts)).astype("int64")
+        sl = np.full((B,), Ts, dtype="int64")
+        to = np.full((B, Tt), CONST, dtype="int64")
+        ti = np.concatenate([np.full((B, 1), BOS), to[:, :-1]], 1)
+        feed = {"src": s, "src_lens": sl, "tgt_in": ti.astype("int64"),
+                "tgt_out": to, "tgt_mask": np.ones((B, Tt), "float32")}
+        for _ in range(150):
+            exe.run(feed=feed, fetch_list=[loss])
+        scope_vals = pt.global_scope()
+
+        pt.reset_default_programs()
+        with unique_name.guard():
+            src_i = layers.data("src", shape=[Ts], dtype="int64")
+            lens_i = layers.data("src_lens", shape=[], dtype="int64")
+            seqs, scores = mt.infer_net(src_i, lens_i, dict_size=V,
+                                        embed_dim=8, hidden_dim=16,
+                                        beam_size=K, max_len=Tt,
+                                        bos_id=BOS, eos_id=EOS)
+        exe2 = pt.Executor()  # shares global scope: params already live
+        got = exe2.run(feed={"src": s, "src_lens": sl},
+                       fetch_list=[seqs])[0]
+        # best beam overwhelmingly emits the constant token
+        assert (got[:, :, 0] == CONST).mean() > 0.7

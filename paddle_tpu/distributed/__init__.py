@@ -1,0 +1,13 @@
+"""Distributed job layer: bootstrap, fault-tolerant master, elasticity.
+
+≙ reference go/ (etcd master + pserver, SURVEY.md §2.3 last row), the
+gen_nccl_id bootstrap (gen_nccl_id_op.cc:24), and the PADDLE_* env role
+protocol (trainer.py:324) — rebuilt TPU-native: jax.distributed bootstrap,
+file-snapshot task master, checkpoint-restart elasticity.
+"""
+
+from .env import (DistributedEnv, PSERVER, TRAINER, global_rank,  # noqa: F401
+                  init_parallel_env, parse_env, world_size)
+from .master import Master, MasterClient, Task  # noqa: F401
+from .elastic import (ElasticTrainer, FailureDetector,  # noqa: F401
+                      PreemptionGuard)

@@ -1,0 +1,93 @@
+"""Distributed role/environment configuration + coordinator bootstrap.
+
+≙ reference env-var role config (PADDLE_TRAINING_ROLE / PADDLE_PSERVER_IPS /
+PADDLE_TRAINER_ID read by trainer.py:324 and benchmark/fluid/fluid_benchmark.py)
+and the gen_nccl_id bootstrap (operators/distributed/gen_nccl_id_op.cc:24,
+which gRPC-broadcasts an ncclUniqueId so every process joins one NCCL world).
+
+TPU translation: the "id broadcast" becomes jax.distributed.initialize
+against a coordinator address — XLA then compiles collectives over the
+ICI/DCN mesh; no per-op communicator plumbing exists or is needed.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+TRAINER = "TRAINER"
+PSERVER = "PSERVER"
+
+
+@dataclass
+class DistributedEnv:
+    """Parsed role config (≙ the PADDLE_* env protocol)."""
+    training_role: str = TRAINER
+    trainer_id: int = 0
+    num_trainers: int = 1
+    coordinator: Optional[str] = None      # host:port of process 0
+    pserver_endpoints: tuple = ()
+    current_endpoint: Optional[str] = None
+
+    @property
+    def is_chief(self) -> bool:
+        return self.trainer_id == 0
+
+
+def parse_env(environ=None) -> DistributedEnv:
+    """Read the reference's env-var protocol (trainer.py:324 names kept,
+    coordinator added for the jax.distributed bootstrap)."""
+    e = environ if environ is not None else os.environ
+    return DistributedEnv(
+        training_role=e.get("PADDLE_TRAINING_ROLE", TRAINER).upper(),
+        trainer_id=int(e.get("PADDLE_TRAINER_ID", "0")),
+        num_trainers=int(e.get("PADDLE_TRAINERS_NUM",
+                               e.get("PADDLE_TRAINERS", "1"))),
+        coordinator=e.get("PADDLE_COORDINATOR_ENDPOINT") or None,
+        pserver_endpoints=tuple(
+            p for p in e.get("PADDLE_PSERVER_IPS", "").split(",") if p),
+        current_endpoint=e.get("PADDLE_CURRENT_ENDPOINT") or None,
+    )
+
+
+_initialized = False
+
+
+def init_parallel_env(env: Optional[DistributedEnv] = None,
+                      timeout_s: int = 300) -> DistributedEnv:
+    """Join the multi-host world (≙ gen_nccl_id bootstrap).
+
+    On a single host (no coordinator configured) this is a no-op so the same
+    training script runs everywhere. With PADDLE_COORDINATOR_ENDPOINT set,
+    process `trainer_id` of `num_trainers` calls jax.distributed.initialize;
+    afterwards jax.devices() spans every host and pjit/shard_map programs
+    compile cross-host collectives over DCN+ICI.
+    """
+    global _initialized
+    env = env or parse_env()
+    if env.coordinator and env.num_trainers > 1 and not _initialized:
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=env.coordinator,
+            num_processes=env.num_trainers,
+            process_id=env.trainer_id,
+            initialization_timeout=timeout_s)
+        _initialized = True
+    return env
+
+
+def global_rank() -> int:
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def world_size() -> int:
+    try:
+        import jax
+        return jax.process_count()
+    except Exception:
+        return 1

@@ -41,15 +41,18 @@ def _gru_cell(x, h_prev, hidden_dim, name):
                                   layers.elementwise_mul(one_minus_u, cand))
 
 
-def _attention(state, enc_out, hidden_dim, name):
+def _attention(state, enc_out, src_mask, name):
     """Dot-product attention of decoder state over encoder outputs
     (≙ the reference's simple_attention in book machine_translation).
-    state [B, H] (or [B, K, H]), enc_out [B, T, H] -> context like state."""
+    state [B, H] (or [B, K, H]), enc_out [B, T, H], src_mask [B, T] 0/1
+    (padded source positions muted) -> context like state."""
     if len(state.shape) == 2:
         q = layers.unsqueeze(state, axes=[1])          # [B, 1, H]
     else:
         q = state                                      # [B, K, H]
     scores = layers.matmul(q, enc_out, transpose_y=True)  # [B, *, T]
+    neg = layers.scale(src_mask, scale=1e9, bias=-1e9)    # 0 -> -1e9, 1 -> 0
+    scores = layers.elementwise_add(scores, layers.unsqueeze(neg, axes=[1]))
     weights = layers.softmax(scores)
     ctx = layers.matmul(weights, enc_out)              # [B, *, H]
     if len(state.shape) == 2:
@@ -73,6 +76,7 @@ def train_net(src, src_lens, tgt_in, tgt_out, tgt_mask, dict_size=10000,
     """Teacher-forced training graph. src [B, Ts], tgt_in/tgt_out [B, Tt],
     tgt_mask [B, Tt] float 0/1. Returns (avg_loss, logits)."""
     enc_out = encoder(src, src_lens, dict_size, embed_dim, hidden_dim)
+    src_mask = layers.sequence_mask(src_lens, maxlen=src.shape[1])
     dec_init = layers.fc(layers.sequence_last_step(enc_out),
                          size=hidden_dim, act="tanh", name="dec_init")
 
@@ -83,7 +87,7 @@ def train_net(src, src_lens, tgt_in, tgt_out, tgt_mask, dict_size=10000,
     with rnn.step():
         w = rnn.step_input(tgt_emb)                    # [B, E]
         h_prev = rnn.memory(init=dec_init)             # [B, H]
-        ctx = _attention(h_prev, enc_out, hidden_dim, "att")
+        ctx = _attention(h_prev, enc_out, src_mask, "att")
         inp = layers.concat([w, ctx], axis=1)
         h = _gru_cell(inp, h_prev, hidden_dim, "dec_gru")
         rnn.update_memory(h_prev, h)
@@ -107,10 +111,10 @@ def infer_net(src, src_lens, dict_size=10000, embed_dim=64, hidden_dim=128,
     """Beam-search decode graph reusing the trained parameter names.
     Returns (sequences [B, max_len, K], scores [B, K])."""
     enc_out = encoder(src, src_lens, dict_size, embed_dim, hidden_dim)
+    src_mask = layers.sequence_mask(src_lens, maxlen=src.shape[1])
     dec_init = layers.fc(layers.sequence_last_step(enc_out),
                          size=hidden_dim, act="tanh", name="dec_init")
 
-    b = src.shape[0]
     K = beam_size
     # expand to beams: [B, K, H]
     state0 = layers.expand(layers.unsqueeze(dec_init, axes=[1]),
@@ -137,7 +141,7 @@ def infer_net(src, src_lens, dict_size=10000, embed_dim=64, hidden_dim=128,
 
         w = layers.embedding(ids_prev, size=[dict_size, embed_dim],
                              param_attr=ParamAttr(name="tgt_emb"))  # [B,K,E]
-        ctx = _attention(h_prev, enc_out, hidden_dim, "att")        # [B,K,H]
+        ctx = _attention(h_prev, enc_out, src_mask, "att")          # [B,K,H]
         inp = layers.concat([w, ctx], axis=2)
         h = _gru_cell(inp, h_prev, hidden_dim, "dec_gru")           # [B,K,H]
         logits = layers.fc(h, size=dict_size, num_flatten_dims=2,
@@ -153,7 +157,7 @@ def infer_net(src, src_lens, dict_size=10000, embed_dim=64, hidden_dim=128,
         rnn.step_output(sel_ids)
         rnn.step_output(parent)
     ids_seq, parent_seq = rnn()                        # [B, T, K] each
-    final_scores = rnn._final_mems[2]                  # [B, K]
+    final_scores = rnn.final_memories()[2]             # [B, K] (sc_prev)
     seqs = layers.beam_search_decode(ids_seq, parent_seq)
     return seqs, final_scores
 

@@ -231,7 +231,8 @@ def _softmax(ctx, ins, attrs):
 
 @register_op("log_softmax")
 def _log_softmax(ctx, ins, attrs):
-    return {"Out": [jax.nn.log_softmax(ins["X"][0], axis=-1)]}
+    return {"Out": [jax.nn.log_softmax(ins["X"][0],
+                                       axis=attrs.get("axis", -1))]}
 
 
 @register_op("softmax_with_cross_entropy")

@@ -121,6 +121,44 @@ class TestMachineTranslationBook:
         # all decoded tokens are valid vocab ids
         assert ((got_seqs >= 0) & (got_seqs < V)).all()
 
+    def test_variable_length_sources_train_and_decode(self, rng):
+        # exercises attention masking of padded source positions
+        B, Ts, Tt, V, K = 6, 6, 4, 16, 2
+        with unique_name.guard():
+            src = layers.data("src", shape=[Ts], dtype="int64")
+            src_lens = layers.data("src_lens", shape=[], dtype="int64")
+            tgt_in = layers.data("tgt_in", shape=[Tt], dtype="int64")
+            tgt_out = layers.data("tgt_out", shape=[Tt], dtype="int64")
+            tgt_mask = layers.data("tgt_mask", shape=[Tt], dtype="float32")
+            loss, _ = mt.train_net(src, src_lens, tgt_in, tgt_out, tgt_mask,
+                                   dict_size=V, embed_dim=8, hidden_dim=16)
+            pt.optimizer.AdamOptimizer(learning_rate=5e-3).minimize(loss)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        s = rng.randint(2, V, (B, Ts)).astype("int64")
+        sl = rng.randint(2, Ts + 1, (B,)).astype("int64")  # ragged lengths
+        for b in range(B):
+            s[b, sl[b]:] = 0  # pad
+        to = rng.randint(2, V, (B, Tt)).astype("int64")
+        ti = np.concatenate([np.zeros((B, 1), "int64"), to[:, :-1]], 1)
+        feed = {"src": s, "src_lens": sl, "tgt_in": ti, "tgt_out": to,
+                "tgt_mask": np.ones((B, Tt), "float32")}
+        l0 = float(exe.run(feed=feed, fetch_list=[loss])[0])
+        for _ in range(10):
+            l1 = float(exe.run(feed=feed, fetch_list=[loss])[0])
+        assert np.isfinite(l1) and l1 < l0
+
+        pt.reset_default_programs()
+        with unique_name.guard():
+            src_i = layers.data("src", shape=[Ts], dtype="int64")
+            lens_i = layers.data("src_lens", shape=[], dtype="int64")
+            seqs, scores = mt.infer_net(src_i, lens_i, dict_size=V,
+                                        embed_dim=8, hidden_dim=16,
+                                        beam_size=K, max_len=Tt)
+        got, sc = pt.Executor().run(feed={"src": s, "src_lens": sl},
+                                    fetch_list=[seqs, scores])
+        assert got.shape == (B, Tt, K) and np.isfinite(sc).all()
+
     def test_beam_decode_prefers_trained_tokens(self, rng):
         # after training on a constant-target task, beam 0 should decode
         # mostly that target token

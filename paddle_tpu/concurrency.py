@@ -1,0 +1,264 @@
+"""CSP concurrency: Go-style channels, select, go.
+
+≙ reference framework/channel.h:33 / channel_impl.h (buffered + unbuffered
+channels with close semantics), operators channel_create/send/recv/close,
+select_op.cc, go_op.cc, and the Python surface fluid/concurrency.py:28,196,282
+(Go/Select/make_channel).
+
+Design note: the reference threads channels *through programs* (CHANNEL
+variables executed by interpreting executors). Under XLA there is no
+interpreter to block inside a compiled step, so the capability lands where it
+is actually used on TPU: host-side coordination between Python threads
+(input pipelines, async checkpointing, parameter servers). Semantics mirror
+Go precisely: unbuffered channels rendezvous; receive on a closed, drained
+channel returns (zero, False); send on a closed channel raises; select picks
+uniformly among ready cases and supports a default.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from collections import deque
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from .core.enforce import InvalidArgumentError, enforce
+
+
+class ChannelClosedError(RuntimeError):
+    """Send on a closed channel (≙ PADDLE_ENFORCE in ChannelImpl::Send)."""
+
+
+class Channel:
+    """Buffered (capacity > 0) or unbuffered (capacity == 0) channel
+    (≙ ChannelImpl, reference framework/channel_impl.h)."""
+
+    def __init__(self, capacity: int = 0, dtype=None, name: str = ""):
+        enforce(capacity >= 0, InvalidArgumentError,
+                "channel capacity must be >= 0")
+        self.capacity = capacity
+        self.dtype = dtype
+        self.name = name
+        self._buf: deque = deque()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._closed = False
+        # unbuffered rendezvous bookkeeping: receivers waiting
+        self._recv_waiting = 0
+
+    # -- probes used by Select (called under no lock; advisory) -----------
+    def _can_send(self) -> bool:
+        if self._closed:
+            return True   # send will raise — still "ready" so select surfaces it
+        if self.capacity > 0:
+            return len(self._buf) < self.capacity
+        return self._recv_waiting > 0
+
+    def _can_recv(self) -> bool:
+        return bool(self._buf) or self._closed
+
+    # -- core ops ---------------------------------------------------------
+    def send(self, value: Any, timeout: Optional[float] = None) -> bool:
+        """Blocks until delivered (unbuffered: until a receiver takes it).
+        Raises ChannelClosedError if the channel is/becomes closed.
+        Returns False on timeout."""
+        with self._cond:
+            if self._closed:
+                raise ChannelClosedError(f"send on closed channel {self.name}")
+            if self.capacity > 0:
+                ok = self._cond.wait_for(
+                    lambda: self._closed or len(self._buf) < self.capacity,
+                    timeout)
+                if not ok:
+                    return False
+                if self._closed:
+                    raise ChannelClosedError(
+                        f"send on closed channel {self.name}")
+                self._buf.append(value)
+                self._cond.notify_all()
+                return True
+            # unbuffered rendezvous: wait for a receiver AND an empty slot,
+            # park a tokened value, then wait until the receiver takes it
+            ok = self._cond.wait_for(
+                lambda: self._closed or (self._recv_waiting > 0
+                                         and not self._buf), timeout)
+            if not ok:
+                return False
+            if self._closed:
+                raise ChannelClosedError(f"send on closed channel {self.name}")
+            token = object()
+            self._buf.append((token, value))
+            self._cond.notify_all()
+            ok = self._cond.wait_for(
+                lambda: self._closed or not any(
+                    t is token for t, _ in self._buf), timeout)
+            still_parked = any(t is token for t, _ in self._buf)
+            if still_parked:
+                self._buf = deque((t, v) for t, v in self._buf
+                                  if t is not token)
+                if self._closed:
+                    raise ChannelClosedError(
+                        f"send on closed channel {self.name}")
+                return False   # timeout before rendezvous completed
+            return True
+
+    def recv(self, timeout: Optional[float] = None) -> Tuple[Any, bool]:
+        """Blocks for a value. Returns (value, True), or (None, False) once
+        the channel is closed and drained (Go semantics; ≙ Receive returning
+        false, channel_impl.h)."""
+        with self._cond:
+            self._recv_waiting += 1
+            self._cond.notify_all()
+            try:
+                ok = self._cond.wait_for(
+                    lambda: self._buf or self._closed, timeout)
+                if not ok:
+                    return None, False
+                if self._buf:
+                    v = self._buf.popleft()
+                    if self.capacity == 0:
+                        v = v[1]          # unwrap (token, value)
+                    self._cond.notify_all()
+                    return v, True
+                return None, False    # closed and drained
+            finally:
+                self._recv_waiting -= 1
+
+    def close(self):
+        """Wake all blocked senders/receivers (≙ ChannelImpl::Close)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def __len__(self):
+        with self._lock:
+            return len(self._buf)
+
+
+def make_channel(dtype=None, capacity: int = 0, name: str = "") -> Channel:
+    """≙ fluid.concurrency.make_channel (concurrency.py:282)."""
+    return Channel(capacity=capacity, dtype=dtype, name=name)
+
+
+def channel_send(channel: Channel, value, timeout=None) -> bool:
+    return channel.send(value, timeout=timeout)
+
+
+def channel_recv(channel: Channel, timeout=None) -> Tuple[Any, bool]:
+    return channel.recv(timeout=timeout)
+
+
+def channel_close(channel: Channel):
+    channel.close()
+
+
+class Go:
+    """Run a block concurrently (≙ go_op.cc / fluid.concurrency.Go:28).
+    Usable as a decorator or context manager:
+
+        @Go
+        def producer(): ...
+        producer.join()
+    """
+
+    def __init__(self, fn: Callable = None):
+        self._thread: Optional[threading.Thread] = None
+        self.result = None
+        self.exception: Optional[BaseException] = None
+        if fn is not None:
+            self._start(fn)
+
+    def _start(self, fn, *args, **kwargs):
+        def run():
+            try:
+                self.result = fn(*args, **kwargs)
+            except BaseException as e:  # surfaced on join
+                self.exception = e
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def join(self, timeout: Optional[float] = None):
+        if self._thread:
+            self._thread.join(timeout)
+        if self.exception is not None:
+            raise self.exception
+        return self.result
+
+
+def go(fn: Callable, *args, **kwargs) -> Go:
+    """go(fn, ...) — launch fn concurrently, return the handle."""
+    g = Go.__new__(Go)
+    g._thread = None
+    g.result = None
+    g.exception = None
+    g._start(fn, *args, **kwargs)
+    return g
+
+
+class Select:
+    """Multi-way channel select (≙ select_op.cc / fluid.concurrency.Select
+    :196). Build cases then run():
+
+        sel = Select()
+        sel.case_recv(ch_a, lambda v, ok: ...)
+        sel.case_send(ch_b, value, lambda: ...)
+        sel.default(lambda: ...)          # optional, makes run() non-blocking
+        which = sel.run(timeout=...)      # index of the fired case
+
+    Ready-case choice is uniformly random (Go fairness).
+    """
+
+    _POLL_S = 0.0005
+
+    def __init__(self):
+        self._cases: List[tuple] = []
+        self._default: Optional[Callable] = None
+
+    def case_recv(self, ch: Channel, body: Callable[[Any, bool], Any]):
+        self._cases.append(("recv", ch, None, body))
+        return self
+
+    def case_send(self, ch: Channel, value, body: Callable[[], Any]):
+        self._cases.append(("send", ch, value, body))
+        return self
+
+    def default(self, body: Callable[[], Any]):
+        self._default = body
+        return self
+
+    def run(self, timeout: Optional[float] = None) -> int:
+        """Execute one ready case; returns its index (-1 for default).
+        Raises TimeoutError when nothing becomes ready in `timeout`."""
+        enforce(self._cases or self._default is not None,
+                InvalidArgumentError, "select with no cases")
+        import time
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            ready = [i for i, (kind, ch, _, _) in enumerate(self._cases)
+                     if (ch._can_recv() if kind == "recv"
+                         else ch._can_send())]
+            if ready:
+                i = random.choice(ready)
+                kind, ch, value, body = self._cases[i]
+                if kind == "recv":
+                    v, ok = ch.recv(timeout=self._POLL_S)
+                    if ok or ch.closed:
+                        body(v, ok)
+                        return i
+                    continue   # lost the race; retry
+                else:
+                    if ch.send(value, timeout=self._POLL_S):
+                        body()
+                        return i
+                    continue
+            if self._default is not None:
+                self._default()
+                return -1
+            if deadline is not None and time.time() >= deadline:
+                raise TimeoutError("select timed out")
+            time.sleep(self._POLL_S)

@@ -19,8 +19,7 @@ import time
 from typing import Callable, Optional
 
 from ..framework.program import default_main_program
-from ..trainer import (get_latest_checkpoint_serial, load_checkpoint,
-                       save_checkpoint)
+from ..trainer import load_checkpoint, save_checkpoint
 
 
 class PreemptionGuard:
@@ -87,18 +86,22 @@ class ElasticTrainer:
         step = (self.resume_step() if start_step is None else start_step - 1)
         losses = []
         preempted = False
+        last_saved = step
         while step + 1 < num_steps:
             step += 1
             losses.append(float(train_step(step)))
-            at_interval = (step + 1) % self.interval == 0
-            if at_interval or self.guard.should_stop:
+            # read the flag ONCE: a signal landing between two reads must
+            # not skip the checkpoint the docstring promises
+            stopping = self.guard.should_stop
+            if stopping or (step + 1) % self.interval == 0:
                 save_checkpoint(self.exe, self.dir, self.program,
                                 trainer_args={"step": step},
                                 max_num_checkpoints=self.max_checkpoints)
-            if self.guard.should_stop:
+                last_saved = step
+            if stopping:
                 preempted = True
                 break
-        if not preempted:
+        if not preempted and last_saved != step:
             save_checkpoint(self.exe, self.dir, self.program,
                             trainer_args={"step": step},
                             max_num_checkpoints=self.max_checkpoints)
@@ -111,19 +114,30 @@ class FailureDetector:
     once when any expected worker misses the horizon."""
 
     def __init__(self, master, expected_workers, horizon_s: float = 30.0,
-                 poll_s: float = 1.0):
+                 poll_s: float = 1.0, grace_s: Optional[float] = None):
         self.master = master
         self.expected = set(expected_workers)
         self.horizon_s = horizon_s
         self.poll_s = poll_s
+        # startup grace: workers still booting have sent no heartbeat yet —
+        # without this the detector fires spuriously on every cold start
+        self.grace_s = horizon_s if grace_s is None else grace_s
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def start(self, on_failure: Callable[[set], None]):
+        started = time.time()
+
         def watch():
+            seen: set = set()
             while not self._stop.is_set():
                 live = set(self.master.live_workers(self.horizon_s))
-                dead = self.expected - live
+                seen.update(live)
+                in_grace = time.time() - started < self.grace_s
+                # during grace, only workers that already joined can "die"
+                watched = self.expected if not in_grace \
+                    else self.expected & seen
+                dead = watched - live
                 if dead:
                     on_failure(dead)
                     return

@@ -1,0 +1,205 @@
+"""CSP channels/select/go tests + API.spec golden test.
+
+≙ reference framework/channel_test.cc (28K of CSP semantics),
+tests covering fluid.concurrency Go/Select/make_channel, and the
+API.spec + tools/diff_api.py CI check.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.concurrency import (Channel, ChannelClosedError, Go, Select,
+                                    channel_close, channel_recv, channel_send,
+                                    go, make_channel)
+
+
+class TestBufferedChannel:
+    def test_fifo_order(self):
+        ch = make_channel(capacity=4)
+        for i in range(4):
+            assert channel_send(ch, i)
+        assert [channel_recv(ch)[0] for _ in range(4)] == [0, 1, 2, 3]
+
+    def test_send_blocks_when_full_until_recv(self):
+        ch = Channel(capacity=1)
+        ch.send("a")
+        got = []
+
+        def sender():
+            ch.send("b")
+            got.append("sent")
+
+        g = go(sender)
+        time.sleep(0.05)
+        assert not got          # blocked: buffer full
+        assert ch.recv() == ("a", True)
+        g.join(timeout=5)
+        assert got == ["sent"]
+        assert ch.recv() == ("b", True)
+
+    def test_recv_blocks_until_send(self):
+        ch = Channel(capacity=1)
+        out = []
+        g = go(lambda: out.append(ch.recv()))
+        time.sleep(0.05)
+        assert not out
+        ch.send(42)
+        g.join(timeout=5)
+        assert out == [(42, True)]
+
+    def test_close_semantics(self):
+        ch = Channel(capacity=2)
+        ch.send(1)
+        ch.close()
+        # drained values still readable after close (Go semantics)
+        assert ch.recv() == (1, True)
+        assert ch.recv() == (None, False)
+        with pytest.raises(ChannelClosedError):
+            ch.send(2)
+
+    def test_close_wakes_blocked_receivers(self):
+        ch = Channel(capacity=1)
+        results = []
+        gs = [go(lambda: results.append(ch.recv())) for _ in range(3)]
+        time.sleep(0.05)
+        channel_close(ch)
+        for g in gs:
+            g.join(timeout=5)
+        assert results == [(None, False)] * 3
+
+
+class TestUnbufferedChannel:
+    def test_rendezvous(self):
+        ch = Channel(capacity=0)
+        order = []
+
+        def sender():
+            order.append("send-start")
+            ch.send("x")
+            order.append("send-done")
+
+        g = go(sender)
+        time.sleep(0.05)
+        assert "send-done" not in order   # no receiver yet
+        v, ok = ch.recv()
+        g.join(timeout=5)
+        assert (v, ok) == ("x", True)
+        assert order == ["send-start", "send-done"]
+
+    def test_many_producers_one_consumer(self):
+        ch = Channel(capacity=0)
+        n = 8
+        gs = [go(ch.send, i) for i in range(n)]
+        got = sorted(ch.recv()[0] for _ in range(n))
+        for g in gs:
+            g.join(timeout=5)
+        assert got == list(range(n))
+
+    def test_close_raises_for_blocked_sender(self):
+        ch = Channel(capacity=0)
+        g = go(ch.send, "never")
+        time.sleep(0.05)
+        ch.close()
+        with pytest.raises(ChannelClosedError):
+            g.join(timeout=5)
+
+
+class TestSelect:
+    def test_picks_ready_recv(self):
+        a, b = Channel(capacity=1), Channel(capacity=1)
+        b.send("from-b")
+        fired = []
+        sel = (Select()
+               .case_recv(a, lambda v, ok: fired.append(("a", v)))
+               .case_recv(b, lambda v, ok: fired.append(("b", v))))
+        which = sel.run(timeout=5)
+        assert which == 1 and fired == [("b", "from-b")]
+
+    def test_default_when_nothing_ready(self):
+        a = Channel(capacity=1)   # empty: recv not ready
+        fired = []
+        which = (Select()
+                 .case_recv(a, lambda v, ok: fired.append("recv"))
+                 .default(lambda: fired.append("default"))).run()
+        assert which == -1 and fired == ["default"]
+
+    def test_send_case(self):
+        a = Channel(capacity=1)
+        fired = []
+        which = (Select()
+                 .case_send(a, 7, lambda: fired.append("sent"))).run(timeout=5)
+        assert which == 0 and fired == ["sent"]
+        assert a.recv() == (7, True)
+
+    def test_timeout(self):
+        a = Channel(capacity=1)
+        with pytest.raises(TimeoutError):
+            Select().case_recv(a, lambda v, ok: None).run(timeout=0.05)
+
+    def test_producer_consumer_pipeline(self):
+        # ≙ the reference's CSP fibonacci/pipeline examples
+        nums, done = Channel(capacity=0), Channel(capacity=0)
+
+        def producer():
+            for i in range(10):
+                nums.send(i)
+            nums.close()
+
+        total = []
+
+        def consumer():
+            while True:
+                v, ok = nums.recv()
+                if not ok:
+                    break
+                total.append(v)
+            done.send(sum(total))
+
+        go(producer)
+        go(consumer)
+        s, ok = done.recv(timeout=10)
+        assert ok and s == 45
+
+
+class TestGo:
+    def test_decorator_and_result(self):
+        @Go
+        def work():
+            return 21 * 2
+        assert work.join(timeout=5) == 42
+
+    def test_exception_propagates_on_join(self):
+        def boom():
+            raise ValueError("boom")
+        g = go(boom)
+        with pytest.raises(ValueError):
+            g.join(timeout=5)
+
+
+class TestAPISpec:
+    """≙ reference API.spec + tools/diff_api.py golden-surface test."""
+
+    def test_api_surface_matches_golden(self):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        sys.path.insert(0, os.path.join(repo, "tools"))
+        import print_signatures
+        current = sorted(set(print_signatures.iter_api()))
+        with open(os.path.join(repo, "API.spec")) as f:
+            golden = [l for l in f.read().splitlines() if l.strip()]
+        added = set(current) - set(golden)
+        removed = set(golden) - set(current)
+        assert not added and not removed, (
+            f"public API changed — review and run "
+            f"`python tools/print_signatures.py --update`.\n"
+            f"added: {sorted(added)[:10]}\nremoved: {sorted(removed)[:10]}")
+
+    def test_spec_is_nontrivial(self):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(repo, "API.spec")) as f:
+            lines = f.read().splitlines()
+        assert len(lines) > 400   # the surface is broad; guard against wipes

@@ -220,8 +220,23 @@ class TestElasticTrainer:
         m.heartbeat("w0")
         fired = []
         det = FailureDetector(m, expected_workers={"w0", "w1"},
-                              horizon_s=10, poll_s=0.01)
+                              horizon_s=10, poll_s=0.01, grace_s=0)
         det.start(lambda dead: fired.append(dead))
         time.sleep(0.2)
         det.stop()
         assert fired and fired[0] == {"w1"}
+
+    def test_failure_detector_grace_tolerates_slow_boot(self):
+        # workers that have not yet joined must not count as dead during
+        # the startup grace window; ones that joined and vanished do
+        m = Master()
+        fired = []
+        det = FailureDetector(m, expected_workers={"w0", "w1"},
+                              horizon_s=0.1, poll_s=0.01, grace_s=30)
+        det.start(lambda dead: fired.append(dead))
+        time.sleep(0.1)
+        assert not fired          # nobody joined yet -> silence, not alarm
+        m.heartbeat("w0")         # w0 boots...
+        time.sleep(0.3)           # ...then misses the 0.1s horizon
+        det.stop()
+        assert fired and fired[0] == {"w0"}

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Print the public API surface as stable one-line signatures.
+
+≙ reference tools/print_signatures.py + paddle/fluid/API.spec +
+tools/diff_api.py: the public Python surface is frozen in a golden file and
+CI fails on unreviewed changes. Run with --update to regenerate API.spec.
+
+Usage:
+    python tools/print_signatures.py            # print to stdout
+    python tools/print_signatures.py --update   # rewrite API.spec
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# modules whose public (non-underscore) callables/classes form the API
+PUBLIC_MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.layers",
+    "paddle_tpu.layers.control_flow",
+    "paddle_tpu.layers.sequence",
+    "paddle_tpu.layers.io",
+    "paddle_tpu.layers.learning_rate_scheduler",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.initializer",
+    "paddle_tpu.regularizer",
+    "paddle_tpu.clip",
+    "paddle_tpu.metrics",
+    "paddle_tpu.io",
+    "paddle_tpu.profiler",
+    "paddle_tpu.trainer",
+    "paddle_tpu.inferencer",
+    "paddle_tpu.nets",
+    "paddle_tpu.concurrency",
+    "paddle_tpu.transpiler",
+    "paddle_tpu.distributed",
+    "paddle_tpu.parallel",
+    "paddle_tpu.data",
+]
+
+
+import re
+
+_ADDR = re.compile(r" at 0x[0-9a-fA-F]+")
+
+
+def _sig(obj) -> str:
+    try:
+        s = str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+    # default values repr'ing with memory addresses are not stable
+    return _ADDR.sub("", s)
+
+
+def iter_api():
+    import importlib
+    for modname in PUBLIC_MODULES:
+        mod = importlib.import_module(modname)
+        names = getattr(mod, "__all__", None) or [
+            n for n in vars(mod) if not n.startswith("_")]
+        for name in sorted(set(names)):
+            obj = getattr(mod, name, None)
+            if obj is None or inspect.ismodule(obj):
+                continue
+            # only symbols defined inside the package
+            owner = getattr(obj, "__module__", "") or ""
+            if not owner.startswith("paddle_tpu"):
+                continue
+            if inspect.isclass(obj):
+                yield f"{modname}.{name}{_sig(obj.__init__)}"
+                for m_name, m in sorted(vars(obj).items()):
+                    if m_name.startswith("_") or not callable(m):
+                        continue
+                    yield f"{modname}.{name}.{m_name}{_sig(m)}"
+            elif callable(obj):
+                yield f"{modname}.{name}{_sig(obj)}"
+
+
+def main():
+    lines = sorted(set(iter_api()))
+    if "--update" in sys.argv:
+        with open(os.path.join(REPO, "API.spec"), "w") as f:
+            f.write("\n".join(lines) + "\n")
+        print(f"wrote {len(lines)} signatures to API.spec")
+    else:
+        print("\n".join(lines))
+
+
+if __name__ == "__main__":
+    main()

@@ -24,9 +24,18 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from .core.enforce import InvalidArgumentError, enforce
 
+__all__ = ["Channel", "ChannelClosedError", "ChannelTimeout", "Go", "Select",
+           "channel_close", "channel_recv", "channel_send", "go",
+           "make_channel"]
+
 
 class ChannelClosedError(RuntimeError):
     """Send on a closed channel (≙ PADDLE_ENFORCE in ChannelImpl::Send)."""
+
+
+class ChannelTimeout(TimeoutError):
+    """recv/send gave up after `timeout` — distinct from close so drain
+    loops (`while ok`) can't mistake a slow producer for end-of-stream."""
 
 
 class Channel:
@@ -34,8 +43,8 @@ class Channel:
     (≙ ChannelImpl, reference framework/channel_impl.h)."""
 
     def __init__(self, capacity: int = 0, dtype=None, name: str = ""):
-        enforce(capacity >= 0, InvalidArgumentError,
-                "channel capacity must be >= 0")
+        enforce(capacity >= 0, "channel capacity must be >= 0",
+                exc=InvalidArgumentError)
         self.capacity = capacity
         self.dtype = dtype
         self.name = name
@@ -43,8 +52,9 @@ class Channel:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._closed = False
-        # unbuffered rendezvous bookkeeping: receivers waiting
+        # unbuffered rendezvous bookkeeping: parked senders / receivers
         self._recv_waiting = 0
+        self._send_waiting = 0
 
     # -- probes used by Select (called under no lock; advisory) -----------
     def _can_send(self) -> bool:
@@ -55,20 +65,28 @@ class Channel:
         return self._recv_waiting > 0
 
     def _can_recv(self) -> bool:
-        return bool(self._buf) or self._closed
+        return bool(self._buf) or self._closed or self._send_waiting > 0
 
     # -- core ops ---------------------------------------------------------
     def send(self, value: Any, timeout: Optional[float] = None) -> bool:
         """Blocks until delivered (unbuffered: until a receiver takes it).
         Raises ChannelClosedError if the channel is/becomes closed.
-        Returns False on timeout."""
+        Returns False on timeout; `timeout` bounds the WHOLE call."""
+        import time as _time
+        deadline = None if timeout is None else _time.monotonic() + timeout
+
+        def remaining():
+            if deadline is None:
+                return None
+            return max(deadline - _time.monotonic(), 0.0)
+
         with self._cond:
             if self._closed:
                 raise ChannelClosedError(f"send on closed channel {self.name}")
             if self.capacity > 0:
                 ok = self._cond.wait_for(
                     lambda: self._closed or len(self._buf) < self.capacity,
-                    timeout)
+                    remaining())
                 if not ok:
                     return False
                 if self._closed:
@@ -77,35 +95,42 @@ class Channel:
                 self._buf.append(value)
                 self._cond.notify_all()
                 return True
-            # unbuffered rendezvous: wait for a receiver AND an empty slot,
-            # park a tokened value, then wait until the receiver takes it
-            ok = self._cond.wait_for(
-                lambda: self._closed or (self._recv_waiting > 0
-                                         and not self._buf), timeout)
-            if not ok:
-                return False
-            if self._closed:
-                raise ChannelClosedError(f"send on closed channel {self.name}")
-            token = object()
-            self._buf.append((token, value))
+            # unbuffered rendezvous: advertise the blocked sender, wait for
+            # a receiver + empty slot, park a tokened value, wait for pickup
+            self._send_waiting += 1
             self._cond.notify_all()
-            ok = self._cond.wait_for(
-                lambda: self._closed or not any(
-                    t is token for t, _ in self._buf), timeout)
-            still_parked = any(t is token for t, _ in self._buf)
-            if still_parked:
-                self._buf = deque((t, v) for t, v in self._buf
-                                  if t is not token)
+            try:
+                ok = self._cond.wait_for(
+                    lambda: self._closed or (self._recv_waiting > 0
+                                             and not self._buf), remaining())
+                if not ok:
+                    return False
                 if self._closed:
                     raise ChannelClosedError(
                         f"send on closed channel {self.name}")
-                return False   # timeout before rendezvous completed
-            return True
+                token = object()
+                self._buf.append((token, value))
+                self._cond.notify_all()
+                self._cond.wait_for(
+                    lambda: self._closed or not any(
+                        t is token for t, _ in self._buf), remaining())
+                still_parked = any(t is token for t, _ in self._buf)
+                if still_parked:
+                    self._buf = deque((t, v) for t, v in self._buf
+                                      if t is not token)
+                    if self._closed:
+                        raise ChannelClosedError(
+                            f"send on closed channel {self.name}")
+                    return False   # timeout before rendezvous completed
+                return True
+            finally:
+                self._send_waiting -= 1
 
     def recv(self, timeout: Optional[float] = None) -> Tuple[Any, bool]:
-        """Blocks for a value. Returns (value, True), or (None, False) once
-        the channel is closed and drained (Go semantics; ≙ Receive returning
-        false, channel_impl.h)."""
+        """Blocks for a value. Returns (value, True), or (None, False) ONLY
+        once the channel is closed and drained (Go semantics; ≙ Receive
+        returning false, channel_impl.h). A timeout raises ChannelTimeout so
+        drain loops can't mistake a slow producer for end-of-stream."""
         with self._cond:
             self._recv_waiting += 1
             self._cond.notify_all()
@@ -113,7 +138,9 @@ class Channel:
                 ok = self._cond.wait_for(
                     lambda: self._buf or self._closed, timeout)
                 if not ok:
-                    return None, False
+                    raise ChannelTimeout(
+                        f"recv on channel {self.name!r} timed out "
+                        f"after {timeout}s")
                 if self._buf:
                     v = self._buf.popleft()
                     if self.capacity == 0:
@@ -235,8 +262,28 @@ class Select:
         """Execute one ready case; returns its index (-1 for default).
         Raises TimeoutError when nothing becomes ready in `timeout`."""
         enforce(self._cases or self._default is not None,
-                InvalidArgumentError, "select with no cases")
+                "select with no cases", exc=InvalidArgumentError)
         import time
+
+        def attempt(i):
+            """Try case i with a tiny timeout; return True if it fired."""
+            kind, ch, value, body = self._cases[i]
+            if kind == "recv":
+                try:
+                    v, ok = ch.recv(timeout=self._POLL_S)
+                except ChannelTimeout:
+                    return False   # lost the race; retry
+                body(v, ok)
+                return True
+            try:
+                sent = ch.send(value, timeout=self._POLL_S)
+            except ChannelClosedError:
+                raise            # surfaced to the caller, like Go's panic
+            if sent:
+                body()
+                return True
+            return False
+
         deadline = None if timeout is None else time.time() + timeout
         while True:
             ready = [i for i, (kind, ch, _, _) in enumerate(self._cases)
@@ -244,21 +291,23 @@ class Select:
                          else ch._can_send())]
             if ready:
                 i = random.choice(ready)
-                kind, ch, value, body = self._cases[i]
-                if kind == "recv":
-                    v, ok = ch.recv(timeout=self._POLL_S)
-                    if ok or ch.closed:
-                        body(v, ok)
-                        return i
-                    continue   # lost the race; retry
-                else:
-                    if ch.send(value, timeout=self._POLL_S):
-                        body()
-                        return i
-                    continue
-            if self._default is not None:
+                if attempt(i):
+                    return i
+            elif self._default is not None:
                 self._default()
                 return -1
+            else:
+                # nothing advertises readiness — actively attempt each case
+                # briefly so two selects (send-side and recv-side) on an
+                # unbuffered channel still rendezvous
+                order = list(range(len(self._cases)))
+                random.shuffle(order)
+                fired = False
+                for i in order:
+                    if attempt(i):
+                        fired = True
+                        break
+                if fired:
+                    return i
             if deadline is not None and time.time() >= deadline:
                 raise TimeoutError("select timed out")
-            time.sleep(self._POLL_S)

@@ -8,5 +8,5 @@ and returns the loss (and aux outputs), exactly as the reference model files
 build programs for fluid_benchmark.py.
 """
 
-from . import (deepfm, machine_translation, mnist, resnet,  # noqa: F401
-               stacked_lstm, transformer, vgg)
+from . import (deepfm, googlenet, machine_translation,  # noqa: F401
+               mnist, resnet, se_resnext, stacked_lstm, transformer, vgg)

@@ -166,6 +166,43 @@ class TestSelect:
         assert ok and s == 45
 
 
+class TestSelectRendezvous:
+    def test_two_selects_rendezvous_on_unbuffered(self):
+        """Regression: a send-select and a recv-select on the same
+        unbuffered channel must complete (Go semantics), even though
+        neither side is 'ready' until the other commits."""
+        from paddle_tpu.concurrency import Channel
+        ch = Channel(capacity=0)
+        got = []
+
+        def recv_side():
+            Select().case_recv(ch, lambda v, ok: got.append(v)).run(timeout=10)
+
+        def send_side():
+            Select().case_send(ch, 42, lambda: got.append("sent")).run(
+                timeout=10)
+
+        g1, g2 = go(recv_side), go(send_side)
+        g1.join(timeout=15)
+        g2.join(timeout=15)
+        assert sorted(map(str, got)) == ["42", "sent"]
+
+    def test_recv_timeout_distinct_from_close(self):
+        from paddle_tpu.concurrency import Channel, ChannelTimeout
+        ch = Channel(capacity=1)
+        with pytest.raises(ChannelTimeout):
+            ch.recv(timeout=0.05)      # slow producer != end-of-stream
+        ch.close()
+        assert ch.recv(timeout=0.05) == (None, False)  # real close
+
+    def test_unbuffered_send_timeout_bounded(self):
+        from paddle_tpu.concurrency import Channel
+        ch = Channel(capacity=0)
+        t0 = time.time()
+        assert ch.send("x", timeout=0.2) is False
+        assert time.time() - t0 < 0.8   # single deadline, not 2x
+
+
 class TestGo:
     def test_decorator_and_result(self):
         @Go

@@ -73,6 +73,9 @@ def iter_api():
             owner = getattr(obj, "__module__", "") or ""
             if not owner.startswith("paddle_tpu"):
                 continue
+            # internal plumbing re-exported by accident is not public API
+            if owner.startswith("paddle_tpu.core"):
+                continue
             if inspect.isclass(obj):
                 yield f"{modname}.{name}{_sig(obj.__init__)}"
                 for m_name, m in sorted(vars(obj).items()):

@@ -1,7 +1,7 @@
 """fluid.layers-equivalent namespace (≙ reference python/paddle/fluid/layers/)."""
 
-from . import (control_flow, io, learning_rate_scheduler, math_ops,  # noqa: F401
-               nn, ops, sequence, tensor)
+from . import (control_flow, detection, io,  # noqa: F401
+               learning_rate_scheduler, math_ops, nn, ops, sequence, tensor)
 from .learning_rate_scheduler import (autoincreased_step_counter,  # noqa: F401
                                       cosine_decay, exponential_decay,
                                       inverse_time_decay, natural_exp_decay,

@@ -17,7 +17,6 @@ def squeeze_excitation(input, num_channels, reduction_ratio=16,
     """Global-pool -> bottleneck MLP -> channel gate (the SE block)."""
     pool = layers.pool2d(input, pool_type="avg", global_pooling=True,
                          data_format=data_format)
-    c_axis = 1 if data_format == "NCHW" else 3
     pool = layers.reshape(pool, shape=[-1, num_channels])
     squeeze = layers.fc(pool, size=max(num_channels // reduction_ratio, 4),
                         act="relu", name=name and name + "_sq")

@@ -1,0 +1,527 @@
+"""Detection/vision ops: priors, box coding, matching, NMS, ROI pooling.
+
+≙ reference paddle/fluid/operators/detection/ (prior_box_op, density_prior_box
+_op, box_coder_op, iou_similarity_op, bipartite_match_op, target_assign_op,
+multiclass_nms_op, anchor_generator_op) and roi_pool_op.cc (SURVEY.md §2.2
+"Detection/vision"). The reference kernels loop over LoD'd boxes on CPU/GPU;
+here everything is static-shape vectorized jax: matching and NMS run as
+lax.fori_loop/scan with masking (outputs padded, counts returned), which is
+the form XLA can compile for TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+
+_NEG = -1e9
+
+
+def expand_aspect_ratios(aspect_ratios, flip):
+    """Dedup'd prior aspect-ratio list incl. the implicit 1.0 and optional
+    flips — shared by the kernel and the layer so declared prior counts
+    always match emitted shapes."""
+    ars = [1.0]
+    for ar in aspect_ratios or [1.0]:
+        if any(abs(float(ar) - a) < 1e-6 for a in ars):
+            continue
+        ars.append(float(ar))
+        if flip and not any(abs(1.0 / float(ar) - a) < 1e-6 for a in ars):
+            ars.append(1.0 / float(ar))
+    return ars
+
+
+# ---------------------------------------------------------------------------
+# similarity + coding
+# ---------------------------------------------------------------------------
+
+def _iou(x, y):
+    """x [N,4], y [M,4] (xmin,ymin,xmax,ymax) -> [N,M] IoU."""
+    area_x = jnp.maximum(x[:, 2] - x[:, 0], 0) * \
+        jnp.maximum(x[:, 3] - x[:, 1], 0)
+    area_y = jnp.maximum(y[:, 2] - y[:, 0], 0) * \
+        jnp.maximum(y[:, 3] - y[:, 1], 0)
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_x[:, None] + area_y[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+@register_op("iou_similarity", stop_gradient=True)
+def _iou_similarity(ctx, ins, attrs):
+    """≙ iou_similarity_op: X [N,4] or [B,N,4] vs Y [M,4]."""
+    x, y = ins["X"][0], ins["Y"][0]
+    if x.ndim == 3:
+        return {"Out": [jax.vmap(lambda xb: _iou(xb, y))(x)]}
+    return {"Out": [_iou(x, y)]}
+
+
+def _center_size(box):
+    w = box[..., 2] - box[..., 0]
+    h = box[..., 3] - box[..., 1]
+    cx = box[..., 0] + w / 2
+    cy = box[..., 1] + h / 2
+    return cx, cy, w, h
+
+
+@register_op("box_coder", stop_gradient=True)
+def _box_coder(ctx, ins, attrs):
+    """≙ box_coder_op.cc: encode/decode boxes against priors with variances.
+
+    PriorBox [M,4], PriorBoxVar [M,4] (optional), TargetBox:
+      encode_center_size: TargetBox [N,4] -> Out [N,M,4]
+      decode_center_size: TargetBox [N,M,4] (offsets) -> Out [N,M,4] boxes
+    """
+    prior = ins["PriorBox"][0]
+    pvar = ins["PriorBoxVar"][0] if ins.get("PriorBoxVar") else None
+    target = ins["TargetBox"][0]
+    code_type = attrs.get("code_type", "encode_center_size")
+    box_normalized = attrs.get("box_normalized", True)
+    norm = 0.0 if box_normalized else 1.0
+
+    pcx, pcy, pw, ph = _center_size(prior)          # [M]
+    pw = pw + norm
+    ph = ph + norm
+    if pvar is None:
+        pvar = jnp.ones((prior.shape[0], 4), prior.dtype)
+
+    if code_type == "encode_center_size":
+        tcx, tcy, tw, th = _center_size(target)     # [N]
+        tw = tw + norm
+        th = th + norm
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(jnp.maximum(tw[:, None] / pw[None, :], 1e-10))
+        dh = jnp.log(jnp.maximum(th[:, None] / ph[None, :], 1e-10))
+        out = jnp.stack([dx, dy, dw, dh], axis=-1) / pvar[None, :, :]
+    else:  # decode_center_size
+        d = target * pvar[None, :, :]
+        cx = d[..., 0] * pw[None, :] + pcx[None, :]
+        cy = d[..., 1] * ph[None, :] + pcy[None, :]
+        w = jnp.exp(d[..., 2]) * pw[None, :]
+        h = jnp.exp(d[..., 3]) * ph[None, :]
+        out = jnp.stack([cx - w / 2, cy - h / 2,
+                         cx + w / 2 - norm, cy + h / 2 - norm], axis=-1)
+    return {"OutputBox": [out]}
+
+
+# ---------------------------------------------------------------------------
+# priors / anchors
+# ---------------------------------------------------------------------------
+
+@register_op("prior_box", stop_gradient=True)
+def _prior_box(ctx, ins, attrs):
+    """≙ prior_box_op.cc (SSD priors). Input [N,C,H,W] or [N,H,W,C] feature
+    map + Image; outputs Boxes [H,W,P,4] and Variances [H,W,P,4]."""
+    feat = ins["Input"][0]
+    img = ins["Image"][0]
+    data_format = attrs.get("data_format", "NCHW")
+    if data_format == "NCHW":
+        fh, fw = feat.shape[2], feat.shape[3]
+        ih, iw = img.shape[2], img.shape[3]
+    else:
+        fh, fw = feat.shape[1], feat.shape[2]
+        ih, iw = img.shape[1], img.shape[2]
+    min_sizes = list(attrs["min_sizes"])
+    max_sizes = list(attrs.get("max_sizes", []) or [])
+    if max_sizes and len(max_sizes) != len(min_sizes):
+        raise ValueError(
+            f"prior_box: len(max_sizes)={len(max_sizes)} must equal "
+            f"len(min_sizes)={len(min_sizes)}")
+    ars = expand_aspect_ratios(attrs.get("aspect_ratios", [1.0]),
+                               attrs.get("flip", True))
+    step_w = attrs.get("step_w", 0.0) or iw / fw
+    step_h = attrs.get("step_h", 0.0) or ih / fh
+    offset = attrs.get("offset", 0.5)
+
+    # per-cell prior sizes (order matches the reference: for each min_size:
+    # all aspect ratios, then the sqrt(min*max) square)
+    widths, heights = [], []
+    for i, ms in enumerate(min_sizes):
+        for ar in ars:
+            widths.append(ms * np.sqrt(ar))
+            heights.append(ms / np.sqrt(ar))
+        if max_sizes:
+            mx = max_sizes[i]
+            widths.append(np.sqrt(ms * mx))
+            heights.append(np.sqrt(ms * mx))
+    pw = jnp.asarray(widths, feat.dtype)           # [P]
+    ph = jnp.asarray(heights, feat.dtype)
+
+    cx = (jnp.arange(fw, dtype=feat.dtype) + offset) * step_w   # [W]
+    cy = (jnp.arange(fh, dtype=feat.dtype) + offset) * step_h   # [H]
+    cxg = jnp.broadcast_to(cx[None, :, None], (fh, fw, pw.shape[0]))
+    cyg = jnp.broadcast_to(cy[:, None, None], (fh, fw, pw.shape[0]))
+    boxes = jnp.stack([(cxg - pw / 2) / iw, (cyg - ph / 2) / ih,
+                       (cxg + pw / 2) / iw, (cyg + ph / 2) / ih], axis=-1)
+    if attrs.get("clip", True):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.asarray(attrs.get("variances", [0.1, 0.1, 0.2, 0.2]),
+                      feat.dtype)
+    variances = jnp.broadcast_to(var, boxes.shape)
+    return {"Boxes": [boxes], "Variances": [variances]}
+
+
+@register_op("density_prior_box", stop_gradient=True)
+def _density_prior_box(ctx, ins, attrs):
+    """≙ density_prior_box_op.cc: dense grid of priors per cell with
+    per-size densities."""
+    feat, img = ins["Input"][0], ins["Image"][0]
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    fixed_sizes = list(attrs["fixed_sizes"])
+    fixed_ratios = list(attrs.get("fixed_ratios", [1.0]))
+    densities = list(attrs["densities"])
+    step_w = attrs.get("step_w", 0.0) or iw / fw
+    step_h = attrs.get("step_h", 0.0) or ih / fh
+    offset = attrs.get("offset", 0.5)
+
+    ws, hs, sx, sy = [], [], [], []
+    for size, dens in zip(fixed_sizes, densities):
+        for ar in fixed_ratios:
+            w = size * np.sqrt(ar)
+            h = size / np.sqrt(ar)
+            shift = 1.0 / dens
+            for di in range(dens):
+                for dj in range(dens):
+                    ws.append(w)
+                    hs.append(h)
+                    sx.append((dj + 0.5) * shift - 0.5)  # cell-rel offsets
+                    sy.append((di + 0.5) * shift - 0.5)
+    pw = jnp.asarray(ws, feat.dtype)
+    ph = jnp.asarray(hs, feat.dtype)
+    ox = jnp.asarray(sx, feat.dtype) * step_w
+    oy = jnp.asarray(sy, feat.dtype) * step_h
+    P = pw.shape[0]
+    cx = (jnp.arange(fw, dtype=feat.dtype) + offset) * step_w
+    cy = (jnp.arange(fh, dtype=feat.dtype) + offset) * step_h
+    cxg = cx[None, :, None] + ox[None, None, :]
+    cyg = cy[:, None, None] + oy[None, None, :]
+    cxg = jnp.broadcast_to(cxg, (fh, fw, P))
+    cyg = jnp.broadcast_to(cyg, (fh, fw, P))
+    boxes = jnp.stack([(cxg - pw / 2) / iw, (cyg - ph / 2) / ih,
+                       (cxg + pw / 2) / iw, (cyg + ph / 2) / ih], axis=-1)
+    if attrs.get("clip", True):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.asarray(attrs.get("variances", [0.1, 0.1, 0.2, 0.2]),
+                      feat.dtype)
+    return {"Boxes": [boxes],
+            "Variances": [jnp.broadcast_to(var, boxes.shape)]}
+
+
+@register_op("anchor_generator", stop_gradient=True)
+def _anchor_generator(ctx, ins, attrs):
+    """≙ anchor_generator_op.cc (RPN anchors, absolute pixel coords)."""
+    feat = ins["Input"][0]
+    fh, fw = feat.shape[2], feat.shape[3]
+    sizes = list(attrs.get("anchor_sizes", [64., 128., 256., 512.]))
+    ratios = list(attrs.get("aspect_ratios", [0.5, 1.0, 2.0]))
+    stride = list(attrs.get("stride", [16.0, 16.0]))
+    offset = attrs.get("offset", 0.5)
+    ws, hs = [], []
+    for r in ratios:
+        for s in sizes:
+            area = stride[0] * stride[1]
+            scale = s / np.sqrt(area)
+            base_w = np.round(np.sqrt(area / r))
+            base_h = np.round(base_w * r)
+            ws.append(scale * base_w)
+            hs.append(scale * base_h)
+    pw = jnp.asarray(ws, feat.dtype)
+    ph = jnp.asarray(hs, feat.dtype)
+    cx = (jnp.arange(fw, dtype=feat.dtype) + offset) * stride[0]
+    cy = (jnp.arange(fh, dtype=feat.dtype) + offset) * stride[1]
+    P = pw.shape[0]
+    cxg = jnp.broadcast_to(cx[None, :, None], (fh, fw, P))
+    cyg = jnp.broadcast_to(cy[:, None, None], (fh, fw, P))
+    anchors = jnp.stack([cxg - pw / 2, cyg - ph / 2,
+                         cxg + pw / 2, cyg + ph / 2], axis=-1)
+    var = jnp.asarray(attrs.get("variances", [0.1, 0.1, 0.2, 0.2]),
+                      feat.dtype)
+    return {"Anchors": [anchors],
+            "Variances": [jnp.broadcast_to(var, anchors.shape)]}
+
+
+# ---------------------------------------------------------------------------
+# matching + target assignment
+# ---------------------------------------------------------------------------
+
+def _bipartite_match_single(dist, match_type, overlap_threshold):
+    """dist [N, M] (rows = ground truth, cols = priors). Returns
+    (match_indices [M] int32 row-or-−1, match_dist [M])."""
+    N, M = dist.shape
+    steps = min(N, M)
+
+    def body(_, carry):
+        midx, mdist, row_used, col_used = carry
+        masked = jnp.where(row_used[:, None] | col_used[None, :], _NEG, dist)
+        flat = jnp.argmax(masked)
+        r, c = flat // M, flat % M
+        best = masked[r, c]
+        valid = best > 0
+        midx = jnp.where(valid, midx.at[c].set(r.astype(jnp.int32)), midx)
+        mdist = jnp.where(valid, mdist.at[c].set(best), mdist)
+        row_used = jnp.where(valid, row_used.at[r].set(True), row_used)
+        col_used = jnp.where(valid, col_used.at[c].set(True), col_used)
+        return midx, mdist, row_used, col_used
+
+    init = (jnp.full((M,), -1, jnp.int32), jnp.zeros((M,), dist.dtype),
+            jnp.zeros((N,), bool), jnp.zeros((M,), bool))
+    midx, mdist, _, _ = jax.lax.fori_loop(0, steps, body, init)
+
+    if match_type == "per_prediction":
+        # unmatched cols additionally match their best row if it clears the
+        # overlap threshold (≙ bipartite_match_op.cc match_type attr)
+        best_row = jnp.argmax(dist, axis=0).astype(jnp.int32)
+        best_val = jnp.max(dist, axis=0)
+        extra = (midx < 0) & (best_val > overlap_threshold)
+        midx = jnp.where(extra, best_row, midx)
+        mdist = jnp.where(extra, best_val, mdist)
+    return midx, mdist
+
+
+@register_op("bipartite_match", stop_gradient=True)
+def _bipartite_match(ctx, ins, attrs):
+    """≙ bipartite_match_op.cc. DistMat [B,N,M] (or [N,M]); outputs
+    ColToRowMatchIndices [B,M] (-1 = unmatched) and ColToRowMatchDist."""
+    dist = ins["DistMat"][0]
+    match_type = attrs.get("match_type", "bipartite")
+    thr = attrs.get("dist_threshold", 0.5)
+    squeeze = dist.ndim == 2
+    if squeeze:
+        dist = dist[None]
+    midx, mdist = jax.vmap(
+        lambda d: _bipartite_match_single(d, match_type, thr))(dist)
+    if squeeze:
+        midx, mdist = midx[0], mdist[0]
+    return {"ColToRowMatchIndices": [midx], "ColToRowMatchDist": [mdist]}
+
+
+@register_op("target_assign", stop_gradient=True)
+def _target_assign(ctx, ins, attrs):
+    """≙ target_assign_op.cc: scatter per-gt rows to matched priors.
+
+    X [B,N,K] per-gt values (boxes or labels), MatchIndices [B,M];
+    Out [B,M,K] with mismatch_value where unmatched, OutWeight [B,M,1]."""
+    x = ins["X"][0]
+    match = ins["MatchIndices"][0]
+    mismatch = attrs.get("mismatch_value", 0)
+    safe = jnp.maximum(match, 0)
+    gathered = jax.vmap(lambda xb, mb: xb[mb])(x, safe)   # [B,M,K]
+    matched = (match >= 0)[..., None]
+    out = jnp.where(matched, gathered,
+                    jnp.asarray(mismatch, x.dtype))
+    weight = matched.astype(jnp.float32)
+    return {"Out": [out], "OutWeight": [weight]}
+
+
+# ---------------------------------------------------------------------------
+# NMS
+# ---------------------------------------------------------------------------
+
+def _nms_single(boxes, scores, iou_threshold, top_k):
+    """boxes [M,4], scores [M] -> keep mask [M] after greedy NMS limited to
+    top_k selections (static-shape suppression loop)."""
+    M = scores.shape[0]
+    order_scores = scores
+    iou = _iou(boxes, boxes)
+
+    def body(_, carry):
+        keep, alive = carry
+        idx = jnp.argmax(jnp.where(alive, order_scores, _NEG))
+        ok = jnp.where(alive[idx], order_scores[idx] > _NEG / 2, False)
+        keep = jnp.where(ok, keep.at[idx].set(True), keep)
+        # suppress overlaps with the selected box
+        suppress = iou[idx] >= iou_threshold
+        alive = jnp.where(ok, alive & ~suppress, alive)
+        alive = alive.at[idx].set(False)
+        return keep, alive
+
+    steps = min(top_k, M) if top_k > 0 else M
+    keep, _ = jax.lax.fori_loop(
+        0, steps, body,
+        (jnp.zeros((M,), bool), jnp.ones((M,), bool)))
+    return keep
+
+
+@register_op("multiclass_nms", stop_gradient=True)
+def _multiclass_nms(ctx, ins, attrs):
+    """≙ multiclass_nms_op.cc. BBoxes [B,M,4], Scores [B,C,M].
+
+    Static-shape output: Out [B, keep_top_k, 6] rows (label, score, x1, y1,
+    x2, y2) sorted by score, padded with -1 labels; NmsRoisNum [B].
+    (The reference emits a LoD tensor; the padded form + count is the
+    static translation.)
+    """
+    bboxes = ins["BBoxes"][0]
+    scores = ins["Scores"][0]
+    score_threshold = attrs.get("score_threshold", 0.01)
+    nms_top_k = attrs.get("nms_top_k", 400)
+    keep_top_k = attrs.get("keep_top_k", 200)
+    nms_threshold = attrs.get("nms_threshold", 0.3)
+    background_label = attrs.get("background_label", 0)
+    B, C, M = scores.shape
+    K = keep_top_k if keep_top_k > 0 else C * M
+
+    def per_image(boxes, sc):
+        def per_class(c_scores):
+            valid = c_scores > score_threshold
+            s = jnp.where(valid, c_scores, _NEG)
+            keep = _nms_single(boxes, s, nms_threshold, nms_top_k)
+            return jnp.where(keep & valid, c_scores, _NEG)
+
+        kept = jax.vmap(per_class)(sc)                  # [C,M]
+        labels = jnp.broadcast_to(jnp.arange(C)[:, None], (C, M))
+        kept = jnp.where(labels == background_label, _NEG, kept)
+        flat_scores = kept.reshape(-1)                  # [C*M]
+        k = min(K, C * M)
+        top_scores, top_idx = jax.lax.top_k(flat_scores, k)
+        top_label = (top_idx // M).astype(jnp.float32)
+        top_box = boxes[top_idx % M]
+        valid = top_scores > _NEG / 2
+        row = jnp.concatenate(
+            [jnp.where(valid, top_label, -1.0)[:, None],
+             jnp.where(valid, top_scores, -1.0)[:, None],
+             jnp.where(valid[:, None], top_box, -1.0)], axis=1)  # [k,6]
+        if k < K:
+            row = jnp.pad(row, ((0, K - k), (0, 0)), constant_values=-1.0)
+        return row, jnp.sum(valid.astype(jnp.int32))
+
+    out, num = jax.vmap(per_image)(bboxes, scores)
+    return {"Out": [out], "NmsRoisNum": [num]}
+
+
+# ---------------------------------------------------------------------------
+# ROI pooling
+# ---------------------------------------------------------------------------
+
+@register_op("roi_pool")
+def _roi_pool(ctx, ins, attrs):
+    """≙ roi_pool_op.cc: quantized max-pool per ROI bin.
+
+    X [N,C,H,W]; ROIs [R,5] rows (batch_idx, x1, y1, x2, y2) in image
+    coords. Out [R, C, ph, pw]. Bin membership is computed as a static
+    [ph*pw, H] x [pw, W] mask pair per ROI — O(R·C·H·W·ph·pw) like the
+    reference kernel, fully vectorized for XLA."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    ph = attrs["pooled_height"]
+    pw = attrs["pooled_width"]
+    scale = attrs.get("spatial_scale", 1.0)
+    N, C, H, W = x.shape
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * scale)
+        y1 = jnp.round(roi[2] * scale)
+        x2 = jnp.round(roi[3] * scale)
+        y2 = jnp.round(roi[4] * scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        hi = jnp.arange(ph, dtype=x.dtype)
+        wi = jnp.arange(pw, dtype=x.dtype)
+        hstart = jnp.clip(jnp.floor(hi * bin_h) + y1, 0, H)
+        hend = jnp.clip(jnp.ceil((hi + 1) * bin_h) + y1, 0, H)
+        wstart = jnp.clip(jnp.floor(wi * bin_w) + x1, 0, W)
+        wend = jnp.clip(jnp.ceil((wi + 1) * bin_w) + x1, 0, W)
+        hpos = jnp.arange(H, dtype=x.dtype)
+        wpos = jnp.arange(W, dtype=x.dtype)
+        hmask = (hpos[None, :] >= hstart[:, None]) & \
+            (hpos[None, :] < hend[:, None])          # [ph,H]
+        wmask = (wpos[None, :] >= wstart[:, None]) & \
+            (wpos[None, :] < wend[:, None])          # [pw,W]
+        mask = hmask[:, None, :, None] & wmask[None, :, None, :]  # [ph,pw,H,W]
+        feat = x[b]                                   # [C,H,W]
+        vals = jnp.where(mask[None], feat[:, None, None, :, :], _NEG)
+        out = jnp.max(vals, axis=(3, 4))              # [C,ph,pw]
+        empty = ~jnp.any(mask, axis=(2, 3))           # [ph,pw]
+        return jnp.where(empty[None], 0.0, out)
+
+    return {"Out": [jax.vmap(one_roi)(rois).astype(x.dtype)]}
+
+
+# ---------------------------------------------------------------------------
+# SSD multibox loss
+# ---------------------------------------------------------------------------
+
+def _smooth_l1(x):
+    ax = jnp.abs(x)
+    return jnp.where(ax < 1.0, 0.5 * x * x, ax - 0.5)
+
+
+@register_op("ssd_loss")
+def _ssd_loss(ctx, ins, attrs):
+    """≙ the composite the reference builds in layers/detection.py ssd_loss
+    (iou_similarity -> bipartite_match -> target_assign -> smooth_l1 +
+    softmax CE with hard negative mining), fused into one differentiable
+    lowering. Matching/mining indices are stop-gradient; loss flows through
+    Location and Confidence.
+    """
+    loc = ins["Location"][0]            # [B,M,4]
+    conf = ins["Confidence"][0]         # [B,M,C]
+    gt_box = ins["GTBox"][0]            # [B,G,4] zero-area rows = padding
+    gt_label = ins["GTLabel"][0]        # [B,G]
+    prior = ins["PriorBox"][0]          # [M,4]
+    pvar = (ins["PriorBoxVar"][0] if ins.get("PriorBoxVar")
+            else jnp.broadcast_to(
+                jnp.asarray([0.1, 0.1, 0.2, 0.2], loc.dtype),
+                (prior.shape[0], 4)))
+    bg = attrs.get("background_label", 0)
+    thr = attrs.get("overlap_threshold", 0.5)
+    ratio = attrs.get("neg_pos_ratio", 3.0)
+    w_loc = attrs.get("loc_loss_weight", 1.0)
+    w_conf = attrs.get("conf_loss_weight", 1.0)
+    B, M, C = conf.shape
+    if gt_label.ndim == 3:
+        gt_label = gt_label[..., 0]
+
+    pcx, pcy, pw, ph = _center_size(prior)
+
+    def per_image(loc_b, conf_b, gtb, gtl):
+        area = jnp.maximum(gtb[:, 2] - gtb[:, 0], 0) * \
+            jnp.maximum(gtb[:, 3] - gtb[:, 1], 0)
+        valid_gt = area > 0
+        iou = _iou(gtb, prior)                        # [G,M]
+        iou = jnp.where(valid_gt[:, None], iou, _NEG)
+        match, _ = _bipartite_match_single(iou, "per_prediction", thr)
+        match = jax.lax.stop_gradient(match)          # [M]
+        pos = match >= 0
+        safe = jnp.maximum(match, 0)
+
+        # --- localization targets (encode_center_size w/ variances) ------
+        mb = gtb[safe]                                # [M,4]
+        gcx, gcy, gw, gh = _center_size(mb)
+        tx = (gcx - pcx) / pw / pvar[:, 0]
+        ty = (gcy - pcy) / ph / pvar[:, 1]
+        tw = jnp.log(jnp.maximum(gw / pw, 1e-10)) / pvar[:, 2]
+        th = jnp.log(jnp.maximum(gh / ph, 1e-10)) / pvar[:, 3]
+        t = jax.lax.stop_gradient(
+            jnp.stack([tx, ty, tw, th], axis=-1))     # [M,4]
+        loc_l = jnp.sum(_smooth_l1(loc_b - t), axis=-1) * pos
+
+        # --- confidence loss with hard negative mining -------------------
+        target_lbl = jnp.where(pos, gtl.astype(jnp.int32)[safe], bg)
+        logp = jax.nn.log_softmax(conf_b, axis=-1)
+        ce = -jnp.take_along_axis(logp, target_lbl[:, None], axis=1)[:, 0]
+        num_pos = jnp.sum(pos)
+        num_neg = jnp.minimum((ratio * num_pos).astype(jnp.int32),
+                              M - num_pos)
+        neg_score = jnp.where(pos, _NEG, jax.lax.stop_gradient(ce))
+        order = jnp.argsort(-neg_score)               # hardest first
+        rank = jnp.zeros((M,), jnp.int32).at[order].set(jnp.arange(M,
+                                                        dtype=jnp.int32))
+        neg_sel = (~pos) & (rank < num_neg)
+        conf_l = jnp.sum(ce * (pos | neg_sel))
+        return jnp.sum(loc_l), conf_l, num_pos
+
+    loc_l, conf_l, npos = jax.vmap(per_image)(loc, conf, gt_box, gt_label)
+    denom = jnp.maximum(jnp.sum(npos).astype(loc.dtype), 1.0)
+    total = (w_loc * jnp.sum(loc_l) + w_conf * jnp.sum(conf_l)) / denom
+    return {"Loss": [total]}

@@ -1,0 +1,235 @@
+"""Detection op family tests vs numpy references.
+
+≙ reference tests test_iou_similarity_op.py, test_box_coder_op.py,
+test_prior_box_op.py, test_anchor_generator_op.py, test_bipartite_match_op
+.py, test_target_assign_op.py, test_multiclass_nms_op.py, test_roi_pool_op
+.py + layers/detection.py coverage (test_detection.py).
+"""
+
+import numpy as np
+import pytest
+
+from op_test import check_grad, run_op
+
+
+def np_iou(x, y):
+    n, m = x.shape[0], y.shape[0]
+    out = np.zeros((n, m))
+    for i in range(n):
+        for j in range(m):
+            ax = max(x[i, 2] - x[i, 0], 0) * max(x[i, 3] - x[i, 1], 0)
+            ay = max(y[j, 2] - y[j, 0], 0) * max(y[j, 3] - y[j, 1], 0)
+            iw = min(x[i, 2], y[j, 2]) - max(x[i, 0], y[j, 0])
+            ih = min(x[i, 3], y[j, 3]) - max(x[i, 1], y[j, 1])
+            inter = max(iw, 0) * max(ih, 0)
+            u = ax + ay - inter
+            out[i, j] = inter / u if u > 0 else 0.0
+    return out
+
+
+def _rand_boxes(rng, n, scale=1.0):
+    xy = rng.rand(n, 2) * 0.6 * scale
+    wh = (rng.rand(n, 2) * 0.3 + 0.05) * scale
+    return np.concatenate([xy, xy + wh], axis=1).astype("float32")
+
+
+class TestIoUAndCoder:
+    def test_iou_matches_numpy(self, rng):
+        x, y = _rand_boxes(rng, 5), _rand_boxes(rng, 7)
+        out = run_op("iou_similarity", {"X": x, "Y": y})["Out"][0]
+        np.testing.assert_allclose(out, np_iou(x, y), atol=1e-5)
+
+    def test_box_coder_roundtrip(self, rng):
+        """decode(encode(t)) == t for every (target, prior) pair."""
+        prior = _rand_boxes(rng, 6)
+        pvar = (rng.rand(6, 4) * 0.2 + 0.1).astype("float32")
+        target = _rand_boxes(rng, 3)
+        enc = run_op("box_coder",
+                     {"PriorBox": prior, "PriorBoxVar": pvar,
+                      "TargetBox": target},
+                     attrs={"code_type": "encode_center_size"})["OutputBox"][0]
+        dec = run_op("box_coder",
+                     {"PriorBox": prior, "PriorBoxVar": pvar,
+                      "TargetBox": enc},
+                     attrs={"code_type": "decode_center_size"})["OutputBox"][0]
+        # every row of dec should reproduce the original target box
+        for j in range(6):
+            np.testing.assert_allclose(dec[:, j, :], target, atol=1e-4)
+
+
+class TestPriorsAnchors:
+    def test_prior_box_shapes_and_geometry(self, rng):
+        feat = rng.rand(1, 8, 4, 4).astype("float32")
+        img = rng.rand(1, 3, 64, 64).astype("float32")
+        out = run_op("prior_box", {"Input": feat, "Image": img},
+                     attrs={"min_sizes": [16.0], "max_sizes": [32.0],
+                            "aspect_ratios": [2.0], "flip": True,
+                            "clip": True})
+        boxes, var = out["Boxes"][0], out["Variances"][0]
+        # P = 1 (ar=1) + 2 (ar=2, flip) + 1 (sqrt(min*max)) = 4
+        assert boxes.shape == (4, 4, 4, 4) and var.shape == boxes.shape
+        assert (boxes >= 0).all() and (boxes <= 1).all()
+        # the ar=1 prior at cell (0,0): centered at offset*step=8, size 16
+        b = boxes[0, 0, 0] * 64
+        np.testing.assert_allclose(b, [0, 0, 16, 16], atol=1e-4)
+        assert (boxes[..., 2] >= boxes[..., 0]).all()
+
+    def test_density_prior_box_count(self, rng):
+        feat = rng.rand(1, 8, 2, 2).astype("float32")
+        img = rng.rand(1, 3, 32, 32).astype("float32")
+        out = run_op("density_prior_box", {"Input": feat, "Image": img},
+                     attrs={"fixed_sizes": [8.0], "fixed_ratios": [1.0],
+                            "densities": [2]})
+        assert out["Boxes"][0].shape == (2, 2, 4, 4)
+
+    def test_anchor_generator(self, rng):
+        feat = rng.rand(1, 8, 3, 3).astype("float32")
+        out = run_op("anchor_generator", {"Input": feat},
+                     attrs={"anchor_sizes": [32.0, 64.0],
+                            "aspect_ratios": [1.0],
+                            "stride": [16.0, 16.0]})
+        anchors = out["Anchors"][0]
+        assert anchors.shape == (3, 3, 2, 4)
+        # size-32 anchor at cell center (8, 8): 32x32 box
+        a = anchors[0, 0, 0]
+        np.testing.assert_allclose(a[2] - a[0], 32.0, atol=1e-3)
+        np.testing.assert_allclose((a[0] + a[2]) / 2, 8.0, atol=1e-3)
+
+
+class TestMatching:
+    def test_bipartite_greedy_matches_best_pairs(self):
+        # row 0 best with col 1 (0.9); row 1 best remaining with col 0 (0.6)
+        dist = np.array([[0.3, 0.9, 0.1],
+                         [0.6, 0.8, 0.2]], dtype="float32")
+        out = run_op("bipartite_match", {"DistMat": dist})
+        idx = out["ColToRowMatchIndices"][0]
+        d = out["ColToRowMatchDist"][0]
+        assert idx[1] == 0 and d[1] == pytest.approx(0.9)
+        assert idx[0] == 1 and d[0] == pytest.approx(0.6)
+        assert idx[2] == -1
+
+    def test_per_prediction_threshold(self):
+        dist = np.array([[0.3, 0.9, 0.45]], dtype="float32")
+        out = run_op("bipartite_match", {"DistMat": dist},
+                     attrs={"match_type": "per_prediction",
+                            "dist_threshold": 0.4})
+        idx = out["ColToRowMatchIndices"][0]
+        # col1 bipartite-matched; col2 clears 0.4 threshold; col0 does not
+        assert idx[1] == 0 and idx[2] == 0 and idx[0] == -1
+
+    def test_target_assign(self):
+        x = np.arange(12, dtype="float32").reshape(1, 3, 4)   # 3 gt rows
+        match = np.array([[1, -1, 0, 2]], dtype="int32")
+        out = run_op("target_assign", {"X": x, "MatchIndices": match},
+                     attrs={"mismatch_value": 7})
+        got, w = out["Out"][0], out["OutWeight"][0]
+        np.testing.assert_array_equal(got[0, 0], x[0, 1])
+        np.testing.assert_array_equal(got[0, 1], [7, 7, 7, 7])
+        np.testing.assert_array_equal(got[0, 2], x[0, 0])
+        np.testing.assert_array_equal(w[0, :, 0], [1, 0, 1, 1])
+
+
+class TestNMS:
+    def test_multiclass_nms_suppresses_overlaps(self):
+        # two heavily-overlapping boxes + one distant; class 1 only
+        boxes = np.array([[[0.0, 0.0, 0.4, 0.4],
+                           [0.01, 0.01, 0.41, 0.41],
+                           [0.6, 0.6, 0.9, 0.9]]], dtype="float32")
+        scores = np.zeros((1, 2, 3), dtype="float32")
+        scores[0, 1] = [0.9, 0.8, 0.7]
+        out = run_op("multiclass_nms",
+                     {"BBoxes": boxes, "Scores": scores},
+                     attrs={"score_threshold": 0.1, "nms_threshold": 0.5,
+                            "keep_top_k": 5, "background_label": 0})
+        rows, num = out["Out"][0], out["NmsRoisNum"][0]
+        assert num[0] == 2          # overlap suppressed
+        assert rows[0, 0, 0] == 1 and rows[0, 0, 1] == pytest.approx(0.9)
+        np.testing.assert_allclose(rows[0, 1, 2:], [0.6, 0.6, 0.9, 0.9],
+                                   atol=1e-5)
+        assert (rows[0, 2:, 0] == -1).all()   # padding
+
+    def test_background_class_excluded(self):
+        boxes = np.array([[[0.0, 0.0, 0.4, 0.4]]], dtype="float32")
+        scores = np.zeros((1, 2, 1), dtype="float32")
+        scores[0, 0, 0] = 0.95     # background
+        scores[0, 1, 0] = 0.4
+        out = run_op("multiclass_nms",
+                     {"BBoxes": boxes, "Scores": scores},
+                     attrs={"score_threshold": 0.1, "keep_top_k": 3,
+                            "background_label": 0})
+        assert out["NmsRoisNum"][0][0] == 1
+        assert out["Out"][0][0, 0, 0] == 1
+
+
+class TestRoiPool:
+    def test_matches_manual_max(self, rng):
+        x = rng.rand(1, 2, 8, 8).astype("float32")
+        rois = np.array([[0, 0, 0, 3, 3],     # 4x4 region -> 2x2 bins
+                         [0, 4, 4, 7, 7]], dtype="float32")
+        out = run_op("roi_pool", {"X": x, "ROIs": rois},
+                     attrs={"pooled_height": 2, "pooled_width": 2,
+                            "spatial_scale": 1.0})["Out"][0]
+        assert out.shape == (2, 2, 2, 2)
+        np.testing.assert_allclose(out[0, 0, 0, 0], x[0, 0, 0:2, 0:2].max(),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(out[1, 1, 1, 1], x[0, 1, 6:8, 6:8].max(),
+                                   rtol=1e-6)
+
+    def test_grad_flows_to_features(self, rng):
+        x = rng.rand(1, 1, 6, 6).astype("float32")
+        rois = np.array([[0, 0, 0, 5, 5]], dtype="float32")
+        check_grad("roi_pool", {"X": x, "ROIs": rois},
+                   grad_slots=["X"],
+                   attrs={"pooled_height": 2, "pooled_width": 2,
+                          "spatial_scale": 1.0}, atol=5e-2, rtol=5e-2)
+
+
+class TestSSDPipeline:
+    def test_ssd_loss_trains_detection_head(self, rng):
+        """End-to-end: multi_box_head + ssd_loss trains; detection_output
+        decodes (≙ book SSD flow built from the detection layers)."""
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.layers import detection as det
+
+        B, G = 2, 3
+        img = layers.data("img", shape=[3, 32, 32])
+        gt_box = layers.data("gt_box", shape=[G, 4])
+        gt_label = layers.data("gt_label", shape=[G], dtype="int64")
+
+        feat = layers.conv2d(img, num_filters=8, filter_size=3, padding=1,
+                             act="relu")
+        feat = layers.pool2d(feat, pool_size=4, pool_stride=4)  # [B,8,8,8]
+        locs, confs, boxes, variances = det.multi_box_head(
+            [feat], img, num_classes=3, min_sizes=[[8.0]],
+            aspect_ratios=[[1.0]], name="mbh")
+        loss = det.ssd_loss(locs, confs, gt_box, gt_label, boxes,
+                            overlap_threshold=0.3)
+        pt.optimizer.AdamOptimizer(learning_rate=1e-2).minimize(loss)
+
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        x = rng.rand(B, 3, 32, 32).astype("float32")
+        gb = np.zeros((B, G, 4), dtype="float32")
+        gl = np.zeros((B, G), dtype="int64")
+        for b in range(B):
+            gb[b, 0] = [0.1, 0.1, 0.4, 0.4]
+            gl[b, 0] = 1
+            gb[b, 1] = [0.5, 0.5, 0.9, 0.9]
+            gl[b, 1] = 2
+            # row 2 stays zero-area = padding
+        feed = {"img": x, "gt_box": gb, "gt_label": gl}
+        l0 = float(exe.run(feed=feed, fetch_list=[loss])[0])
+        for _ in range(15):
+            l1 = float(exe.run(feed=feed, fetch_list=[loss])[0])
+        assert np.isfinite(l1) and l1 < l0
+
+        # inference: decode + NMS over the trained head
+        probs = layers.softmax(confs)
+        scores = layers.transpose(probs, perm=[0, 2, 1])   # [B,C,M]
+        out, num = det.detection_output(locs, scores, boxes, variances,
+                                        score_threshold=0.01,
+                                        keep_top_k=10)
+        res, cnt = exe.run(feed=feed, fetch_list=[out, num])
+        assert res.shape == (B, 10, 6)
+        assert (cnt >= 0).all()

@@ -26,6 +26,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.layers.control_flow",
     "paddle_tpu.layers.sequence",
     "paddle_tpu.layers.io",
+    "paddle_tpu.layers.detection",
     "paddle_tpu.layers.learning_rate_scheduler",
     "paddle_tpu.optimizer",
     "paddle_tpu.initializer",
@@ -74,7 +75,9 @@ def iter_api():
             if not owner.startswith("paddle_tpu"):
                 continue
             # internal plumbing re-exported by accident is not public API
-            if owner.startswith("paddle_tpu.core"):
+            # (places/flags under core ARE public; only helpers are not)
+            if owner in ("paddle_tpu.core.enforce", "paddle_tpu.core.dtypes",
+                         "paddle_tpu.core.unique_name"):
                 continue
             if inspect.isclass(obj):
                 yield f"{modname}.{name}{_sig(obj.__init__)}"

@@ -78,7 +78,7 @@ def _ensure_builtin_ops():
     from ..ops import (elementwise, nn_ops, tensor_ops, reduce_ops,  # noqa: F401
                        optimizer_ops, random_ops, sequence_ops, metric_ops,
                        control_ops, loss_ops, sequence_label_ops,
-                       beam_search_ops, detection_ops)
+                       beam_search_ops, detection_ops, pallas_kernels)
 
 
 @dataclass

@@ -220,7 +220,13 @@ def multi_box_head(inputs, image, num_classes, min_sizes, max_sizes=None,
     from . import tensor as _tensor
     enforce(len(inputs) == len(min_sizes), "one min_size per input",
             exc=InvalidArgumentError)
+    enforce(max_sizes is None or len(max_sizes) == len(inputs),
+            "one max_size per input", exc=InvalidArgumentError)
+    enforce(steps is None or len(steps) == len(inputs),
+            "one step per input", exc=InvalidArgumentError)
     aspect_ratios = aspect_ratios or [[1.0]] * len(inputs)
+    enforce(len(aspect_ratios) == len(inputs),
+            "one aspect_ratio list per input", exc=InvalidArgumentError)
     locs, confs, boxes_all, vars_all = [], [], [], []
     for i, feat in enumerate(inputs):
         ms = min_sizes[i] if isinstance(min_sizes[i], (list, tuple)) \

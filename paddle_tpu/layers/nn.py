@@ -1006,3 +1006,17 @@ def log_softmax(x, axis=-1, name=None):
     helper.append_op(type="log_softmax", inputs={"X": [x]},
                      outputs={"Out": [out]}, attrs={"axis": axis})
     return out
+
+
+def fused_attention(q, k, v, scale=None, causal=False, name=None):
+    """Fused scaled-dot-product attention over [B, H, T, D] tensors —
+    flash kernel (Pallas) on TPU, XLA composite elsewhere
+    (≙ nets.py scaled_dot_product_attention, kernelized)."""
+    helper = LayerHelper("fused_attention", name=name)
+    out = helper.create_tmp_variable(dtype=dtype_name(q.dtype),
+                                     shape=list(q.shape))
+    helper.append_op(type="fused_attention",
+                     inputs={"Q": [q], "K": [k], "V": [v]},
+                     outputs={"Out": [out]},
+                     attrs={"scale": scale, "causal": causal})
+    return out

@@ -51,17 +51,30 @@ def multi_head_attention(q_in, k_in, v_in, d_model, num_heads, dropout=0.0,
     q = split_heads(q, t_q)
     k = split_heads(k, t_k)
     v = split_heads(v, t_k)
-    q = layers.scale(q, scale=float(d_head) ** -0.5)
-    scores = layers.matmul(q, k, transpose_y=True, use_bf16=True)
-    if causal:
-        mask_np = np.triu(np.full((t_q, t_k), -1e9, dtype="float32"), k=1)
-        mask = layers.assign(mask_np.reshape(1, 1, t_q, t_k))
-        scores = layers.elementwise_add(scores, mask)
-    weights = layers.softmax(scores)
-    if dropout:
+    if not dropout or is_test:
+        # fused flash-attention op: Pallas kernel on TPU (O(T) memory),
+        # XLA composite elsewhere — see ops/pallas_kernels.py
+        ctx = layers.fused_attention(q, k, v,
+                                     scale=float(d_head) ** -0.5,
+                                     causal=causal)
+        if dropout and is_test:
+            # downgrade_in_infer: training scaled attention weights by the
+            # keep mask; inference must scale by (1-p) to keep the
+            # expectation the downstream weights were trained against
+            ctx = layers.scale(ctx, scale=1.0 - dropout)
+    else:
+        # attention-weight dropout needs the explicit weights tensor
+        q = layers.scale(q, scale=float(d_head) ** -0.5)
+        scores = layers.matmul(q, k, transpose_y=True, use_bf16=True)
+        if causal:
+            mask_np = np.triu(np.full((t_q, t_k), -1e9, dtype="float32"),
+                              k=1)
+            mask = layers.assign(mask_np.reshape(1, 1, t_q, t_k))
+            scores = layers.elementwise_add(scores, mask)
+        weights = layers.softmax(scores)
         weights = layers.dropout(weights, dropout_prob=dropout,
                                  is_test=is_test)
-    ctx = layers.matmul(weights, v, use_bf16=True)
+        ctx = layers.matmul(weights, v, use_bf16=True)
     ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
     ctx = layers.reshape(ctx, shape=[b, t_q, d_model])
     return layers.fc(ctx, size=d_model, num_flatten_dims=2, bias_attr=False,

@@ -177,6 +177,10 @@ def _density_prior_box(ctx, ins, attrs):
     fixed_sizes = list(attrs["fixed_sizes"])
     fixed_ratios = list(attrs.get("fixed_ratios", [1.0]))
     densities = list(attrs["densities"])
+    if len(densities) != len(fixed_sizes):
+        raise ValueError(
+            f"density_prior_box: len(densities)={len(densities)} must "
+            f"equal len(fixed_sizes)={len(fixed_sizes)}")
     step_w = attrs.get("step_w", 0.0) or iw / fw
     step_h = attrs.get("step_h", 0.0) or ih / fh
     offset = attrs.get("offset", 0.5)

@@ -1,0 +1,213 @@
+"""Pallas TPU kernels for the hot ops.
+
+SURVEY.md §7 stage 4: "Pallas kernels only where XLA underperforms". The
+first such op is fused attention — XLA materializes the [T, T] score matrix
+in HBM for a naive composite, while the flash kernel keeps per-tile scores
+in VMEM with an online softmax (O(T) memory), which is the difference
+between fitting long sequences on-chip or not (reference analogue: the
+hand-written CUDA kernels under operators/math/, e.g. lstm/gru_compute —
+the places the reference dropped below its framework abstractions for
+speed).
+
+Backend selection: on TPU the kernel compiles via Mosaic; elsewhere the
+mathematically-identical jnp composite runs (tests additionally exercise
+the kernel itself in pallas interpret mode to pin the tiling logic).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _auto_backend():
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
+def _attention_reference(q, k, v, scale, causal):
+    """Naive composite (the XLA fallback path). q/k/v: [B, H, T, D].
+    Causal masking is bottom-right aligned (query i sees keys up to
+    i + Tk - Tq — the incremental-decode convention)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  scale, causal, block_q, block_k, num_k_blocks,
+                  causal_offset, true_tk):
+    """One (batch·head, q-block, k-block) grid step of flash attention.
+
+    Grid iterates the k dimension innermost; m/l/acc scratch persists
+    across those sequential iterations (TPU grid semantics), implementing
+    the online softmax.
+    """
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]                                   # [bq, D]
+    k = k_ref[0]                                   # [bk, D]
+    v = v_ref[0]                                   # [bk, D]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # [bq, bk]
+
+    k_pos = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    # padded key columns (from rounding Tk up to the block size) are dead
+    s = jnp.where(k_pos < true_tk, s, _NEG_INF)
+    if causal:
+        qi = pl.program_id(1)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        # bottom-right alignment: matches _attention_reference for Tq != Tk
+        s = jnp.where(q_pos + causal_offset >= k_pos, s, _NEG_INF)
+
+    m_prev = m_ref[:]                              # [bq, 1]
+    l_prev = l_ref[:]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)                         # [bq, bk]
+    l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_ref[:] = m_new
+    l_ref[:] = l_new
+
+    @pl.when(j == num_k_blocks - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] /
+                    jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_attention_pallas(q, k, v, scale, causal, block_q, block_k,
+                            interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, T, D = q.shape
+    Tk = k.shape[2]
+    bq = min(block_q, T)
+    bk = min(block_k, Tk)
+    # round sequence lengths up to block multiples: padded queries are
+    # sliced off, padded keys are masked dead inside the kernel
+    Tp = -(-T // bq) * bq
+    Tkp = -(-Tk // bk) * bk
+    qf = q.reshape(B * H, T, D)
+    kf = k.reshape(B * H, Tk, D)
+    vf = v.reshape(B * H, Tk, D)
+    if Tp != T:
+        qf = jnp.pad(qf, ((0, 0), (0, Tp - T), (0, 0)))
+    if Tkp != Tk:
+        kf = jnp.pad(kf, ((0, 0), (0, Tkp - Tk), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, Tkp - Tk), (0, 0)))
+    nq, nk = Tp // bq, Tkp // bk
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        num_k_blocks=nk, causal_offset=Tk - T, true_tk=Tk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tp, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out[:, :T].reshape(B, H, T, D)
+
+
+def flash_attention(q, k, v, scale=None, causal=False, block_q=128,
+                    block_k=128, backend=None):
+    """Fused multi-head attention. q/k/v: [B, H, T, D].
+
+    backend: None = auto (pallas on TPU, XLA composite elsewhere);
+    "pallas_interpret" forces the kernel through the pallas interpreter
+    (CPU-testable); "xla" forces the composite.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if backend is None:
+        backend = _auto_backend()
+    if backend == "xla":
+        return _attention_reference(q, k, v, scale, causal)
+    return _flash_attention_pallas(
+        q, k, v, scale, causal, block_q, block_k,
+        interpret=(backend == "pallas_interpret"))
+
+
+# ---------------------------------------------------------------------------
+# differentiable wrapper + op registration
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _fused_attention(q, k, v, scale, causal, backend):
+    if backend == "xla":
+        return _attention_reference(q, k, v, scale, causal)
+    return _flash_attention_pallas(q, k, v, scale, causal, 128, 128,
+                                   interpret=(backend == "pallas_interpret"))
+
+
+def _fused_attention_fwd(q, k, v, scale, causal, backend):
+    return _fused_attention(q, k, v, scale, causal, backend), (q, k, v)
+
+
+def _fused_attention_bwd(scale, causal, backend, res, g):
+    # Backward recomputes through the composite (flash-backward kernel is a
+    # follow-up): forward memory stays O(T), backward pays the [T,T] scores
+    # once — same trade as jax.checkpoint'ing the composite.
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _attention_reference(q_, k_, v_, scale, causal),
+        q, k, v)
+    return vjp(g)
+
+
+_fused_attention.defvjp(_fused_attention_fwd, _fused_attention_bwd)
+
+
+def _register():
+    from ..framework.registry import register_op
+
+    @register_op("fused_attention")
+    def _fused_attention_op(ctx, ins, attrs):
+        """Fused scaled-dot-product attention (≙ the composite
+        nets.py:332 scaled_dot_product_attention upgraded to a flash
+        kernel). Lowering picks the backend per device — the TPU-native
+        translation of the reference's (place, dtype, ...) kernel
+        dispatch (op_registry.h:214)."""
+        q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+        scale = attrs.get("scale") or 1.0 / (q.shape[-1] ** 0.5)
+        backend = attrs.get("backend") or _auto_backend()
+        out = _fused_attention(q, k, v, scale,
+                               attrs.get("causal", False), backend)
+        return {"Out": [out]}
+
+
+_register()

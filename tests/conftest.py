@@ -20,7 +20,11 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 import jax._src.xla_bridge as _xb  # noqa: E402
 
-for _plugin in ("axon", "tpu"):
+for _plugin in ("axon",):
+    # NOTE: only the axon tunnel plugin is dropped. The stock "tpu" platform
+    # must stay registered (deviceless): removing it makes platform "tpu"
+    # unknown to MLIR lowering registration, which breaks importing
+    # jax.experimental.pallas.tpu even for interpret-mode runs.
     _xb._backend_factories.pop(_plugin, None)
 # the plugin's register() may have pinned jax_platforms=axon in jax.config
 # before this conftest ran — force CPU for the test session.

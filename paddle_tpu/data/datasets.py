@@ -214,9 +214,9 @@ class wmt_synthetic:
     bos, eos = 0, 1
 
     @staticmethod
-    def train(n=2048, max_len=30):
+    def train(n=2048, max_len=30, seed=41):
         def reader():
-            r = np.random.RandomState(41)
+            r = np.random.RandomState(seed)
             for _ in range(n):
                 slen = int(r.randint(5, max_len))
                 src = r.randint(2, wmt_synthetic.src_vocab, size=slen)
@@ -248,3 +248,236 @@ class ctr_synthetic:
                 yield sparse.astype(np.int64), dense, label
 
         return reader
+
+
+# ------------------------------------------------------------- flowers
+class flowers:
+    """≙ reference dataset/flowers.py (102-category Oxford flowers):
+    224x224x3 images + label."""
+
+    NUM_CLASSES = 102
+
+    @staticmethod
+    def train(n=512):
+        return _synthetic_images(n, (3, 224, 224), flowers.NUM_CLASSES, 101)
+
+    @staticmethod
+    def test(n=128):
+        return _synthetic_images(n, (3, 224, 224), flowers.NUM_CLASSES, 102)
+
+    valid = test
+
+
+# ----------------------------------------------------------- movielens
+class movielens:
+    """≙ reference dataset/movielens.py: (user_id, gender, age, job,
+    movie_id, category vec, title vec) -> rating."""
+
+    MAX_USER = 6040
+    MAX_MOVIE = 3952
+    NUM_JOBS = 21
+    NUM_AGES = 7
+    NUM_CATEGORIES = 18
+    TITLE_LEN = 10
+    TITLE_VOCAB = 5000
+
+    @staticmethod
+    def _reader(n, seed):
+        def reader():
+            r = np.random.RandomState(seed)
+            for _ in range(n):
+                user = r.randint(1, movielens.MAX_USER + 1)
+                gender = r.randint(0, 2)
+                age = r.randint(0, movielens.NUM_AGES)
+                job = r.randint(0, movielens.NUM_JOBS)
+                movie = r.randint(1, movielens.MAX_MOVIE + 1)
+                cats = r.randint(0, movielens.NUM_CATEGORIES,
+                                 (r.randint(1, 4),))
+                title = r.randint(0, movielens.TITLE_VOCAB,
+                                  (movielens.TITLE_LEN,))
+                # learnable structure: rating depends on ids
+                rating = float((user * 7 + movie * 3) % 5 + 1)
+                yield (user, gender, age, job, movie, cats, title, rating)
+        return reader
+
+    @staticmethod
+    def train(n=2048):
+        return movielens._reader(n, 201)
+
+    @staticmethod
+    def test(n=512):
+        return movielens._reader(n, 202)
+
+    @staticmethod
+    def max_user_id():
+        return movielens.MAX_USER
+
+    @staticmethod
+    def max_movie_id():
+        return movielens.MAX_MOVIE
+
+    @staticmethod
+    def max_job_id():
+        return movielens.NUM_JOBS - 1
+
+    @staticmethod
+    def age_table():
+        return [1, 18, 25, 35, 45, 50, 56]
+
+
+# -------------------------------------------------------------- conll05
+class conll05:
+    """≙ reference dataset/conll05.py (semantic role labeling): word seq,
+    predicate, context windows, mark seq -> IOB label seq."""
+
+    WORD_VOCAB = 4000
+    LABEL_DICT_LEN = 59   # reference label dict size
+    PRED_VOCAB = 3000
+
+    @staticmethod
+    def get_dict():
+        word_dict = {f"w{i}": i for i in range(conll05.WORD_VOCAB)}
+        verb_dict = {f"v{i}": i for i in range(conll05.PRED_VOCAB)}
+        label_dict = {f"l{i}": i for i in range(conll05.LABEL_DICT_LEN)}
+        return word_dict, verb_dict, label_dict
+
+    @staticmethod
+    def _reader(n, seed, max_len=30):
+        def reader():
+            r = np.random.RandomState(seed)
+            for _ in range(n):
+                t = r.randint(5, max_len + 1)
+                words = r.randint(0, conll05.WORD_VOCAB, (t,))
+                pred = r.randint(0, conll05.PRED_VOCAB)
+                mark = (r.rand(t) < 0.1).astype(np.int64)
+                # labels correlated with words (learnable)
+                labels = (words * 31 + pred) % conll05.LABEL_DICT_LEN
+                yield (words, pred, mark, labels)
+        return reader
+
+    @staticmethod
+    def train(n=1024):
+        return conll05._reader(n, 301)
+
+    @staticmethod
+    def test(n=256):
+        return conll05._reader(n, 302)
+
+
+# ------------------------------------------------------------ sentiment
+class sentiment:
+    """≙ reference dataset/sentiment.py (NLTK movie reviews): token id
+    sequence -> 0/1 polarity."""
+
+    VOCAB = 5000
+
+    @staticmethod
+    def get_word_dict():
+        return {f"tok{i}": i for i in range(sentiment.VOCAB)}
+
+    @staticmethod
+    def _reader(n, seed):
+        def reader():
+            r = np.random.RandomState(seed)
+            pos = r.permutation(sentiment.VOCAB)[:sentiment.VOCAB // 2]
+            pos_set = set(int(x) for x in pos)
+            for _ in range(n):
+                t = r.randint(8, 60)
+                toks = r.randint(0, sentiment.VOCAB, (t,))
+                score = sum(1 if int(x) in pos_set else -1 for x in toks)
+                yield toks, int(score > 0)
+        return reader
+
+    @staticmethod
+    def train(n=1024):
+        return sentiment._reader(n, 401)
+
+    @staticmethod
+    def test(n=256):
+        return sentiment._reader(n, 402)
+
+
+# -------------------------------------------------------------- voc2012
+class voc2012:
+    """≙ reference dataset/voc2012.py (segmentation): image [3,H,W] +
+    dense label map [H,W] with 21 classes."""
+
+    NUM_CLASSES = 21
+
+    @staticmethod
+    def _reader(n, seed, size=128):
+        def reader():
+            r = np.random.RandomState(seed)
+            for _ in range(n):
+                img = r.rand(3, size, size).astype(np.float32)
+                # blocky label map correlated with intensity (learnable)
+                lbl = (img.mean(0) * voc2012.NUM_CLASSES).astype(np.int64)
+                lbl = np.clip(lbl, 0, voc2012.NUM_CLASSES - 1)
+                yield img, lbl
+        return reader
+
+    @staticmethod
+    def train(n=256):
+        return voc2012._reader(n, 501)
+
+    @staticmethod
+    def test(n=64):
+        return voc2012._reader(n, 502)
+
+    val = test
+
+
+# ------------------------------------------------------------ wmt14/16
+class wmt14:
+    """≙ reference dataset/wmt14.py: (src ids, tgt ids, tgt_next ids)."""
+
+    DICT_SIZE = 30000
+
+    @staticmethod
+    def train(dict_size=DICT_SIZE, n=2048, max_len=30):
+        return wmt_synthetic.train(n=n, max_len=max_len)
+
+    @staticmethod
+    def test(dict_size=DICT_SIZE, n=512, max_len=30):
+        # distinct stream from train (seed 42 vs 41): evaluating on
+        # training samples would silently inflate metrics
+        return wmt_synthetic.train(n=n, max_len=max_len, seed=42)
+
+
+class wmt16(wmt14):
+    """≙ reference dataset/wmt16.py — same reader contract."""
+
+
+# --------------------------------------------------------------- mq2007
+class mq2007:
+    """≙ reference dataset/mq2007.py (LETOR learning-to-rank): per query a
+    list of (feature[46], relevance) pairs; pairwise/listwise modes."""
+
+    FEATURE_DIM = 46
+
+    @staticmethod
+    def _reader(n_queries, seed, format="pairwise"):
+        def reader():
+            r = np.random.RandomState(seed)
+            w = r.randn(mq2007.FEATURE_DIM).astype(np.float32)
+            for _ in range(n_queries):
+                docs = r.randint(5, 20)
+                feats = r.rand(docs, mq2007.FEATURE_DIM).astype(np.float32)
+                rel = ((feats @ w) > 0).astype(np.int64) + \
+                    ((feats @ w) > 1).astype(np.int64)
+                if format == "listwise":
+                    yield feats, rel
+                else:  # pairwise: yield (query-level) doc pairs d1 > d2
+                    for i in range(docs):
+                        for j in range(docs):
+                            if rel[i] > rel[j]:
+                                yield rel[i] - rel[j], feats[i], feats[j]
+        return reader
+
+    @staticmethod
+    def train(format="pairwise", n_queries=128):
+        return mq2007._reader(n_queries, 601, format)
+
+    @staticmethod
+    def test(format="pairwise", n_queries=32):
+        return mq2007._reader(n_queries, 602, format)

@@ -30,3 +30,192 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
         block.create_var(name=name + "@SEQLEN", shape=[-1], dtype="int32",
                          is_data=True, stop_gradient=True)
     return var
+
+
+# ---------------------------------------------------------------------------
+# Reader pipeline (≙ reference layers/io.py:345-968: open_recordio_file,
+# py_reader, open_files, shuffle/batch/double_buffer decorators,
+# Preprocessor). TPU translation: readers are python iterators over feed
+# dicts; py_reader is a bounded blocking queue decoupling a producer thread
+# from the train loop (≙ LoDTensorBlockingQueue, reader/
+# lod_tensor_blocking_queue.h:31); double-buffering stages batches onto the
+# device ahead of compute (≙ buffered_reader.h:27).
+# ---------------------------------------------------------------------------
+
+class PyReader:
+    """Queue-fed async input (≙ layers/io.py py_reader:474).
+
+    feed_list names the data vars each record provides. A producer thread
+    calls decorate_* then start(); the train loop iterates feed dicts.
+    """
+
+    def __init__(self, feed_list, capacity=64, name=None,
+                 use_double_buffer=False):
+        import queue as _q
+        self.feed_names = [getattr(v, "name", v) for v in feed_list]
+        self._capacity = capacity
+        self._queue = _q.Queue(maxsize=capacity)
+        self._END = object()
+        self._thread = None
+        self._gen = None
+        self._err = []
+        self.use_double_buffer = use_double_buffer
+
+    def decorate_sample_list_generator(self, generator):
+        """generator() yields lists/tuples aligned with feed_list."""
+        self._gen = generator
+        return self
+
+    decorate_paddle_reader = decorate_sample_list_generator  # API parity
+
+    def start(self):
+        import threading
+
+        q = self._queue  # bind: a later reset() must not receive our data
+
+        def produce():
+            try:
+                for sample in self._gen():
+                    if isinstance(sample, dict):
+                        q.put(sample)
+                    else:
+                        q.put(dict(zip(self.feed_names, sample)))
+            except BaseException as e:  # surfaced in the consumer
+                self._err.append(e)
+            finally:
+                q.put(self._END)
+        self._thread = threading.Thread(target=produce, daemon=True)
+        self._thread.start()
+        return self
+
+    def reset(self):
+        """Abandon the current epoch. The old producer (if still running)
+        keeps writing into its own orphaned queue and exits; the next
+        start() gets a fresh queue, so no stale samples leak across."""
+        import queue as _q
+        self._queue = _q.Queue(maxsize=self._capacity)
+        self._thread = None
+        self._err = []
+
+    def _raw_iter(self):
+        q = self._queue
+        while True:
+            item = q.get()
+            if item is self._END:
+                if self._err:
+                    raise self._err[0]
+                return
+            yield item
+
+    def __iter__(self):
+        if self.use_double_buffer:
+            from ..data.prefetch import DevicePrefetcher
+            yield from DevicePrefetcher(self._raw_iter)
+        else:
+            yield from self._raw_iter()
+
+
+def py_reader(capacity, shapes, dtypes, names, use_double_buffer=True):
+    """≙ reference layers/io.py py_reader:474 — declares the data vars and
+    returns a PyReader bound to them. `use_double_buffer` composes the
+    device prefetcher (see double_buffer)."""
+    feed_vars = []
+    for nm, shape, dtype in zip(names, shapes, dtypes):
+        feed_vars.append(data(nm, shape=list(shape), dtype=dtype,
+                              append_batch_size=False))
+    return PyReader(feed_vars, capacity=capacity,
+                    use_double_buffer=use_double_buffer)
+
+
+def open_recordio_file(filename, shapes, dtypes, names):
+    """≙ layers/io.py open_recordio_file:345 — a reader over the native
+    chunked record container (paddle_tpu/native/recordio.cc). Records are
+    flat float32/int payloads written by data.recordio.RecordIOWriter;
+    each record deserializes per `shapes`/`dtypes` into a feed dict."""
+    import numpy as np
+
+    from ..data.recordio import RecordIOScanner
+
+    def reader():
+        with RecordIOScanner(filename) as sc:
+            for rec in sc:
+                out = {}
+                off = 0
+                for nm, shape, dtype in zip(names, shapes, dtypes):
+                    arr = np.frombuffer(rec, dtype=dtype, offset=off,
+                                        count=int(np.prod(shape)))
+                    out[nm] = arr.reshape(shape).copy()
+                    off += arr.nbytes
+                yield out
+    return reader
+
+
+def open_files(filenames, shapes, dtypes, names, thread_num=1):
+    """≙ layers/io.py open_files:724 — multi-file recordio reader; files
+    are interleaved (thread_num kept for API parity; IO parallelism comes
+    from the native loader + prefetcher)."""
+    def reader():
+        for fn in filenames:
+            yield from open_recordio_file(fn, shapes, dtypes, names)()
+    return reader
+
+
+def shuffle(reader, buffer_size):
+    """≙ layers/io.py shuffle:843 (reader-level)."""
+    from ..data import decorator
+    return decorator.shuffle(reader, buffer_size)
+
+
+def batch(reader, batch_size, drop_last=True):
+    """≙ layers/io.py batch (reader-level): stacks per-key feed dicts."""
+    import numpy as np
+
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield {k: np.stack([b[k] for b in buf]) for k in buf[0]}
+                buf = []
+        if buf and not drop_last:
+            yield {k: np.stack([b[k] for b in buf]) for k in buf[0]}
+    return batched
+
+
+def double_buffer(reader, place=None):
+    """≙ layers/io.py double_buffer:921 — stage upcoming batches on device
+    while the current step computes (DevicePrefetcher). Keeps the reader
+    contract: returns a zero-arg callable, composable with batch/shuffle.
+    `place` accepted for API parity (XLA owns placement)."""
+    from ..data.prefetch import DevicePrefetcher
+
+    def buffered():
+        yield from DevicePrefetcher(reader)
+    return buffered
+
+
+class Preprocessor:
+    """≙ layers/io.py Preprocessor:968 — user-defined transform stage in
+    the reader pipeline.
+
+        p = Preprocessor(reader)
+        @p.def_transform
+        def _(sample): ...
+        new_reader = p()
+    """
+
+    def __init__(self, reader, name=None):
+        self._reader = reader
+        self._fn = None
+
+    def def_transform(self, fn):
+        self._fn = fn
+        return fn
+
+    def __call__(self):
+        def transformed():
+            for item in self._reader():
+                out = self._fn(item)
+                if out is not None:
+                    yield out
+        return transformed

@@ -1020,3 +1020,76 @@ def fused_attention(q, k, v, scale=None, causal=False, name=None):
                      outputs={"Out": [out]},
                      attrs={"scale": scale, "causal": causal})
     return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             name=None):
+    """≙ reference layers/nn.py row_conv (lookahead convolution).
+    input [B, T, D]; future_context_size = lookahead window - 1."""
+    helper = LayerHelper("row_conv", name=name, param_attr=param_attr,
+                         act=act)
+    d = input.shape[-1]
+    w = helper.create_parameter(param_attr,
+                                shape=[future_context_size + 1, d],
+                                dtype=dtype_name(input.dtype))
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=list(input.shape))
+    helper.append_op(type="row_conv",
+                     inputs={"X": [input], "Filter": [w]},
+                     outputs={"Out": [out]})
+    return helper.append_activation(out)
+
+
+def lstm_unit(x_t, cell_t_prev, forget_bias=0.0, name=None):
+    """≙ reference layers lstm_unit: x_t [B, 4H] pre-projected gates.
+    Returns (hidden, cell)."""
+    helper = LayerHelper("lstm_unit", name=name)
+    h = cell_t_prev.shape[-1]
+    dtype = dtype_name(x_t.dtype)
+    c = helper.create_tmp_variable(dtype=dtype, shape=list(cell_t_prev.shape))
+    hid = helper.create_tmp_variable(dtype=dtype,
+                                     shape=list(cell_t_prev.shape))
+    helper.append_op(type="lstm_unit",
+                     inputs={"X": [x_t], "C_prev": [cell_t_prev]},
+                     outputs={"C": [c], "H": [hid]},
+                     attrs={"forget_bias": float(forget_bias)})
+    return hid, c
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             name=None):
+    """≙ reference layers gru_unit: input [B, 3H] pre-projected; hidden
+    [B, H]. Returns (new_hidden, reset_hidden_prev, gate)."""
+    helper = LayerHelper("gru_unit", name=name, param_attr=param_attr)
+    h = size // 3
+    dtype = dtype_name(input.dtype)
+    w = helper.create_parameter(param_attr, shape=[h, 3 * h], dtype=dtype)
+    inputs = {"Input": [input], "HiddenPrev": [hidden], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[3 * h], dtype=dtype,
+                                    is_bias=True)
+        inputs["Bias"] = [b]
+    new_h = helper.create_tmp_variable(dtype=dtype, shape=list(hidden.shape))
+    gate = helper.create_tmp_variable(dtype=dtype,
+                                      shape=[hidden.shape[0], 2 * h])
+    reset = helper.create_tmp_variable(dtype=dtype,
+                                       shape=list(hidden.shape))
+    helper.append_op(type="gru_unit", inputs=inputs,
+                     outputs={"Hidden": [new_h], "Gate": [gate],
+                              "ResetHiddenPrev": [reset]})
+    return new_h, reset, gate
+
+
+def spp(input, pyramid_height=3, pool_type="max", name=None):
+    """≙ reference layers spp (spatial pyramid pooling) — [N,C,H,W] ->
+    [N, C * sum(4^l for l < pyramid_height)]."""
+    helper = LayerHelper("spp", name=name)
+    c = input.shape[1]
+    total_bins = sum(4 ** l for l in range(pyramid_height))
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=[input.shape[0], c * total_bins])
+    helper.append_op(type="spp", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pyramid_height": pyramid_height,
+                            "pooling_type": pool_type})
+    return out

@@ -438,3 +438,37 @@ def _grid_sampler(ctx, ins, attrs):
            sample(x0, y1) * ((1 - wx) * wy)[..., None] +
            sample(x1, y1) * (wx * wy)[..., None])
     return {"Output": [jnp.transpose(val, (0, 3, 1, 2))]}
+
+
+@register_op("spp")
+def _spp(ctx, ins, attrs):
+    """≙ spp_op.cc (spatial pyramid pooling): pool the [N,C,H,W] input at
+    pyramid levels 1x1, 2x2, ... 2^(L-1) grids and concat the flattened
+    bins -> [N, C * sum(4^l)]."""
+    x = ins["X"][0]
+    levels = attrs.get("pyramid_height", 3)
+    pool_type = attrs.get("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    def bounds(extent, bins):
+        # nearly-even sections, never empty: when extent < bins the bins
+        # overlap (each still >= 1 element) so the output bin count — and
+        # the layer's declared shape — stays C * sum(4^l)
+        out = []
+        for i in range(bins):
+            lo = min(extent - 1, extent * i // bins)
+            hi = max(lo + 1, -(-extent * (i + 1) // bins))
+            out.append((lo, min(hi, extent)))
+        return out
+
+    for lvl in range(levels):
+        bins = 2 ** lvl
+        hb = bounds(h, bins)
+        wb = bounds(w, bins)
+        for (h0, h1) in hb:
+            for (w0, w1) in wb:
+                sl = x[:, :, h0:h1, w0:w1]
+                red = (jnp.max if pool_type == "max" else jnp.mean)(
+                    sl, axis=(2, 3))
+                outs.append(red)
+    return {"Out": [jnp.concatenate(outs, axis=1)]}

@@ -320,3 +320,55 @@ def _sequence_conv(ctx, ins, attrs):
     out = jnp.matmul(ctx_mat, w, preferred_element_type=jnp.float32)
     out = out.astype(x.dtype) * m
     return {"Out": [out]}
+
+
+@register_op("row_conv")
+def _row_conv(ctx, ins, attrs):
+    """≙ row_conv_op.cc (lookahead row convolution from DeepSpeech2):
+    out[t] = sum_{i=0..k-1} w[i] * x[t+i], zero past the sequence end.
+    X [B, T, D], Filter [k, D]."""
+    x = ins["X"][0]
+    w = ins["Filter"][0]
+    k = w.shape[0]
+    T = x.shape[1]
+    out = jnp.zeros_like(x)
+    for i in range(k):  # k is small (lookahead window); unrolled is fine
+        shifted = jnp.pad(x, ((0, 0), (0, i), (0, 0)))[:, i:i + T, :]
+        out = out + shifted * w[i][None, None, :]
+    return {"Out": [out]}
+
+
+@register_op("lstm_unit")
+def _lstm_unit(ctx, ins, attrs):
+    """≙ lstm_unit_op.cc: one LSTM cell step from pre-projected gates.
+    X [B, 4H] (i,f,c,o gate pre-activations), C_prev [B, H]."""
+    x = ins["X"][0]
+    c_prev = ins["C_prev"][0]
+    h = c_prev.shape[-1]
+    forget_bias = attrs.get("forget_bias", 0.0)
+    i, f, c, o = (x[:, :h], x[:, h:2 * h], x[:, 2 * h:3 * h], x[:, 3 * h:])
+    new_c = c_prev * jax.nn.sigmoid(f + forget_bias) + \
+        jax.nn.sigmoid(i) * jnp.tanh(c)
+    new_h = jnp.tanh(new_c) * jax.nn.sigmoid(o)
+    return {"C": [new_c], "H": [new_h]}
+
+
+@register_op("gru_unit")
+def _gru_unit(ctx, ins, attrs):
+    """≙ gru_unit_op.cc: one GRU cell step. Input [B, 3H] (pre-projected
+    x contributions for update/reset/candidate), HiddenPrev [B, H],
+    Weight [H, 3H] (recurrent), Bias [3H] optional."""
+    x = ins["Input"][0]
+    h_prev = ins["HiddenPrev"][0]
+    w = ins["Weight"][0]
+    h = h_prev.shape[-1]
+    bias = ins["Bias"][0] if ins.get("Bias") else jnp.zeros((3 * h,), x.dtype)
+    xu, xr, xc = x[:, :h], x[:, h:2 * h], x[:, 2 * h:]
+    hu = h_prev @ w[:, :h]
+    hr = h_prev @ w[:, h:2 * h]
+    u = jax.nn.sigmoid(xu + hu + bias[:h])
+    r = jax.nn.sigmoid(xr + hr + bias[h:2 * h])
+    c = jnp.tanh(xc + (r * h_prev) @ w[:, 2 * h:] + bias[2 * h:])
+    new_h = u * h_prev + (1 - u) * c
+    return {"Hidden": [new_h], "Gate": [jnp.concatenate([u, r], axis=-1)],
+            "ResetHiddenPrev": [r * h_prev]}

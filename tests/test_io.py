@@ -110,3 +110,175 @@ def test_save_as_bf16(tmp_path, rng):
     # loaded back as float32 per var dtype
     w = np.asarray(pt.global_scope().get(saved[0]))
     assert w.dtype == np.float32
+
+
+# ---------------------------------------------------------------------------
+# reader pipeline layers (py_reader / recordio readers / decorators)
+# ---------------------------------------------------------------------------
+
+def test_py_reader_feeds_training(rng):
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.layers.io import py_reader
+
+    reader = py_reader(capacity=8, shapes=[(4, 8), (4, 1)],
+                       dtypes=["float32", "int64"],
+                       names=["px", "py"])
+    h = layers.fc(pt.default_main_program().global_block().vars["px"],
+                  size=4)
+    loss = layers.mean(h)
+    pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+
+    def gen():
+        for _ in range(5):
+            yield [rng.rand(4, 8).astype("float32"),
+                   rng.randint(0, 2, (4, 1)).astype("int64")]
+
+    reader.decorate_sample_list_generator(gen)
+    reader.start()
+    n = 0
+    for feed in reader:
+        out = exe.run(feed={"px": feed["px"]}, fetch_list=[loss])
+        n += 1
+    assert n == 5
+
+
+def test_open_recordio_file_roundtrip(rng, tmp_path):
+    import numpy as np
+    from paddle_tpu.data.recordio import RecordIOWriter
+    from paddle_tpu.layers.io import batch, open_recordio_file, shuffle
+
+    path = str(tmp_path / "data.recordio")
+    xs = [rng.rand(3, 4).astype("float32") for _ in range(10)]
+    ys = [rng.randint(0, 5, (1,)).astype("int64") for _ in range(10)]
+    with RecordIOWriter(path) as w:
+        for x, y in zip(xs, ys):
+            w.write(x.tobytes() + y.tobytes())
+
+    reader = open_recordio_file(path, shapes=[(3, 4), (1,)],
+                                dtypes=["float32", "int64"],
+                                names=["x", "y"])
+    got = list(reader())
+    assert len(got) == 10
+    np.testing.assert_allclose(got[0]["x"], xs[0])
+    np.testing.assert_array_equal(got[0]["y"], ys[0])
+
+    # decorators compose: shuffle then batch
+    batched = batch(shuffle(reader, buffer_size=10), batch_size=5)
+    bs = list(batched())
+    assert len(bs) == 2 and bs[0]["x"].shape == (5, 3, 4)
+
+
+def test_preprocessor_transform(rng, tmp_path):
+    from paddle_tpu.layers.io import Preprocessor
+
+    def reader():
+        for i in range(4):
+            yield {"v": i}
+
+    p = Preprocessor(reader)
+
+    @p.def_transform
+    def _double(sample):
+        return {"v": sample["v"] * 2}
+
+    assert [s["v"] for s in p()()] == [0, 2, 4, 6]
+
+
+def test_new_datasets_readers():
+    from paddle_tpu.data import datasets as D
+    x, y = next(iter(D.flowers.train(n=2)()))
+    assert x.shape == (3, 224, 224) and 0 <= y < 102
+    rec = next(iter(D.movielens.train(n=2)()))
+    assert len(rec) == 8 and 1 <= rec[-1] <= 5
+    words, pred, mark, labels = next(iter(D.conll05.train(n=2)()))
+    assert len(words) == len(mark) == len(labels)
+    toks, pol = next(iter(D.sentiment.train(n=2)()))
+    assert pol in (0, 1)
+    img, lbl = next(iter(D.voc2012.train(n=2)()))
+    assert img.shape[1:] == lbl.shape
+    src, tgt, nxt = next(iter(D.wmt14.train(n=2)()))
+    assert len(tgt) == len(nxt)
+    d, f1, f2 = next(iter(D.mq2007.train(n_queries=2)()))
+    assert f1.shape == (46,) and d >= 1
+    feats, rel = next(iter(D.mq2007.train(format="listwise",
+                                          n_queries=2)()))
+    assert feats.shape[1] == 46 and len(rel) == feats.shape[0]
+
+
+def test_py_reader_reset_isolates_epochs(rng):
+    """Regression: a producer still blocked mid-epoch must not leak stale
+    samples (or its END sentinel) into the queue after reset()+start()."""
+    import time
+    from paddle_tpu.layers.io import PyReader
+
+    r = PyReader(["a"], capacity=2)
+
+    def gen_big():
+        for i in range(100):
+            yield {"a": ("old", i)}
+
+    r.decorate_sample_list_generator(gen_big)
+    r.start()
+    it = iter(r)
+    next(it)              # producer now blocked on the full queue
+    r.reset()
+
+    def gen_new():
+        for i in range(3):
+            yield {"a": ("new", i)}
+
+    r.decorate_sample_list_generator(gen_new)
+    r.start()
+    got = [s["a"] for s in r]
+    assert got == [("new", 0), ("new", 1), ("new", 2)]
+
+
+def test_py_reader_producer_error_surfaces(rng):
+    from paddle_tpu.layers.io import PyReader
+
+    r = PyReader(["a"], capacity=4)
+
+    def bad_gen():
+        yield {"a": 1}
+        raise RuntimeError("corrupt record")
+
+    r.decorate_sample_list_generator(bad_gen)
+    r.start()
+    with pytest.raises(RuntimeError, match="corrupt record"):
+        list(r)
+
+
+def test_double_buffer_keeps_reader_contract(rng):
+    from paddle_tpu.layers.io import batch, double_buffer
+
+    def reader():
+        for i in range(6):
+            yield {"x": np.full((2,), i, dtype="float32")}
+
+    buffered = double_buffer(reader)
+    assert callable(buffered)
+    vals = [f["x"] for f in buffered()]
+    assert len(vals) == 6
+    # composes with batch()
+    b = list(batch(double_buffer(reader), batch_size=3)())
+    assert len(b) == 2 and b[0]["x"].shape == (3, 2)
+
+
+def test_spp_tiny_spatial_input(rng):
+    """Regression: feature maps smaller than the finest pyramid grid must
+    pool with overlapping (never empty) bins."""
+    from op_test import run_op
+    x = rng.rand(1, 2, 2, 2).astype("float32")
+    out = run_op("spp", {"X": x}, attrs={"pyramid_height": 3})["Out"][0]
+    assert out.shape == (1, 2 * (1 + 4 + 16))
+    assert np.isfinite(out).all()
+
+
+def test_wmt14_test_split_differs_from_train():
+    from paddle_tpu.data import datasets as D
+    tr = next(iter(D.wmt14.train(n=1)()))
+    te = next(iter(D.wmt14.test(n=1)()))
+    assert not np.array_equal(tr[0], te[0])

@@ -304,3 +304,58 @@ class TestReviewRegressions:
                      {"padding_idx": -1})  # means row 9
         np.testing.assert_allclose(out["Out"][0][1], 0.0, atol=1e-7)
         np.testing.assert_allclose(out["Out"][0][0], w[1], rtol=1e-6)
+
+
+class TestUnitCellsAndMisc:
+    def test_row_conv_matches_numpy(self, rng):
+        x = rng.rand(2, 6, 3).astype("float32")
+        w = rng.rand(3, 3).astype("float32")  # lookahead 2
+        out = run_op("row_conv", {"X": x, "Filter": w})["Out"][0]
+        exp = np.zeros_like(x)
+        for t in range(6):
+            for i in range(3):
+                if t + i < 6:
+                    exp[:, t] += x[:, t + i] * w[i]
+        np.testing.assert_allclose(out, exp, rtol=1e-5)
+        check_grad("row_conv", {"X": x, "Filter": w},
+                   grad_slots=["X", "Filter"], atol=5e-3, rtol=5e-3)
+
+    def test_lstm_unit(self, rng):
+        B, H = 3, 4
+        x = rng.randn(B, 4 * H).astype("float32")
+        c = rng.randn(B, H).astype("float32")
+        out = run_op("lstm_unit", {"X": x, "C_prev": c},
+                     attrs={"forget_bias": 1.0})
+        i, f, cc, o = np.split(x, 4, axis=1)
+        sig = lambda v: 1 / (1 + np.exp(-v))  # noqa: E731
+        exp_c = c * sig(f + 1.0) + sig(i) * np.tanh(cc)
+        exp_h = np.tanh(exp_c) * sig(o)
+        np.testing.assert_allclose(out["C"][0], exp_c, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(out["H"][0], exp_h, rtol=1e-5, atol=1e-5)
+
+    def test_gru_unit_consistency(self, rng):
+        B, H = 2, 3
+        x = rng.randn(B, 3 * H).astype("float32")
+        h0 = rng.randn(B, H).astype("float32")
+        w = rng.randn(H, 3 * H).astype("float32") * 0.5
+        out = run_op("gru_unit", {"Input": x, "HiddenPrev": h0,
+                                  "Weight": w})
+        sig = lambda v: 1 / (1 + np.exp(-v))  # noqa: E731
+        u = sig(x[:, :H] + h0 @ w[:, :H])
+        r = sig(x[:, H:2*H] + h0 @ w[:, H:2*H])
+        c = np.tanh(x[:, 2*H:] + (r * h0) @ w[:, 2*H:])
+        exp = u * h0 + (1 - u) * c
+        np.testing.assert_allclose(out["Hidden"][0], exp, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_spp_pyramid(self, rng):
+        x = rng.rand(2, 3, 8, 8).astype("float32")
+        out = run_op("spp", {"X": x},
+                     attrs={"pyramid_height": 2,
+                            "pooling_type": "max"})["Out"][0]
+        assert out.shape == (2, 3 * (1 + 4))
+        np.testing.assert_allclose(out[:, :3], x.max(axis=(2, 3)), rtol=1e-6)
+        # level-1 first bin = top-left quadrant max
+        np.testing.assert_allclose(out[:, 3:6],
+                                   x[:, :, :4, :4].max(axis=(2, 3)),
+                                   rtol=1e-6)

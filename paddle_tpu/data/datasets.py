@@ -327,8 +327,10 @@ class movielens:
 
 # -------------------------------------------------------------- conll05
 class conll05:
-    """≙ reference dataset/conll05.py (semantic role labeling): word seq,
-    predicate, context windows, mark seq -> IOB label seq."""
+    """≙ reference dataset/conll05.py (semantic role labeling). Yields the
+    reference's 9 slots: (word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2,
+    predicate, mark, label) where ctx_* are the +-2 context windows around
+    the predicate position broadcast over the sequence."""
 
     WORD_VOCAB = 4000
     LABEL_DICT_LEN = 59   # reference label dict size
@@ -346,13 +348,20 @@ class conll05:
         def reader():
             r = np.random.RandomState(seed)
             for _ in range(n):
-                t = r.randint(5, max_len + 1)
+                t = int(r.randint(5, max_len + 1))
                 words = r.randint(0, conll05.WORD_VOCAB, (t,))
+                pred_pos = int(r.randint(0, t))
                 pred = r.randint(0, conll05.PRED_VOCAB)
-                mark = (r.rand(t) < 0.1).astype(np.int64)
-                # labels correlated with words (learnable)
+                # +-2 context window around the predicate, broadcast over
+                # the sequence (the reference's ctx_n2..ctx_p2 slots)
+                def ctx(offset):
+                    j = min(max(pred_pos + offset, 0), t - 1)
+                    return np.full((t,), words[j], dtype=np.int64)
+                mark = np.zeros((t,), dtype=np.int64)
+                mark[pred_pos] = 1
                 labels = (words * 31 + pred) % conll05.LABEL_DICT_LEN
-                yield (words, pred, mark, labels)
+                yield (words, ctx(-2), ctx(-1), ctx(0), ctx(1), ctx(2),
+                       pred, mark, labels)
         return reader
 
     @staticmethod

@@ -69,30 +69,47 @@ class PyReader:
     decorate_paddle_reader = decorate_sample_list_generator  # API parity
 
     def start(self):
+        import queue as _q
         import threading
 
-        q = self._queue  # bind: a later reset() must not receive our data
+        # bind everything per-epoch: a later reset() must neither receive
+        # this producer's data nor its errors, and must be able to stop it
+        q = self._queue
+        err = self._err
+        stop = threading.Event()
+        self._stop = stop
 
         def produce():
             try:
                 for sample in self._gen():
-                    if isinstance(sample, dict):
-                        q.put(sample)
-                    else:
-                        q.put(dict(zip(self.feed_names, sample)))
+                    if not isinstance(sample, dict):
+                        sample = dict(zip(self.feed_names, sample))
+                    while not stop.is_set():
+                        try:
+                            q.put(sample, timeout=0.1)
+                            break
+                        except _q.Full:
+                            continue
+                    if stop.is_set():
+                        return
             except BaseException as e:  # surfaced in the consumer
-                self._err.append(e)
+                err.append(e)
             finally:
-                q.put(self._END)
+                try:
+                    q.put_nowait(self._END)
+                except _q.Full:
+                    pass  # stopped epoch; nobody is reading this queue
         self._thread = threading.Thread(target=produce, daemon=True)
         self._thread.start()
         return self
 
     def reset(self):
-        """Abandon the current epoch. The old producer (if still running)
-        keeps writing into its own orphaned queue and exits; the next
-        start() gets a fresh queue, so no stale samples leak across."""
+        """Abandon the current epoch: signal the producer to exit (it stops
+        at its next put attempt) and swap in a fresh queue/error list so no
+        stale samples or errors leak into the next start()."""
         import queue as _q
+        if getattr(self, "_stop", None) is not None:
+            self._stop.set()
         self._queue = _q.Queue(maxsize=self._capacity)
         self._thread = None
         self._err = []
@@ -146,6 +163,11 @@ def open_recordio_file(filename, shapes, dtypes, names):
                                         count=int(np.prod(shape)))
                     out[nm] = arr.reshape(shape).copy()
                     off += arr.nbytes
+                if off != len(rec):
+                    raise ValueError(
+                        f"record in {filename!r} has {len(rec)} bytes but "
+                        f"shapes/dtypes consume {off} — shape or dtype "
+                        f"misconfiguration (no silent data loss)")
                 yield out
     return reader
 

@@ -340,24 +340,32 @@ def _row_conv(ctx, ins, attrs):
 
 @register_op("lstm_unit")
 def _lstm_unit(ctx, ins, attrs):
-    """≙ lstm_unit_op.cc: one LSTM cell step from pre-projected gates.
-    X [B, 4H] (i,f,c,o gate pre-activations), C_prev [B, H]."""
+    """≙ lstm_unit_op.h:63-66: one LSTM cell step from pre-projected gates.
+    X [B, 4H] sliced (i, f, o, g) in the REFERENCE order:
+    i = sig(X[:, :H]), f = sig(X[:, H:2H] + forget_bias),
+    o = sig(X[:, 2H:3H]), g = tanh(X[:, 3H:])."""
     x = ins["X"][0]
     c_prev = ins["C_prev"][0]
     h = c_prev.shape[-1]
     forget_bias = attrs.get("forget_bias", 0.0)
-    i, f, c, o = (x[:, :h], x[:, h:2 * h], x[:, 2 * h:3 * h], x[:, 3 * h:])
-    new_c = c_prev * jax.nn.sigmoid(f + forget_bias) + \
-        jax.nn.sigmoid(i) * jnp.tanh(c)
-    new_h = jnp.tanh(new_c) * jax.nn.sigmoid(o)
+    i = jax.nn.sigmoid(x[:, :h])
+    f = jax.nn.sigmoid(x[:, h:2 * h] + forget_bias)
+    o = jax.nn.sigmoid(x[:, 2 * h:3 * h])
+    g = jnp.tanh(x[:, 3 * h:])
+    new_c = c_prev * f + i * g
+    new_h = jnp.tanh(new_c) * o
     return {"C": [new_c], "H": [new_h]}
 
 
 @register_op("gru_unit")
 def _gru_unit(ctx, ins, attrs):
-    """≙ gru_unit_op.cc: one GRU cell step. Input [B, 3H] (pre-projected
+    """≙ gru_unit_op.h: one GRU cell step. Input [B, 3H] (pre-projected
     x contributions for update/reset/candidate), HiddenPrev [B, H],
-    Weight [H, 3H] (recurrent), Bias [3H] optional."""
+    Weight [H, 3H] (recurrent), Bias [3H] optional.
+
+    Reference semantics (gru_unit_op.h:116): h = u*(c - h_prev) + h_prev,
+    i.e. the update gate moves TOWARD the candidate. Gate output is the
+    reference's [B, 3H] = (u, r, c)."""
     x = ins["Input"][0]
     h_prev = ins["HiddenPrev"][0]
     w = ins["Weight"][0]
@@ -369,6 +377,7 @@ def _gru_unit(ctx, ins, attrs):
     u = jax.nn.sigmoid(xu + hu + bias[:h])
     r = jax.nn.sigmoid(xr + hr + bias[h:2 * h])
     c = jnp.tanh(xc + (r * h_prev) @ w[:, 2 * h:] + bias[2 * h:])
-    new_h = u * h_prev + (1 - u) * c
-    return {"Hidden": [new_h], "Gate": [jnp.concatenate([u, r], axis=-1)],
+    new_h = u * c + (1 - u) * h_prev
+    return {"Hidden": [new_h],
+            "Gate": [jnp.concatenate([u, r, c], axis=-1)],
             "ResetHiddenPrev": [r * h_prev]}

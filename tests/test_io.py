@@ -193,8 +193,11 @@ def test_new_datasets_readers():
     assert x.shape == (3, 224, 224) and 0 <= y < 102
     rec = next(iter(D.movielens.train(n=2)()))
     assert len(rec) == 8 and 1 <= rec[-1] <= 5
-    words, pred, mark, labels = next(iter(D.conll05.train(n=2)()))
+    rec9 = next(iter(D.conll05.train(n=2)()))
+    assert len(rec9) == 9   # word, 5 ctx slots, pred, mark, label
+    words, mark, labels = rec9[0], rec9[7], rec9[8]
     assert len(words) == len(mark) == len(labels)
+    assert all(len(c) == len(words) for c in rec9[1:6])
     toks, pol = next(iter(D.sentiment.train(n=2)()))
     assert pol in (0, 1)
     img, lbl = next(iter(D.voc2012.train(n=2)()))
@@ -282,3 +285,34 @@ def test_wmt14_test_split_differs_from_train():
     tr = next(iter(D.wmt14.train(n=1)()))
     te = next(iter(D.wmt14.test(n=1)()))
     assert not np.array_equal(tr[0], te[0])
+
+
+def test_py_reader_reset_stops_producer_thread():
+    """Regression: reset() must signal the blocked producer to exit, not
+    leak a thread per epoch."""
+    import time
+    from paddle_tpu.layers.io import PyReader
+
+    r = PyReader(["a"], capacity=1)
+    r.decorate_sample_list_generator(lambda: ({"a": i} for i in range(50)))
+    r.start()
+    t = r._thread
+    next(iter(r))          # producer now blocked on the full queue
+    r.reset()
+    t.join(timeout=5)
+    assert not t.is_alive()
+
+
+def test_open_recordio_rejects_mismatched_shapes(tmp_path):
+    import numpy as np
+    import pytest as _pytest
+    from paddle_tpu.data.recordio import RecordIOWriter
+    from paddle_tpu.layers.io import open_recordio_file
+
+    path = str(tmp_path / "d.recordio")
+    with RecordIOWriter(path) as w:
+        w.write(np.zeros(12, "float32").tobytes())
+    bad = open_recordio_file(path, shapes=[(4,)], dtypes=["float32"],
+                             names=["x"])
+    with _pytest.raises(ValueError, match="misconfiguration"):
+        list(bad())

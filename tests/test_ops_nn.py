@@ -326,9 +326,10 @@ class TestUnitCellsAndMisc:
         c = rng.randn(B, H).astype("float32")
         out = run_op("lstm_unit", {"X": x, "C_prev": c},
                      attrs={"forget_bias": 1.0})
-        i, f, cc, o = np.split(x, 4, axis=1)
+        # REFERENCE slot order (lstm_unit_op.h:63-66): i, f, o, g
+        i, f, o, g = np.split(x, 4, axis=1)
         sig = lambda v: 1 / (1 + np.exp(-v))  # noqa: E731
-        exp_c = c * sig(f + 1.0) + sig(i) * np.tanh(cc)
+        exp_c = c * sig(f + 1.0) + sig(i) * np.tanh(g)
         exp_h = np.tanh(exp_c) * sig(o)
         np.testing.assert_allclose(out["C"][0], exp_c, rtol=1e-5, atol=1e-5)
         np.testing.assert_allclose(out["H"][0], exp_h, rtol=1e-5, atol=1e-5)
@@ -344,9 +345,11 @@ class TestUnitCellsAndMisc:
         u = sig(x[:, :H] + h0 @ w[:, :H])
         r = sig(x[:, H:2*H] + h0 @ w[:, H:2*H])
         c = np.tanh(x[:, 2*H:] + (r * h0) @ w[:, 2*H:])
-        exp = u * h0 + (1 - u) * c
+        # REFERENCE update semantics (gru_unit_op.h:116): toward candidate
+        exp = u * c + (1 - u) * h0
         np.testing.assert_allclose(out["Hidden"][0], exp, rtol=1e-4,
                                    atol=1e-4)
+        assert out["Gate"][0].shape == (B, 3 * H)
 
     def test_spp_pyramid(self, rng):
         x = rng.rand(2, 3, 8, 8).astype("float32")

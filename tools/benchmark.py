@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""Benchmark CLI over the model zoo.
+
+≙ reference benchmark/fluid/fluid_benchmark.py (models mnist / resnet / vgg /
+stacked_dynamic_lstm / machine_translation with --update_method
+{local,pserver,nccl2}, printing images/sec). TPU translation: the pserver and
+nccl2 modes collapse into `--update_method collective` (ParallelExecutor over
+the device mesh — compiled XLA collectives); `local` is the single-device
+Executor. Synthetic data keeps the harness runnable anywhere
+(≙ --use_fake_data).
+
+Examples:
+    python tools/benchmark.py --model resnet --batch_size 64 --iters 20
+    python tools/benchmark.py --model transformer --update_method collective
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _mnist(args, rng):
+    from paddle_tpu import layers
+    from paddle_tpu.models import mnist
+    loss, acc = mnist.mlp()[:2]
+    feed = {"img": rng.rand(args.batch_size, 784).astype("float32"),
+            "label": rng.randint(0, 10,
+                                 (args.batch_size, 1)).astype("int64")}
+    return loss, feed, args.batch_size
+
+
+def _resnet(args, rng):
+    from paddle_tpu.models import resnet
+    loss, acc, _ = resnet.resnet_imagenet(
+        depth=args.depth, data_format="NHWC", use_bf16=not args.no_bf16,
+        class_num=1000)
+    feed = {"img": rng.rand(args.batch_size, 224, 224, 3).astype("float32"),
+            "label": rng.randint(0, 1000,
+                                 (args.batch_size, 1)).astype("int64")}
+    return loss, feed, args.batch_size
+
+
+def _vgg(args, rng):
+    from paddle_tpu.models import vgg
+    loss, acc, _ = vgg.vgg(depth=16, class_num=1000,
+                           image_shape=[224, 224, 3],
+                           data_format="NHWC", use_bf16=not args.no_bf16)
+    feed = {"img": rng.rand(args.batch_size, 224, 224, 3).astype("float32"),
+            "label": rng.randint(0, 1000,
+                                 (args.batch_size, 1)).astype("int64")}
+    return loss, feed, args.batch_size
+
+
+def _se_resnext(args, rng):
+    from paddle_tpu import layers
+    from paddle_tpu.models import se_resnext
+    loss, acc, _ = se_resnext.se_resnext_imagenet(
+        depth=50, use_bf16=not args.no_bf16)
+    feed = {"img": rng.rand(args.batch_size, 224, 224, 3).astype("float32"),
+            "label": rng.randint(0, 1000,
+                                 (args.batch_size, 1)).astype("int64")}
+    return loss, feed, args.batch_size
+
+
+def _googlenet(args, rng):
+    from paddle_tpu.models import googlenet
+    loss, acc, _ = googlenet.googlenet_imagenet(use_bf16=not args.no_bf16)
+    feed = {"img": rng.rand(args.batch_size, 224, 224, 3).astype("float32"),
+            "label": rng.randint(0, 1000,
+                                 (args.batch_size, 1)).astype("int64")}
+    return loss, feed, args.batch_size
+
+
+def _stacked_lstm(args, rng):
+    from paddle_tpu.models import stacked_lstm
+    seq = args.seq_len
+    loss, acc, _ = stacked_lstm.stacked_lstm_net(
+        dict_dim=10000, emb_dim=256, hid_dim=256, max_len=seq)
+    feed = {"words": rng.randint(0, 10000,
+                                 (args.batch_size, seq)).astype("int64"),
+            "words@SEQLEN": [seq] * args.batch_size,
+            "label": rng.randint(0, 2,
+                                 (args.batch_size, 1)).astype("int64")}
+    import numpy as np
+    feed["words@SEQLEN"] = np.full((args.batch_size,), seq, dtype="int32")
+    return loss, feed, args.batch_size
+
+
+def _machine_translation(args, rng):
+    from paddle_tpu import layers
+    from paddle_tpu.models import machine_translation as mt
+    import numpy as np
+    Ts = Tt = args.seq_len
+    V = 10000
+    src = layers.data("src", shape=[Ts], dtype="int64")
+    src_lens = layers.data("src_lens", shape=[], dtype="int64")
+    tgt_in = layers.data("tgt_in", shape=[Tt], dtype="int64")
+    tgt_out = layers.data("tgt_out", shape=[Tt], dtype="int64")
+    tgt_mask = layers.data("tgt_mask", shape=[Tt], dtype="float32")
+    loss, _ = mt.train_net(src, src_lens, tgt_in, tgt_out, tgt_mask,
+                           dict_size=V, embed_dim=256, hidden_dim=512)
+    b = args.batch_size
+    feed = {"src": rng.randint(2, V, (b, Ts)).astype("int64"),
+            "src_lens": np.full((b,), Ts, "int64"),
+            "tgt_in": rng.randint(2, V, (b, Tt)).astype("int64"),
+            "tgt_out": rng.randint(2, V, (b, Tt)).astype("int64"),
+            "tgt_mask": np.ones((b, Tt), "float32")}
+    return loss, feed, b * Tt  # tokens/sec
+
+
+def _transformer(args, rng):
+    from paddle_tpu.models import transformer
+    import numpy as np
+    T = args.seq_len
+    loss, _ = transformer.transformer_lm(
+        vocab=32000, max_len=T, d_model=512, d_inner=2048, num_heads=8,
+        num_layers=6, dropout=0.0)
+    b = args.batch_size
+    feed = {"tokens": rng.randint(0, 32000, (b, T)).astype("int64"),
+            "tokens@SEQLEN": np.full((b,), T, "int32"),
+            "targets": rng.randint(0, 32000, (b, T)).astype("int64")}
+    return loss, feed, b * T  # tokens/sec
+
+
+def _deepfm(args, rng):
+    from paddle_tpu.models import deepfm
+    import numpy as np
+    b = args.batch_size
+    loss, _ = deepfm.deepfm(num_fields=39, vocab_size=100000)
+    feed = {"feat_ids": rng.randint(0, 100000, (b, 39)).astype("int64"),
+            "feat_vals": rng.rand(b, 39).astype("float32"),
+            "label": rng.randint(0, 2, (b, 1)).astype("float32")}
+    return loss, feed, b
+
+
+MODELS = {
+    "mnist": _mnist,
+    "resnet": _resnet,
+    "vgg": _vgg,
+    "se_resnext": _se_resnext,
+    "googlenet": _googlenet,
+    "stacked_lstm": _stacked_lstm,
+    "machine_translation": _machine_translation,
+    "transformer": _transformer,
+    "deepfm": _deepfm,
+}
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", choices=sorted(MODELS), default="resnet")
+    p.add_argument("--batch_size", type=int, default=32)
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--seq_len", type=int, default=64)
+    p.add_argument("--depth", type=int, default=50)
+    p.add_argument("--learning_rate", type=float, default=0.01)
+    p.add_argument("--update_method", choices=["local", "collective"],
+                   default="local",
+                   help="local = single device; collective = "
+                        "ParallelExecutor over the mesh (≙ nccl2/pserver)")
+    p.add_argument("--optimizer", default="momentum",
+                   choices=["sgd", "momentum", "adam"])
+    p.add_argument("--no_bf16", action="store_true")
+    p.add_argument("--profile", action="store_true")
+    args = p.parse_args()
+
+    import numpy as np
+    import jax
+    import paddle_tpu as pt
+
+    rng = np.random.RandomState(0)
+    loss, feed, units_per_step = MODELS[args.model](args, rng)
+
+    opt = {"sgd": lambda: pt.optimizer.SGDOptimizer(args.learning_rate),
+           "momentum": lambda: pt.optimizer.MomentumOptimizer(
+               args.learning_rate, momentum=0.9),
+           "adam": lambda: pt.optimizer.AdamOptimizer(args.learning_rate),
+           }[args.optimizer]()
+    opt.minimize(loss)
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    if args.update_method == "collective":
+        from paddle_tpu.parallel import ParallelExecutor
+        runner = ParallelExecutor(loss_name=loss.name)
+    else:
+        runner = exe
+
+    if args.profile:
+        pt.profiler.start_profiler("All")
+    for _ in range(args.warmup):
+        out = runner.run(feed=feed, fetch_list=[loss], return_numpy=False)
+    jax.block_until_ready(out)
+
+    t0 = time.time()
+    for _ in range(args.iters):
+        out = runner.run(feed=feed, fetch_list=[loss], return_numpy=False)
+    jax.block_until_ready(out)
+    dt = time.time() - t0
+    if args.profile:
+        pt.profiler.stop_profiler(sorted_key="total")
+
+    unit = ("tokens/sec" if args.model in
+            ("transformer", "machine_translation") else "examples/sec")
+    print(json.dumps({
+        "model": args.model,
+        "update_method": args.update_method,
+        "batch_size": args.batch_size,
+        "iters": args.iters,
+        "latency_ms": round(dt / args.iters * 1000, 3),
+        "throughput": round(units_per_step * args.iters / dt, 2),
+        "unit": unit,
+        "device": jax.devices()[0].platform,
+    }))
+
+
+if __name__ == "__main__":
+    main()

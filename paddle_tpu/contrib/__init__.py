@@ -1,0 +1,3 @@
+"""Contrib utilities (≙ reference python/paddle/fluid/contrib/)."""
+
+from .memory_usage_calc import memory_usage  # noqa: F401

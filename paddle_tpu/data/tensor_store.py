@@ -1,0 +1,151 @@
+"""ctypes binding for the native tensor container (tensor_store.cc).
+
+≙ reference save_combine_op.cc / load_combine_op.cc + LoDTensor
+SerializeToStream (framework/lod_tensor.cc): many named tensors in one
+CRC-checked file, streamed through C++. io.save_persistables/
+load_persistables use this as their storage backend when
+``format="native"`` (the default npz path stays for portability).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List
+
+import numpy as np
+
+try:  # registers the bfloat16/float16 numpy dtypes
+    import ml_dtypes  # noqa: F401
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+    pass
+
+from .recordio import _load  # shared library loader (builds on demand)
+
+_DTYPES = ["float32", "float64", "int32", "int64", "uint8", "bool",
+           "bfloat16", "float16", "int16", "uint32", "uint64"]
+_CODE = {name: i for i, name in enumerate(_DTYPES)}
+
+
+def _lib():
+    lib = _load()
+    lib.ptpu_store_writer_open.restype = ctypes.c_void_p
+    lib.ptpu_store_writer_open.argtypes = [ctypes.c_char_p]
+    lib.ptpu_store_writer_add.restype = ctypes.c_int
+    lib.ptpu_store_writer_add.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint8,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint8,
+        ctypes.c_char_p, ctypes.c_uint64]
+    lib.ptpu_store_writer_finish.restype = ctypes.c_int
+    lib.ptpu_store_writer_finish.argtypes = [ctypes.c_void_p]
+    lib.ptpu_store_reader_open.restype = ctypes.c_void_p
+    lib.ptpu_store_reader_open.argtypes = [ctypes.c_char_p]
+    lib.ptpu_store_reader_names.restype = ctypes.c_uint64
+    lib.ptpu_store_reader_names.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    lib.ptpu_store_reader_meta.restype = ctypes.c_uint64
+    lib.ptpu_store_reader_meta.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint64)]
+    lib.ptpu_store_reader_read.restype = ctypes.c_int
+    lib.ptpu_store_reader_read.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64]
+    lib.ptpu_store_reader_close.restype = None
+    lib.ptpu_store_reader_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def _np_dtype_name(arr) -> str:
+    name = arr.dtype.name
+    if name not in _CODE:
+        raise ValueError(f"tensor_store: unsupported dtype {name!r}")
+    return name
+
+
+def save_tensors(path: str, tensors: Dict[str, np.ndarray]):
+    """Write named arrays into one native container file. Atomic: data goes
+    to a temp file that replaces `path` only after a successful finalize, so
+    a mid-save failure can never leave a valid-looking partial checkpoint
+    over the previous good one."""
+    import os
+    lib = _lib()
+    tmp = path + ".tmp"
+    h = lib.ptpu_store_writer_open(tmp.encode())
+    if not h:
+        raise IOError(f"tensor_store: cannot open {tmp!r} for writing")
+    try:
+        for name, arr in tensors.items():
+            arr = np.ascontiguousarray(arr)
+            if arr.ndim > 16:
+                raise ValueError(
+                    f"tensor_store: {name!r} has {arr.ndim} dims; the "
+                    f"container supports at most 16")
+            # bfloat16 arrays pass through as raw bytes with their code
+            code = _CODE[_np_dtype_name(arr)]
+            dims = (ctypes.c_uint64 * max(arr.ndim, 1))(*arr.shape)
+            ok = lib.ptpu_store_writer_add(
+                h, name.encode(), code, dims, arr.ndim,
+                arr.tobytes(), arr.nbytes)
+            if not ok:
+                raise IOError(f"tensor_store: write failed for {name!r}")
+    except Exception:
+        lib.ptpu_store_writer_finish(h)   # release the handle...
+        try:
+            os.unlink(tmp)                # ...and discard the partial file
+        except OSError:
+            pass
+        raise
+    if not lib.ptpu_store_writer_finish(h):
+        raise IOError(f"tensor_store: finalize failed for {path!r}")
+    os.replace(tmp, path)
+
+
+def load_tensors(path: str, names: List[str] = None) -> Dict[str, np.ndarray]:
+    """Read (a subset of) named arrays back; every payload is
+    CRC-verified."""
+    lib = _lib()
+    h = lib.ptpu_store_reader_open(path.encode())
+    if not h:
+        raise IOError(f"tensor_store: cannot open {path!r} (missing, "
+                      f"truncated, or corrupt index)")
+    try:
+        n = lib.ptpu_store_reader_names(h, None, 0)
+        buf = ctypes.create_string_buffer(int(n))
+        lib.ptpu_store_reader_names(h, buf, n)
+        available = buf.raw[:int(n)].decode().split("\n") if n else []
+        wanted = available if names is None else list(names)
+        out: Dict[str, np.ndarray] = {}
+        for name in wanted:
+            dtype = ctypes.c_uint8()
+            ndim = ctypes.c_uint8()
+            dims = (ctypes.c_uint64 * 16)()
+            dlen = lib.ptpu_store_reader_meta(
+                h, name.encode(), ctypes.byref(dtype), ctypes.byref(ndim),
+                dims)
+            if dlen == ctypes.c_uint64(-1).value:
+                raise KeyError(f"tensor_store: {name!r} not in {path!r}")
+            raw = ctypes.create_string_buffer(int(dlen))
+            if not lib.ptpu_store_reader_read(h, name.encode(), raw, dlen):
+                raise IOError(
+                    f"tensor_store: CRC/read failure for {name!r} "
+                    f"in {path!r}")
+            shape = tuple(dims[i] for i in range(ndim.value))
+            arr = np.frombuffer(raw.raw[:int(dlen)],
+                                dtype=_DTYPES[dtype.value]).reshape(shape)
+            out[name] = arr.copy()
+        return out
+    finally:
+        lib.ptpu_store_reader_close(h)
+
+
+def list_tensors(path: str) -> List[str]:
+    lib = _lib()
+    h = lib.ptpu_store_reader_open(path.encode())
+    if not h:
+        raise IOError(f"tensor_store: cannot open {path!r}")
+    try:
+        n = lib.ptpu_store_reader_names(h, None, 0)
+        buf = ctypes.create_string_buffer(int(n))
+        lib.ptpu_store_reader_names(h, buf, n)
+        return buf.raw[:int(n)].decode().split("\n") if n else []
+    finally:
+        lib.ptpu_store_reader_close(h)

@@ -102,6 +102,12 @@ def save_vars(executor: Optional[Executor], dirname: str,
                 f"program before saving")
         arrays[v.name] = _maybe_bf16(as_numpy(scope.get(v.name)),
                                      save_as_bf16)
+    if filename is not None and filename.endswith(".pts"):
+        # native C++ tensor container (≙ save_combine_op.cc): streamed,
+        # CRC-checked, O(1) name lookup — the fast path for big checkpoints
+        from .data.tensor_store import save_tensors
+        save_tensors(os.path.join(dirname, filename), arrays)
+        return sorted(arrays)
     encoded = dict(_encode_for_npy(n, a) for n, a in arrays.items())
     if filename is None:
         for name, arr in encoded.items():
@@ -123,17 +129,25 @@ def load_vars(executor: Optional[Executor], dirname: str,
         enforce(predicate is not None, "need vars or predicate",
                 exc=InvalidArgumentError)
         vars = _select_vars(program, predicate)
-    if filename is not None:
+    if filename is not None and filename.endswith(".pts"):
+        from .data.tensor_store import load_tensors
+        store = load_tensors(os.path.join(dirname, filename),
+                             [v.name for v in vars])
+        decode_native = True
+    elif filename is not None:
         path = os.path.join(dirname, filename)
         with np.load(path) as data:
             store = {k: data[k] for k in data.files}
+        decode_native = False
     else:
         store = None
+        decode_native = False
     import jax.numpy as jnp
     loaded = []
     for v in vars:
         if store is not None:
-            arr = _decode_from_store(v.name, store)
+            arr = (store[v.name] if decode_native
+                   else _decode_from_store(v.name, store))
         else:
             path = os.path.join(dirname, v.name + ".npy")
             tagged = os.path.join(dirname, v.name + BF16_TAG + ".npy")

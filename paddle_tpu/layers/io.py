@@ -95,10 +95,15 @@ class PyReader:
             except BaseException as e:  # surfaced in the consumer
                 err.append(e)
             finally:
-                try:
-                    q.put_nowait(self._END)
-                except _q.Full:
-                    pass  # stopped epoch; nobody is reading this queue
+                # END must actually arrive or the consumer hangs; only a
+                # reset() (stop set) may abandon delivery — that queue is
+                # orphaned and nobody reads it
+                while not stop.is_set():
+                    try:
+                        q.put(self._END, timeout=0.1)
+                        break
+                    except _q.Full:
+                        continue
         self._thread = threading.Thread(target=produce, daemon=True)
         self._thread.start()
         return self

@@ -1071,7 +1071,7 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
         inputs["Bias"] = [b]
     new_h = helper.create_tmp_variable(dtype=dtype, shape=list(hidden.shape))
     gate = helper.create_tmp_variable(dtype=dtype,
-                                      shape=[hidden.shape[0], 2 * h])
+                                      shape=[hidden.shape[0], 3 * h])
     reset = helper.create_tmp_variable(dtype=dtype,
                                        shape=list(hidden.shape))
     helper.append_op(type="gru_unit", inputs=inputs,

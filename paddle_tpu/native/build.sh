@@ -3,4 +3,4 @@
 # import (paddle_tpu/data/recordio.py) when the .so is missing or stale.
 set -e
 cd "$(dirname "$0")"
-g++ -O2 -std=c++17 -fPIC -shared -o libptpu_native.so recordio.cc -lz -lpthread
+g++ -O2 -std=c++17 -fPIC -shared -o libptpu_native.so recordio.cc tensor_store.cc -lz -lpthread

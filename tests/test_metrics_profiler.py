@@ -239,3 +239,22 @@ class TestTrainer:
                        pt.optimizer.SGDOptimizer(learning_rate=0.1))
         t.train(num_epochs=5, event_handler=handler, reader=_reader(),
                 feed_order=["x", "label"])
+
+
+def test_memory_usage_estimate(rng):
+    """≙ reference contrib/memory_usage_calc.py test coverage."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.contrib import memory_usage
+
+    x = layers.data("x", shape=[256])
+    h = layers.fc(x, size=512)
+    layers.fc(h, size=10)
+    m = memory_usage(batch_size=32)
+    # fc params: 256*512 + 512 + 512*10 + 10 floats
+    expected_params = (256 * 512 + 512 + 512 * 10 + 10) * 4
+    assert m["parameters"] == expected_params
+    # activations scale with batch size
+    m2 = memory_usage(batch_size=64)
+    assert m2["activations"] > m["activations"]
+    assert "state" in m["summary"]

@@ -163,3 +163,79 @@ class TestFailureModes:
             got = list(s)
             assert s.skipped_chunks >= 1
         assert len(got) > 0
+
+
+# ---------------------------------------------------------------------------
+# native tensor container (tensor_store.cc)
+# ---------------------------------------------------------------------------
+
+class TestTensorStore:
+    def test_roundtrip_many_dtypes(self, rng, tmp_path):
+        import ml_dtypes
+        from paddle_tpu.data.tensor_store import (list_tensors, load_tensors,
+                                                  save_tensors)
+        path = str(tmp_path / "ckpt.pts")
+        tensors = {
+            "w": rng.randn(17, 9).astype("float32"),
+            "step": np.asarray(123, dtype="int64"),
+            "mask": (rng.rand(5) > 0.5),
+            "bf": rng.randn(8, 8).astype(ml_dtypes.bfloat16),
+            "emb": rng.randn(100, 4).astype("float64"),
+        }
+        save_tensors(path, tensors)
+        assert sorted(list_tensors(path)) == sorted(tensors)
+        back = load_tensors(path)
+        for k, v in tensors.items():
+            assert back[k].dtype == v.dtype
+            np.testing.assert_array_equal(
+                back[k].view(np.uint8) if v.dtype.name == "bfloat16"
+                else back[k],
+                v.view(np.uint8) if v.dtype.name == "bfloat16" else v)
+
+    def test_subset_load(self, rng, tmp_path):
+        from paddle_tpu.data.tensor_store import load_tensors, save_tensors
+        path = str(tmp_path / "c.pts")
+        save_tensors(path, {"a": np.zeros(3, "float32"),
+                            "b": np.ones(4, "float32")})
+        got = load_tensors(path, ["b"])
+        assert list(got) == ["b"]
+
+    def test_truncated_file_rejected(self, rng, tmp_path):
+        from paddle_tpu.data.tensor_store import load_tensors, save_tensors
+        path = str(tmp_path / "t.pts")
+        save_tensors(path, {"a": rng.rand(64).astype("float32")})
+        data = open(path, "rb").read()
+        open(path, "wb").write(data[:-10])   # chop the footer
+        with pytest.raises(IOError):
+            load_tensors(path)
+
+    def test_corrupt_payload_detected(self, rng, tmp_path):
+        from paddle_tpu.data.tensor_store import load_tensors, save_tensors
+        path = str(tmp_path / "x.pts")
+        save_tensors(path, {"a": rng.rand(64).astype("float32")})
+        data = bytearray(open(path, "rb").read())
+        data[60] ^= 0xFF                      # flip a payload byte
+        open(path, "wb").write(bytes(data))
+        with pytest.raises((IOError, KeyError)):
+            load_tensors(path, ["a"])
+
+    def test_io_save_load_vars_native_format(self, rng, tmp_path):
+        """save_vars/load_vars route *.pts filenames through the native
+        container (≙ save_combine/load_combine single-file flow)."""
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+
+        x = layers.data("x", shape=[8])
+        layers.fc(x, size=4, name="ts_fc")
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        scope = pt.global_scope()
+        before = {n: np.asarray(scope.get(n)).copy()
+                  for n in scope.local_var_names()}
+
+        pt.io.save_params(exe, str(tmp_path), filename="params.pts")
+        for n in before:
+            scope.set_var(n, np.zeros_like(before[n]))
+        pt.io.load_params(exe, str(tmp_path), filename="params.pts")
+        for n, v in before.items():
+            np.testing.assert_allclose(np.asarray(scope.get(n)), v)

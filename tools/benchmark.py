@@ -79,17 +79,16 @@ def _googlenet(args, rng):
 
 
 def _stacked_lstm(args, rng):
+    import numpy as np
     from paddle_tpu.models import stacked_lstm
     seq = args.seq_len
     loss, acc, _ = stacked_lstm.stacked_lstm_net(
         dict_dim=10000, emb_dim=256, hid_dim=256, max_len=seq)
     feed = {"words": rng.randint(0, 10000,
                                  (args.batch_size, seq)).astype("int64"),
-            "words@SEQLEN": [seq] * args.batch_size,
+            "words@SEQLEN": np.full((args.batch_size,), seq, dtype="int32"),
             "label": rng.randint(0, 2,
                                  (args.batch_size, 1)).astype("int64")}
-    import numpy as np
-    feed["words@SEQLEN"] = np.full((args.batch_size,), seq, dtype="int32")
     return loss, feed, args.batch_size
 
 
@@ -171,6 +170,10 @@ def main():
     p.add_argument("--no_bf16", action="store_true")
     p.add_argument("--profile", action="store_true")
     args = p.parse_args()
+    if args.iters < 1:
+        p.error("--iters must be >= 1")
+    if args.warmup < 0:
+        p.error("--warmup must be >= 0")
 
     import numpy as np
     import jax
@@ -196,9 +199,11 @@ def main():
 
     if args.profile:
         pt.profiler.start_profiler("All")
+    out = None
     for _ in range(args.warmup):
         out = runner.run(feed=feed, fetch_list=[loss], return_numpy=False)
-    jax.block_until_ready(out)
+    if out is not None:
+        jax.block_until_ready(out)
 
     t0 = time.time()
     for _ in range(args.iters):

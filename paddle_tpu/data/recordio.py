@@ -29,8 +29,12 @@ def _load() -> ctypes.CDLL:
     global _lib
     if _lib is not None:
         return _lib
+    # stale if older than ANY native source (the .so bundles every .cc)
+    srcs = [os.path.join(_NATIVE_DIR, f) for f in os.listdir(_NATIVE_DIR)
+            if f.endswith(".cc") or f == "build.sh"]
     if (not os.path.exists(_SO_PATH) or
-            os.path.getmtime(_SO_PATH) < os.path.getmtime(_SRC_PATH)):
+            os.path.getmtime(_SO_PATH) < max(os.path.getmtime(s)
+                                             for s in srcs)):
         subprocess.run(["sh", os.path.join(_NATIVE_DIR, "build.sh")],
                        check=True, capture_output=True)
     lib = ctypes.CDLL(_SO_PATH)

@@ -2,9 +2,9 @@
 
 ≙ reference save_combine_op.cc / load_combine_op.cc + LoDTensor
 SerializeToStream (framework/lod_tensor.cc): many named tensors in one
-CRC-checked file, streamed through C++. io.save_persistables/
-load_persistables use this as their storage backend when
-``format="native"`` (the default npz path stays for portability).
+CRC-checked file, streamed through C++. io.save_vars/load_vars (and the
+save/load_params/persistables wrappers) route any ``filename`` ending in
+``.pts`` through this container; other filenames use the portable npz path.
 """
 
 from __future__ import annotations
@@ -34,7 +34,7 @@ def _lib():
     lib.ptpu_store_writer_add.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint8,
         ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint8,
-        ctypes.c_char_p, ctypes.c_uint64]
+        ctypes.c_void_p, ctypes.c_uint64]
     lib.ptpu_store_writer_finish.restype = ctypes.c_int
     lib.ptpu_store_writer_finish.argtypes = [ctypes.c_void_p]
     lib.ptpu_store_reader_open.restype = ctypes.c_void_p
@@ -82,9 +82,11 @@ def save_tensors(path: str, tensors: Dict[str, np.ndarray]):
             # bfloat16 arrays pass through as raw bytes with their code
             code = _CODE[_np_dtype_name(arr)]
             dims = (ctypes.c_uint64 * max(arr.ndim, 1))(*arr.shape)
+            # hand C++ the array's own buffer — no tobytes() copy; `arr`
+            # stays referenced for the duration of the call
             ok = lib.ptpu_store_writer_add(
                 h, name.encode(), code, dims, arr.ndim,
-                arr.tobytes(), arr.nbytes)
+                ctypes.c_void_p(arr.ctypes.data), arr.nbytes)
             if not ok:
                 raise IOError(f"tensor_store: write failed for {name!r}")
     except Exception:

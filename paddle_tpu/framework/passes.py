@@ -1,0 +1,145 @@
+"""Program pass framework: Pass base, registry, builtin passes, Analyzer.
+
+≙ reference framework/ir/ (ir::Pass + PassRegistry, ir/pass.h:32; fuse and
+graph_viz passes) and the inference analysis pipeline
+(inference/analysis/analyzer.h:53 — an ordered pass manager rewriting the
+program before serving). TPU translation: passes rewrite the Program (and
+Scope for constant-folding passes) directly; the heavy fusion work the
+reference does in fc_fuse/TensorRT-subgraph passes belongs to XLA here, so
+the pass set focuses on semantic rewrites XLA cannot do (constant-folding
+batch norms, freezing quantization, pruning, rematerialization policy).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from ..core.enforce import AlreadyExistsError, NotFoundError, enforce
+from .program import Program
+from .scope import Scope, global_scope
+
+
+class Pass:
+    """A named program rewrite (≙ ir::Pass, reference ir/pass.h:32)."""
+
+    name = "pass"
+
+    def __init__(self, **attrs):
+        self.attrs = attrs
+
+    def apply(self, program: Program, scope: Optional[Scope] = None) -> Program:
+        raise NotImplementedError
+
+    def __call__(self, program, scope=None):
+        return self.apply(program, scope)
+
+
+_REGISTRY: Dict[str, Callable[..., Pass]] = {}
+
+
+def register_pass(name: str):
+    """≙ REGISTER_PASS (reference ir/pass.h PassRegistry)."""
+
+    def deco(cls):
+        if name in _REGISTRY:
+            raise AlreadyExistsError(f"pass {name!r} already registered")
+        cls.name = name
+        _REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+def get_pass(name: str, **attrs) -> Pass:
+    if name not in _REGISTRY:
+        raise NotFoundError(
+            f"no pass named {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**attrs)
+
+
+def registered_passes() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# builtin passes
+# ---------------------------------------------------------------------------
+
+@register_pass("prune_pass")
+class PrunePass(Pass):
+    """Keep only ops needed for `targets` (≙ framework/prune.cc via
+    Program.prune). attrs: targets=[var names or Variables]."""
+
+    def apply(self, program, scope=None):
+        return program.prune(self.attrs["targets"])
+
+
+@register_pass("bn_fold_pass")
+class BNFoldPass(Pass):
+    """Constant-fold inference batch_norm into the preceding conv/mul
+    (≙ the mkldnn conv-bn fuse in inference_transpiler.py:24)."""
+
+    def apply(self, program, scope=None):
+        from ..transpiler import InferenceTranspiler
+        InferenceTranspiler().transpile(program, scope=scope or global_scope())
+        return program
+
+
+@register_pass("quant_freeze_pass")
+class QuantFreezePass(Pass):
+    """Bake QAT weight quantization into stored weights (≙ the reference
+    freeze flow over fake_quantize ops)."""
+
+    def apply(self, program, scope=None):
+        from ..transpiler import QuantizeTranspiler
+        QuantizeTranspiler(**{k: v for k, v in self.attrs.items()
+                              if k in ("weight_bits", "activation_bits")}) \
+            .freeze_program(program, scope=scope or global_scope())
+        return program
+
+
+@register_pass("memory_optimize_pass")
+class MemoryOptimizePass(Pass):
+    """Remat + live-out narrowing (≙ memory_optimization_transpiler)."""
+
+    def apply(self, program, scope=None):
+        from ..transpiler import memory_optimize
+        return memory_optimize(program, level=self.attrs.get("level", 0))
+
+
+@register_pass("graph_viz_pass")
+class GraphVizPass(Pass):
+    """Dump the program graph as graphviz dot (≙ ir/graph_viz_pass.cc).
+    attrs: path=...; block_idx=0."""
+
+    def apply(self, program, scope=None):
+        from ..debugger import draw_block_graphviz
+        block = program.blocks[self.attrs.get("block_idx", 0)]
+        draw_block_graphviz(block, self.attrs["path"])
+        return program
+
+
+class Analyzer:
+    """Ordered pass manager preparing a trained program for serving
+    (≙ inference/analysis/analyzer.h:53 running its pass pipeline over the
+    data-flow graph; TensorRT-subgraph slots are XLA's job here).
+
+        program = Analyzer(passes=["bn_fold_pass", "quant_freeze_pass"]) \
+            .run(program, scope)
+    """
+
+    DEFAULT_PASSES = ["bn_fold_pass"]
+
+    def __init__(self, passes: Optional[List[str]] = None, **pass_attrs):
+        self.pass_names = list(passes or self.DEFAULT_PASSES)
+        self.pass_attrs = pass_attrs
+
+    def run(self, program: Program, scope: Optional[Scope] = None,
+            targets=None) -> Program:
+        scope = scope or global_scope()
+        if targets is not None:
+            program = get_pass("prune_pass", targets=targets)(program, scope)
+        for name in self.pass_names:
+            attrs = self.pass_attrs.get(name, {})
+            program = get_pass(name, **attrs)(program, scope)
+        return program

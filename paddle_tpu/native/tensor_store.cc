@@ -11,8 +11,9 @@
 // File format (little-endian):
 //   file   := MAGIC u32 | version u32 | entry* | index | index_off u64
 //             | index_len u32 | crc32(index) u32 | MAGIC u32
-//   entry  := name_len u16 | name | dtype u8 | ndim u8 | dims u64*ndim
-//             | data_len u64 | crc32(data) u32 | data
+//   entry  := name_len u16 | name | crc32(hdr) u32 | hdr | data
+//   hdr    := dtype u8 | ndim u8 | dims u64*ndim | data_len u64
+//             | crc32(data) u32
 //   index  := count u32 | (name_len u16 | name | entry_off u64)*
 //
 // dtype codes match numpy kinds the framework uses:
@@ -30,11 +31,20 @@
 namespace {
 
 constexpr uint32_t kMagic = 0x50545453;  // "PTTS"
-constexpr uint32_t kVersion = 1;
+constexpr uint32_t kVersion = 2;         // v2: per-entry header CRC
+constexpr uint8_t kMaxDims = 16;
 
 uint32_t Crc(const char* data, size_t n) {
-  return static_cast<uint32_t>(
-      crc32(0L, reinterpret_cast<const Bytef*>(data), n));
+  // zlib's length parameter is 32-bit: feed >4GiB payloads in chunks so a
+  // 4GiB-aligned tensor is fully covered, not hashed as zero bytes
+  uLong c = crc32(0L, nullptr, 0);
+  while (n > 0) {
+    uInt step = n > (1u << 30) ? (1u << 30) : static_cast<uInt>(n);
+    c = crc32(c, reinterpret_cast<const Bytef*>(data), step);
+    data += step;
+    n -= step;
+  }
+  return static_cast<uint32_t>(c);
 }
 
 struct Entry {
@@ -60,18 +70,25 @@ class StoreWriter {
   bool Add(const char* name, uint8_t dtype, const uint64_t* dims,
            uint8_t ndim, const char* data, uint64_t len) {
     if (!f_) return false;
+    if (ndim > kMaxDims) return false;
     uint16_t name_len = static_cast<uint16_t>(std::strlen(name));
     long long off = ftello(f_);
     if (off < 0) return false;
     index_[std::string(name)] = static_cast<uint64_t>(off);
+    // header blob (dtype|ndim|dims|data_len|data_crc) is itself CRC'd so
+    // metadata corruption fails loudly instead of decoding garbage
+    std::string hdr;
+    hdr.append(reinterpret_cast<const char*>(&dtype), 1);
+    hdr.append(reinterpret_cast<const char*>(&ndim), 1);
+    hdr.append(reinterpret_cast<const char*>(dims), 8ull * ndim);
+    hdr.append(reinterpret_cast<const char*>(&len), 8);
+    uint32_t dcrc = Crc(data, len);
+    hdr.append(reinterpret_cast<const char*>(&dcrc), 4);
+    uint32_t hcrc = Crc(hdr.data(), hdr.size());
     std::fwrite(&name_len, 2, 1, f_);
     std::fwrite(name, 1, name_len, f_);
-    std::fwrite(&dtype, 1, 1, f_);
-    std::fwrite(&ndim, 1, 1, f_);
-    std::fwrite(dims, 8, ndim, f_);
-    std::fwrite(&len, 8, 1, f_);
-    uint32_t crc = Crc(data, len);
-    std::fwrite(&crc, 4, 1, f_);
+    std::fwrite(&hcrc, 4, 1, f_);
+    std::fwrite(hdr.data(), 1, hdr.size(), f_);
     return std::fwrite(data, 1, len, f_) == len || len == 0;
   }
 
@@ -213,14 +230,21 @@ class StoreReader {
     if (nl && std::fread(&stored[0], 1, nl, f_) != nl) return false;
     if (stored != name) return false;  // index/entry mismatch = corruption
     Entry e;
-    uint8_t ndim = 0;
-    if (std::fread(&e.dtype, 1, 1, f_) != 1 ||
-        std::fread(&ndim, 1, 1, f_) != 1) return false;
+    uint32_t hcrc = 0;
+    if (std::fread(&hcrc, 4, 1, f_) != 1) return false;
+    std::string hdr(2, '\0');
+    if (std::fread(&hdr[0], 1, 2, f_) != 2) return false;
+    uint8_t ndim = static_cast<uint8_t>(hdr[1]);
+    if (ndim > kMaxDims) return false;
+    size_t rest = 8ull * ndim + 8 + 4;
+    hdr.resize(2 + rest);
+    if (std::fread(&hdr[2], 1, rest, f_) != rest) return false;
+    if (Crc(hdr.data(), hdr.size()) != hcrc) return false;
+    e.dtype = static_cast<uint8_t>(hdr[0]);
     e.dims.resize(ndim);
-    if (ndim && std::fread(e.dims.data(), 8, ndim, f_) !=
-        static_cast<size_t>(ndim)) return false;
-    if (std::fread(&e.data_len, 8, 1, f_) != 1 ||
-        std::fread(&e.crc, 4, 1, f_) != 1) return false;
+    std::memcpy(e.dims.data(), hdr.data() + 2, 8ull * ndim);
+    std::memcpy(&e.data_len, hdr.data() + 2 + 8ull * ndim, 8);
+    std::memcpy(&e.crc, hdr.data() + 2 + 8ull * ndim + 8, 4);
     long long pos = ftello(f_);
     if (pos < 0) return false;
     e.data_off = static_cast<uint64_t>(pos);

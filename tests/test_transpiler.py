@@ -288,3 +288,48 @@ class TestDistTranspiler:
         hn = HashName(["a", "b", "c"])
         d1 = hn.dispatch(["w1", "w2", "w1"])
         assert d1[0] == d1[2]  # stable by name
+
+
+class TestPassFrameworkAndAnalyzer:
+    def test_registry_and_unknown_pass(self):
+        from paddle_tpu import get_pass, registered_passes
+        from paddle_tpu.core.enforce import NotFoundError
+        assert {"prune_pass", "bn_fold_pass", "quant_freeze_pass",
+                "memory_optimize_pass",
+                "graph_viz_pass"} <= set(registered_passes())
+        with pytest.raises(NotFoundError):
+            get_pass("nope_pass")
+
+    def test_analyzer_pipeline_serving_prep(self, rng, tmp_path):
+        """prune -> BN fold -> viz over a trained conv program; outputs
+        unchanged (≙ analyzer running its pass pipeline before serving)."""
+        import paddle_tpu as pt
+        from paddle_tpu import Analyzer, layers
+
+        img = layers.data("img", shape=[3, 8, 8])
+        c = layers.conv2d(img, num_filters=4, filter_size=3, bias_attr=False)
+        out = layers.batch_norm(c, is_test=True)
+        aux = layers.reduce_sum(out)  # prune target excludes this
+        prog = pt.default_main_program().clone(for_test=True)
+
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        scope = pt.global_scope()
+        bn = [op for op in prog.global_block().ops
+              if op.type == "batch_norm"][0]
+        scope.set_var(bn.inputs["Mean"][0], rng.rand(4).astype("float32"))
+        scope.set_var(bn.inputs["Variance"][0],
+                      (rng.rand(4) + 0.5).astype("float32"))
+        feed = {"img": rng.rand(2, 3, 8, 8).astype("float32")}
+        base = exe.run(prog, feed=feed, fetch_list=[out])[0]
+
+        dot = str(tmp_path / "g.dot")
+        analyzed = Analyzer(
+            passes=["bn_fold_pass", "graph_viz_pass"],
+            graph_viz_pass={"path": dot}).run(prog, scope, targets=[out])
+        types = [op.type for op in analyzed.global_block().ops]
+        assert "batch_norm" not in types
+        assert "reduce_sum" not in types   # pruned away
+        got = exe.run(analyzed, feed=feed, fetch_list=[out])[0]
+        np.testing.assert_allclose(got, base, atol=1e-4, rtol=1e-4)
+        assert "digraph" in open(dot).read()

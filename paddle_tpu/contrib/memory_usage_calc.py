@@ -12,8 +12,6 @@ from __future__ import annotations
 
 from typing import Optional
 
-import numpy as np
-
 from ..framework.program import Program, default_main_program
 
 _DTYPE_BYTES = {"float32": 4, "float64": 8, "int32": 4, "int64": 8,

@@ -20,7 +20,6 @@ from ..core.enforce import InvalidArgumentError, NotFoundError, enforce
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
 _SO_PATH = os.path.join(_NATIVE_DIR, "libptpu_native.so")
-_SRC_PATH = os.path.join(_NATIVE_DIR, "recordio.cc")
 
 _lib = None
 

@@ -107,8 +107,7 @@ def load_tensors(path: str, names: List[str] = None) -> Dict[str, np.ndarray]:
     lib = _lib()
     h = lib.ptpu_store_reader_open(path.encode())
     if not h:
-        raise IOError(f"tensor_store: cannot open {path!r} (missing, "
-                      f"truncated, or corrupt index)")
+        raise IOError(_open_error(path))
     try:
         n = lib.ptpu_store_reader_names(h, None, 0)
         buf = ctypes.create_string_buffer(int(n))
@@ -139,11 +138,34 @@ def load_tensors(path: str, names: List[str] = None) -> Dict[str, np.ndarray]:
         lib.ptpu_store_reader_close(h)
 
 
+_FORMAT_VERSION = 2
+
+
+def _open_error(path: str) -> str:
+    """Distinguish 'wrong container version' from genuine corruption."""
+    import os as _os
+    import struct
+    if not _os.path.exists(path):
+        return f"tensor_store: {path!r} does not exist"
+    try:
+        with open(path, "rb") as f:
+            head = f.read(8)
+        magic, version = struct.unpack("<II", head)
+        if magic == 0x50545453 and version != _FORMAT_VERSION:
+            return (f"tensor_store: {path!r} is container format "
+                    f"v{version}; this build reads v{_FORMAT_VERSION} — "
+                    f"re-save the checkpoint with the current version")
+    except Exception:
+        pass
+    return (f"tensor_store: cannot open {path!r} "
+            f"(truncated or corrupt index)")
+
+
 def list_tensors(path: str) -> List[str]:
     lib = _lib()
     h = lib.ptpu_store_reader_open(path.encode())
     if not h:
-        raise IOError(f"tensor_store: cannot open {path!r}")
+        raise IOError(_open_error(path))
     try:
         n = lib.ptpu_store_reader_names(h, None, 0)
         buf = ctypes.create_string_buffer(int(n))

@@ -17,7 +17,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence, Set, Tuple, Union
 
 from ..core.enforce import InvalidArgumentError, enforce
-from .lowering import GRAD_SUFFIX, _ancestor_op_indices, grad_var_name
+from .lowering import _ancestor_op_indices, grad_var_name
 from .program import Parameter, Program, Variable
 
 

@@ -20,11 +20,19 @@ from .scope import Scope, global_scope
 
 
 class Pass:
-    """A named program rewrite (≙ ir::Pass, reference ir/pass.h:32)."""
+    """A named program rewrite (≙ ir::Pass, reference ir/pass.h:32).
+    Subclasses list `allowed_attrs`; unknown attrs raise instead of
+    silently no-op'ing a mistyped option."""
 
     name = "pass"
+    allowed_attrs: tuple = ()
 
     def __init__(self, **attrs):
+        unknown = set(attrs) - set(self.allowed_attrs)
+        if unknown:
+            raise TypeError(
+                f"pass {self.name!r} got unknown attrs {sorted(unknown)}; "
+                f"allowed: {sorted(self.allowed_attrs)}")
         self.attrs = attrs
 
     def apply(self, program: Program, scope: Optional[Scope] = None) -> Program:
@@ -70,6 +78,8 @@ class PrunePass(Pass):
     """Keep only ops needed for `targets` (≙ framework/prune.cc via
     Program.prune). attrs: targets=[var names or Variables]."""
 
+    allowed_attrs = ("targets",)
+
     def apply(self, program, scope=None):
         return program.prune(self.attrs["targets"])
 
@@ -78,6 +88,8 @@ class PrunePass(Pass):
 class BNFoldPass(Pass):
     """Constant-fold inference batch_norm into the preceding conv/mul
     (≙ the mkldnn conv-bn fuse in inference_transpiler.py:24)."""
+
+    allowed_attrs = ()
 
     def apply(self, program, scope=None):
         from ..transpiler import InferenceTranspiler
@@ -90,10 +102,11 @@ class QuantFreezePass(Pass):
     """Bake QAT weight quantization into stored weights (≙ the reference
     freeze flow over fake_quantize ops)."""
 
+    allowed_attrs = ("weight_bits", "activation_bits")
+
     def apply(self, program, scope=None):
         from ..transpiler import QuantizeTranspiler
-        QuantizeTranspiler(**{k: v for k, v in self.attrs.items()
-                              if k in ("weight_bits", "activation_bits")}) \
+        QuantizeTranspiler(**self.attrs) \
             .freeze_program(program, scope=scope or global_scope())
         return program
 
@@ -102,15 +115,22 @@ class QuantFreezePass(Pass):
 class MemoryOptimizePass(Pass):
     """Remat + live-out narrowing (≙ memory_optimization_transpiler)."""
 
+    allowed_attrs = ("level", "skip_opt_set", "print_log")
+
     def apply(self, program, scope=None):
         from ..transpiler import memory_optimize
-        return memory_optimize(program, level=self.attrs.get("level", 0))
+        return memory_optimize(
+            program, level=self.attrs.get("level", 0),
+            skip_opt_set=self.attrs.get("skip_opt_set"),
+            print_log=self.attrs.get("print_log", False))
 
 
 @register_pass("graph_viz_pass")
 class GraphVizPass(Pass):
     """Dump the program graph as graphviz dot (≙ ir/graph_viz_pass.cc).
     attrs: path=...; block_idx=0."""
+
+    allowed_attrs = ("path", "block_idx")
 
     def apply(self, program, scope=None):
         from ..debugger import draw_block_graphviz

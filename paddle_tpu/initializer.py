@@ -11,7 +11,6 @@ from __future__ import annotations
 import numpy as np
 
 from .core.dtypes import dtype_name
-from .framework.program import default_startup_program
 
 
 class Initializer:

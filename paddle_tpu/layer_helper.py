@@ -14,8 +14,8 @@ from .core.dtypes import dtype_name
 from .core.enforce import InvalidArgumentError, enforce
 from .framework.program import (Parameter, Variable, default_main_program,
                                 default_startup_program)
-from .initializer import (ConstantInitializer, XavierInitializer,
-                          _global_bias_initializer, _global_weight_initializer)
+from .initializer import (_global_bias_initializer,
+                          _global_weight_initializer)
 from .param_attr import ParamAttr
 
 

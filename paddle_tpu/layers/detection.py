@@ -217,7 +217,6 @@ def multi_box_head(inputs, image, num_classes, min_sizes, max_sizes=None,
     (mbox_locs [B,M,4], mbox_confs [B,M,C] raw logits — softmax +
     transpose to [B,C,M] before detection_output/multiclass_nms —,
     boxes [M,4], variances [M,4])."""
-    from . import tensor as _tensor
     enforce(len(inputs) == len(min_sizes), "one min_size per input",
             exc=InvalidArgumentError)
     enforce(max_sizes is None or len(max_sizes) == len(inputs),

@@ -9,7 +9,7 @@ reader ops.
 
 from __future__ import annotations
 
-from ..framework.program import default_main_program, default_startup_program
+from ..framework.program import default_main_program
 
 
 def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,

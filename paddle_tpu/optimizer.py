@@ -14,7 +14,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from .clip import append_gradient_clip_ops
 from .core import unique_name
 from .core.dtypes import dtype_name
-from .core.enforce import InvalidArgumentError, enforce
+from .core.enforce import enforce
 from .framework.backward import append_backward
 from .framework.program import (Parameter, Program, Variable,
                                 default_main_program,

@@ -14,7 +14,6 @@ import functools
 from typing import Callable, Sequence
 
 import jax
-import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 

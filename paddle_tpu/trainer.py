@@ -22,7 +22,7 @@ from .core.enforce import InvalidArgumentError, enforce
 from .data.feeder import DataFeeder
 from .framework.executor import Executor
 from .framework.program import (Program, Variable, program_guard)
-from .framework.scope import Scope, global_scope
+from .framework.scope import Scope
 
 
 class BeginEpochEvent:

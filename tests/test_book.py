@@ -1,0 +1,174 @@
+"""End-to-end "book" flows: train -> save inference model -> reload -> infer.
+
+≙ reference tests/book/test_{fit_a_line, word2vec, recommender_system,
+understand_sentiment}.py (SURVEY.md §4 "End-to-end book tests" — each
+trains briefly, saves an inference model, reloads it in a fresh scope, and
+infers). recognize_digits / image_classification / machine_translation /
+label_semantic_roles equivalents live in test_mnist_mlp.py,
+test_models.py, test_machine_translation.py, test_sequence_labeling.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.data import datasets as D
+
+
+def _train_save_load(loss, feed_fn, feed_names, targets, tmp_path, steps=30,
+                     lr=0.01, opt="sgd"):
+    """Shared book-flow driver; returns (infer_fn, first_loss, last_loss)."""
+    optimizer = (pt.optimizer.AdamOptimizer(lr) if opt == "adam"
+                 else pt.optimizer.SGDOptimizer(lr))
+    optimizer.minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    first = last = None
+    for i in range(steps):
+        out = exe.run(feed=feed_fn(i), fetch_list=[loss])[0]
+        first = out if first is None else first
+        last = out
+    model_dir = str(tmp_path / "model")
+    pt.io.save_inference_model(model_dir, feed_names, targets, exe)
+
+    # fresh process equivalent: new scope + program loaded from disk
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    exe2 = pt.Executor()
+    program, feeds, fetches = pt.io.load_inference_model(model_dir, exe2)
+
+    def infer(feed):
+        return exe2.run(program, feed=feed, fetch_list=fetches)
+
+    return infer, float(np.asarray(first).reshape(-1)[0]), \
+        float(np.asarray(last).reshape(-1)[0])
+
+
+class TestFitALine:
+    def test_linear_regression_book_flow(self, rng, tmp_path):
+        """≙ book test_fit_a_line: uci_housing linear regressor."""
+        batch = [s for _, s in zip(range(64), D.uci_housing.train()())]
+        xs = np.stack([b[0] for b in batch]).astype("float32")
+        ys = np.asarray([b[1] for b in batch], "float32").reshape(-1, 1)
+
+        x = layers.data("x", shape=[xs.shape[1]])
+        y = layers.data("y", shape=[1])
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+
+        infer, first, last = _train_save_load(
+            loss, lambda i: {"x": xs, "y": ys}, ["x"], [pred], tmp_path,
+            steps=50, lr=0.01, opt="adam")
+        assert last < first
+        out = infer({"x": xs[:4]})[0]
+        assert out.shape == (4, 1) and np.isfinite(out).all()
+
+
+class TestWord2Vec:
+    def test_ngram_lm_book_flow(self, rng, tmp_path):
+        """≙ book test_word2vec: N-gram next-word model over shared
+        embeddings."""
+        V, E, N = 200, 16, 4
+        samples = [s for _, s in zip(range(128), D.imikolov.train(n=N + 1)())]
+        grams = np.asarray([s[:N] for s in samples], "int64") % V
+        nxt = np.asarray([s[N] for s in samples], "int64").reshape(-1, 1) % V
+
+        words = [layers.data(f"w{i}", shape=[1], dtype="int64")
+                 for i in range(N)]
+        embs = [layers.embedding(w, size=[V, E],
+                                 param_attr=pt.ParamAttr(name="shared_emb"))
+                for w in words]
+        concat = layers.concat([layers.reshape(e, shape=[-1, E])
+                                for e in embs], axis=1)
+        h = layers.fc(concat, size=64, act="relu")
+        logits = layers.fc(h, size=V)
+        label = layers.data("next", shape=[1], dtype="int64")
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+
+        def feed(i):
+            f = {f"w{k}": grams[:, k:k + 1] for k in range(N)}
+            f["next"] = nxt
+            return f
+
+        infer, first, last = _train_save_load(
+            loss, feed, [f"w{i}" for i in range(N)], [logits], tmp_path,
+            steps=40, lr=5e-3, opt="adam")
+        assert last < first
+        out = infer({f"w{k}": grams[:2, k:k + 1] for k in range(N)})[0]
+        assert out.shape == (2, V)
+
+
+class TestRecommenderSystem:
+    def test_movielens_book_flow(self, rng, tmp_path):
+        """≙ book test_recommender_system: user/movie towers -> cos_sim
+        rating."""
+        samples = [s for _, s in zip(range(256), D.movielens.train()())]
+        uid = np.asarray([s[0] for s in samples], "int64").reshape(-1, 1)
+        gender = np.asarray([s[1] for s in samples], "int64").reshape(-1, 1)
+        age = np.asarray([s[2] for s in samples], "int64").reshape(-1, 1)
+        job = np.asarray([s[3] for s in samples], "int64").reshape(-1, 1)
+        mid = np.asarray([s[4] for s in samples], "int64").reshape(-1, 1)
+        rating = np.asarray([s[7] for s in samples],
+                            "float32").reshape(-1, 1)
+
+        def tower(name, inputs_sizes):
+            feats = []
+            for nm, vocab in inputs_sizes:
+                v = layers.data(nm, shape=[1], dtype="int64")
+                feats.append(layers.reshape(
+                    layers.embedding(v, size=[vocab, 16]), shape=[-1, 16]))
+            return layers.fc(layers.concat(feats, axis=1), size=32,
+                             act="tanh", name=name)
+
+        usr = tower("usr_fc", [("uid", D.movielens.MAX_USER + 1),
+                               ("gender", 2),
+                               ("age", D.movielens.NUM_AGES),
+                               ("job", D.movielens.NUM_JOBS)])
+        mov = tower("mov_fc", [("mid", D.movielens.MAX_MOVIE + 1)])
+        sim = layers.cos_sim(usr, mov)
+        scaled = layers.scale(sim, scale=5.0)
+        label = layers.data("rating", shape=[1])
+        loss = layers.mean(layers.square_error_cost(scaled, label))
+
+        feed_all = {"uid": uid, "gender": gender, "age": age, "job": job,
+                    "mid": mid, "rating": rating}
+        infer, first, last = _train_save_load(
+            loss, lambda i: feed_all,
+            ["uid", "gender", "age", "job", "mid"], [scaled], tmp_path,
+            steps=60, lr=5e-3, opt="adam")
+        assert last < first
+        out = infer({k: v[:4] for k, v in feed_all.items()
+                     if k != "rating"})[0]
+        assert out.shape == (4, 1)
+        assert np.isfinite(out).all()
+
+
+class TestUnderstandSentiment:
+    def test_stacked_lstm_book_flow(self, rng, tmp_path):
+        """≙ book test_understand_sentiment (stacked LSTM variant) over the
+        synthetic sentiment set."""
+        from paddle_tpu.models import stacked_lstm
+
+        T = 24
+        samples = [s for _, s in zip(range(64), D.sentiment.train()())]
+        toks = np.zeros((len(samples), T), "int64")
+        lens = np.zeros((len(samples),), "int32")
+        labels = np.zeros((len(samples), 1), "int64")
+        for i, (t, y) in enumerate(samples):
+            n = min(len(t), T)
+            toks[i, :n] = t[:n]
+            lens[i] = n
+            labels[i, 0] = y
+
+        loss, acc, logits = stacked_lstm.stacked_lstm_net(
+            dict_dim=D.sentiment.VOCAB, emb_dim=32, hid_dim=32,
+            stacked_num=2, max_len=T)
+        feed = {"words": toks, "words@SEQLEN": lens, "label": labels}
+        infer, first, last = _train_save_load(
+            loss, lambda i: feed, ["words", "words@SEQLEN"], [logits],
+            tmp_path, steps=25, lr=2e-3, opt="adam")
+        assert last < first
+        out = infer({"words": toks[:4], "words@SEQLEN": lens[:4]})[0]
+        assert out.shape == (4, 2)

@@ -138,7 +138,11 @@ def load_tensors(path: str, names: List[str] = None) -> Dict[str, np.ndarray]:
         lib.ptpu_store_reader_close(h)
 
 
-_FORMAT_VERSION = 2
+def _format_version() -> int:
+    """The native library is the single source of truth for the format."""
+    lib = _load()
+    lib.ptpu_store_version.restype = ctypes.c_uint32
+    return int(lib.ptpu_store_version())
 
 
 def _open_error(path: str) -> str:
@@ -151,9 +155,10 @@ def _open_error(path: str) -> str:
         with open(path, "rb") as f:
             head = f.read(8)
         magic, version = struct.unpack("<II", head)
-        if magic == 0x50545453 and version != _FORMAT_VERSION:
+        current = _format_version()
+        if magic == 0x50545453 and version != current:
             return (f"tensor_store: {path!r} is container format "
-                    f"v{version}; this build reads v{_FORMAT_VERSION} — "
+                    f"v{version}; this build reads v{current} — "
                     f"re-save the checkpoint with the current version")
     except Exception:
         pass

@@ -318,4 +318,6 @@ void ptpu_store_reader_close(void* h) {
   delete static_cast<StoreReader*>(h);
 }
 
+uint32_t ptpu_store_version() { return kVersion; }
+
 }  // extern "C"

@@ -31,19 +31,26 @@ def _auto_backend():
 def _attention_reference(q, k, v, scale, causal):
     """Naive composite (the XLA fallback path). q/k/v: [B, H, T, D].
     Causal masking is bottom-right aligned (query i sees keys up to
-    i + Tk - Tq — the incremental-decode convention)."""
+    i + Tk - Tq — the incremental-decode convention). A query row with NO
+    visible keys (causal T > Tk head rows) outputs zeros — the flash
+    kernels' semantics — rather than softmax's uniform-weights artifact,
+    so every backend computes identical values and gradients."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal:
         tq, tk = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)
         s = jnp.where(mask, s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
+        p = jax.nn.softmax(s, axis=-1)
+        any_key = jnp.any(mask, axis=-1)          # [tq]
+        p = jnp.where(any_key[None, None, :, None], p, 0.0)
+    else:
+        p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                  scale, causal, block_q, block_k, num_k_blocks,
-                  causal_offset, true_tk):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                  acc_ref, *, scale, causal, block_q, block_k,
+                  num_k_blocks, causal_offset, true_tk):
     """One (batch·head, q-block, k-block) grid step of flash attention.
 
     Grid iterates the k dimension innermost; m/l/acc scratch persists
@@ -84,6 +91,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     m_new = jnp.maximum(m_prev, m_cur)
     alpha = jnp.exp(m_prev - m_new)
     p = jnp.exp(s - m_new)                         # [bq, bk]
+    # a fully-masked row has m == s == NEG_INF, making exp(s - m) == 1 for
+    # every DEAD entry — zero them so such rows output 0, not mean(v)
+    p = jnp.where(s > _NEG_INF / 2, p, 0.0)
     l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
     acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
         p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -95,10 +105,19 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     def _finalize():
         o_ref[0] = (acc_ref[:] /
                     jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+        # logsumexp per query row — the backward kernels' residual
+        lse_ref[0] = (m_ref[:] +
+                      jnp.log(jnp.maximum(l_ref[:], 1e-30)))[:, 0]
+
+
+def _pad_to(x, axis, target):
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, target - x.shape[axis])
+    return jnp.pad(x, pad) if target != x.shape[axis] else x
 
 
 def _flash_attention_pallas(q, k, v, scale, causal, block_q, block_k,
-                            interpret):
+                            interpret, with_lse=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -110,20 +129,15 @@ def _flash_attention_pallas(q, k, v, scale, causal, block_q, block_k,
     # sliced off, padded keys are masked dead inside the kernel
     Tp = -(-T // bq) * bq
     Tkp = -(-Tk // bk) * bk
-    qf = q.reshape(B * H, T, D)
-    kf = k.reshape(B * H, Tk, D)
-    vf = v.reshape(B * H, Tk, D)
-    if Tp != T:
-        qf = jnp.pad(qf, ((0, 0), (0, Tp - T), (0, 0)))
-    if Tkp != Tk:
-        kf = jnp.pad(kf, ((0, 0), (0, Tkp - Tk), (0, 0)))
-        vf = jnp.pad(vf, ((0, 0), (0, Tkp - Tk), (0, 0)))
+    qf = _pad_to(q.reshape(B * H, T, D), 1, Tp)
+    kf = _pad_to(k.reshape(B * H, Tk, D), 1, Tkp)
+    vf = _pad_to(v.reshape(B * H, Tk, D), 1, Tkp)
     nq, nk = Tp // bq, Tkp // bk
 
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
         num_k_blocks=nk, causal_offset=Tk - T, true_tk=Tk)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, nq, nk),
         in_specs=[
@@ -131,8 +145,14 @@ def _flash_attention_pallas(q, k, v, scale, causal, block_q, block_k,
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tp, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tp, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Tp), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -140,7 +160,163 @@ def _flash_attention_pallas(q, k, v, scale, causal, block_q, block_k,
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out[:, :T].reshape(B, H, T, D)
+    out = out[:, :T].reshape(B, H, T, D)
+    if with_lse:
+        return out, lse[:, :T].reshape(B, H, T)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# flash backward (FlashAttention-2 style): recompute P tiles from (q, k,
+# lse) in VMEM — no [T, T] materialization in HBM on the backward either
+# ---------------------------------------------------------------------------
+
+def _bwd_masks(qi, j, block_q, block_k, causal, causal_offset,
+               true_tq, true_tk):
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    valid = (q_pos < true_tq) & (k_pos < true_tk)
+    if causal:
+        valid &= q_pos + causal_offset >= k_pos
+    return valid
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, acc_ref, *, scale, causal, block_q,
+                         block_k, num_k_blocks, causal_offset, true_tq,
+                         true_tk):
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0][:, None]                      # [bq, 1]
+    delta = delta_ref[0][:, None]                  # [bq, 1]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    valid = _bwd_masks(qi, j, block_q, block_k, causal,
+                       causal_offset, true_tq, true_tk)
+    p = jnp.where(valid, jnp.exp(s - lse), 0.0)    # [bq, bk]
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    acc_ref[:] += jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                          block_q, block_k, num_q_blocks, causal_offset,
+                          true_tq, true_tk):
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(2)      # inner: q blocks
+    ki = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0][:, None]
+    delta = delta_ref[0][:, None]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    valid = _bwd_masks(i, ki, block_q, block_k, causal,
+                       causal_offset, true_tq, true_tk)
+    p = jnp.where(valid, jnp.exp(s - lse), 0.0)    # [bq, bk]
+    dv_acc[:] += jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)        # [bk, D]
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale                  # [bq, bk]
+    dk_acc[:] += jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)        # [bk, D]
+
+    @pl.when(i == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_attention_bwd_pallas(q, k, v, o, lse, do, scale, causal,
+                                block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, T, D = q.shape
+    Tk = k.shape[2]
+    bq = min(block_q, T)
+    bk = min(block_k, Tk)
+    Tp = -(-T // bq) * bq
+    Tkp = -(-Tk // bk) * bk
+    nq, nk = Tp // bq, Tkp // bk
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                       # [B, H, T]
+    qf = _pad_to(q.reshape(B * H, T, D), 1, Tp)
+    kf = _pad_to(k.reshape(B * H, Tk, D), 1, Tkp)
+    vf = _pad_to(v.reshape(B * H, Tk, D), 1, Tkp)
+    dof = _pad_to(do.reshape(B * H, T, D), 1, Tp)
+    lsef = _pad_to(lse.reshape(B * H, T), 1, Tp)
+    deltaf = _pad_to(delta.reshape(B * H, T), 1, Tp)
+
+    common = dict(scale=scale, causal=causal, block_q=bq, block_k=bk,
+                  causal_offset=Tk - T, true_tq=T, true_tk=Tk)
+    q_spec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
+    r_spec = pl.BlockSpec((1, bq), lambda b, i, j: (b, i))
+    k_spec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, num_k_blocks=nk, **common),
+        grid=(B * H, nq, nk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((B * H, Tp, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    # dk/dv: k blocks are the outer (revisited) dim, q blocks stream inner
+    qi_spec = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0))
+    ri_spec = pl.BlockSpec((1, bq), lambda b, j, i: (b, i))
+    kj_spec = pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, num_q_blocks=nq, **common),
+        grid=(B * H, nk, nq),
+        in_specs=[qi_spec, kj_spec, kj_spec, qi_spec, ri_spec, ri_spec],
+        out_specs=[kj_spec, kj_spec],
+        out_shape=[jax.ShapeDtypeStruct((B * H, Tkp, D), k.dtype),
+                   jax.ShapeDtypeStruct((B * H, Tkp, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    return (dq[:, :T].reshape(B, H, T, D),
+            dk[:, :Tk].reshape(B, H, Tk, D),
+            dv[:, :Tk].reshape(B, H, Tk, D))
 
 
 def flash_attention(q, k, v, scale=None, causal=False, block_q=128,
@@ -175,18 +351,27 @@ def _fused_attention(q, k, v, scale, causal, backend):
 
 
 def _fused_attention_fwd(q, k, v, scale, causal, backend):
-    return _fused_attention(q, k, v, scale, causal, backend), (q, k, v)
+    if backend == "xla":
+        out = _attention_reference(q, k, v, scale, causal)
+        return out, (q, k, v, None, None)
+    out, lse = _flash_attention_pallas(
+        q, k, v, scale, causal, 128, 128,
+        interpret=(backend == "pallas_interpret"), with_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _fused_attention_bwd(scale, causal, backend, res, g):
-    # Backward recomputes through the composite (flash-backward kernel is a
-    # follow-up): forward memory stays O(T), backward pays the [T,T] scores
-    # once — same trade as jax.checkpoint'ing the composite.
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _attention_reference(q_, k_, v_, scale, causal),
-        q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    if backend == "xla":
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _attention_reference(q_, k_, v_, scale,
+                                                    causal), q, k, v)
+        return vjp(g)
+    # flash backward: recompute P tiles from (q, k, lse) in VMEM — the
+    # [T, T] score matrix never exists in HBM in either direction
+    return _flash_attention_bwd_pallas(
+        q, k, v, o, lse, g, scale, causal, 128, 128,
+        interpret=(backend == "pallas_interpret"))
 
 
 _fused_attention.defvjp(_fused_attention_fwd, _fused_attention_bwd)

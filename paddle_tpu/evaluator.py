@@ -1,0 +1,9 @@
+"""≙ reference python/paddle/fluid/evaluator.py — the deprecated Evaluator
+aliases the reference kept for compatibility; real implementations live in
+paddle_tpu.metrics."""
+
+from .metrics import (Accuracy, Auc, ChunkEvaluator,  # noqa: F401
+                      DetectionMAP, EditDistance, Precision, Recall)
+
+__all__ = ["Accuracy", "Auc", "ChunkEvaluator", "DetectionMAP",
+           "EditDistance", "Precision", "Recall"]

@@ -1,6 +1,6 @@
 """fluid.layers-equivalent namespace (≙ reference python/paddle/fluid/layers/)."""
 
-from . import (control_flow, detection, io,  # noqa: F401
+from . import (control_flow, detection, device, io,  # noqa: F401
                learning_rate_scheduler, math_ops, nn, ops, sequence, tensor)
 from .learning_rate_scheduler import (autoincreased_step_counter,  # noqa: F401
                                       cosine_decay, exponential_decay,
@@ -10,6 +10,7 @@ from .learning_rate_scheduler import (autoincreased_step_counter,  # noqa: F401
 from .control_flow import (DynamicRNN, IfElse, StaticRNN, Switch,  # noqa: F401
                            While, cond, equal, greater_equal, greater_than,
                            increment, less_equal, less_than, not_equal)
+from .device import get_places  # noqa: F401
 from .io import data  # noqa: F401
 from .sequence import (chunk_eval, crf_decoding,  # noqa: F401
                        ctc_greedy_decoder, dynamic_gru, dynamic_lstm,

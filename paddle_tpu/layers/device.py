@@ -1,0 +1,25 @@
+"""Device layer helpers (≙ reference python/paddle/fluid/layers/device.py)."""
+
+from __future__ import annotations
+
+from ..core import places as _places
+from ..core.places import CPUPlace, TPUPlace
+
+
+def get_places(device_count=None, device_type=None):
+    """≙ reference layers.device.get_places (used by ParallelDo-era code):
+    list the visible device Places. Multi-device execution goes through
+    ParallelExecutor/pjit; this exists for API parity and introspection.
+
+    device_type: None (all), "CPU", or "TPU"/"GPU" (accelerators)."""
+    kind = None
+    if device_type == "CPU":
+        kind = "cpu"
+    elif device_type in ("GPU", "TPU"):
+        kind = "tpu"
+    devs = _places.devices(kind)   # handles platform aliases (axon -> tpu)
+    if device_count:
+        devs = devs[:device_count]
+    tpu_aliases = _places._KIND_ALIASES.get("tpu", ("tpu",))
+    return [TPUPlace(d.id) if d.platform in tpu_aliases else CPUPlace(d.id)
+            for d in devs]

@@ -105,9 +105,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
     def _finalize():
         o_ref[0] = (acc_ref[:] /
                     jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
-        # logsumexp per query row — the backward kernels' residual
-        lse_ref[0] = (m_ref[:] +
-                      jnp.log(jnp.maximum(l_ref[:], 1e-30)))[:, 0]
+        if lse_ref is not None:
+            # logsumexp per query row — the backward kernels' residual
+            lse_ref[0] = (m_ref[:] +
+                          jnp.log(jnp.maximum(l_ref[:], 1e-30)))[:, 0]
 
 
 def _pad_to(x, axis, target):
@@ -137,7 +138,17 @@ def _flash_attention_pallas(q, k, v, scale, causal, block_q, block_k,
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
         num_k_blocks=nk, causal_offset=Tk - T, true_tk=Tk)
-    out, lse = pl.pallas_call(
+    out_specs = [pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((B * H, Tp, D), q.dtype)]
+    if with_lse:
+        out_specs.append(pl.BlockSpec((1, bq), lambda b, i, j: (b, i)))
+        out_shape.append(jax.ShapeDtypeStruct((B * H, Tp), jnp.float32))
+    else:
+        # inference path: don't compute/write the residual it won't use
+        def kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                   _k=kernel):
+            _k(q_ref, k_ref, v_ref, o_ref, None, m_ref, l_ref, acc_ref)
+    res = pl.pallas_call(
         kernel,
         grid=(B * H, nq, nk),
         in_specs=[
@@ -145,14 +156,8 @@ def _flash_attention_pallas(q, k, v, scale, causal, block_q, block_k,
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B * H, Tp, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Tp), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -160,9 +165,9 @@ def _flash_attention_pallas(q, k, v, scale, causal, block_q, block_k,
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    out = out[:, :T].reshape(B, H, T, D)
+    out = res[0][:, :T].reshape(B, H, T, D)
     if with_lse:
-        return out, lse[:, :T].reshape(B, H, T)
+        return out, res[1][:, :T].reshape(B, H, T)
     return out
 
 

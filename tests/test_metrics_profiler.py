@@ -258,3 +258,28 @@ def test_memory_usage_estimate(rng):
     m2 = memory_usage(batch_size=64)
     assert m2["activations"] > m["activations"]
     assert "state" in m["summary"]
+
+
+def test_weighted_average_and_evaluator_aliases():
+    """≙ reference average.py + evaluator.py surfaces."""
+    import pytest as _pytest
+    from paddle_tpu.average import WeightedAverage
+    from paddle_tpu import evaluator
+
+    w = WeightedAverage()
+    with _pytest.raises(Exception):
+        w.eval()
+    w.add(1.0, weight=1)
+    w.add(3.0, weight=3)
+    assert abs(w.eval() - 2.5) < 1e-9
+    w.reset()
+    w.add(5.0)
+    assert w.eval() == 5.0
+    assert evaluator.ChunkEvaluator is not None
+
+
+def test_get_places_lists_devices():
+    from paddle_tpu.layers import get_places
+    places = get_places()
+    assert len(places) == 8  # the virtual CPU mesh
+    assert get_places(device_count=2) == places[:2]

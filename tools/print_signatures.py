@@ -33,6 +33,8 @@ PUBLIC_MODULES = [
     "paddle_tpu.regularizer",
     "paddle_tpu.clip",
     "paddle_tpu.metrics",
+    "paddle_tpu.average",
+    "paddle_tpu.evaluator",
     "paddle_tpu.io",
     "paddle_tpu.profiler",
     "paddle_tpu.trainer",

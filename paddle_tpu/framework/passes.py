@@ -163,3 +163,67 @@ class Analyzer:
             attrs = self.pass_attrs.get(name, {})
             program = get_pass(name, **attrs)(program, scope)
         return program
+
+
+@register_pass("check_pass")
+class CheckPass(Pass):
+    """Validate program well-formedness before execution (≙ the
+    multi_devices_check_pass + ir::HasCircle asserts the reference applies
+    at parallel_executor.cc:91 / multi_devices_graph_pass.cc:465): every op
+    input must be produced by an earlier op, fed (is_data), persistable, or
+    a recognized companion var. Raises with the full violation list."""
+
+    allowed_attrs = ("extra_feeds",)
+
+    def apply(self, program, scope=None):
+        extra = set(self.attrs.get("extra_feeds", ()))
+        problems = []
+
+        # Sub-block binder names: a control-flow op (while/static_rnn/
+        # cond_block/...) binds inner vars (step views, carried memories,
+        # captures) at lowering time via string/string-list attrs; those
+        # names are defined inside the block the op references.
+        bound: dict = {}
+        for blk in program.blocks:
+            for op in blk.ops:
+                sub_idxs = [v for v in op.attrs.values()
+                            if isinstance(v, int) and not isinstance(v, bool)
+                            and 0 < v < len(program.blocks)]
+                if not sub_idxs:
+                    continue
+                names = set()
+                for v in op.attrs.values():
+                    if isinstance(v, str):
+                        names.add(v)
+                    elif isinstance(v, (list, tuple)) and \
+                            all(isinstance(x, str) for x in v):
+                        names.update(v)
+                for si in sub_idxs:
+                    bound.setdefault(si, set()).update(names)
+
+        for block in program.blocks:
+            defined = set(extra) | bound.get(block.idx, set())
+            for name, var in block.vars.items():
+                if (getattr(var, "persistable", False)
+                        or getattr(var, "is_data", False)):
+                    defined.add(name)
+                    defined.add(name + "@SEQLEN")
+            # parent-block vars are visible in sub-blocks
+            b = block
+            while b.parent is not None:
+                b = b.parent
+                defined |= set(b.vars)
+            for idx, op in enumerate(block.ops):
+                for name in op.input_names():
+                    if name not in defined:
+                        problems.append(
+                            f"block {block.idx} op#{idx} {op.type!r} reads "
+                            f"{name!r} before any producer/feed")
+                # vjp_region declares Grads/LossGrad outputs like any op;
+                # registering them keeps later grad reads honest without a
+                # blanket @GRAD exemption
+                defined.update(op.output_names())
+        if problems:
+            raise NotFoundError(
+                "program check failed:\n  " + "\n  ".join(problems))
+        return program

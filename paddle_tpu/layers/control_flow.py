@@ -493,3 +493,71 @@ def equal(x, y, cond=None):
 
 def not_equal(x, y, cond=None):
     return _compare("not_equal", x, y, cond)
+
+
+# ---------------------------------------------------------------------------
+# Tensor arrays (≙ reference layers/control_flow.py array ops :741-1148:
+# create_array / array_write / array_read / array_length over
+# LoDTensorArray). Static-shape translation: an "array" is a preallocated
+# [max_len, ...] dense var; writes are functional index updates. The
+# reference's dynamically-growing arrays need an interpreting executor;
+# under XLA the capacity is declared up front.
+# ---------------------------------------------------------------------------
+
+def create_array(dtype, initial_value=0.0, max_len=None, shape=None,
+                 name=None):
+    """Preallocate a [max_len, *shape] array var (≙ create_array; the extra
+    max_len/shape args are the static-shape contract)."""
+    from ..layer_helper import LayerHelper
+    enforce(max_len is not None and shape is not None,
+            "create_array on TPU needs static max_len and element shape",
+            exc=InvalidArgumentError)
+    enforce(all(int(d) > 0 for d in shape),
+            "create_array element shape must be fully static (no -1): "
+            "preallocated arrays cannot defer dims to feed time",
+            exc=InvalidArgumentError)
+    helper = LayerHelper("create_array", name=name)
+    out = helper.create_tmp_variable(dtype=dtype,
+                                     shape=[int(max_len)] + list(shape))
+    helper.append_op(type="fill_constant", inputs={},
+                     outputs={"Out": [out]},
+                     attrs={"shape": [int(max_len)] + list(shape),
+                            "dtype": dtype, "value": float(initial_value)})
+    return out
+
+
+def array_write(x, i, array):
+    """Functional write: returns the UPDATED array var (≙ array_write;
+    callers thread the returned var, matching the functional executor)."""
+    from ..core.dtypes import dtype_name
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("array_write")
+    out = helper.create_tmp_variable(dtype=dtype_name(array.dtype),
+                                     shape=list(array.shape))
+    helper.append_op(type="array_write",
+                     inputs={"Array": [array], "X": [x], "I": [i]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def array_read(array, i):
+    """≙ array_read: the element at index i."""
+    from ..core.dtypes import dtype_name
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("array_read")
+    out = helper.create_tmp_variable(dtype=dtype_name(array.dtype),
+                                     shape=list(array.shape[1:]))
+    helper.append_op(type="array_read",
+                     inputs={"Array": [array], "I": [i]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def array_length(array):
+    """≙ array_length: the (static) capacity of the array."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("array_length")
+    out = helper.create_tmp_variable(dtype="int64", shape=[])
+    helper.append_op(type="array_length", inputs={"X": [array]},
+                     outputs={"Out": [out]})
+    return out

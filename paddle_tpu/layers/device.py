@@ -16,10 +16,20 @@ def get_places(device_count=None, device_type=None):
     if device_type == "CPU":
         kind = "cpu"
     elif device_type in ("GPU", "TPU"):
-        kind = "tpu"
+        kind = "tpu"   # "GPU" means "the accelerators" in reference code
     devs = _places.devices(kind)   # handles platform aliases (axon -> tpu)
     if device_count:
         devs = devs[:device_count]
-    tpu_aliases = _places._KIND_ALIASES.get("tpu", ("tpu",))
-    return [TPUPlace(d.id) if d.platform in tpu_aliases else CPUPlace(d.id)
-            for d in devs]
+    # device_id is the KIND-LOCAL index (what place_to_device expects),
+    # not jax's global id; accelerator = anything that is not host cpu
+    cpu_i = 0
+    acc_i = 0
+    out = []
+    for d in devs:
+        if d.platform == "cpu":
+            out.append(CPUPlace(cpu_i))
+            cpu_i += 1
+        else:
+            out.append(TPUPlace(acc_i))
+            acc_i += 1
+    return out

@@ -287,3 +287,31 @@ def _piecewise_decay(ctx, ins, attrs):
     values = jnp.asarray(attrs["values"], dtype=jnp.float32)
     idx = jnp.searchsorted(boundaries, step.reshape(()), side="right")
     return {"Out": [values[idx].reshape(1)]}
+
+
+@register_op("array_write")
+def _array_write(ctx, ins, attrs):
+    """≙ tensor_array_read_write.cc WriteToArray: functional index write
+    into a preallocated [max_len, ...] array (the static-shape translation
+    of the reference's dynamically-growing LoDTensorArray)."""
+    arr = ins["Array"][0]
+    x = ins["X"][0]
+    i = ins["I"][0].reshape(()).astype(jnp.int32)
+    return {"Out": [jax.lax.dynamic_update_index_in_dim(
+        arr, x.astype(arr.dtype), i, axis=0)]}
+
+
+@register_op("array_read")
+def _array_read(ctx, ins, attrs):
+    """≙ ReadFromArray: dynamic index read."""
+    arr = ins["Array"][0]
+    i = ins["I"][0].reshape(()).astype(jnp.int32)
+    return {"Out": [jax.lax.dynamic_index_in_dim(arr, i, axis=0,
+                                                 keepdims=False)]}
+
+
+@register_op("array_length", stop_gradient=True)
+def _array_length(ctx, ins, attrs):
+    """≙ lod_array_length_op: the array's capacity (static translation —
+    preallocated arrays have fixed leading extent)."""
+    return {"Out": [jnp.asarray(ins["X"][0].shape[0], jnp.int64)]}

@@ -172,3 +172,86 @@ def test_static_rnn_gradients_reach_cell_params(rng):
                       "h0": np.zeros((2, 8), "float32")},
                 fetch_list=["reg_cell.w_0@GRAD", "reg_cell.w_1@GRAD"])
     assert np.abs(g[0]).max() > 0 and np.abs(g[1]).max() > 0
+
+
+class TestTensorArrays:
+    def test_write_read_roundtrip(self, rng):
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.layers.control_flow import (array_length, array_read,
+                                                    array_write,
+                                                    create_array)
+        x = layers.data("x", shape=[4])
+        arr = create_array("float32", max_len=3, shape=[2, 4])
+        # functional threading: each write returns the new array
+        i0 = layers.fill_constant([], "int64", 0)
+        i1 = layers.fill_constant([], "int64", 1)
+        arr = array_write(x, i0, arr)
+        arr = array_write(x * 2.0, i1, arr)
+        got0 = array_read(arr, i0)
+        got1 = array_read(arr, i1)
+        n = array_length(arr)
+        exe = pt.Executor()
+        xv = rng.rand(2, 4).astype("float32")
+        a, b, ln = exe.run(feed={"x": xv}, fetch_list=[got0, got1, n])
+        np.testing.assert_allclose(a, xv, rtol=1e-6)
+        np.testing.assert_allclose(b, xv * 2, rtol=1e-6)
+        assert ln == 3
+
+
+class TestCheckPass:
+    def test_clean_program_passes(self):
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        x = layers.data("x", shape=[4])
+        layers.fc(x, size=2)
+        pt.get_pass("check_pass")(pt.default_main_program())
+
+    def test_undefined_read_reported(self):
+        import paddle_tpu as pt
+        from paddle_tpu.core.enforce import NotFoundError
+        prog = pt.Program()
+        blk = prog.global_block()
+        blk.create_var(name="ghost_in", shape=[2], dtype="float32")
+        blk.create_var(name="out", shape=[2], dtype="float32")
+        blk.append_op(type="relu", inputs={"X": ["ghost_in"]},
+                      outputs={"Out": ["out"]})
+        with pytest.raises(NotFoundError, match="ghost_in"):
+            pt.get_pass("check_pass")(prog)
+
+
+def test_check_pass_accepts_static_rnn_programs(rng):
+    """Regression: scan-bound sub-block vars (step inputs, memories) are
+    binder-defined, not op-produced — check_pass must accept them."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    x = layers.data("x", shape=[4, 8])
+    h0 = layers.data("h0", shape=[8])
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        xt = rnn.step_input(x)
+        h = rnn.memory(init=h0)
+        nh = layers.fc(layers.concat([xt, h], axis=1), size=8, act="tanh")
+        rnn.update_memory(h, nh)
+        rnn.step_output(nh)
+    loss = layers.mean(rnn())
+    pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    pt.get_pass("check_pass")(pt.default_main_program())
+
+
+def test_check_pass_catches_grad_read_without_backward():
+    """An optimizer op reading w@GRAD with no vjp_region producing it must
+    be reported (no blanket @GRAD exemption)."""
+    import paddle_tpu as pt
+    from paddle_tpu.core.enforce import NotFoundError
+    prog = pt.Program()
+    blk = prog.global_block()
+    blk.create_parameter(name="w", shape=[4], dtype="float32")
+    blk.create_var(name="w@GRAD", shape=[4], dtype="float32")
+    blk.create_var(name="lr", shape=[], dtype="float32", persistable=True)
+    blk.append_op(type="sgd",
+                  inputs={"Param": ["w"], "Grad": ["w@GRAD"],
+                          "LearningRate": ["lr"]},
+                  outputs={"ParamOut": ["w"]})
+    with pytest.raises(NotFoundError, match="w@GRAD"):
+        pt.get_pass("check_pass")(prog)

@@ -19,6 +19,7 @@ from .framework.program import (Program, Variable, default_main_program,  # noqa
                                 reset_default_programs)
 from .framework.registry import registered_ops  # noqa: F401
 from .framework.scope import Scope, global_scope, reset_global_scope  # noqa: F401
+from .framework.selected_rows import SelectedRows  # noqa: F401
 from .framework.passes import (Analyzer, Pass, get_pass,  # noqa: F401
                                register_pass, registered_passes)
 from .param_attr import ParamAttr  # noqa: F401

@@ -183,12 +183,21 @@ class CheckPass(Pass):
         # cond_block/...) binds inner vars (step views, carried memories,
         # captures) at lowering time via string/string-list attrs; those
         # names are defined inside the block the op references.
+        # control-flow ops store sub-block references under these attr
+        # keys (while/static_rnn/cond_block/switch_case); binder names are
+        # the string/string-list attrs of THAT op only
+        _SUB_KEYS = ("sub_block", "true_block", "false_block",
+                     "case_blocks", "default_block")
         bound: dict = {}
         for blk in program.blocks:
             for op in blk.ops:
-                sub_idxs = [v for v in op.attrs.values()
-                            if isinstance(v, int) and not isinstance(v, bool)
-                            and 0 < v < len(program.blocks)]
+                sub_idxs = []
+                for key in _SUB_KEYS:
+                    v = op.attrs.get(key)
+                    if isinstance(v, int) and not isinstance(v, bool):
+                        sub_idxs.append(v)
+                    elif isinstance(v, (list, tuple)):
+                        sub_idxs.extend(x for x in v if isinstance(x, int))
                 if not sub_idxs:
                     continue
                 names = set()
@@ -199,7 +208,8 @@ class CheckPass(Pass):
                             all(isinstance(x, str) for x in v):
                         names.update(v)
                 for si in sub_idxs:
-                    bound.setdefault(si, set()).update(names)
+                    if 0 < si < len(program.blocks):
+                        bound.setdefault(si, set()).update(names)
 
         for block in program.blocks:
             defined = set(extra) | bound.get(block.idx, set())

@@ -1,0 +1,73 @@
+"""SelectedRows: sparse row-slice representation.
+
+≙ reference framework/selected_rows.h:32 — {rows, value tensor, height},
+the reference's sparse-gradient carrier (embedding grads, sparse optimizer
+updates, pserver row dispatch). TPU translation: under XLA, embedding
+gradients are produced by scatter-add in the VJP and arrive dense, so
+SelectedRows is NOT the autodiff carrier here; it is the host-side exchange
+format for the sharded-embedding/parameter-service path (which rows moved,
+their values) and for row-sparse checkpoint deltas. Ops split_ids /
+merge_ids / split_selected_rows / lookup_sparse_table operate on the same
+shapes the reference's pserver helpers do.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..core.enforce import InvalidArgumentError, enforce
+
+
+class SelectedRows:
+    """{rows, value, height} sparse row set (≙ selected_rows.h:32)."""
+
+    def __init__(self, rows: Sequence[int], value, height: int):
+        rows = np.asarray(rows, dtype=np.int64)
+        value = np.asarray(value)
+        enforce(rows.ndim == 1, "rows must be 1-D",
+                exc=InvalidArgumentError)
+        enforce(value.shape[0] == rows.shape[0],
+                f"value rows {value.shape[0]} != len(rows) {rows.shape[0]}",
+                exc=InvalidArgumentError)
+        enforce(height >= 0, "height must be >= 0",
+                exc=InvalidArgumentError)
+        if rows.size:
+            enforce(int(rows.min()) >= 0 and int(rows.max()) < height,
+                    f"rows must lie in [0, {height}); got "
+                    f"[{rows.min()}, {rows.max()}]",
+                    exc=InvalidArgumentError)
+        self.rows = rows
+        self.value = value
+        self.height = int(height)
+
+    def to_dense(self) -> np.ndarray:
+        """Materialize [height, width] with duplicate rows summed
+        (≙ math::scatter::MergeAdd)."""
+        out = np.zeros((self.height,) + self.value.shape[1:],
+                       dtype=self.value.dtype)
+        np.add.at(out, self.rows, self.value)
+        return out
+
+    @staticmethod
+    def from_dense(dense: np.ndarray, nonzero_only: bool = True):
+        dense = np.asarray(dense)
+        if nonzero_only:
+            mask = np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1)
+            rows = np.nonzero(mask)[0]
+        else:
+            rows = np.arange(dense.shape[0])
+        return SelectedRows(rows, dense[rows], dense.shape[0])
+
+    def merge_add(self) -> "SelectedRows":
+        """Coalesce duplicate rows (≙ MergeAdd) keeping sparsity."""
+        uniq, inv = np.unique(self.rows, return_inverse=True)
+        val = np.zeros((uniq.shape[0],) + self.value.shape[1:],
+                       dtype=self.value.dtype)
+        np.add.at(val, inv, self.value)
+        return SelectedRows(uniq, val, self.height)
+
+    def __repr__(self):
+        return (f"SelectedRows(rows={self.rows.tolist()}, "
+                f"height={self.height}, value.shape={self.value.shape})")

@@ -512,6 +512,8 @@ def create_array(dtype, initial_value=0.0, max_len=None, shape=None,
     enforce(max_len is not None and shape is not None,
             "create_array on TPU needs static max_len and element shape",
             exc=InvalidArgumentError)
+    enforce(int(max_len) > 0, "create_array needs max_len >= 1",
+            exc=InvalidArgumentError)
     enforce(all(int(d) > 0 for d in shape),
             "create_array element shape must be fully static (no -1): "
             "preallocated arrays cannot defer dims to feed time",

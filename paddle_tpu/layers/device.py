@@ -3,7 +3,14 @@
 from __future__ import annotations
 
 from ..core import places as _places
-from ..core.places import CPUPlace, TPUPlace
+from ..core.places import Place
+
+
+def _kind_of(platform: str) -> str:
+    for kind, aliases in _places._KIND_ALIASES.items():
+        if platform in aliases:
+            return kind
+    return platform
 
 
 def get_places(device_count=None, device_type=None):
@@ -21,15 +28,12 @@ def get_places(device_count=None, device_type=None):
     if device_count:
         devs = devs[:device_count]
     # device_id is the KIND-LOCAL index (what place_to_device expects),
-    # not jax's global id; accelerator = anything that is not host cpu
-    cpu_i = 0
-    acc_i = 0
+    # paired with the device's ACTUAL kind so the place resolves back
+    counters: dict = {}
     out = []
     for d in devs:
-        if d.platform == "cpu":
-            out.append(CPUPlace(cpu_i))
-            cpu_i += 1
-        else:
-            out.append(TPUPlace(acc_i))
-            acc_i += 1
+        k = _kind_of(d.platform)
+        i = counters.get(k, 0)
+        counters[k] = i + 1
+        out.append(Place(k, i))
     return out

@@ -289,23 +289,46 @@ def _piecewise_decay(ctx, ins, attrs):
     return {"Out": [values[idx].reshape(1)]}
 
 
+def _array_bounds_guard(i, cap, what):
+    """XLA clamps out-of-range dynamic indices; under the debug flag
+    (PTPU_CHECK_NAN_INF — the framework's runtime-guards mode) report them
+    instead of silently reading/writing the last slot."""
+    from ..core import flags as _flags
+    if not _flags.get_flag("check_nan_inf"):
+        return
+    bad = (i < 0) | (i >= cap)
+
+    def _report(bad_flag, i_val, what=what, cap=cap):
+        if bool(bad_flag):
+            raise IndexError(
+                f"{what} index {int(i_val)} outside preallocated "
+                f"capacity {cap}")
+
+    jax.debug.callback(_report, bad, i)
+
+
 @register_op("array_write")
 def _array_write(ctx, ins, attrs):
     """≙ tensor_array_read_write.cc WriteToArray: functional index write
     into a preallocated [max_len, ...] array (the static-shape translation
-    of the reference's dynamically-growing LoDTensorArray)."""
+    of the reference's dynamically-growing LoDTensorArray). NOTE: XLA
+    clamps an out-of-range index to the last slot; enable the
+    check_nan_inf debug flag to fail loudly instead."""
     arr = ins["Array"][0]
     x = ins["X"][0]
     i = ins["I"][0].reshape(()).astype(jnp.int32)
+    _array_bounds_guard(i, arr.shape[0], "array_write")
     return {"Out": [jax.lax.dynamic_update_index_in_dim(
         arr, x.astype(arr.dtype), i, axis=0)]}
 
 
 @register_op("array_read")
 def _array_read(ctx, ins, attrs):
-    """≙ ReadFromArray: dynamic index read."""
+    """≙ ReadFromArray: dynamic index read (same clamping caveat as
+    array_write; debug flag reports out-of-range)."""
     arr = ins["Array"][0]
     i = ins["I"][0].reshape(()).astype(jnp.int32)
+    _array_bounds_guard(i, arr.shape[0], "array_read")
     return {"Out": [jax.lax.dynamic_index_in_dim(arr, i, axis=0,
                                                  keepdims=False)]}
 
@@ -315,3 +338,63 @@ def _array_length(ctx, ins, attrs):
     """≙ lod_array_length_op: the array's capacity (static translation —
     preallocated arrays have fixed leading extent)."""
     return {"Out": [jnp.asarray(ins["X"][0].shape[0], jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# sparse/dist helpers (≙ split_ids_op / merge_ids_op /
+# lookup_sparse_table_op / split_selected_rows_op — the pserver row-dispatch
+# family, SURVEY.md §2.2 "Sparse/dist helpers"). Static-shape translation:
+# shard membership is a mask, outputs are padded to the input length with
+# sentinel -1 ids and zero rows; counts come back alongside.
+# ---------------------------------------------------------------------------
+
+@register_op("split_ids", stop_gradient=True)
+def _split_ids(ctx, ins, attrs):
+    """Partition ids across `num_shards` by modulo (the reference's hash
+    dispatch). Out: one [N] padded id tensor per shard + [num_shards]
+    counts; order within a shard is preserved."""
+    ids = ins["Ids"][0].reshape(-1).astype(jnp.int64)
+    n = attrs["num_shards"]
+    outs, counts = [], []
+    for s in range(n):
+        mask = (ids % n) == s
+        cnt = jnp.sum(mask.astype(jnp.int32))
+        pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+        scatter_pos = jnp.where(mask, pos, ids.shape[0])
+        buf = jnp.full((ids.shape[0] + 1,), -1, jnp.int64)
+        buf = buf.at[scatter_pos].set(ids)
+        outs.append(buf[:-1])
+        counts.append(cnt)
+    return {"Out": outs, "Count": [jnp.stack(counts)]}
+
+
+@register_op("merge_ids", stop_gradient=True)
+def _merge_ids(ctx, ins, attrs):
+    """≙ merge_ids_op: route per-shard row values back to the original id
+    order. Ids [N] (the original query), per-shard padded ids + rows as
+    produced by split_ids + a sharded lookup."""
+    ids = ins["Ids"][0].reshape(-1).astype(jnp.int64)
+    shard_ids = ins["X"]            # list of [N] padded id tensors
+    shard_rows = ins["Rows"]        # list of [N, D] row values
+    n = len(shard_ids)
+    d = shard_rows[0].shape[-1]
+    out = jnp.zeros((ids.shape[0], d), shard_rows[0].dtype)
+    for s in range(n):
+        mask = (ids % n) == s
+        pos = jnp.cumsum(mask.astype(jnp.int32)) - 1   # index into shard
+        gathered = shard_rows[s][jnp.maximum(pos, 0)]
+        out = jnp.where(mask[:, None], gathered, out)
+    return {"Out": [out]}
+
+
+@register_op("lookup_sparse_table", stop_gradient=True)
+def _lookup_sparse_table(ctx, ins, attrs):
+    """≙ lookup_sparse_table_op: gather rows by id from a table shard;
+    padded (-1) ids yield zero rows (the reference auto-grows unseen rows —
+    static translation returns the init value 0)."""
+    w = ins["W"][0]
+    ids = ins["Ids"][0].reshape(-1).astype(jnp.int64)
+    valid = ids >= 0
+    safe = jnp.where(valid, ids, 0)
+    rows = w[safe]
+    return {"Out": [jnp.where(valid[:, None], rows, 0.0)]}

@@ -186,3 +186,60 @@ class TestManip:
         ids = np.array([[1], [5], [19]], dtype=np.int32)
         out = run_op("lookup_table", {"W": w, "Ids": ids}, {})
         np.testing.assert_allclose(out["Out"][0], w[[1, 5, 19]])
+
+
+class TestSelectedRowsAndDistHelpers:
+    """≙ reference selected_rows.h + split_ids/merge_ids/
+    lookup_sparse_table pserver helpers (test_split_ids_op.py etc.)."""
+
+    def test_selected_rows_dense_roundtrip(self, rng):
+        from paddle_tpu import SelectedRows
+        dense = np.zeros((6, 3), "float32")
+        dense[1] = rng.rand(3)
+        dense[4] = rng.rand(3)
+        sr = SelectedRows.from_dense(dense)
+        assert sorted(sr.rows.tolist()) == [1, 4]
+        np.testing.assert_allclose(sr.to_dense(), dense)
+
+    def test_selected_rows_merge_add(self, rng):
+        from paddle_tpu import SelectedRows
+        sr = SelectedRows([2, 0, 2], rng.rand(3, 4).astype("float32"), 5)
+        merged = sr.merge_add()
+        assert merged.rows.tolist() == [0, 2]
+        np.testing.assert_allclose(merged.to_dense(), sr.to_dense(),
+                                   rtol=1e-6)
+
+    def test_sharded_lookup_roundtrip(self, rng):
+        """The pserver prefetch flow: split ids by shard, look each shard
+        up in its own table slice, merge rows back into query order."""
+        from op_test import run_op
+        V, D, N, S = 12, 4, 7, 3
+        table = rng.rand(V, D).astype("float32")
+        ids = rng.randint(0, V, (N,)).astype("int64")
+
+        split = run_op("split_ids", {"Ids": ids},
+                       attrs={"num_shards": S})
+        shard_ids = split["Out"]
+        counts = split["Count"][0]
+        assert int(counts.sum()) == N
+        # each shard owns its modulo class
+        for s in range(S):
+            valid = shard_ids[s][shard_ids[s] >= 0]
+            assert all(v % S == s for v in valid.tolist())
+
+        rows = [run_op("lookup_sparse_table",
+                       {"W": table, "Ids": shard_ids[s]})["Out"][0]
+                for s in range(S)]
+        merged = run_op("merge_ids",
+                        {"Ids": ids, "X": list(shard_ids),
+                         "Rows": rows})["Out"][0]
+        np.testing.assert_allclose(merged, table[ids], rtol=1e-6)
+
+    def test_lookup_sparse_table_padded_ids_zero(self, rng):
+        from op_test import run_op
+        table = rng.rand(5, 3).astype("float32")
+        ids = np.array([2, -1, 4], dtype="int64")
+        out = run_op("lookup_sparse_table",
+                     {"W": table, "Ids": ids})["Out"][0]
+        np.testing.assert_allclose(out[0], table[2], rtol=1e-6)
+        np.testing.assert_array_equal(out[1], 0)

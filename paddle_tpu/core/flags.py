@@ -96,6 +96,9 @@ define_int("vlog", 0, "Verbose logging level (≙ glog VLOG).")
 define_bool("use_bf16_matmul", True,
             "Prefer bfloat16 MXU matmul precision where layers opt in.")
 define_string("jit_cache", "", "Persistent XLA compilation cache directory.")
+define_bool("disable_pallas", False,
+            "Force XLA-composite lowerings for ops that default to Pallas "
+            "kernels on TPU (escape hatch: PTPU_DISABLE_PALLAS=1).")
 define_int("num_iteration_per_drop_scope", 1,
            "Iterations between temporary-scope cleanups "
            "(≙ ExecutionStrategy::num_iteration_per_drop_scope_).")

@@ -56,14 +56,17 @@ def device_count(kind: Optional[str] = None) -> int:
     return len(devices(kind))
 
 
-def default_place() -> Place:
-    """Best available backend: TPU > GPU > CPU."""
-    devs = jax.devices()
-    platform = devs[0].platform
+def kind_of(platform: str) -> str:
+    """Resolve a jax platform name to its place kind (axon -> tpu etc.)."""
     for kind, aliases in _KIND_ALIASES.items():
         if platform in aliases:
-            return Place(kind, 0)
-    return Place("cpu", 0)
+            return kind
+    return platform
+
+
+def default_place() -> Place:
+    """Best available backend: TPU > GPU > CPU."""
+    return Place(kind_of(jax.devices()[0].platform), 0)
 
 
 def place_to_device(place: Place) -> jax.Device:

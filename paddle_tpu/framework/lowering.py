@@ -68,7 +68,11 @@ def _scatter_outputs(op: Operator, outs: Dict[str, List[Any]],
 
 def _nan_guard(op_type: str, name: str, value):
     """Debug-mode NaN/Inf scan (≙ FLAGS_check_nan_inf + CheckTensorNANOrInf,
-    reference framework/operator.cc:651,726-736)."""
+    reference framework/operator.cc:651,726-736). Host callbacks are a
+    CPU-debug facility — the tunneled TPU backend has no host send/recv, so
+    the guard no-ops off-CPU (rerun under JAX_PLATFORMS=cpu to localize)."""
+    if jax.default_backend() != "cpu":
+        return
     bad = jnp.logical_not(jnp.all(jnp.isfinite(value)))
 
     def _report(bad_flag, op_type=op_type, name=name):

@@ -6,33 +6,25 @@ from ..core import places as _places
 from ..core.places import Place
 
 
-def _kind_of(platform: str) -> str:
-    for kind, aliases in _places._KIND_ALIASES.items():
-        if platform in aliases:
-            return kind
-    return platform
-
-
 def get_places(device_count=None, device_type=None):
     """≙ reference layers.device.get_places (used by ParallelDo-era code):
     list the visible device Places. Multi-device execution goes through
     ParallelExecutor/pjit; this exists for API parity and introspection.
 
-    device_type: None (all), "CPU", or "TPU"/"GPU" (accelerators)."""
-    kind = None
+    device_type: None (all), "CPU", or "TPU"/"GPU" (any accelerator)."""
+    devs = _places.devices()
     if device_type == "CPU":
-        kind = "cpu"
+        devs = [d for d in devs if d.platform == "cpu"]
     elif device_type in ("GPU", "TPU"):
-        kind = "tpu"   # "GPU" means "the accelerators" in reference code
-    devs = _places.devices(kind)   # handles platform aliases (axon -> tpu)
+        devs = [d for d in devs if d.platform != "cpu"]
     if device_count:
         devs = devs[:device_count]
     # device_id is the KIND-LOCAL index (what place_to_device expects),
-    # paired with the device's ACTUAL kind so the place resolves back
+    # paired with the device's real kind so the place resolves back
     counters: dict = {}
     out = []
     for d in devs:
-        k = _kind_of(d.platform)
+        k = _places.kind_of(d.platform)
         i = counters.get(k, 0)
         counters[k] = i + 1
         out.append(Place(k, i))

@@ -25,6 +25,9 @@ _NEG_INF = -1e30
 
 
 def _auto_backend():
+    from ..core import flags as _flags
+    if _flags.get_flag("disable_pallas"):
+        return "xla"
     return "pallas" if jax.default_backend() == "tpu" else "xla"
 
 

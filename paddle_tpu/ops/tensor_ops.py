@@ -292,9 +292,14 @@ def _piecewise_decay(ctx, ins, attrs):
 def _array_bounds_guard(i, cap, what):
     """XLA clamps out-of-range dynamic indices; under the debug flag
     (PTPU_CHECK_NAN_INF — the framework's runtime-guards mode) report them
-    instead of silently reading/writing the last slot."""
+    instead of silently reading/writing the last slot. Host callbacks are a
+    CPU-debug facility: the tunneled TPU backend has no host send/recv, so
+    the guard is a no-op there (run the repro under JAX_PLATFORMS=cpu)."""
     from ..core import flags as _flags
     if not _flags.get_flag("check_nan_inf"):
+        return
+    import jax as _jax
+    if _jax.default_backend() != "cpu":
         return
     bad = (i < 0) | (i >= cap)
 
@@ -353,7 +358,9 @@ def _split_ids(ctx, ins, attrs):
     """Partition ids across `num_shards` by modulo (the reference's hash
     dispatch). Out: one [N] padded id tensor per shard + [num_shards]
     counts; order within a shard is preserved."""
-    ids = ins["Ids"][0].reshape(-1).astype(jnp.int64)
+    # int32 id space (the framework runs without x64; ids >= 2**31 are
+    # outside the supported vocab range)
+    ids = ins["Ids"][0].reshape(-1).astype(jnp.int32)
     n = attrs["num_shards"]
     outs, counts = [], []
     for s in range(n):
@@ -361,7 +368,7 @@ def _split_ids(ctx, ins, attrs):
         cnt = jnp.sum(mask.astype(jnp.int32))
         pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
         scatter_pos = jnp.where(mask, pos, ids.shape[0])
-        buf = jnp.full((ids.shape[0] + 1,), -1, jnp.int64)
+        buf = jnp.full((ids.shape[0] + 1,), -1, jnp.int32)
         buf = buf.at[scatter_pos].set(ids)
         outs.append(buf[:-1])
         counts.append(cnt)
@@ -373,7 +380,7 @@ def _merge_ids(ctx, ins, attrs):
     """≙ merge_ids_op: route per-shard row values back to the original id
     order. Ids [N] (the original query), per-shard padded ids + rows as
     produced by split_ids + a sharded lookup."""
-    ids = ins["Ids"][0].reshape(-1).astype(jnp.int64)
+    ids = ins["Ids"][0].reshape(-1).astype(jnp.int32)
     shard_ids = ins["X"]            # list of [N] padded id tensors
     shard_rows = ins["Rows"]        # list of [N, D] row values
     n = len(shard_ids)
@@ -393,7 +400,7 @@ def _lookup_sparse_table(ctx, ins, attrs):
     padded (-1) ids yield zero rows (the reference auto-grows unseen rows —
     static translation returns the init value 0)."""
     w = ins["W"][0]
-    ids = ins["Ids"][0].reshape(-1).astype(jnp.int64)
+    ids = ins["Ids"][0].reshape(-1).astype(jnp.int32)
     valid = ids >= 0
     safe = jnp.where(valid, ids, 0)
     rows = w[safe]

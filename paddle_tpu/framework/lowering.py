@@ -72,6 +72,8 @@ def _nan_guard(op_type: str, name: str, value):
     CPU-debug facility — the tunneled TPU backend has no host send/recv, so
     the guard no-ops off-CPU (rerun under JAX_PLATFORMS=cpu to localize)."""
     if jax.default_backend() != "cpu":
+        from ..ops.tensor_ops import _warn_guards_inactive
+        _warn_guards_inactive()
         return
     bad = jnp.logical_not(jnp.all(jnp.isfinite(value)))
 

@@ -262,3 +262,44 @@ def load_inference_model(dirname: str,
     load_vars(executor, dirname, main_program=program, vars=persistables,
               filename=params_filename or PARAMS_COMBINED_FILE, scope=scope)
     return program, list(meta["feed_names"]), list(meta["fetch_names"])
+
+
+TRAIN_PROGRAM_FILE = "__train_program__"
+
+
+def save_program(dirname: str,
+                 main_program: Optional[Program] = None,
+                 startup_program: Optional[Program] = None,
+                 feed_names: Optional[Sequence[str]] = None,
+                 fetch_names: Optional[Sequence] = None):
+    """Serialize a TRAINING program pair (main + startup) so a driver with
+    no model-building code can train it (≙ the reference's C++ demo
+    trainer input: a saved ProgramDesc consumed by train/demo/
+    demo_trainer.cc:55-80). Parameters are NOT saved — the startup program
+    initializes them, exactly as in the reference demo."""
+    from .framework.program import default_startup_program
+    main_program = main_program or default_main_program()
+    startup_program = startup_program or default_startup_program()
+    os.makedirs(dirname, exist_ok=True)
+    meta = {
+        "main_program": json.loads(main_program.to_json()),
+        "startup_program": json.loads(startup_program.to_json()),
+        "feed_names": list(feed_names or []),
+        "fetch_names": [f.name if isinstance(f, Variable) else f
+                        for f in (fetch_names or [])],
+    }
+    with open(os.path.join(dirname, TRAIN_PROGRAM_FILE), "w") as f:
+        json.dump(meta, f)
+
+
+def load_program(dirname: str):
+    """Load a program pair saved by save_program. Returns
+    (main_program, startup_program, feed_names, fetch_names)."""
+    path = os.path.join(dirname, TRAIN_PROGRAM_FILE)
+    if not os.path.exists(path):
+        raise NotFoundError(f"no saved training program at {path}")
+    with open(path) as f:
+        meta = json.load(f)
+    return (Program.from_json(json.dumps(meta["main_program"])),
+            Program.from_json(json.dumps(meta["startup_program"])),
+            meta["feed_names"], meta["fetch_names"])

@@ -289,6 +289,30 @@ def _piecewise_decay(ctx, ins, attrs):
     return {"Out": [values[idx].reshape(1)]}
 
 
+_guards_warned = []
+
+
+def _warn_guards_inactive():
+    if not _guards_warned:
+        import warnings
+        warnings.warn(
+            "check_nan_inf runtime guards are a CPU-debug facility; they "
+            "are INACTIVE on this backend (no host callbacks). Rerun under "
+            "JAX_PLATFORMS=cpu to localize the failure.")
+        _guards_warned.append(True)
+
+
+def _as_id32(ids):
+    """Ids live in the int32 space (the framework runs without x64). Under
+    jax_enable_x64 an id beyond int32 range is mapped to the INVALID
+    sentinel (negative) instead of silently wrapping into someone else's
+    row: lookups return zero rows and dispatch routes it to the padded
+    class, so corruption is visible rather than plausible."""
+    if ids.dtype == jnp.int64:   # only possible with x64 enabled
+        ids = jnp.where(jnp.abs(ids) > 2**31 - 1, -(2**31 - 1), ids)
+    return ids.astype(jnp.int32)
+
+
 def _array_bounds_guard(i, cap, what):
     """XLA clamps out-of-range dynamic indices; under the debug flag
     (PTPU_CHECK_NAN_INF — the framework's runtime-guards mode) report them
@@ -298,8 +322,8 @@ def _array_bounds_guard(i, cap, what):
     from ..core import flags as _flags
     if not _flags.get_flag("check_nan_inf"):
         return
-    import jax as _jax
-    if _jax.default_backend() != "cpu":
+    if jax.default_backend() != "cpu":
+        _warn_guards_inactive()
         return
     bad = (i < 0) | (i >= cap)
 
@@ -360,7 +384,7 @@ def _split_ids(ctx, ins, attrs):
     counts; order within a shard is preserved."""
     # int32 id space (the framework runs without x64; ids >= 2**31 are
     # outside the supported vocab range)
-    ids = ins["Ids"][0].reshape(-1).astype(jnp.int32)
+    ids = _as_id32(ins["Ids"][0].reshape(-1))
     n = attrs["num_shards"]
     outs, counts = [], []
     for s in range(n):
@@ -380,7 +404,7 @@ def _merge_ids(ctx, ins, attrs):
     """≙ merge_ids_op: route per-shard row values back to the original id
     order. Ids [N] (the original query), per-shard padded ids + rows as
     produced by split_ids + a sharded lookup."""
-    ids = ins["Ids"][0].reshape(-1).astype(jnp.int32)
+    ids = _as_id32(ins["Ids"][0].reshape(-1))
     shard_ids = ins["X"]            # list of [N] padded id tensors
     shard_rows = ins["Rows"]        # list of [N, D] row values
     n = len(shard_ids)
@@ -400,7 +424,7 @@ def _lookup_sparse_table(ctx, ins, attrs):
     padded (-1) ids yield zero rows (the reference auto-grows unseen rows —
     static translation returns the init value 0)."""
     w = ins["W"][0]
-    ids = ins["Ids"][0].reshape(-1).astype(jnp.int32)
+    ids = _as_id32(ins["Ids"][0].reshape(-1))
     valid = ids >= 0
     safe = jnp.where(valid, ids, 0)
     rows = w[safe]

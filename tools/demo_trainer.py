@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Train a SAVED program with no model-building code.
+
+≙ reference paddle/fluid/train/demo/demo_trainer.cc:55-80 — the pure-C++
+trainer that loads a serialized startup+main ProgramDesc and loops
+`executor.Run(main)`. The capability being demonstrated is identical:
+training is fully described by the serialized program; the driver knows
+nothing about the model. (The reference's driver is C++ because its
+executor is C++; here the executor is the XLA runtime, reached through the
+thin python shim — the native layer below it is XLA/Mosaic itself.)
+
+Usage:
+    # save a program from any model script:
+    #   pt.io.save_program(dir, feed_names=[...], fetch_names=[loss])
+    python tools/demo_trainer.py --model_dir DIR --iters 10 --batch_size 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def synth_feed(program, feed_names, batch_size, seed=0):
+    """Synthesize feed arrays from the program's declared var shapes
+    (≙ the demo's fake data)."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    feed = {}
+    blk = program.global_block()
+    for name in feed_names:
+        var = blk.var(name)
+        shape = [batch_size if int(d) == -1 else int(d)
+                 for d in (var.shape or [])]
+        dname = var.dtype.name if hasattr(var.dtype, "name") else str(var.dtype)
+        if "int" in dname:
+            feed[name] = rng.randint(0, 2, size=shape).astype(dname)
+        else:
+            feed[name] = rng.rand(*shape).astype(dname)
+    return feed
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model_dir", required=True)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--batch_size", type=int, default=8)
+    args = p.parse_args()
+
+    import paddle_tpu as pt
+
+    main_prog, startup_prog, feed_names, fetch_names = \
+        pt.io.load_program(args.model_dir)
+    exe = pt.Executor()
+    exe.run(startup_prog)
+
+    feed = synth_feed(main_prog, feed_names, args.batch_size)
+    for i in range(args.iters):
+        vals = exe.run(main_prog, feed=feed, fetch_list=fetch_names)
+        line = " ".join(f"{n}={float(v.reshape(-1)[0]):.6f}"
+                        for n, v in zip(fetch_names, vals))
+        print(f"iter {i}: {line}")
+    print(json.dumps({"status": "ok", "iters": args.iters,
+                      "fetches": fetch_names}))
+
+
+if __name__ == "__main__":
+    main()

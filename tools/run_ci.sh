@@ -1,0 +1,23 @@
+#!/bin/sh
+# CI driver (≙ reference paddle/scripts/paddle_build.sh: build + test +
+# API check + benchmark smoke). Runs on the virtual 8-device CPU mesh.
+set -e
+cd "$(dirname "$0")/.."
+
+echo "== build native runtime =="
+sh paddle_tpu/native/build.sh
+
+echo "== API surface check =="
+JAX_PLATFORMS=cpu python tools/print_signatures.py > /tmp/api_current.txt
+diff <(sort API.spec) <(sort /tmp/api_current.txt) || {
+    echo "API surface drifted — review and run tools/print_signatures.py --update"; exit 1; }
+
+echo "== test pyramid =="
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -x
+
+echo "== benchmark smoke =="
+JAX_PLATFORMS=cpu python tools/benchmark.py --model mnist --batch_size 8 \
+    --iters 3 --warmup 1
+
+echo "CI OK"

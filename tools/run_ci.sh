@@ -1,4 +1,4 @@
-#!/bin/sh
+#!/bin/bash
 # CI driver (≙ reference paddle/scripts/paddle_build.sh: build + test +
 # API check + benchmark smoke). Runs on the virtual 8-device CPU mesh.
 set -e
@@ -8,11 +8,12 @@ echo "== build native runtime =="
 sh paddle_tpu/native/build.sh
 
 echo "== API surface check =="
-JAX_PLATFORMS=cpu python tools/print_signatures.py > /tmp/api_current.txt
-diff <(sort API.spec) <(sort /tmp/api_current.txt) || {
+JAX_PLATFORMS=cpu python tools/print_signatures.py | sort > /tmp/api_current.txt
+sort API.spec > /tmp/api_golden.txt
+diff /tmp/api_golden.txt /tmp/api_current.txt || {
     echo "API surface drifted — review and run tools/print_signatures.py --update"; exit 1; }
 
-echo "== test pyramid =="
+echo "== test pyramid (~15 min on 2 cores) =="
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -x
 

@@ -388,3 +388,31 @@ def chunk_eval(input, label, length, chunk_scheme, num_chunk_types,
                             "excluded_chunk_types":
                                 list(excluded_chunk_types or [])})
     return precision, recall, f1, n_inf, n_lab, n_cor
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """≙ reference lod_reset_op: re-tag a tensor with new sequence lengths.
+    In the static-shape translation, "LoD" is the companion @SEQLEN length
+    vector — resetting it means tagging `x` with `y`'s lengths (or an
+    explicit lengths variable)."""
+    enforce(y is not None or target_lod is not None,
+            "lod_reset needs y (a tagged sequence or lengths var) or "
+            "target_lod", exc=InvalidArgumentError)
+    if y is not None:
+        try:
+            lengths = get_seqlen(y)
+        except NotFoundError:
+            lengths = y            # y IS a lengths vector
+    else:
+        lengths = target_lod
+    return tag_sequence(x, lengths)
+
+
+def max_sequence_len(rank_table_or_seq):
+    """≙ max_sequence_len_op (over a lod_rank_table in the reference): the
+    longest sequence length in the batch."""
+    from . import nn as _nn
+    lengths = get_seqlen(rank_table_or_seq) \
+        if not str(rank_table_or_seq.name).endswith("@SEQLEN") \
+        else rank_table_or_seq
+    return _nn.reduce_max(lengths)

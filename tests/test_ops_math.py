@@ -243,3 +243,25 @@ class TestSelectedRowsAndDistHelpers:
                      {"W": table, "Ids": ids})["Out"][0]
         np.testing.assert_allclose(out[0], table[2], rtol=1e-6)
         np.testing.assert_array_equal(out[1], 0)
+
+
+def test_lod_reset_and_max_sequence_len(rng):
+    """≙ reference lod_reset_op / max_sequence_len_op (static-shape LoD
+    translation: companion @SEQLEN re-tagging)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.layers.sequence import lod_reset, max_sequence_len
+
+    x1 = layers.data("x1", shape=[6, 4], lod_level=1)
+    x2 = layers.data("x2", shape=[6, 4], lod_level=1)
+    y = lod_reset(x1, x2)
+    m = max_sequence_len(y)
+    pooled = layers.sequence_pool(y, pool_type="sum")
+    exe = pt.Executor()
+    feed = {"x1": np.ones((2, 6, 4), "float32"),
+            "x1@SEQLEN": np.array([6, 6], "int32"),
+            "x2": np.zeros((2, 6, 4), "float32"),
+            "x2@SEQLEN": np.array([2, 3], "int32")}
+    mv, pv = exe.run(feed=feed, fetch_list=[m, pooled])
+    assert mv == 3
+    assert pv[0, 0] == 2.0 and pv[1, 0] == 3.0

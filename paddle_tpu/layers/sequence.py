@@ -390,29 +390,52 @@ def chunk_eval(input, label, length, chunk_scheme, num_chunk_types,
     return precision, recall, f1, n_inf, n_lab, n_cor
 
 
+def _as_lengths_var(v, what):
+    """Accept a tagged sequence (its lengths are extracted) or a rank-1
+    integer lengths Variable; anything else is rejected loudly."""
+    from ..framework.program import Variable
+    enforce(isinstance(v, Variable),
+            f"{what} must be a Variable (a tagged sequence or a [B] int "
+            f"lengths vector); got {type(v).__name__} — note: this "
+            f"framework's 'LoD' is per-sequence LENGTHS, not offset lists",
+            exc=InvalidArgumentError)
+    try:
+        return get_seqlen(v)
+    except NotFoundError:
+        is_len_vec = (len(v.shape or ()) == 1 and
+                      "int" in str(v.dtype))
+        enforce(is_len_vec,
+                f"{what} ({v.name!r}) is neither a tagged sequence nor a "
+                f"rank-1 integer lengths vector (shape={v.shape}, "
+                f"dtype={v.dtype})", exc=InvalidArgumentError)
+        return v
+
+
 def lod_reset(x, y=None, target_lod=None):
     """≙ reference lod_reset_op: re-tag a tensor with new sequence lengths.
     In the static-shape translation, "LoD" is the companion @SEQLEN length
-    vector — resetting it means tagging `x` with `y`'s lengths (or an
-    explicit lengths variable)."""
+    vector — resetting means tagging a COPY of `x` with `y`'s lengths (or
+    an explicit lengths Variable via target_lod). `x` itself keeps its
+    original tagging, matching the reference op's fresh output var."""
+    from ..core.dtypes import dtype_name
+    from ..layer_helper import LayerHelper
     enforce(y is not None or target_lod is not None,
             "lod_reset needs y (a tagged sequence or lengths var) or "
             "target_lod", exc=InvalidArgumentError)
-    if y is not None:
-        try:
-            lengths = get_seqlen(y)
-        except NotFoundError:
-            lengths = y            # y IS a lengths vector
-    else:
-        lengths = target_lod
-    return tag_sequence(x, lengths)
+    lengths = _as_lengths_var(y if y is not None else target_lod,
+                              "lod_reset lengths source")
+    helper = LayerHelper("lod_reset")
+    out = helper.create_tmp_variable(dtype=dtype_name(x.dtype),
+                                     shape=list(x.shape))
+    helper.append_op(type="assign", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return tag_sequence(out, lengths)
 
 
 def max_sequence_len(rank_table_or_seq):
     """≙ max_sequence_len_op (over a lod_rank_table in the reference): the
-    longest sequence length in the batch."""
+    longest sequence length in the batch. Accepts a tagged sequence or a
+    rank-1 integer lengths vector."""
     from . import nn as _nn
-    lengths = get_seqlen(rank_table_or_seq) \
-        if not str(rank_table_or_seq.name).endswith("@SEQLEN") \
-        else rank_table_or_seq
+    lengths = _as_lengths_var(rank_table_or_seq, "max_sequence_len input")
     return _nn.reduce_max(lengths)

@@ -265,3 +265,43 @@ def test_lod_reset_and_max_sequence_len(rng):
     mv, pv = exe.run(feed=feed, fetch_list=[m, pooled])
     assert mv == 3
     assert pv[0, 0] == 2.0 and pv[1, 0] == 3.0
+
+
+def test_lod_reset_does_not_alias_input(rng):
+    """Regression: lod_reset returns a fresh var; the input keeps its own
+    lengths (the reference op writes a new output var)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.layers.sequence import lod_reset
+
+    x1 = layers.data("x1", shape=[6, 4], lod_level=1)
+    x2 = layers.data("x2", shape=[6, 4], lod_level=1)
+    y = lod_reset(x1, x2)
+    assert y.name != x1.name
+    pooled_x1 = layers.sequence_pool(x1, pool_type="sum")   # original tags
+    pooled_y = layers.sequence_pool(y, pool_type="sum")     # new tags
+    exe = pt.Executor()
+    feed = {"x1": np.ones((2, 6, 4), "float32"),
+            "x1@SEQLEN": np.array([6, 6], "int32"),
+            "x2": np.zeros((2, 6, 4), "float32"),
+            "x2@SEQLEN": np.array([2, 3], "int32")}
+    a, b = exe.run(feed=feed, fetch_list=[pooled_x1, pooled_y])
+    assert a[0, 0] == 6.0 and a[1, 0] == 6.0
+    assert b[0, 0] == 2.0 and b[1, 0] == 3.0
+
+
+def test_lod_reset_rejects_non_lengths(rng):
+    import pytest as _pytest
+    from paddle_tpu import layers
+    from paddle_tpu.core.enforce import InvalidArgumentError
+    from paddle_tpu.layers.sequence import lod_reset, max_sequence_len
+
+    x = layers.data("xq", shape=[6, 4], lod_level=1)
+    bad = layers.data("badf", shape=[6, 4])   # float, untagged
+    with _pytest.raises(InvalidArgumentError):
+        lod_reset(x, bad)
+    with _pytest.raises(InvalidArgumentError):
+        lod_reset(x, target_lod=[0, 2, 5])    # python list: not a Variable
+    # a plain [B] int lengths var IS accepted
+    lens = layers.data("plain_lens", shape=[], dtype="int32")
+    assert max_sequence_len(lens) is not None

@@ -50,10 +50,30 @@ class _CompiledStep:
         self.fetch_names = fetch_names
 
 
+_jit_cache_configured = []
+
+
+def _configure_jit_cache():
+    """Wire the PTPU_JIT_CACHE flag into jax's persistent compilation
+    cache (once): compiled XLA executables survive process restarts, which
+    on TPU turns 20-40s first compiles into millisecond cache loads."""
+    if _jit_cache_configured:
+        return
+    _jit_cache_configured.append(True)
+    path = flags.get_flag("jit_cache")
+    if not path:
+        return
+    import os
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
 class Executor:
     """≙ fluid.Executor (reference python/paddle/fluid/executor.py:256)."""
 
     def __init__(self, place: Optional[Place] = None):
+        _configure_jit_cache()
         self.place = place or default_place()
         self._cache: Dict[Any, _CompiledStep] = {}
         self._persistable_cache: Dict[Any, list] = {}

@@ -283,3 +283,32 @@ def test_get_places_lists_devices():
     places = get_places()
     assert len(places) == 8  # the virtual CPU mesh
     assert get_places(device_count=2) == places[:2]
+
+
+def test_jit_cache_flag_wires_persistent_cache(tmp_path, rng):
+    """PTPU_JIT_CACHE -> jax persistent compilation cache (compiled
+    executables survive restarts; the 20-40s TPU first-compiles become
+    cache loads)."""
+    import glob
+    import jax
+    from paddle_tpu.core import flags
+    from paddle_tpu.framework import executor as ex
+
+    prev = flags.get_flag("jit_cache")
+    prev_cfg = jax.config.jax_compilation_cache_dir
+    cache = str(tmp_path / "xla_cache")
+    from paddle_tpu import layers
+    try:
+        flags.set_flag("jit_cache", cache)
+        ex._jit_cache_configured.clear()
+        x = layers.data("jcx", shape=[32])
+        loss = layers.mean(layers.fc(x, size=32))
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        exe.run(feed={"jcx": np.zeros((4, 32), "float32")},
+                fetch_list=[loss])
+        assert jax.config.jax_compilation_cache_dir == cache
+    finally:
+        flags.set_flag("jit_cache", prev)
+        jax.config.update("jax_compilation_cache_dir", prev_cfg)
+        ex._jit_cache_configured.clear()

@@ -85,6 +85,15 @@ def all_flags() -> Dict[str, Any]:
     return {k: v.value for k, v in _REGISTRY.items()}
 
 
+def vlog(level: int, msg: str, *args) -> None:
+    """Verbose logging gated on the `vlog` flag (≙ glog VLOG(level) used
+    throughout the reference's C++; enable with PTPU_VLOG=N)."""
+    if get_flag("vlog") >= level:
+        import sys
+        print(f"[VLOG{level}] " + (msg % args if args else msg),
+              file=sys.stderr)
+
+
 # --- Core framework flags (≙ the reference's gflags config surface, SURVEY §5) ---
 define_bool("check_nan_inf", False,
             "Scan every op's outputs for NaN/Inf during execution "
@@ -99,6 +108,8 @@ define_string("jit_cache", "", "Persistent XLA compilation cache directory.")
 define_bool("disable_pallas", False,
             "Force XLA-composite lowerings for ops that default to Pallas "
             "kernels on TPU (escape hatch: PTPU_DISABLE_PALLAS=1).")
-define_int("num_iteration_per_drop_scope", 1,
+# (num_iteration_per_drop_scope lives on ExecutionStrategy for API parity;
+# the functional executor has no per-iteration kid scopes to drop)
+define_int("_reserved_num_iteration_per_drop_scope", 1,
            "Iterations between temporary-scope cleanups "
            "(≙ ExecutionStrategy::num_iteration_per_drop_scope_).")

@@ -110,6 +110,9 @@ class Master:
             t = self._q.todo.pop(0)
             t.deadline = time.time() + self.timeout_s
             self._q.pending[t.task_id] = t
+            from ..core.flags import vlog
+            vlog(2, "master: leased task %d (%d chunks) to %s",
+                 t.task_id, len(t.chunks), worker_id or "?")
             self._snapshot()
             return {"task_id": t.task_id, "chunks": list(t.chunks),
                     "epoch": self._q.epoch}

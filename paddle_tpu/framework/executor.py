@@ -133,6 +133,9 @@ class Executor:
             new_state = tuple(env[n] for n in state_out_names)
             return fetches, new_state
 
+        flags.vlog(1, "compiling program id=%s version=%s feeds=%s "
+                   "fetches=%s", id(program), program._version,
+                   list(feed_names), list(fetch_names))
         jit_kwargs: Dict[str, Any] = {"donate_argnums": (2,)}
         if in_shardings is not None:
             jit_kwargs["in_shardings"] = in_shardings

@@ -19,6 +19,9 @@ from ..framework.registry import register_op
 
 def _maybe_bf16(x, attrs):
     if attrs.get("use_bf16", False) and x.dtype == jnp.float32:
+        from ..core import flags
+        if not flags.get_flag("use_bf16_matmul"):
+            return x   # global kill-switch (PTPU_USE_BF16_MATMUL=0)
         return x.astype(jnp.bfloat16)
     return x
 

@@ -97,3 +97,37 @@ def _random_crop(ctx, ins, attrs):
     slices = [jnp.asarray(0)] * lead + starts
     sizes = list(x.shape[:lead]) + list(shape)
     return {"Out": [jax.lax.dynamic_slice(x, slices, sizes)]}
+
+
+def _bsl_shape(ins, attrs):
+    """Resolve the shape of a *_batch_size_like op: copy the batch dim from
+    the Input reference (≙ the reference's BatchSizeLikeOp base)."""
+    ref = ins["Input"][0]
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = \
+        ref.shape[attrs.get("input_dim_idx", 0)]
+    return shape
+
+
+@register_op("uniform_random_batch_size_like", stop_gradient=True)
+def _uniform_random_bsl(ctx, ins, attrs):
+    """≙ uniform_random_batch_size_like_op.cc."""
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    shape = _bsl_shape(ins, attrs)
+    key = (jax.random.PRNGKey(attrs["seed"]) if attrs.get("seed")
+           else ctx.next_key())
+    return {"Out": [jax.random.uniform(
+        key, shape, dtype=jnp.float32, minval=attrs.get("min", -1.0),
+        maxval=attrs.get("max", 1.0)).astype(dtype)]}
+
+
+@register_op("gaussian_random_batch_size_like", stop_gradient=True)
+def _gaussian_random_bsl(ctx, ins, attrs):
+    """≙ gaussian_random_batch_size_like_op.cc."""
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    shape = _bsl_shape(ins, attrs)
+    key = (jax.random.PRNGKey(attrs["seed"]) if attrs.get("seed")
+           else ctx.next_key())
+    out = attrs.get("mean", 0.0) + attrs.get("std", 1.0) * \
+        jax.random.normal(key, shape, dtype=jnp.float32)
+    return {"Out": [out.astype(dtype)]}

@@ -305,3 +305,17 @@ def test_lod_reset_rejects_non_lengths(rng):
     # a plain [B] int lengths var IS accepted
     lens = layers.data("plain_lens", shape=[], dtype="int32")
     assert max_sequence_len(lens) is not None
+
+
+def test_random_batch_size_like_variants(rng):
+    """≙ uniform/gaussian_random_batch_size_like ops (SURVEY §2.2)."""
+    from op_test import run_op
+    ref = np.zeros((5, 7), "float32")
+    u = run_op("uniform_random_batch_size_like",
+               {"Input": ref}, attrs={"shape": [-1, 3], "min": 0.0,
+                                      "max": 1.0, "seed": 7})["Out"][0]
+    assert u.shape == (5, 3) and (u >= 0).all() and (u <= 1).all()
+    g = run_op("gaussian_random_batch_size_like",
+               {"Input": ref}, attrs={"shape": [-1, 4], "mean": 10.0,
+                                      "std": 0.1, "seed": 7})["Out"][0]
+    assert g.shape == (5, 4) and abs(float(g.mean()) - 10.0) < 0.5

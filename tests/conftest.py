@@ -6,29 +6,14 @@ the multi-chip path via __graft_entry__.dryrun_multichip).
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags +
-                               " --xla_force_host_platform_device_count=8")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# Single source of truth for the axon-plugin workaround + virtual-device
+# bootstrap (shared with the driver's multichip dryrun).
+from __graft_entry__ import _ensure_virtual_cpu_devices  # noqa: E402
 
-# Drop any TPU-tunnel backend factory (e.g. the axon PJRT plugin registered by
-# sitecustomize): CPU-only tests must never block on remote-device client
-# creation, and the plugin's get_backend hook initializes it even under
-# JAX_PLATFORMS=cpu.
-import jax  # noqa: E402
-import jax._src.xla_bridge as _xb  # noqa: E402
-
-for _plugin in ("axon",):
-    # NOTE: only the axon tunnel plugin is dropped. The stock "tpu" platform
-    # must stay registered (deviceless): removing it makes platform "tpu"
-    # unknown to MLIR lowering registration, which breaks importing
-    # jax.experimental.pallas.tpu even for interpret-mode runs.
-    _xb._backend_factories.pop(_plugin, None)
-# the plugin's register() may have pinned jax_platforms=axon in jax.config
-# before this conftest ran — force CPU for the test session.
-jax.config.update("jax_platforms", "cpu")
+_ensure_virtual_cpu_devices(8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

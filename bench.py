@@ -1,10 +1,26 @@
 """Benchmark: ResNet-50 synthetic-ImageNet training throughput on one chip.
 
-Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", "evidence"}.
+
 vs_baseline compares against the reference's best published in-repo ResNet-50
 training number (84.08 images/sec, 2-socket Xeon 6148 MKL-DNN bs=256 —
 reference benchmark/IntelOptimizedPaddle.md:39-45; the reference publishes no
 Fluid-GPU tables, see BASELINE.md).
+
+The evidence block makes the headline auditable (≙ the hardware context the
+reference publishes next to its tables, reference benchmark/README.md:33-39):
+  - flops_per_step from XLA's own cost model (Executor.cost_analysis), so
+    implied TFLOP/s and MFU vs the chip's bf16 peak can be checked;
+  - loss_first/loss_last over the timed window with a convergent lr, so the
+    timed steps are demonstrably real training (fwd+bwd+update), not a
+    degenerate or dead-code-eliminated loop;
+  - a DevicePrefetcher-fed variant over distinct host batches, so the input
+    pipeline (host->device staging) is measured, not bypassed;
+  - blocked per-step latency alongside pipelined throughput: the TPU tunnel
+    has high dispatch latency, async pipelining through the functional state
+    chain is what a real input loop achieves;
+  - a Pallas flash-attention vs XLA-composite micro-bench (fwd+bwd), the
+    number that justifies the hand-written kernel (SURVEY §7 stage 4).
 """
 
 from __future__ import annotations
@@ -16,57 +32,234 @@ import numpy as np
 
 BASELINE_IMGS_PER_SEC = 84.08
 
+# bf16 peak TFLOP/s per chip generation (public spec sheets), keyed by
+# substring of jax Device.device_kind.
+_PEAK_BF16_TFLOPS = (
+    ("v5 lite", 197.0),   # TPU v5e
+    ("v5e", 197.0),
+    ("v5p", 459.0),
+    ("v6", 918.0),        # Trillium
+    ("v4", 275.0),
+)
 
-def main():
-    import jax
+
+def _chip_peak_tflops(device) -> float | None:
+    kind = getattr(device, "device_kind", "") or ""
+    for sub, peak in _PEAK_BF16_TFLOPS:
+        if sub in kind.lower():
+            return peak
+    return None
+
+
+def _build_resnet_train(batch: int, depth: int = 50):
     import paddle_tpu as pt
     from paddle_tpu import models
-
-    platform = jax.devices()[0].platform
-    # TPU: full-size config; CPU fallback (no tunnel): tiny shapes so the
-    # script stays runnable anywhere.
-    on_accel = platform not in ("cpu",)
-    batch = 128 if on_accel else 8
-    depth = 50
 
     pt.reset_default_programs()
     pt.reset_global_scope()
     loss, acc, _ = models.resnet.resnet_imagenet(
         depth=depth, is_test=False, data_format="NHWC", use_bf16=True)
-    opt = pt.optimizer.MomentumOptimizer(learning_rate=0.1, momentum=0.9)
+    # lr must be convergent at this batch size: the timed window doubles as
+    # the work-verification window (loss must decrease during it).
+    opt = pt.optimizer.MomentumOptimizer(learning_rate=3e-3, momentum=0.9)
     opt.minimize(loss)
-
     exe = pt.Executor()
     exe.run(pt.default_startup_program())
+    return exe, loss
 
-    rng = np.random.RandomState(0)
-    img = rng.rand(batch, 224, 224, 3).astype("float32")
-    label = rng.randint(0, 1000, (batch, 1)).astype("int64")
-    # stage the batch on device once (a real input pipeline overlaps
-    # host->device transfer via DevicePrefetcher; re-uploading the same
-    # fixed batch every step would benchmark PCIe, not the chip)
+
+def _resnet_throughput(batch: int, iters: int):
+    """Pipelined steady-state throughput on one staged batch; returns
+    (imgs/sec, blocked_step_ms, losses, flops_per_step, (exe, loss)).
+
+    Sync discipline: the only barrier trusted is host-value realization
+    (float(...) of a fetched loss) — through the remote-TPU tunnel,
+    block_until_ready has been observed returning before execution completes,
+    which is exactly the artifact that inflated the round-1 number. The loss
+    of step k depends on step k-1's updated parameters, so realizing the
+    final loss bounds all timed steps.
+    """
     import jax.numpy as jnp
-    feed = {"img": jnp.asarray(img), "label": jnp.asarray(label)}
-    jax.block_until_ready(list(feed.values()))
 
-    # warmup (compile + 2 steady steps)
-    for _ in range(3):
-        out = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
-    jax.block_until_ready(out)
+    exe, loss = _build_resnet_train(batch)
+    rng = np.random.RandomState(0)
+    feed = {
+        "img": jnp.asarray(rng.rand(batch, 224, 224, 3).astype("float32")),
+        "label": jnp.asarray(
+            rng.randint(0, 1000, (batch, 1)).astype("int64")),
+    }
 
-    iters = 20 if on_accel else 3
+    out = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
+    float(out[0])  # compile + drain: queue is empty past this point
+
+    # blocked latency: one fully-synchronized step (dispatch + execute + fetch
+    # round-trip)
+    t0 = time.time()
+    out = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
+    float(out[0])
+    blocked_ms = (time.time() - t0) * 1e3
+
+    fetched = []
     t0 = time.time()
     for _ in range(iters):
         out = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
-    jax.block_until_ready(out)
+        fetched.append(out[0])
+    float(fetched[-1])  # realization barrier
     dt = time.time() - t0
+    losses = [float(x) for x in fetched]
 
-    imgs_per_sec = batch * iters / dt
+    ca = exe.cost_analysis(feed=feed, fetch_list=[loss])
+    flops = float(ca.get("flops", 0.0)) if ca else 0.0
+    return batch * iters / dt, blocked_ms, losses, flops, (exe, loss)
+
+
+def _h2d_bandwidth_mbps(batch: int) -> float:
+    """Host->device staging bandwidth for one image batch (the prefetcher
+    variant is bounded by this; through the dev tunnel it is network-limited,
+    on a real TPU host it is PCIe/DMA)."""
+    import jax
+
+    x = np.random.rand(batch, 224, 224, 3).astype("float32")
+    d = jax.device_put(x)
+    float(d[0, 0, 0, 0])
+    t0 = time.time()
+    for _ in range(2):
+        d = jax.device_put(x)
+        float(d[0, 0, 0, 0])
+    dt = (time.time() - t0) / 2
+    return x.nbytes / dt / 1e6
+
+
+def _resnet_prefetcher_throughput(batch: int, iters: int, exe, loss):
+    """Throughput with the real input pipeline: distinct host batches staged
+    to device by DevicePrefetcher's background thread. Reuses an
+    already-compiled (exe, loss) train step at the same batch size — the
+    feed signature is unchanged, so no recompile."""
+    from paddle_tpu.data.prefetch import DevicePrefetcher
+
+    rng = np.random.RandomState(1)
+    host_batches = [
+        {"img": rng.rand(batch, 224, 224, 3).astype("float32"),
+         "label": rng.randint(0, 1000, (batch, 1)).astype("int64")}
+        for _ in range(4)
+    ]
+
+    def feed_iter():
+        for i in range(iters + 2):
+            yield host_batches[i % len(host_batches)]
+
+    pf = iter(DevicePrefetcher(feed_iter, capacity=2))
+    for _ in range(2):  # warmup (compile happens on the first)
+        out = exe.run(feed=next(pf), fetch_list=[loss], return_numpy=False)
+    float(out[0])
+
+    fetched = []
+    t0 = time.time()
+    for feed in pf:
+        out = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
+        fetched.append(out[0])
+    float(fetched[-1])
+    dt = time.time() - t0
+    return batch * len(fetched) / dt
+
+
+def _flash_attention_speedup(seq_len: int = 8192, heads: int = 8,
+                             head_dim: int = 128, batch: int = 1):
+    """Pallas flash attention vs the XLA composite, fwd+bwd wall clock.
+
+    T=8192 is where the O(T) kernel earns its keep on a v5e: the composite's
+    [T, T] score materialization pushes HBM to the limit (it OOMs outright at
+    T=16384 where the flash kernel still runs)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.RandomState(2)
+    shape = (batch, heads, seq_len, head_dim)
+    q = jnp.asarray(rng.randn(*shape).astype(np.float32), dtype=jnp.bfloat16)
+    k = jnp.asarray(rng.randn(*shape).astype(np.float32), dtype=jnp.bfloat16)
+    v = jnp.asarray(rng.randn(*shape).astype(np.float32), dtype=jnp.bfloat16)
+    scale = 1.0 / np.sqrt(head_dim)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(pk.flash_attention(q, k, v, scale=scale, causal=True)
+                       .astype(jnp.float32))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(pk._attention_reference(q, k, v, scale, causal=True)
+                       .astype(jnp.float32))
+
+    def timed(fn):
+        g = jax.jit(jax.grad(fn, argnums=(0, 1, 2)))
+        out = g(q, k, v)
+        float(out[0][0, 0, 0, 0])  # compile + drain (realization barrier)
+        t0 = time.time()
+        for _ in range(5):
+            out = g(q, k, v)
+        float(out[0][0, 0, 0, 0])  # device queue is FIFO: bounds all 5
+        return (time.time() - t0) / 5
+
+    try:
+        t_flash = timed(loss_flash)
+    except Exception as e:
+        # surface the failure in the evidence — a broken kernel must not
+        # silently read as "unavailable on this backend"
+        return f"flash_error: {e!r:.120}"
+    try:
+        t_ref = timed(loss_ref)
+    except Exception:
+        return "xla_oom"  # composite cannot even run at this T
+    return round(t_ref / t_flash, 3)
+
+
+def main():
+    import jax
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+    on_accel = platform not in ("cpu",)
+    peak_tflops = _chip_peak_tflops(dev) if on_accel else None
+
+    main_bs = 256 if on_accel else 8
+    alt_bs = 128 if on_accel else 4
+    iters = 20 if on_accel else 3
+
+    imgs_s, blocked_ms, losses, flops, _ = _resnet_throughput(main_bs, iters)
+    alt_imgs_s, _, _, _, (alt_exe, alt_loss) = _resnet_throughput(
+        alt_bs, iters)
+    pf_imgs_s = _resnet_prefetcher_throughput(alt_bs, iters, alt_exe,
+                                              alt_loss)
+    h2d_mbps = _h2d_bandwidth_mbps(alt_bs)
+    flash_speedup = _flash_attention_speedup() if on_accel else None
+
+    loss_first, loss_last = losses[0], losses[-1]
+    assert loss_last < loss_first, (
+        f"loss did not decrease over the timed window "
+        f"({loss_first:.3f} -> {loss_last:.3f}); benchmark invalid")
+
+    implied_tflops = flops * imgs_s / main_bs / 1e12 if flops else None
+    evidence = {
+        "device_kind": getattr(dev, "device_kind", str(dev)),
+        "flops_per_step_xla": flops,
+        "implied_tflops": round(implied_tflops, 2) if implied_tflops else None,
+        "peak_bf16_tflops": peak_tflops,
+        "mfu": (round(implied_tflops / peak_tflops, 4)
+                if implied_tflops and peak_tflops else None),
+        "loss_first": round(loss_first, 4),
+        "loss_last": round(loss_last, 4),
+        "blocked_step_ms": round(blocked_ms, 1),
+        f"images_per_sec_bs{alt_bs}": round(alt_imgs_s, 2),
+        f"prefetcher_fed_images_per_sec_bs{alt_bs}": round(pf_imgs_s, 2),
+        "h2d_staging_MBps": round(h2d_mbps, 1),
+        "flash_attention_fwd_bwd_speedup_vs_xla_T8192": flash_speedup,
+    }
     print(json.dumps({
-        "metric": f"resnet50_train_images_per_sec_bs{batch}_{platform}",
-        "value": round(imgs_per_sec, 2),
+        "metric": f"resnet50_train_images_per_sec_bs{main_bs}_{platform}",
+        "value": round(imgs_s, 2),
         "unit": "images/sec",
-        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
+        "vs_baseline": round(imgs_s / BASELINE_IMGS_PER_SEC, 3),
+        "evidence": evidence,
     }))
 
 

@@ -146,6 +146,34 @@ class Executor:
         compiled.state_out_names = state_out_names
         return compiled
 
+    def _lookup_or_compile(self, program: Program, feed: Dict[str, Any],
+                           fetch_names, scope: Scope) -> _CompiledStep:
+        """Validate fetch targets and return the cached compiled step for
+        (program, feed signature, fetches, scope contents), compiling on
+        miss. The cache key includes which persistable vars currently exist
+        in the scope: compiling before the startup program ran must not
+        poison the cache for post-initialization runs."""
+        block = program.global_block()
+        defined = set(feed)
+        for op in block.ops:
+            defined.update(op.output_names())
+        for name in fetch_names:
+            if name not in defined and not block.has_var(name):
+                raise NotFoundError(
+                    f"fetch target {name!r} is not produced by the program "
+                    f"and not fed")
+        avail_key = self._scope_avail_key(program, scope)
+        key = (id(program), program._version, _feed_signature(feed),
+               tuple(fetch_names), id(scope), avail_key)
+        compiled = self._cache.get(key)
+        if compiled is None:
+            from .. import profiler as _prof
+            with _prof.RecordEvent("executor/trace_and_compile"):
+                compiled = self._compile(program, scope, list(feed.keys()),
+                                         fetch_names)
+            self._cache[key] = compiled
+        return compiled
+
     # -- execution --------------------------------------------------------
     def run(self,
             program: Optional[Program] = None,
@@ -162,29 +190,8 @@ class Executor:
         fetch_names = [f.name if isinstance(f, Variable) else f
                        for f in fetch_list]
 
-        block = program.global_block()
-        defined = set(feed)
-        for op in block.ops:
-            defined.update(op.output_names())
-        for name in fetch_names:
-            if name not in defined and not block.has_var(name):
-                raise NotFoundError(
-                    f"fetch target {name!r} is not produced by the program "
-                    f"and not fed")
-
-        # cache key includes which persistable vars currently exist in the
-        # scope: compiling before the startup program ran must not poison the
-        # cache for post-initialization runs.
-        avail_key = self._scope_avail_key(program, scope)
-        key = (id(program), program._version, _feed_signature(feed),
-               tuple(fetch_names), id(scope), avail_key)
         from .. import profiler as _prof
-        compiled = self._cache.get(key)
-        if compiled is None:
-            with _prof.RecordEvent("executor/trace_and_compile"):
-                compiled = self._compile(program, scope, list(feed.keys()),
-                                         fetch_names)
-            self._cache[key] = compiled
+        compiled = self._lookup_or_compile(program, feed, fetch_names, scope)
 
         feed_vals = tuple(jnp.asarray(feed[n]) for n in compiled.feed_names)
         ro_vals = tuple(scope.get(n) for n in compiled.ro_names)
@@ -206,6 +213,25 @@ class Executor:
         if return_numpy:
             return [as_numpy(f) for f in fetches]
         return list(fetches)
+
+    def cost_analysis(self, program=None, feed=None, fetch_list=None,
+                      scope=None):
+        """XLA cost analysis (flops, bytes accessed) of the compiled step for
+        the given (program, feed, fetch) — the evidence the reference
+        publishes next to its benchmark tables (reference
+        benchmark/README.md:33). Compiles if not already cached."""
+        program = program or default_main_program()
+        feed = dict(feed or {})
+        scope = scope or global_scope()
+        fetch_names = [f.name if isinstance(f, Variable) else f
+                       for f in (fetch_list or [])]
+        compiled = self._lookup_or_compile(program, feed, fetch_names, scope)
+        feed_vals = tuple(jnp.asarray(feed[n]) for n in compiled.feed_names)
+        ro_vals = tuple(scope.get(n) for n in compiled.ro_names)
+        rw_vals = tuple(scope.get(n) for n in compiled.rw_names)
+        ca = compiled.fn.lower(feed_vals, ro_vals, rw_vals,
+                               np.uint32(0)).compile().cost_analysis()
+        return ca[0] if isinstance(ca, (list, tuple)) else ca
 
     def close(self):
         """≙ Executor::Close (reference executor.cc:48) — drop caches."""

@@ -109,9 +109,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
         o_ref[0] = (acc_ref[:] /
                     jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
         if lse_ref is not None:
-            # logsumexp per query row — the backward kernels' residual
-            lse_ref[0] = (m_ref[:] +
-                          jnp.log(jnp.maximum(l_ref[:], 1e-30)))[:, 0]
+            # logsumexp per query row — the backward kernels' residual.
+            # Stored broadcast over 128 lanes: Mosaic requires the last two
+            # block dims to be (8k, 128m)-tileable, so a [bq] vector output
+            # is illegal on real TPU (same layout as jax's own tpu
+            # flash_attention lse).
+            lse = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))  # [bq,1]
+            lse_ref[0] = jnp.broadcast_to(lse, lse_ref[0].shape)
 
 
 def _pad_to(x, axis, target):
@@ -144,8 +148,10 @@ def _flash_attention_pallas(q, k, v, scale, causal, block_q, block_k,
     out_specs = [pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))]
     out_shape = [jax.ShapeDtypeStruct((B * H, Tp, D), q.dtype)]
     if with_lse:
-        out_specs.append(pl.BlockSpec((1, bq), lambda b, i, j: (b, i)))
-        out_shape.append(jax.ShapeDtypeStruct((B * H, Tp), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((B * H, Tp, 128), jnp.float32))
     else:
         # inference path: don't compute/write the residual it won't use
         def kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
@@ -170,7 +176,7 @@ def _flash_attention_pallas(q, k, v, scale, causal, block_q, block_k,
     )(qf, kf, vf)
     out = res[0][:, :T].reshape(B, H, T, D)
     if with_lse:
-        return out, res[1][:, :T].reshape(B, H, T)
+        return out, res[1][:, :T, 0].reshape(B, H, T)
     return out
 
 
@@ -208,8 +214,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k = k_ref[0]
     v = v_ref[0]
     do = do_ref[0]
-    lse = lse_ref[0][:, None]                      # [bq, 1]
-    delta = delta_ref[0][:, None]                  # [bq, 1]
+    lse = lse_ref[0][:, :1]                        # [bq, 1] (128-lane bcast)
+    delta = delta_ref[0][:, :1]                    # [bq, 1]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     valid = _bwd_masks(qi, j, block_q, block_k, causal,
@@ -245,8 +251,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k = k_ref[0]
     v = v_ref[0]
     do = do_ref[0]
-    lse = lse_ref[0][:, None]
-    delta = delta_ref[0][:, None]
+    lse = lse_ref[0][:, :1]                        # [bq, 1] (128-lane bcast)
+    delta = delta_ref[0][:, :1]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     valid = _bwd_masks(i, ki, block_q, block_k, causal,
@@ -287,13 +293,19 @@ def _flash_attention_bwd_pallas(q, k, v, o, lse, do, scale, causal,
     kf = _pad_to(k.reshape(B * H, Tk, D), 1, Tkp)
     vf = _pad_to(v.reshape(B * H, Tk, D), 1, Tkp)
     dof = _pad_to(do.reshape(B * H, T, D), 1, Tp)
-    lsef = _pad_to(lse.reshape(B * H, T), 1, Tp)
-    deltaf = _pad_to(delta.reshape(B * H, T), 1, Tp)
+    # per-row residuals ride broadcast over 128 lanes (Mosaic tiling; see
+    # the forward lse layout note)
+    lsef = jnp.broadcast_to(
+        _pad_to(lse.reshape(B * H, T), 1, Tp)[..., None],
+        (B * H, Tp, 128))
+    deltaf = jnp.broadcast_to(
+        _pad_to(delta.reshape(B * H, T), 1, Tp)[..., None],
+        (B * H, Tp, 128))
 
     common = dict(scale=scale, causal=causal, block_q=bq, block_k=bk,
                   causal_offset=Tk - T, true_tq=T, true_tk=Tk)
     q_spec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
-    r_spec = pl.BlockSpec((1, bq), lambda b, i, j: (b, i))
+    r_spec = pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0))
     k_spec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0))
 
     dq = pl.pallas_call(
@@ -308,7 +320,7 @@ def _flash_attention_bwd_pallas(q, k, v, o, lse, do, scale, causal,
 
     # dk/dv: k blocks are the outer (revisited) dim, q blocks stream inner
     qi_spec = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0))
-    ri_spec = pl.BlockSpec((1, bq), lambda b, j, i: (b, i))
+    ri_spec = pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0))
     kj_spec = pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, num_q_blocks=nq, **common),
@@ -339,6 +351,9 @@ def flash_attention(q, k, v, scale=None, causal=False, block_q=128,
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if backend is None:
         backend = _auto_backend()
+    if (block_q, block_k) == (128, 128):
+        # default blocks: differentiable path (custom_vjp flash backward)
+        return _fused_attention(q, k, v, scale, causal, backend)
     if backend == "xla":
         return _attention_reference(q, k, v, scale, causal)
     return _flash_attention_pallas(

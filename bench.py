@@ -234,9 +234,10 @@ def main():
     flash_speedup = _flash_attention_speedup() if on_accel else None
 
     loss_first, loss_last = losses[0], losses[-1]
-    assert loss_last < loss_first, (
-        f"loss did not decrease over the timed window "
-        f"({loss_first:.3f} -> {loss_last:.3f}); benchmark invalid")
+    if not loss_last < loss_first:  # not assert: must survive python -O
+        raise RuntimeError(
+            f"loss did not decrease over the timed window "
+            f"({loss_first:.3f} -> {loss_last:.3f}); benchmark invalid")
 
     implied_tflops = flops * imgs_s / main_bs / 1e12 if flops else None
     evidence = {

@@ -226,12 +226,20 @@ class Executor:
         fetch_names = [f.name if isinstance(f, Variable) else f
                        for f in (fetch_list or [])]
         compiled = self._lookup_or_compile(program, feed, fetch_names, scope)
-        feed_vals = tuple(jnp.asarray(feed[n]) for n in compiled.feed_names)
-        ro_vals = tuple(scope.get(n) for n in compiled.ro_names)
-        rw_vals = tuple(scope.get(n) for n in compiled.rw_names)
-        ca = compiled.fn.lower(feed_vals, ro_vals, rw_vals,
-                               np.uint32(0)).compile().cost_analysis()
-        return ca[0] if isinstance(ca, (list, tuple)) else ca
+        ca = getattr(compiled, "cost_analysis_cache", None)
+        if ca is None:
+            feed_vals = tuple(jnp.asarray(feed[n])
+                              for n in compiled.feed_names)
+            ro_vals = tuple(scope.get(n) for n in compiled.ro_names)
+            rw_vals = tuple(scope.get(n) for n in compiled.rw_names)
+            # the AOT lower().compile() path bypasses the jit executable
+            # cache, so memoize on the cached step — the repeated-call cost
+            # would otherwise be a full XLA compile each time
+            ca = compiled.fn.lower(feed_vals, ro_vals, rw_vals,
+                                   np.uint32(0)).compile().cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            compiled.cost_analysis_cache = ca
+        return ca
 
     def close(self):
         """≙ Executor::Close (reference executor.cc:48) — drop caches."""

@@ -71,15 +71,16 @@ def _conv2d(ctx, ins, attrs):
     """≙ conv_op.cc / conv_cudnn_op.cu.cc. Filter layout is OIHW as in the
     reference; groups>1 supported (depthwise = groups == C_in)."""
     x, w = ins["Input"][0], ins["Filter"][0]
-    strides = tuple(attrs.get("strides", [1, 1]))
-    pads = attrs.get("paddings", [0, 0])
-    dilations = tuple(attrs.get("dilations", [1, 1]))
+    nd = x.ndim - 2  # spatial rank: 2 for conv2d, 3 for conv3d
+    strides = tuple(attrs.get("strides", [1] * nd))
+    pads = attrs.get("paddings", [0] * nd)
+    dilations = tuple(attrs.get("dilations", [1] * nd))
     groups = attrs.get("groups", 1) or 1
     data_format = attrs.get("data_format", "NCHW")
     dn = _conv_dimension_numbers(data_format, x.ndim)
-    if data_format == "NHWC":
-        # framework stores filters OIHW; convert to HWIO for NHWC convs
-        w = jnp.transpose(w, (2, 3, 1, 0))
+    if data_format in ("NHWC", "NDHWC"):
+        # framework stores filters OI<spatial>; convert to <spatial>IO
+        w = jnp.transpose(w, tuple(range(2, 2 + nd)) + (1, 0))
     padding = [(p, p) for p in pads]
     x, w = _maybe_bf16(x, attrs), _maybe_bf16(w, attrs)
     # No preferred_element_type here: a f32-upcast output makes the conv vjp

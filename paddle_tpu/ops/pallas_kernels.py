@@ -351,39 +351,35 @@ def flash_attention(q, k, v, scale=None, causal=False, block_q=128,
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if backend is None:
         backend = _auto_backend()
-    if (block_q, block_k) == (128, 128):
-        # default blocks: differentiable path (custom_vjp flash backward)
-        return _fused_attention(q, k, v, scale, causal, backend)
-    if backend == "xla":
-        return _attention_reference(q, k, v, scale, causal)
-    return _flash_attention_pallas(
-        q, k, v, scale, causal, block_q, block_k,
-        interpret=(backend == "pallas_interpret"))
+    return _fused_attention(q, k, v, scale, causal, backend, block_q,
+                            block_k)
 
 
 # ---------------------------------------------------------------------------
 # differentiable wrapper + op registration
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _fused_attention(q, k, v, scale, causal, backend):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _fused_attention(q, k, v, scale, causal, backend, block_q=128,
+                     block_k=128):
     if backend == "xla":
         return _attention_reference(q, k, v, scale, causal)
-    return _flash_attention_pallas(q, k, v, scale, causal, 128, 128,
+    return _flash_attention_pallas(q, k, v, scale, causal, block_q, block_k,
                                    interpret=(backend == "pallas_interpret"))
 
 
-def _fused_attention_fwd(q, k, v, scale, causal, backend):
+def _fused_attention_fwd(q, k, v, scale, causal, backend, block_q=128,
+                         block_k=128):
     if backend == "xla":
         out = _attention_reference(q, k, v, scale, causal)
         return out, (q, k, v, None, None)
     out, lse = _flash_attention_pallas(
-        q, k, v, scale, causal, 128, 128,
+        q, k, v, scale, causal, block_q, block_k,
         interpret=(backend == "pallas_interpret"), with_lse=True)
     return out, (q, k, v, out, lse)
 
 
-def _fused_attention_bwd(scale, causal, backend, res, g):
+def _fused_attention_bwd(scale, causal, backend, block_q, block_k, res, g):
     q, k, v, o, lse = res
     if backend == "xla":
         _, vjp = jax.vjp(
@@ -393,7 +389,7 @@ def _fused_attention_bwd(scale, causal, backend, res, g):
     # flash backward: recompute P tiles from (q, k, lse) in VMEM — the
     # [T, T] score matrix never exists in HBM in either direction
     return _flash_attention_bwd_pallas(
-        q, k, v, o, lse, g, scale, causal, 128, 128,
+        q, k, v, o, lse, g, scale, causal, block_q, block_k,
         interpret=(backend == "pallas_interpret"))
 
 

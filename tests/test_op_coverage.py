@@ -1099,18 +1099,21 @@ def test_op(op):
         attrs = attrs(rng)
     is_test = spec.get("is_test", False)
 
-    got = run_op(op, ins, attrs, is_test=is_test)
+    if spec.get("ref") is not None:
+        # check_output runs the op and returns the outputs — one execution
+        # serves both the parity check and the finite-smoke check below
+        expected = spec["ref"](_np(ins), attrs)
+        got = check_output(op, ins, expected, attrs,
+                           atol=spec.get("atol", 1e-5),
+                           rtol=spec.get("rtol", 1e-5), is_test=is_test)
+    else:
+        got = run_op(op, ins, attrs, is_test=is_test)
     # smoke: every float output must be finite
     for slot, vals in got.items():
         for v in vals:
             if np.issubdtype(np.asarray(v).dtype, np.floating):
                 assert np.isfinite(v).all(), f"{op}: non-finite {slot}"
 
-    if spec.get("ref") is not None:
-        expected = spec["ref"](_np(ins), attrs)
-        check_output(op, ins, expected, attrs,
-                     atol=spec.get("atol", 1e-5),
-                     rtol=spec.get("rtol", 1e-5), is_test=is_test)
     if spec.get("check") is not None:
         spec["check"](got, _np(ins), attrs)
 

@@ -35,6 +35,16 @@ class OpDef:
 _OPS: Dict[str, OpDef] = {}
 
 
+def dim_prod(dims) -> Any:
+    """Product of shape dims WITHOUT int() coercion: under jax.export a
+    leading dim may be symbolic, and int() on it raises. Use this in any
+    lowering that flattens leading dims."""
+    out = 1
+    for d in dims:
+        out = out * d
+    return out
+
+
 def register_op(op_type: str, *, stop_gradient: bool = False, tags=()):
     """Decorator registering a lowering rule (≙ REGISTER_OPERATOR +
     REGISTER_OP_*_KERNEL, reference op_registry.h:185-217)."""

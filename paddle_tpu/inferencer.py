@@ -59,6 +59,50 @@ class Predictor:
         p.fetch_names = list(self.fetch_names)
         return p
 
+    @staticmethod
+    def from_exported(model_dir: str) -> "ExportedPredictor":
+        """Cold-load the jax.export artifact written by
+        save_inference_model(..., export=True). The returned predictor runs
+        with no program, no op registry, and no tracer — the serving path
+        (≙ the reference's C++ predictor loading a ProgramDesc+params dir,
+        api_impl.cc:126; here the deployable unit is serialized StableHLO
+        executable by any PJRT runtime)."""
+        return ExportedPredictor(model_dir)
+
+
+class ExportedPredictor:
+    """Serve a serialized StableHLO inference function (see
+    io.export_inference_model). Parameters travel inside the artifact."""
+
+    def __init__(self, model_dir: str):
+        self._exported, self.feed_names, self.fetch_names = \
+            pio.load_exported_model(model_dir)
+
+    def run(self, feed: Dict[str, Any],
+            fetch_names: Optional[Sequence[str]] = None,
+            return_numpy: bool = True) -> List[Any]:
+        # same error contract as Predictor.run
+        missing = set(self.feed_names) - set(feed)
+        extra = set(feed) - set(self.feed_names)
+        enforce(not missing, f"missing feeds: {sorted(missing)}",
+                exc=InvalidArgumentError)
+        enforce(not extra, f"unexpected feeds: {sorted(extra)}",
+                exc=InvalidArgumentError)
+        if fetch_names is not None:
+            unknown = set(fetch_names) - set(self.fetch_names)
+            enforce(not unknown,
+                    f"unknown fetch names {sorted(unknown)}; exported "
+                    f"fetches are {self.fetch_names}",
+                    exc=InvalidArgumentError)
+        outs = self._exported.call(*(feed[n] for n in self.feed_names))
+        if fetch_names is not None:
+            index = {n: i for i, n in enumerate(self.fetch_names)}
+            outs = [outs[index[n]] for n in fetch_names]
+        if return_numpy:
+            import numpy as np
+            return [np.asarray(o) for o in outs]
+        return list(outs)
+
 
 class Inferencer:
     """≙ fluid.Inferencer — high-level wrapper over Predictor."""

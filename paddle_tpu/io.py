@@ -210,10 +210,13 @@ def save_inference_model(dirname: str,
                          model_filename: Optional[str] = None,
                          params_filename: Optional[str] = None,
                          scope: Optional[Scope] = None,
-                         save_as_bf16: bool = False):
+                         save_as_bf16: bool = False,
+                         export: bool = False):
     """≙ fluid.io.save_inference_model (reference io.py:561): prune the
     program to the fetch targets, switch to test mode, serialize program +
-    parameters."""
+    parameters. With export=True additionally emits a serialized
+    jax.export/StableHLO artifact (see export_inference_model) that serves
+    cold without the tracer."""
     program = main_program or default_main_program()
     scope = scope or global_scope()
     target_names = [t.name if isinstance(t, Variable) else t
@@ -240,6 +243,9 @@ def save_inference_model(dirname: str,
               vars=persistables,
               filename=params_filename or PARAMS_COMBINED_FILE, scope=scope,
               save_as_bf16=save_as_bf16)
+    if export:
+        export_inference_model(dirname, feeded_var_names, target_names,
+                               inference_program, scope=scope)
     return target_names
 
 
@@ -262,6 +268,93 @@ def load_inference_model(dirname: str,
     load_vars(executor, dirname, main_program=program, vars=persistables,
               filename=params_filename or PARAMS_COMBINED_FILE, scope=scope)
     return program, list(meta["feed_names"]), list(meta["fetch_names"])
+
+
+EXPORTED_ARTIFACT_FILE = "__exported__.bin"
+EXPORTED_META_FILE = "__exported__.json"
+
+
+def export_inference_model(dirname: str,
+                           feeded_var_names: Sequence[str],
+                           target_names: Sequence[str],
+                           inference_program: Program,
+                           scope: Optional[Scope] = None,
+                           platforms: Sequence[str] = ("cpu", "tpu")):
+    """Emit a serialized jax.export (StableHLO) artifact next to the JSON
+    program: the whole pruned inference function — parameters baked in as
+    constants — in a form a serving process loads and calls COLD, with no
+    program tracer, no op registry, and no model-building code.
+
+    ≙ the reference's C++-loadable serving artifact
+    (inference/api/paddle_inference_api.h:1 + api_impl.cc:126 +
+    inference/io.cc LoadInferenceModel): its ProgramDesc+params directory is
+    what a C++ server consumes; here the equivalent deployable unit is
+    serialized StableHLO, which any PJRT runtime (tpu serving, CPU) can
+    execute. Leading -1 dims export as symbolic so one artifact serves any
+    batch size.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jax_export
+
+    from .framework.lowering import build_plan, run_plan
+    from .framework.registry import LowerCtx
+
+    scope = scope or global_scope()
+    block = inference_program.global_block()
+    plan = build_plan(block)
+    feed_names = list(feeded_var_names)
+    target_names = list(target_names)
+
+    read = set()
+    for op in block.ops:
+        read |= set(op.input_names())
+    state_names = sorted(n for n in read
+                         if scope.has_var(n) and n not in feed_names)
+    # fetched to host once; embedded as constants in the artifact
+    state_vals = {n: np.asarray(as_numpy(scope.get(n)))
+                  for n in state_names}
+
+    def fn(*feeds):
+        env: Dict[str, object] = dict(state_vals)
+        env.update(zip(feed_names, feeds))
+        ctx = LowerCtx(rng_key=jax.random.PRNGKey(0), is_test=True)
+        run_plan(plan, env, block, ctx)
+        return tuple(env[n] for n in target_names)
+
+    sym_scope = jax_export.SymbolicScope()
+    args = []
+    for i, name in enumerate(feed_names):
+        v = block.var(name)
+        dims = [f"d{i}_{j}" if d == -1 else str(d)
+                for j, d in enumerate(v.shape)]
+        shape = jax_export.symbolic_shape(", ".join(dims), scope=sym_scope) \
+            if any(d == -1 for d in v.shape) else tuple(v.shape)
+        args.append(jax.ShapeDtypeStruct(shape, jnp.dtype(v.dtype)))
+
+    exported = jax_export.export(jax.jit(fn), platforms=tuple(platforms))(
+        *args)
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, EXPORTED_ARTIFACT_FILE), "wb") as f:
+        f.write(exported.serialize())
+    with open(os.path.join(dirname, EXPORTED_META_FILE), "w") as f:
+        json.dump({"feed_names": feed_names, "fetch_names": target_names,
+                   "platforms": list(platforms)}, f)
+
+
+def load_exported_model(dirname: str):
+    """Deserialize a jax.export artifact written by export_inference_model.
+    Returns (exported, feed_names, fetch_names); `exported.call(*feeds)`
+    runs it — no program, no registry, no tracer."""
+    from jax import export as jax_export
+    path = os.path.join(dirname, EXPORTED_ARTIFACT_FILE)
+    if not os.path.exists(path):
+        raise NotFoundError(f"no exported artifact at {path}")
+    with open(path, "rb") as f:
+        exported = jax_export.deserialize(bytearray(f.read()))
+    with open(os.path.join(dirname, EXPORTED_META_FILE)) as f:
+        meta = json.load(f)
+    return exported, list(meta["feed_names"]), list(meta["fetch_names"])
 
 
 TRAIN_PROGRAM_FILE = "__train_program__"

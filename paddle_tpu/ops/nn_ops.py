@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..framework.registry import register_op
+from ..framework.registry import dim_prod, register_op
 
 
 def _maybe_bf16(x, attrs):
@@ -33,8 +33,8 @@ def _mul(ctx, ins, attrs):
     xd = attrs.get("x_num_col_dims", 1)
     yd = attrs.get("y_num_col_dims", 1)
     xs, ys = x.shape, y.shape
-    x2 = jnp.reshape(x, (int(np.prod(xs[:xd])), -1))
-    y2 = jnp.reshape(y, (int(np.prod(ys[:yd])), -1))
+    x2 = jnp.reshape(x, (dim_prod(xs[:xd]), -1))
+    y2 = jnp.reshape(y, (dim_prod(ys[:yd]), -1))
     x2, y2 = _maybe_bf16(x2, attrs), _maybe_bf16(y2, attrs)
     out = jnp.dot(x2, y2, preferred_element_type=jnp.float32)
     out = jnp.reshape(out, xs[:xd] + ys[yd:]).astype(x.dtype)

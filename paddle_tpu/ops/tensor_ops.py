@@ -13,7 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.dtypes import convert_dtype
-from ..framework.registry import register_op
+from ..framework.registry import dim_prod, register_op
 
 
 @register_op("reshape")
@@ -102,7 +102,7 @@ def _unsqueeze(ctx, ins, attrs):
 def _flatten(ctx, ins, attrs):
     x = ins["X"][0]
     ax = attrs.get("axis", 1)
-    lead = int(np.prod(x.shape[:ax])) if ax > 0 else 1
+    lead = dim_prod(x.shape[:ax]) if ax > 0 else 1
     return {"Out": [jnp.reshape(x, (lead, -1))]}
 
 

@@ -65,7 +65,7 @@ def _free_port():
 _JOIN_SCRIPT = _BOOT + r"""
 import json
 import jax.numpy as jnp
-from paddle_tpu.distributed import init_parallel_env, parse_env
+from paddle_tpu.distributed import init_parallel_env
 from paddle_tpu.distributed.env import global_rank, world_size
 
 env = init_parallel_env()          # reads the PADDLE_* vars from os.environ
@@ -133,10 +133,8 @@ endpoint, worker_id, ckpt_dir, lock_path, die_after, result_path = \
 die_after = int(die_after)
 
 import paddle_tpu as pt
-from paddle_tpu import layers
-from paddle_tpu.core import unique_name
 from paddle_tpu.distributed import MasterClient
-from chunk_common import W_TRUE, chunk_data, train_chunk, build
+from chunk_common import train_chunk, build
 
 exe, loss_var, step_fn = build()
 client = MasterClient(endpoint, worker_id=worker_id)

@@ -77,6 +77,35 @@ def test_save_load_inference_model(tmp_path, rng):
     assert "softmax_with_cross_entropy" not in optypes
 
 
+def test_export_cold_load_round_trip(tmp_path, rng):
+    """train -> save(export=True) -> reset everything -> cold-load the
+    StableHLO artifact -> logits match the tracer-based Predictor, at a
+    batch size never seen at export time (symbolic batch dim).
+    ≙ reference paddle_inference_api.h:1 + api_impl.cc:126 + inference/io.cc
+    (the servable artifact a fresh process loads without model code)."""
+    exe, loss, logits, x, y = _train_mlp(rng)
+    pt.save_inference_model(str(tmp_path / "model"), ["img"], [logits], exe,
+                            export=True)
+    assert (tmp_path / "model" / "__exported__.bin").exists()
+
+    reference_out, = pt.Predictor(str(tmp_path / "model")).run(
+        {"img": x[:8]})
+
+    # cold process simulation: no programs, no scope, no tracer involved —
+    # ExportedPredictor only deserializes StableHLO and calls it
+    pt.reset_global_scope()
+    pt.reset_default_programs()
+    cold = pt.Predictor.from_exported(str(tmp_path / "model"))
+    assert cold.feed_names == ["img"]
+    out, = cold.run({"img": x[:8]})
+    np.testing.assert_allclose(out, reference_out, rtol=1e-5, atol=1e-6)
+
+    # polymorphic batch: a size never used at export/trace time
+    out3, = cold.run({"img": x[:3]})
+    np.testing.assert_allclose(out3, reference_out[:3], rtol=1e-5,
+                               atol=1e-6)
+
+
 def test_inferencer_and_clone(tmp_path, rng):
     exe, loss, logits, x, y = _train_mlp(rng, steps=3)
     pt.save_inference_model(str(tmp_path / "m"), ["img"], [logits], exe)

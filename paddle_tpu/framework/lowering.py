@@ -143,6 +143,43 @@ def build_plan(block: Block):
     return plan
 
 
+# Optimizer ops with a SelectedRows (sparse) apply branch — the only
+# sanctioned consumers of a sparse grad (≙ the reference's SelectedRows
+# optimizer kernels, adam_op.h / math/selected_rows_functor.cc).
+SPARSE_CAPABLE_OPT_OPS = frozenset({"sgd", "momentum", "adam"})
+
+
+def _find_sparse_embedding_specs(seg_ops, target_names, env, block, ctx):
+    """Params whose gradient can ship as (rows, values) instead of a dense
+    [vocab, dim] array: an is_sparse lookup_table param, read exactly once in
+    the segment, ids available before the region, every block-level consumer
+    of its @GRAD a sparse-capable optimizer op, and the grad not fetched."""
+    fetches = set(ctx.extras.get("fetch_names", ()))
+    specs = []
+    for op in seg_ops:
+        if op.type != "lookup_table" or not op.attrs.get("is_sparse"):
+            continue
+        w = op.inputs["W"][0]
+        gname = grad_var_name(w)
+        if w not in target_names or gname in fetches:
+            continue
+        ids_name = op.inputs["Ids"][0]
+        if ids_name not in env:
+            continue  # ids computed inside the region: dense fallback
+        reads = sum(n == w for o in seg_ops
+                    for ns in o.inputs.values() for n in ns)
+        if reads != 1:
+            continue  # table also read elsewhere: grads would be partial
+        consumers = [o.type for o in block.ops
+                     if gname in {n for ns in o.inputs.values() for n in ns}]
+        if not consumers or any(t not in SPARSE_CAPABLE_OPT_OPS
+                                for t in consumers):
+            continue
+        specs.append((w, op.outputs["Out"][0], ids_name,
+                      op.attrs.get("padding_idx", None)))
+    return specs
+
+
 def run_vjp_region(region_op: Operator, seg_indices: Sequence[int],
                    env: Dict[str, Any], block: Block, ctx: LowerCtx):
     """Execute a forward segment under jax.vjp, producing forward vars AND
@@ -169,11 +206,32 @@ def run_vjp_region(region_op: Operator, seg_indices: Sequence[int],
     # Snapshot of everything the segment may read, minus the diff targets.
     base_env = {k: v for k, v in env.items()}
 
-    def fwd(target_vals):
+    # Sparse embedding grads: differentiate wrt a zero perturbation ADDED to
+    # the lookup output instead of wrt the [vocab, dim] table — the
+    # perturbation's cotangent IS the per-row gradient values, and the rows
+    # are the ids. The table never takes a dense gradient.
+    sparse_specs = _find_sparse_embedding_specs(seg_ops, target_names, env,
+                                                block, ctx)
+    sparse_names = {w for w, _, _, _ in sparse_specs}
+    dense_names = [n for n in target_names if n not in sparse_names]
+    perturb_for = {out: i for i, (_, out, _, _) in enumerate(sparse_specs)}
+    perturbs = []
+    for w, _, ids_name, _ in sparse_specs:
+        wval, ids = env[w], env[ids_name]
+        idshape = (ids.shape[:-1] if ids.ndim >= 2 and ids.shape[-1] == 1
+                   else ids.shape)
+        perturbs.append(jnp.zeros(idshape + (wval.shape[1],),
+                                  dtype=wval.dtype))
+
+    def fwd(dense_vals, perturb_vals):
         env2 = dict(base_env)
-        env2.update(zip(target_names, target_vals))
+        env2.update(zip(dense_names, dense_vals))
         for op in seg_ops:
             run_op(op, env2, block, ctx)
+            for n in op.output_names():
+                i = perturb_for.get(n)
+                if i is not None:
+                    env2[n] = env2[n] + perturb_vals[i]
         loss = env2[loss_name]
         aux = tuple(env2[n] for n in produced)
         return loss, aux
@@ -187,14 +245,29 @@ def run_vjp_region(region_op: Operator, seg_indices: Sequence[int],
                   if policy_name else None)
         fwd = jax.checkpoint(fwd, policy=policy)
 
-    target_vals = tuple(env[n] for n in target_names)
-    loss_val, vjp_fn, aux = jax.vjp(fwd, target_vals, has_aux=True)
+    dense_vals = tuple(env[n] for n in dense_names)
+    loss_val, vjp_fn, aux = jax.vjp(fwd, dense_vals, tuple(perturbs),
+                                    has_aux=True)
     seed = jnp.ones_like(loss_val)  # ≙ fill_constant loss@GRAD=1 (backward.py:566)
-    (grads,) = vjp_fn(seed)
+    dgrads, pgrads = vjp_fn(seed)
     env.update(zip(produced, aux))
     env[grad_var_name(loss_name)] = seed
-    for name, g in zip(target_names, grads):
+    for name, g in zip(dense_names, dgrads):
         env[grad_var_name(name)] = g
+    if sparse_specs:
+        from .selected_rows import TracedSelectedRows
+        for (w, _, ids_name, padding_idx), pg in zip(sparse_specs, pgrads):
+            ids = env[ids_name]
+            if ids.ndim >= 2 and ids.shape[-1] == 1:
+                ids = jnp.squeeze(ids, axis=-1)
+            rows = ids.reshape(-1)
+            vals = pg.reshape((-1, pg.shape[-1]))
+            if padding_idx is not None:
+                pad = (padding_idx if padding_idx >= 0
+                       else padding_idx + env[w].shape[0])
+                vals = vals * (rows != pad)[:, None].astype(vals.dtype)
+            env[grad_var_name(w)] = TracedSelectedRows(
+                rows, vals, env[w].shape[0])
 
 
 from .registry import register_op  # noqa: E402

@@ -20,6 +20,32 @@ import numpy as np
 from ..core.enforce import InvalidArgumentError, enforce
 
 
+class TracedSelectedRows:
+    """In-trace sparse-gradient carrier: {rows, value, height} where rows and
+    value are traced jax arrays (duplicate rows NOT yet merged).
+
+    ≙ the reference's SelectedRows flowing from lookup_table_grad into the
+    optimizer's SelectedRows kernels (reference operators/adam_op.h
+    SparseAdamFunctor, math/selected_rows_functor.cc). Produced by
+    run_vjp_region for is_sparse embedding params; consumed by the sparse
+    branches of the sgd/momentum/adam lowerings, which touch only the looked-
+    up rows instead of rewriting the whole [vocab, dim] table + accumulators.
+    """
+
+    __slots__ = ("rows", "value", "height")
+
+    def __init__(self, rows, value, height: int):
+        self.rows = rows          # [n] int traced
+        self.value = value        # [n, width] traced
+        self.height = int(height)
+
+    def to_dense(self):
+        import jax.numpy as jnp
+        out = jnp.zeros((self.height,) + tuple(self.value.shape[1:]),
+                        dtype=self.value.dtype)
+        return out.at[self.rows].add(self.value)
+
+
 class SelectedRows:
     """{rows, value, height} sparse row set (≙ selected_rows.h:32)."""
 

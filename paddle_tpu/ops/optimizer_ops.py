@@ -12,12 +12,34 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..framework.registry import register_op
+from ..framework.selected_rows import TracedSelectedRows
+
+
+def _merge_sparse_rows(g: TracedSelectedRows):
+    """Coalesce duplicate rows inside the trace (≙ math::scatter::MergeAdd,
+    reference math/selected_rows_functor.cc). Returns (rows_u, values_u)
+    where padding entries carry row index == height — gather sites must clip
+    and scatter sites must use mode='drop'."""
+    rows_u, inv = jnp.unique(g.rows, return_inverse=True,
+                             size=g.rows.shape[0], fill_value=g.height)
+    vals_u = jnp.zeros((rows_u.shape[0],) + tuple(g.value.shape[1:]),
+                       dtype=g.value.dtype).at[inv.reshape(-1)].add(g.value)
+    return rows_u, vals_u
+
+
+def _gather_rows(x, rows, height):
+    return x[jnp.clip(rows, 0, height - 1)]
 
 
 @register_op("sgd")
 def _sgd(ctx, ins, attrs):
     p, g = ins["Param"][0], ins["Grad"][0]
     lr = ins["LearningRate"][0]
+    if isinstance(g, TracedSelectedRows):
+        # linear update: scatter-add handles duplicate rows directly
+        # (≙ sgd_op.h SelectedRows kernel)
+        return {"ParamOut": [p.at[g.rows].add(
+            -(lr * g.value).astype(p.dtype), mode="drop")]}
     return {"ParamOut": [p - lr * g.astype(p.dtype)]}
 
 
@@ -26,6 +48,21 @@ def _momentum(ctx, ins, attrs):
     p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
     lr = ins["LearningRate"][0]
     mu = attrs["mu"]
+    if isinstance(g, TracedSelectedRows):
+        # ≙ momentum_op.h SparseMomentumFunctor — NOT lazy: the reference
+        # decays velocity for every row (rows absent from the grad see g=0),
+        # so only the gradient arrives sparse; the apply is table-wide.
+        # (Unlike adam, momentum has no lazy reference mode — freezing
+        # untouched rows would silently change training results.)
+        rows, g_rows = _merge_sparse_rows(g)
+        v_out = (mu * v).at[rows].add(g_rows.astype(v.dtype), mode="drop")
+        if attrs.get("use_nesterov", False):
+            # dense form p - (g + mu*v_out)*lr with g zero off-rows
+            p_out = (p - lr * mu * v_out).at[rows].add(
+                -(lr * g_rows).astype(p.dtype), mode="drop")
+        else:
+            p_out = p - lr * v_out
+        return {"ParamOut": [p_out], "VelocityOut": [v_out]}
     v_out = mu * v + g
     if attrs.get("use_nesterov", False):
         p_out = p - (g + mu * v_out) * lr
@@ -41,6 +78,23 @@ def _adam(ctx, ins, attrs):
     b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
     lr = ins["LearningRate"][0]
     b1, b2, eps = attrs["beta1"], attrs["beta2"], attrs["epsilon"]
+    if isinstance(g, TracedSelectedRows):
+        # ≙ adam_op.h SparseAdamFunctor (lazy mode): only looked-up rows of
+        # param and both moments move; beta pows advance globally
+        rows, g_rows = _merge_sparse_rows(g)
+        m_rows = b1 * _gather_rows(m, rows, g.height) + (1 - b1) * g_rows
+        v_rows = (b2 * _gather_rows(v, rows, g.height)
+                  + (1 - b2) * jnp.square(g_rows))
+        lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+        p_rows = _gather_rows(p, rows, g.height) \
+            - lr_t * m_rows / (jnp.sqrt(v_rows) + eps)
+        return {"ParamOut": [p.at[rows].set(p_rows.astype(p.dtype),
+                                            mode="drop")],
+                "Moment1Out": [m.at[rows].set(m_rows.astype(m.dtype),
+                                              mode="drop")],
+                "Moment2Out": [v.at[rows].set(v_rows.astype(v.dtype),
+                                              mode="drop")],
+                "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2]}
     m_out = b1 * m + (1 - b1) * g
     v_out = b2 * v + (1 - b2) * jnp.square(g)
     lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)

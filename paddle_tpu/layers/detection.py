@@ -20,6 +20,7 @@ __all__ = [
     "prior_box", "density_prior_box", "anchor_generator", "iou_similarity",
     "box_coder", "bipartite_match", "target_assign", "multiclass_nms",
     "detection_output", "ssd_loss", "roi_pool", "multi_box_head",
+    "rpn_target_assign", "generate_proposals", "detection_map",
 ]
 
 
@@ -290,3 +291,73 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
                             "conf_loss_weight": conf_loss_weight,
                             "mismatch_value": mismatch_value})
     return loss
+
+
+def rpn_target_assign(anchor_box, gt_box, rpn_batch_size_per_im=256,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, name=None):
+    """≙ reference layers/detection.py rpn_target_assign
+    (rpn_target_assign_op.cc). Static-shape form: returns per-anchor
+    (labels, box_deltas, box_inside_weight) — labels [N] in {-1 ignore,
+    0 bg, 1 fg}, deltas/weights [N, 4] — instead of gathered index lists
+    (dynamic shapes don't compile on TPU)."""
+    helper = LayerHelper("rpn_target_assign", name=name)
+    n = anchor_box.shape[0]
+    dtype = dtype_name(anchor_box.dtype)
+    labels = _tmp(helper, "int32", [n])
+    deltas = _tmp(helper, dtype, [n, 4])
+    inside_w = _tmp(helper, dtype, [n, 4])
+    helper.append_op(type="rpn_target_assign",
+                     inputs={"Anchor": [anchor_box], "GtBox": [gt_box]},
+                     outputs={"Labels": [labels], "BoxDeltas": [deltas],
+                              "BoxInsideWeight": [inside_w]},
+                     attrs={"rpn_batch_size_per_im": rpn_batch_size_per_im,
+                            "rpn_fg_fraction": rpn_fg_fraction,
+                            "rpn_positive_overlap": rpn_positive_overlap,
+                            "rpn_negative_overlap": rpn_negative_overlap})
+    return labels, deltas, inside_w
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, name=None):
+    """≙ reference generate_proposals_op.cc. scores [B, A], bbox_deltas
+    [B, A, 4], anchors [A, 4], im_info [B, 3] (h, w, scale). Returns
+    (rpn_rois [B, post, 4], rpn_roi_probs [B, post, 1],
+    rpn_rois_num [B])."""
+    helper = LayerHelper("generate_proposals", name=name)
+    b = scores.shape[0]
+    dtype = dtype_name(scores.dtype)
+    rois = _tmp(helper, dtype, [b, post_nms_top_n, 4])
+    probs = _tmp(helper, dtype, [b, post_nms_top_n, 1])
+    nums = _tmp(helper, "int32", [b])
+    helper.append_op(type="generate_proposals",
+                     inputs={"Scores": [scores],
+                             "BboxDeltas": [bbox_deltas],
+                             "ImInfo": [im_info], "Anchors": [anchors]},
+                     outputs={"RpnRois": [rois], "RpnRoiProbs": [probs],
+                              "RpnRoisNum": [nums]},
+                     attrs={"pre_nms_top_n": pre_nms_top_n,
+                            "post_nms_top_n": post_nms_top_n,
+                            "nms_thresh": nms_thresh, "min_size": min_size})
+    return rois, probs, nums
+
+
+def detection_map(detect_res, label, class_num, overlap_threshold=0.5,
+                  ap_version="integral", name=None):
+    """≙ reference detection_map_op.cc, IN-graph (the host-side fallback
+    lives in metrics.DetectionMAP). detect_res [B, K, 6] rows
+    (label, score, box) — the multiclass_nms layout; label (gt) [B, G, 5]
+    rows (label, box), zero-area padding. Returns the scalar mAP."""
+    enforce(ap_version == "integral",
+            "only integral AP is implemented (11point would silently be a "
+            "different metric)", exc=InvalidArgumentError)
+    helper = LayerHelper("detection_map", name=name)
+    m_ap = _tmp(helper, "float32", [1])
+    helper.append_op(type="detection_map",
+                     inputs={"DetectRes": [detect_res], "Label": [label]},
+                     outputs={"MAP": [m_ap]},
+                     attrs={"class_num": class_num,
+                            "overlap_threshold": overlap_threshold,
+                            "ap_type": ap_version})
+    return m_ap

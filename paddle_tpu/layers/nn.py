@@ -214,6 +214,190 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
     return out
 
 
+def _triple(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x, x, x]
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           use_cudnn=True, name=None, data_format="NCDHW", use_bf16=False):
+    """≙ reference layers/nn.py conv3d (conv_op.cc vol2col path). Input
+    [N, C, D, H, W] (or NDHWC); filter [M, C/g, kd, kh, kw]."""
+    helper = LayerHelper("conv3d", name=name, act=act, bias_attr=bias_attr)
+    filter_size = _triple(filter_size)
+    stride = _triple(stride)
+    padding = _triple(padding)
+    dilation = _triple(dilation)
+    groups = groups or 1
+    c_axis = 1 if data_format == "NCDHW" else 4
+    num_channels = input.shape[c_axis]
+    w_shape = [num_filters, num_channels // groups] + filter_size
+    fan_in = (num_channels // groups) * int(
+        filter_size[0] * filter_size[1] * filter_size[2])
+    std = (2.0 / fan_in) ** 0.5
+    w = helper.create_parameter(param_attr, shape=w_shape,
+                                dtype=dtype_name(input.dtype),
+                                default_initializer=NormalInitializer(0., std))
+    spatial_in = (input.shape[2:5] if data_format == "NCDHW"
+                  else input.shape[1:4])
+    spatial_out = [_conv_out_dim(s, filter_size[i], padding[i], stride[i],
+                                 dilation[i])
+                   for i, s in enumerate(spatial_in)]
+    if data_format == "NCDHW":
+        out_shape = [input.shape[0], num_filters] + spatial_out
+    else:
+        out_shape = [input.shape[0]] + spatial_out + [num_filters]
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=out_shape)
+    helper.append_op(type="conv3d",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": stride, "paddings": padding,
+                            "dilations": dilation, "groups": groups,
+                            "data_format": data_format, "use_bf16": use_bf16})
+    pre_act = helper.append_bias_op(out, dim_start=c_axis,
+                                    dim_end=c_axis + 1)
+    return helper.append_activation(pre_act)
+
+
+def conv3d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, param_attr=None,
+                     bias_attr=None, act=None, use_cudnn=True, name=None):
+    """≙ reference layers/nn.py conv3d_transpose (conv_transpose_op.cc 3-D
+    path). Input [N, C, D, H, W]; filter stored [C, M, kd, kh, kw]."""
+    helper = LayerHelper("conv3d_transpose", name=name, act=act,
+                         bias_attr=bias_attr)
+    stride = _triple(stride)
+    padding = _triple(padding)
+    dilation = _triple(dilation)
+    n, c = input.shape[0], input.shape[1]
+    spatial_in = list(input.shape[2:5])
+    if filter_size is None:
+        enforce(output_size is not None,
+                "conv3d_transpose needs filter_size or output_size",
+                exc=InvalidArgumentError)
+        output_size = _triple(output_size)
+        filter_size = [
+            output_size[i] - (spatial_in[i] - 1) * stride[i] + 2 * padding[i]
+            if spatial_in[i] != -1 else 1 for i in range(3)]
+    else:
+        filter_size = _triple(filter_size)
+    w = helper.create_parameter(param_attr,
+                                shape=[c, num_filters] + filter_size,
+                                dtype=dtype_name(input.dtype))
+    spatial_out = [
+        (spatial_in[i] - 1) * stride[i] - 2 * padding[i]
+        + dilation[i] * (filter_size[i] - 1) + 1
+        if spatial_in[i] != -1 else -1 for i in range(3)]
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=[n, num_filters] + spatial_out)
+    helper.append_op(type="conv3d_transpose",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": stride, "paddings": padding,
+                            "dilations": dilation})
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, use_cudnn=True, name=None, data_format="NCDHW"):
+    """≙ reference layers/nn.py pool3d."""
+    helper = LayerHelper("pool3d", name=name)
+    pool_size = _triple(pool_size)
+    pool_stride = _triple(pool_stride)
+    pool_padding = _triple(pool_padding)
+    spatial = (2, 3, 4) if data_format == "NCDHW" else (1, 2, 3)
+    out_shape = list(input.shape)
+    for i, d in enumerate(spatial):
+        if global_pooling:
+            out_shape[d] = 1
+        elif out_shape[d] != -1:
+            span = out_shape[d] + 2 * pool_padding[i] - pool_size[i]
+            if ceil_mode:
+                out_shape[d] = -(-span // pool_stride[i]) + 1
+            else:
+                out_shape[d] = span // pool_stride[i] + 1
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=out_shape)
+    helper.append_op(type="pool3d", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pooling_type": pool_type, "ksize": pool_size,
+                            "strides": pool_stride, "paddings": pool_padding,
+                            "global_pooling": global_pooling,
+                            "exclusive": exclusive, "ceil_mode": ceil_mode,
+                            "data_format": data_format})
+    return out
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR"):
+    """≙ reference layers/nn.py image_resize (bilinear_interp_op). Input
+    [N, C, H, W]; out_shape [H', W'] or scale factor."""
+    enforce(resample.upper() == "BILINEAR",
+            "only BILINEAR resample is supported", exc=InvalidArgumentError)
+    helper = LayerHelper("image_resize", name=name)
+    h, w = input.shape[2], input.shape[3]
+    if out_shape is None:
+        enforce(scale is not None, "image_resize needs out_shape or scale",
+                exc=InvalidArgumentError)
+        out_h, out_w = int(h * scale), int(w * scale)
+    else:
+        out_h, out_w = int(out_shape[0]), int(out_shape[1])
+    out = helper.create_tmp_variable(
+        dtype=dtype_name(input.dtype),
+        shape=[input.shape[0], input.shape[1], out_h, out_w])
+    helper.append_op(type="bilinear_interp", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"out_h": out_h, "out_w": out_w})
+    return out
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None):
+    """≙ reference layers/nn.py resize_bilinear."""
+    return image_resize(input, out_shape=out_shape, scale=scale, name=name)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """≙ reference layers/nn.py image_resize_short: resize so the SHORT side
+    equals out_short_len, keeping aspect ratio."""
+    h, w = input.shape[2], input.shape[3]
+    short = min(h, w)
+    out_h = int(h * out_short_len / short)
+    out_w = int(w * out_short_len / short)
+    return image_resize(input, out_shape=[out_h, out_w], resample=resample)
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """≙ reference layers/nn.py dice_loss: 1 - 2|X∩Y| / (|X|+|Y|).
+    input [N, D] probabilities, label [N, 1] int class indices."""
+
+    label = one_hot(label, depth=input.shape[-1])
+    reduce_dims = list(range(1, len(input.shape)))
+    inse = reduce_sum(input * label, dim=reduce_dims)
+    dice_denominator = reduce_sum(input, dim=reduce_dims) + \
+        reduce_sum(label, dim=reduce_dims) + epsilon
+    dice_score = 1 - inse * 2 / dice_denominator
+    return reduce_mean(dice_score)
+
+
+def positive_negative_pair(score, label, query_id, name=None):
+    """≙ reference positive_negative_pair_op.cc: counts of correctly /
+    incorrectly / neutrally ranked pairs per query group. Returns
+    (positive, negative, neutral) float scalars."""
+    helper = LayerHelper("positive_negative_pair", name=name)
+    pos = helper.create_tmp_variable(dtype="float32", shape=[1])
+    neg = helper.create_tmp_variable(dtype="float32", shape=[1])
+    neu = helper.create_tmp_variable(dtype="float32", shape=[1])
+    helper.append_op(type="positive_negative_pair",
+                     inputs={"Score": [score], "Label": [label],
+                             "QueryID": [query_id]},
+                     outputs={"PositivePair": [pos], "NegativePair": [neg],
+                              "NeutralPair": [neu]})
+    return pos, neg, neu
+
+
 # ---------------------------------------------------------------- norms
 def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                param_attr=None, bias_attr=None, data_layout="NCHW",

@@ -76,6 +76,65 @@ def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
     return tag_sequence(hidden, seqlen), tag_sequence(cell, seqlen)
 
 
+def dynamic_lstmp(input, size, proj_size, h_0=None, c_0=None,
+                  param_attr=None, bias_attr=None, use_peepholes=True,
+                  is_reverse=False, gate_activation="sigmoid",
+                  cell_activation="tanh", candidate_activation="tanh",
+                  proj_activation="identity", dtype="float32", name=None):
+    """≙ reference layers/nn.py dynamic_lstmp (lstmp_op.cc): LSTM with a
+    recurrent projection layer. `input` is the pre-projected [B, T, 4H]
+    sequence; size = 4 * hidden; proj_size = P. Returns (projection, cell):
+    [B, T, P] and [B, T, H]."""
+    enforce(size % 4 == 0, "dynamic_lstmp size must be 4*hidden",
+            exc=InvalidArgumentError)
+    helper = LayerHelper("dynamic_lstmp", name=name)
+    hidden_size = size // 4
+    seqlen = get_seqlen(input)
+    weight = helper.create_parameter(param_attr,
+                                     shape=[proj_size, 4 * hidden_size],
+                                     dtype=dtype)
+    proj_weight = helper.create_parameter(param_attr,
+                                          shape=[hidden_size, proj_size],
+                                          dtype=dtype)
+    bias = helper.create_parameter(
+        bias_attr, shape=[7 * hidden_size if use_peepholes
+                          else 4 * hidden_size],
+        dtype=dtype, is_bias=True)
+    b, t = input.shape[0], input.shape[1]
+    proj = helper.create_tmp_variable(dtype=dtype, shape=[b, t, proj_size])
+    cell = helper.create_tmp_variable(dtype=dtype,
+                                      shape=[b, t, hidden_size])
+    helper.append_op(type="dynamic_lstmp",
+                     inputs={"Input": [input], "Weight": [weight],
+                             "ProjWeight": [proj_weight], "Bias": [bias],
+                             "SeqLen": [seqlen]},
+                     outputs={"Projection": [proj], "Cell": [cell]},
+                     attrs={"use_peepholes": use_peepholes,
+                            "is_reverse": is_reverse,
+                            "gate_activation": gate_activation,
+                            "cell_activation": cell_activation,
+                            "candidate_activation": candidate_activation,
+                            "proj_activation": proj_activation})
+    return tag_sequence(proj, seqlen), tag_sequence(cell, seqlen)
+
+
+def sequence_reshape(input, new_dim):
+    """≙ reference layers/nn.py sequence_reshape (sequence_reshape_op.cc):
+    change the feature width, scaling every sequence length by
+    old_dim / new_dim."""
+    helper = LayerHelper("sequence_reshape", name=None)
+    seqlen = get_seqlen(input)
+    b, t, d = input.shape
+    out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),
+                                     shape=[b, (t * d) // new_dim, new_dim])
+    new_len = helper.create_tmp_variable(dtype="int32", shape=[b])
+    helper.append_op(type="sequence_reshape",
+                     inputs={"X": [input], "SeqLen": [seqlen]},
+                     outputs={"Out": [out], "SeqLenOut": [new_len]},
+                     attrs={"new_dim": new_dim})
+    return tag_sequence(out, new_len)
+
+
 def dynamic_gru(input, size, param_attr=None, bias_attr=None,
                 is_reverse=False, gate_activation="sigmoid",
                 candidate_activation="tanh", h_0=None, name=None):

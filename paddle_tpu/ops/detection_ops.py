@@ -529,3 +529,225 @@ def _ssd_loss(ctx, ins, attrs):
     denom = jnp.maximum(jnp.sum(npos).astype(loc.dtype), 1.0)
     total = (w_loc * jnp.sum(loc_l) + w_conf * jnp.sum(conf_l)) / denom
     return {"Loss": [total]}
+
+
+# ---------------------------------------------------------------------------
+# RPN: anchor target assignment + proposal generation
+# ---------------------------------------------------------------------------
+
+def _rank_desc(score):
+    """rank[i] = position of i when sorting score descending (0 = best)."""
+    order = jnp.argsort(-score)
+    return jnp.zeros_like(order).at[order].set(jnp.arange(order.shape[0]))
+
+
+@register_op("rpn_target_assign", stop_gradient=True)
+def _rpn_target_assign(ctx, ins, attrs):
+    """≙ rpn_target_assign_op.cc (reference layers/detection.py
+    rpn_target_assign). Anchor [N,4]; GtBox [G,4] with zero-area padding
+    rows.
+
+    Static-shape translation: instead of gathering sampled indices (dynamic
+    shapes), emits per-anchor Labels [N] in {-1 ignore, 0 bg, 1 fg}, encoded
+    BoxDeltas [N,4] toward each anchor's best gt, and BoxInsideWeight [N,4]
+    (1 for kept fg anchors). Subsampling to rpn_batch_size_per_im caps the
+    fg/bg sets deterministically by IoU rank (≙ use_random=False)."""
+    anchor = ins["Anchor"][0]
+    gt = ins["GtBox"][0]
+    pos_thr = attrs.get("rpn_positive_overlap", 0.7)
+    neg_thr = attrs.get("rpn_negative_overlap", 0.3)
+    batch = attrs.get("rpn_batch_size_per_im", 256)
+    fg_frac = attrs.get("rpn_fg_fraction", 0.5)
+
+    gt_area = jnp.maximum(gt[:, 2] - gt[:, 0], 0) * \
+        jnp.maximum(gt[:, 3] - gt[:, 1], 0)
+    valid_gt = gt_area > 0
+    iou = jnp.where(valid_gt[None, :], _iou(anchor, gt), -1.0)  # [N,G]
+    max_iou = jnp.max(iou, axis=1)
+    best_gt = jnp.argmax(iou, axis=1)
+
+    # an anchor is fg if IoU >= pos_thr with any gt, or it is some gt's
+    # best anchor (guarantees every gt owns at least one anchor)
+    gt_best_anchor = jnp.argmax(iou, axis=0)                    # [G]
+    is_gt_best = jnp.zeros((anchor.shape[0],), bool).at[
+        gt_best_anchor].max(valid_gt, mode="drop")
+    fg = (max_iou >= pos_thr) | is_gt_best
+    # an image with no valid gt has max_iou == -1 everywhere: every anchor
+    # is background (the reference still samples negatives there, it does
+    # not drop the image from the classification loss)
+    bg = (~fg) & (max_iou < neg_thr)
+
+    fg_cap = int(batch * fg_frac)
+    fg_rank = _rank_desc(jnp.where(fg, max_iou, _NEG))
+    fg_keep = fg & (fg_rank < fg_cap)
+    bg_cap = batch - jnp.sum(fg_keep)
+    # hardest negatives first (highest IoU below the negative threshold),
+    # like the ssd_loss negative mining above
+    bg_rank = _rank_desc(jnp.where(bg, max_iou, _NEG))
+    bg_keep = bg & (bg_rank < bg_cap)
+
+    labels = jnp.where(fg_keep, 1, jnp.where(bg_keep, 0, -1)).astype(
+        jnp.int32)
+
+    # encode anchor -> matched gt as center-size deltas (unit variances,
+    # ≙ the reference's default)
+    mg = gt[jnp.clip(best_gt, 0, gt.shape[0] - 1)]
+    acx, acy, aw, ah = _center_size(anchor)
+    gcx, gcy, gw, gh = _center_size(mg)
+    deltas = jnp.stack([
+        (gcx - acx) / jnp.maximum(aw, 1e-8),
+        (gcy - acy) / jnp.maximum(ah, 1e-8),
+        jnp.log(jnp.maximum(gw / jnp.maximum(aw, 1e-8), 1e-10)),
+        jnp.log(jnp.maximum(gh / jnp.maximum(ah, 1e-8), 1e-10)),
+    ], axis=-1)
+    inside_w = jnp.broadcast_to(fg_keep[:, None], deltas.shape).astype(
+        anchor.dtype)
+    return {"Labels": [labels], "BoxDeltas": [deltas * inside_w],
+            "BoxInsideWeight": [inside_w]}
+
+
+@register_op("generate_proposals", stop_gradient=True)
+def _generate_proposals(ctx, ins, attrs):
+    """≙ generate_proposals_op.cc. Scores [B,A], BboxDeltas [B,A,4],
+    Anchors [A,4], ImInfo [B,3] (h, w, scale).
+
+    Static-shape: per image, top pre_nms_top_n by score -> decode ->
+    clip to image -> min_size mask -> NMS -> RpnRois [B,post,4],
+    RpnRoiProbs [B,post,1], RpnRoisNum [B] (valid counts; tail rows zero)."""
+    scores = ins["Scores"][0]
+    deltas = ins["BboxDeltas"][0]
+    anchors = ins["Anchors"][0]
+    im_info = ins["ImInfo"][0]
+    pre_n = min(attrs.get("pre_nms_top_n", 6000), anchors.shape[0])
+    post_n = attrs.get("post_nms_top_n", 1000)
+    nms_thresh = attrs.get("nms_thresh", 0.5)
+    min_size = attrs.get("min_size", 0.1)
+
+    acx, acy, aw, ah = _center_size(anchors)
+
+    def per_image(sc, dl, info):
+        top_sc, idx = jax.lax.top_k(sc, pre_n)
+        d = dl[idx]
+        cx = d[:, 0] * aw[idx] + acx[idx]
+        cy = d[:, 1] * ah[idx] + acy[idx]
+        w = jnp.exp(jnp.minimum(d[:, 2], 10.0)) * aw[idx]
+        h = jnp.exp(jnp.minimum(d[:, 3], 10.0)) * ah[idx]
+        boxes = jnp.stack([cx - w / 2, cy - h / 2,
+                           cx + w / 2, cy + h / 2], axis=-1)
+        ih, iw = info[0], info[1]
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, iw - 1), jnp.clip(boxes[:, 1], 0, ih - 1),
+            jnp.clip(boxes[:, 2], 0, iw - 1), jnp.clip(boxes[:, 3], 0, ih - 1),
+        ], axis=-1)
+        bw = boxes[:, 2] - boxes[:, 0]
+        bh = boxes[:, 3] - boxes[:, 1]
+        ms = min_size * info[2]
+        ok = (bw >= ms) & (bh >= ms)
+        sc_f = jnp.where(ok, top_sc, _NEG)
+        keep = _nms_single(boxes, sc_f, nms_thresh, post_n)
+        sel_sc = jnp.where(keep, sc_f, _NEG)
+        if pre_n < post_n:
+            # fewer candidates than the declared static output rows: pad so
+            # the emitted shape always matches the layer's [post_n, 4]
+            pad = post_n - pre_n
+            sel_sc = jnp.concatenate([sel_sc, jnp.full((pad,), _NEG)])
+            boxes = jnp.concatenate([boxes, jnp.zeros((pad, 4))])
+            top_sc = jnp.concatenate([top_sc, jnp.zeros((pad,))])
+        order = jnp.argsort(-sel_sc)[:post_n]
+        valid = sel_sc[order] > _NEG / 2
+        rois = boxes[order] * valid[:, None]
+        probs = (top_sc[order] * valid)[:, None]
+        return rois, probs, jnp.sum(valid.astype(jnp.int32))
+
+    rois, probs, nums = jax.vmap(per_image)(scores, deltas, im_info)
+    return {"RpnRois": [rois], "RpnRoiProbs": [probs], "RpnRoisNum": [nums]}
+
+
+# ---------------------------------------------------------------------------
+# in-graph evaluation: detection mAP + positive/negative pair
+# ---------------------------------------------------------------------------
+
+@register_op("detection_map", stop_gradient=True)
+def _detection_map(ctx, ins, attrs):
+    """≙ detection_map_op.cc, in-graph. DetectRes [B,K,6] rows
+    (label, score, xmin, ymin, xmax, ymax) — the multiclass_nms layout,
+    label < 0 padding; GtLabel [B,G,5] rows (label, box), zero-area padding.
+
+    Integral average precision per class (ap_type='integral'), averaged
+    over classes that have ground truth. Matching is greedy by score with
+    one-to-one gt assignment at overlap_threshold, the reference rule."""
+    det = ins["DetectRes"][0]
+    gt = ins["Label"][0]
+    thr = attrs.get("overlap_threshold", 0.5)
+    class_num = attrs["class_num"]
+    B, K, _ = det.shape
+    G = gt.shape[1]
+
+    gt_area = jnp.maximum(gt[..., 3] - gt[..., 1], 0) * \
+        jnp.maximum(gt[..., 4] - gt[..., 2], 0)
+    gt_valid = gt_area > 0
+
+    def ap_for_class(c):
+        det_c = det[..., 0] == c            # [B,K]
+        gt_c = gt_valid & (gt[..., 0] == c)  # [B,G]
+        npos = jnp.sum(gt_c)
+        # flatten detections, order globally by score
+        score = jnp.where(det_c, det[..., 1], _NEG).reshape(-1)   # [B*K]
+        order = jnp.argsort(-score)
+
+        iou_bg = jax.vmap(_iou)(det[..., 2:6], gt[..., 1:5])      # [B,K,G]
+        iou_flat = iou_bg.reshape(B * K, G)
+        img_of = jnp.repeat(jnp.arange(B), K)
+
+        def body(i, carry):
+            matched, tp, fp = carry        # matched [B,G]
+            di = order[i]
+            b = img_of[di]
+            cand = gt_c[b] & ~matched[b]
+            iou_row = jnp.where(cand, iou_flat[di], -1.0)
+            gi = jnp.argmax(iou_row)
+            hit = (iou_row[gi] >= thr) & (score[di] > _NEG / 2)
+            miss = (~hit) & (score[di] > _NEG / 2)
+            matched = matched.at[b, gi].set(matched[b, gi] | hit)
+            tp = tp.at[i].set(hit)
+            fp = fp.at[i].set(miss)
+            return matched, tp, fp
+
+        _, tp, fp = jax.lax.fori_loop(
+            0, B * K, body,
+            (jnp.zeros((B, G), bool), jnp.zeros((B * K,), bool),
+             jnp.zeros((B * K,), bool)))
+        ctp = jnp.cumsum(tp.astype(jnp.float32))
+        cfp = jnp.cumsum(fp.astype(jnp.float32))
+        recall = ctp / jnp.maximum(npos.astype(jnp.float32), 1.0)
+        precision = ctp / jnp.maximum(ctp + cfp, 1.0)
+        rec_prev = jnp.concatenate([jnp.zeros((1,)), recall[:-1]])
+        ap = jnp.sum((recall - rec_prev) * precision)
+        return ap, npos > 0
+
+    aps, has_gt = jax.vmap(ap_for_class)(jnp.arange(class_num))
+    n_classes = jnp.maximum(jnp.sum(has_gt.astype(jnp.float32)), 1.0)
+    m_ap = jnp.sum(jnp.where(has_gt, aps, 0.0)) / n_classes
+    return {"MAP": [m_ap]}
+
+
+@register_op("positive_negative_pair", stop_gradient=True)
+def _positive_negative_pair(ctx, ins, attrs):
+    """≙ positive_negative_pair_op.cc: within each query group, count pairs
+    ranked correctly (positive), incorrectly (negative), or tied (neutral)
+    by Score relative to the Label ordering. Score/Label/QueryID: [N,1]."""
+    s = ins["Score"][0].reshape(-1)
+    l = ins["Label"][0].reshape(-1)
+    q = ins["QueryID"][0].reshape(-1)
+    pair = (q[:, None] == q[None, :]) & (l[:, None] > l[None, :])
+    ds = s[:, None] - s[None, :]
+    pos = jnp.sum((pair & (ds > 0)).astype(jnp.float32))
+    neg = jnp.sum((pair & (ds < 0)).astype(jnp.float32))
+    neu = jnp.sum((pair & (ds == 0)).astype(jnp.float32))
+    if ins.get("AccumulatePositivePair"):
+        pos = pos + ins["AccumulatePositivePair"][0].reshape(())
+        neg = neg + ins["AccumulateNegativePair"][0].reshape(())
+        neu = neu + ins["AccumulateNeutralPair"][0].reshape(())
+    return {"PositivePair": [pos.reshape(1)],
+            "NegativePair": [neg.reshape(1)],
+            "NeutralPair": [neu.reshape(1)]}

@@ -110,48 +110,58 @@ def _depthwise_conv2d(ctx, ins, attrs):
 @register_op("conv2d_transpose")
 def _conv2d_transpose(ctx, ins, attrs):
     x, w = ins["Input"][0], ins["Filter"][0]
-    strides = tuple(attrs.get("strides", [1, 1]))
-    pads = attrs.get("paddings", [0, 0])
-    dilations = tuple(attrs.get("dilations", [1, 1]))
-    # filter stored as (C_in, C_out, H, W) per reference conv_transpose_op;
-    # transpose_kernel=True expects the *forward* conv kernel layout, i.e.
-    # HWIO with O = C_in of x (the forward conv maps C_out -> C_in).
-    # jax applies `padding` to the stride-dilated input, so the reference's
-    # deconv padding p becomes kernel_extent-1-p, giving
-    # out = (i-1)*s - 2p + kernel_extent as in conv_transpose_op.cc.
+    nd = x.ndim - 2  # spatial rank: 2 for conv2d_transpose, 3 for conv3d_
+    strides = tuple(attrs.get("strides", [1] * nd))
+    pads = attrs.get("paddings", [0] * nd)
+    dilations = tuple(attrs.get("dilations", [1] * nd))
+    # filter stored as (C_in, C_out, *spatial) per reference
+    # conv_transpose_op; transpose_kernel=True expects the *forward* conv
+    # kernel layout, i.e. <spatial>IO with O = C_in of x (the forward conv
+    # maps C_out -> C_in). jax applies `padding` to the stride-dilated
+    # input, so the reference's deconv padding p becomes kernel_extent-1-p,
+    # giving out = (i-1)*s - 2p + kernel_extent as in conv_transpose_op.cc.
     ks = w.shape[2:]
     padding = [(d * (k - 1) - p, d * (k - 1) - p)
                for k, p, d in zip(ks, pads, dilations)]
+    dn = (("NCHW", "HWIO", "NCHW") if nd == 2
+          else ("NCDHW", "DHWIO", "NCDHW"))
     out = jax.lax.conv_transpose(
-        x, jnp.transpose(w, (2, 3, 1, 0)),  # -> (H, W, C_out, C_in)
+        x, jnp.transpose(w, tuple(range(2, 2 + nd)) + (1, 0)),
         strides=strides, padding=padding,
         rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "HWIO", "NCHW"),
+        dimension_numbers=dn,
         transpose_kernel=True)
     return {"Output": [out]}
 
 
+register_op("conv3d_transpose")(_conv2d_transpose)
+
+
 @register_op("pool2d")
 def _pool2d(ctx, ins, attrs):
-    """≙ pool_op.cc: max/avg, global_pooling, ceil_mode, exclusive avg."""
+    """≙ pool_op.cc: max/avg, global_pooling, ceil_mode, exclusive avg.
+    Rank-general: serves pool3d too (NCDHW / NDHWC)."""
     x = ins["X"][0]
+    nd = x.ndim - 2  # spatial rank
     ptype = attrs.get("pooling_type", "max")
-    ksize = list(attrs.get("ksize", [2, 2]))
+    ksize = list(attrs.get("ksize", [2] * nd))
     strides = list(attrs.get("strides", ksize))
-    pads = list(attrs.get("paddings", [0, 0]))
+    pads = list(attrs.get("paddings", [0] * nd))
     data_format = attrs.get("data_format", "NCHW")
-    spatial = (2, 3) if data_format == "NCHW" else (1, 2)
+    channels_last = data_format in ("NHWC", "NDHWC")
+    spatial = tuple(range(1, 1 + nd)) if channels_last \
+        else tuple(range(2, 2 + nd))
     if attrs.get("global_pooling", False):
         ksize = [x.shape[d] for d in spatial]
         strides = ksize
-        pads = [0, 0]
-    window = [1, 1, 1, 1]
-    stride4 = [1, 1, 1, 1]
-    pad4 = [(0, 0)] * 4
+        pads = [0] * nd
+    window = [1] * x.ndim
+    stride_full = [1] * x.ndim
+    pad_full = [(0, 0)] * x.ndim
     ceil_mode = attrs.get("ceil_mode", False)
     for i, d in enumerate(spatial):
         window[d] = ksize[i]
-        stride4[d] = strides[i]
+        stride_full[d] = strides[i]
         hi = pads[i]
         if ceil_mode:
             # extra high padding so the last partial window is included
@@ -159,20 +169,26 @@ def _pool2d(ctx, ins, attrs):
             rem = span % strides[i]
             if rem != 0:
                 hi += strides[i] - rem
-        pad4[d] = (pads[i], hi)
+        pad_full[d] = (pads[i], hi)
     if ptype == "max":
         init = -jnp.inf
-        out = jax.lax.reduce_window(x, init, jax.lax.max, window, stride4, pad4)
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window,
+                                    stride_full, pad_full)
     else:
-        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, stride4, pad4)
-        if attrs.get("exclusive", True) and any(p > 0 for p in pads):
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, stride_full,
+                                  pad_full)
+        padded = any(lo > 0 or hi > 0 for lo, hi in pad_full)
+        if attrs.get("exclusive", True) and padded:
             ones = jnp.ones_like(x)
             cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
-                                        stride4, pad4)
+                                        stride_full, pad_full)
             out = s / cnt
         else:
             out = s / float(np.prod(ksize))
     return {"Out": [out]}
+
+
+register_op("pool3d")(_pool2d)
 
 
 @register_op("batch_norm")

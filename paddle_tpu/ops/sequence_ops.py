@@ -211,6 +211,81 @@ def _dynamic_lstm(ctx, ins, attrs):
     return {"Hidden": [hs], "Cell": [cs]}
 
 
+@register_op("dynamic_lstmp")
+def _dynamic_lstmp(ctx, ins, attrs):
+    """≙ lstmp_op.cc: LSTM with a recurrent projection layer. Input
+    [B, T, 4H] pre-projected; Weight [P, 4H] recurrent (acts on the
+    PROJECTED state); ProjWeight [H, P]. Emits Projection [B, T, P] and
+    Cell [B, T, H]."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]          # [P, 4H]
+    w_proj = ins["ProjWeight"][0]  # [H, P]
+    seqlen = ins["SeqLen"][0]
+    h = w_proj.shape[0]
+    p_dim = w_proj.shape[1]
+    b, t, _ = x.shape
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    if bias is not None:
+        x = x + bias.reshape(1, 1, -1)[:, :, :4 * h]
+    gate_act = _ACTS[attrs.get("gate_activation", "sigmoid")]
+    cell_act = _ACTS[attrs.get("cell_activation", "tanh")]
+    cand_act = _ACTS[attrs.get("candidate_activation", "tanh")]
+    proj_act = _ACTS[attrs.get("proj_activation", "identity")]
+    reverse = attrs.get("is_reverse", False)
+    if reverse:
+        x = jnp.flip(x, axis=1)
+    steps = jnp.arange(t)
+    r0 = jnp.zeros((b, p_dim), x.dtype)
+    c0 = jnp.zeros((b, h), x.dtype)
+
+    def step(carry, inp):
+        r_prev, c_prev = carry
+        xt, it = inp
+        gates = xt + jnp.dot(r_prev, w)
+        i, f, c_hat, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = gate_act(i), gate_act(f), gate_act(o)
+        c_new = f * c_prev + i * cand_act(c_hat)
+        h_new = o * cell_act(c_new)
+        r_new = proj_act(jnp.dot(h_new, w_proj))
+        tpos = it if not reverse else (t - 1 - it)
+        valid = (tpos < seqlen)[:, None]
+        r_new = jnp.where(valid, r_new, r_prev)
+        c_new = jnp.where(valid, c_new, c_prev)
+        return (r_new, c_new), (r_new, c_new)
+
+    (_, _), (rs, cs) = jax.lax.scan(
+        step, (r0, c0), (jnp.swapaxes(x, 0, 1), steps))
+    rs = jnp.swapaxes(rs, 0, 1)
+    cs = jnp.swapaxes(cs, 0, 1)
+    if reverse:
+        rs = jnp.flip(rs, axis=1)
+        cs = jnp.flip(cs, axis=1)
+    return {"Projection": [rs], "Cell": [cs]}
+
+
+@register_op("sequence_reshape")
+def _sequence_reshape(ctx, ins, attrs):
+    """≙ sequence_reshape_op.cc: change the feature width of a sequence,
+    scaling each sequence length by old_dim/new_dim. [B, T, D] + lengths
+    -> [B, T*D/new_dim, new_dim] + scaled lengths.
+
+    The reference additionally checks every sequence's numel is divisible
+    by new_dim at runtime; that is a data-dependent error the compiled
+    graph cannot raise, so divisibility of each seqlen*D is the caller's
+    contract (it holds automatically whenever new_dim divides D)."""
+    from ..core.enforce import InvalidArgumentError, enforce
+    x = ins["X"][0]
+    seqlen = ins["SeqLen"][0]
+    new_dim = attrs["new_dim"]
+    b, t, d = x.shape
+    enforce((t * d) % new_dim == 0,
+            f"sequence_reshape: T*D={t*d} not divisible by "
+            f"new_dim={new_dim}", exc=InvalidArgumentError)
+    out = jnp.reshape(x, (b, (t * d) // new_dim, new_dim))
+    new_len = (seqlen * d) // new_dim
+    return {"Out": [out], "SeqLenOut": [new_len]}
+
+
 @register_op("dynamic_gru")
 def _dynamic_gru(ctx, ins, attrs):
     """≙ gru_op.cc: Input [B, T, 3H] pre-projected; Weight packs

@@ -1,0 +1,246 @@
+"""Layer-level tests for the round-2 tail: 3-D conv family, RPN building
+blocks, in-graph detection_map, dice_loss, image_resize, dynamic_lstmp,
+sequence_reshape, positive_negative_pair.
+
+≙ reference layers/detection.py (rpn_target_assign, generate_proposals,
+detection_map), layers/nn.py (conv3d family, dice_loss, image_resize,
+dynamic_lstmp, sequence_reshape), positive_negative_pair_op.cc.
+"""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.layers import detection
+
+
+def test_conv3d_pool3d_train_step(rng):
+    """A tiny 3-D conv net trains end to end (conv3d -> pool3d -> fc)."""
+    vol = layers.data("vol", shape=[2, 6, 6, 6], dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+    c = layers.conv3d(vol, num_filters=3, filter_size=3, padding=1,
+                      act="relu")
+    p = layers.pool3d(c, pool_size=2, pool_stride=2, pool_type="avg")
+    logits = layers.fc(p, size=4)
+    loss = layers.reduce_mean(
+        layers.softmax_with_cross_entropy(logits, label))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    feed = {"vol": rng.rand(2, 2, 6, 6, 6).astype("float32"),
+            "label": rng.randint(0, 4, (2, 1)).astype("int64")}
+    l0 = float(exe.run(feed=feed, fetch_list=[loss])[0])
+    for _ in range(5):
+        l1 = float(exe.run(feed=feed, fetch_list=[loss])[0])
+    assert np.isfinite(l1) and l1 < l0
+
+
+def test_conv3d_transpose_upsamples(rng):
+    x = layers.data("x", shape=[2, 3, 3, 3], dtype="float32")
+    up = layers.conv3d_transpose(x, num_filters=4, filter_size=2, stride=2)
+    assert list(up.shape) == [-1, 4, 6, 6, 6]
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    out, = exe.run(feed={"x": rng.rand(1, 2, 3, 3, 3).astype("float32")},
+                   fetch_list=[up])
+    assert out.shape == (1, 4, 6, 6, 6)
+
+
+def test_dice_loss_perfect_prediction_near_zero(rng):
+    pred = layers.data("pred", shape=[4], dtype="float32")
+    lab = layers.data("lab", shape=[1], dtype="int64")
+    loss = layers.dice_loss(pred, lab)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    labels = rng.randint(0, 4, (6, 1)).astype("int64")
+    onehot = np.eye(4, dtype="float32")[labels.reshape(-1)]
+    perfect, = exe.run(feed={"pred": onehot, "lab": labels},
+                       fetch_list=[loss])
+    assert float(perfect) < 1e-3
+    uniform, = exe.run(feed={"pred": np.full((6, 4), 0.25, "float32"),
+                             "lab": labels}, fetch_list=[loss])
+    assert float(uniform) > 0.5
+
+
+def test_image_resize_and_short(rng):
+    img = layers.data("img", shape=[3, 8, 6], dtype="float32")
+    up = layers.image_resize(img, out_shape=[16, 12])
+    short = layers.image_resize_short(img, out_short_len=12)
+    assert list(up.shape) == [-1, 3, 16, 12]
+    assert list(short.shape) == [-1, 3, 16, 12]  # short side 6 -> 12
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    x = rng.rand(2, 3, 8, 6).astype("float32")
+    a, b = exe.run(feed={"img": x}, fetch_list=[up, short])
+    assert a.shape == (2, 3, 16, 12) and b.shape == (2, 3, 16, 12)
+    # constant image stays constant under bilinear resize
+    const, = exe.run(feed={"img": np.ones((1, 3, 8, 6), "float32")},
+                     fetch_list=[up])
+    np.testing.assert_allclose(const, 1.0, rtol=1e-6)
+
+
+def test_dynamic_lstmp_shapes_and_masking(rng):
+    x = layers.data("x", shape=[5, 6], dtype="float32", lod_level=1)
+    proj = layers.fc(x, size=16, num_flatten_dims=2, bias_attr=False)
+    proj = layers.sequence.tag_sequence(proj, layers.sequence.get_seqlen(x))
+    r, c = layers.sequence.dynamic_lstmp(proj, size=16, proj_size=3)
+    assert list(r.shape) == [-1, 5, 3]
+    assert list(c.shape) == [-1, 5, 4]
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    feed = {"x": rng.rand(2, 5, 6).astype("float32"),
+            "x@SEQLEN": np.array([5, 3], "int32")}
+    rv, cv = exe.run(feed=feed, fetch_list=[r, c])
+    assert rv.shape == (2, 5, 3) and cv.shape == (2, 5, 4)
+    # finished timesteps freeze the projected state (masked scan)
+    np.testing.assert_allclose(rv[1, 3], rv[1, 2], rtol=1e-6)
+    np.testing.assert_allclose(rv[1, 4], rv[1, 2], rtol=1e-6)
+
+
+def test_sequence_reshape_roundtrip(rng):
+    x = layers.data("x", shape=[4, 6], dtype="float32", lod_level=1)
+    out = layers.sequence.sequence_reshape(x, new_dim=3)
+    assert list(out.shape) == [-1, 8, 3]
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    xv = rng.rand(2, 4, 6).astype("float32")
+    ov, = exe.run(feed={"x": xv, "x@SEQLEN": np.array([4, 2], "int32")},
+                  fetch_list=[out])
+    np.testing.assert_allclose(ov, xv.reshape(2, 8, 3), rtol=1e-6)
+
+
+def test_rpn_target_assign_layer(rng):
+    anchors = layers.data("anchors", shape=[4], dtype="float32")
+    gt = layers.data("gt", shape=[4], dtype="float32")
+    labels, deltas, inw = detection.rpn_target_assign(
+        anchors, gt, rpn_batch_size_per_im=16)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+
+    def boxes(n, scale=1.0):
+        x1 = rng.uniform(0, 0.5, (n,))
+        y1 = rng.uniform(0, 0.5, (n,))
+        return np.stack([x1, y1, x1 + rng.uniform(0.1, 0.5, (n,)),
+                         y1 + rng.uniform(0.1, 0.5, (n,))],
+                        -1).astype("float32") * scale
+
+    av, gv = boxes(32), boxes(4)
+    lv, dv, wv = exe.run(feed={"anchors": av, "gt": gv},
+                         fetch_list=[labels, deltas, inw])
+    assert set(np.unique(lv)) <= {-1, 0, 1}
+    assert (lv == 1).sum() >= 1
+    # deltas are zeroed outside the fg set
+    assert np.all(dv[lv != 1] == 0)
+
+
+def test_generate_proposals_layer(rng):
+    scores = layers.data("scores", shape=[24], dtype="float32")
+    deltas = layers.data("deltas", shape=[24, 4], dtype="float32")
+    iminfo = layers.data("iminfo", shape=[3], dtype="float32")
+    anchors_in = layers.data("anch", shape=[4], dtype="float32")
+    rois, probs, nums = detection.generate_proposals(
+        scores, deltas, iminfo, anchors_in, pre_nms_top_n=16,
+        post_nms_top_n=5, nms_thresh=0.7)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    x1 = rng.uniform(0, 10, (24,))
+    y1 = rng.uniform(0, 10, (24,))
+    av = np.stack([x1, y1, x1 + rng.uniform(2, 8, (24,)),
+                   y1 + rng.uniform(2, 8, (24,))], -1).astype("float32")
+    rv, pv, nv = exe.run(
+        feed={"scores": rng.rand(1, 24).astype("float32"),
+              "deltas": (rng.randn(1, 24, 4) * 0.1).astype("float32"),
+              "iminfo": np.array([[20, 20, 1.0]], "float32"),
+              "anch": av},
+        fetch_list=[rois, probs, nums])
+    assert rv.shape == (1, 5, 4) and pv.shape == (1, 5, 1)
+    assert 1 <= int(nv[0]) <= 5
+    # all kept rois inside the image
+    assert rv.min() >= 0 and rv.max() <= 19.0 + 1e-5
+
+
+def test_detection_map_layer_degrades_with_bad_boxes(rng):
+    det = layers.data("det", shape=[2, 6], dtype="float32")
+    gt = layers.data("gt", shape=[2, 5], dtype="float32")
+    m = detection.detection_map(det, gt, class_num=3)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    gt_v = np.array([[[1, .1, .1, .4, .4], [2, .5, .5, .9, .9]]], "float32")
+    perfect = np.array(
+        [[[1, .9, .1, .1, .4, .4], [2, .8, .5, .5, .9, .9]]], "float32")
+    wrong = np.array(
+        [[[1, .9, .6, .6, .8, .8], [2, .8, .05, .05, .2, .2]]], "float32")
+    mp, = exe.run(feed={"det": perfect, "gt": gt_v}, fetch_list=[m])
+    mw, = exe.run(feed={"det": wrong, "gt": gt_v}, fetch_list=[m])
+    assert abs(float(mp) - 1.0) < 1e-6
+    assert float(mw) < 0.5
+
+
+def test_positive_negative_pair_layer():
+    s = layers.data("s", shape=[1], dtype="float32")
+    l = layers.data("l", shape=[1], dtype="float32")
+    q = layers.data("q", shape=[1], dtype="int64")
+    pos, neg, neu = layers.positive_negative_pair(s, l, q)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    pv, nv, uv = exe.run(
+        feed={"s": np.array([[.9], [.5], [.1]], "float32"),
+              "l": np.array([[2], [1], [0]], "float32"),
+              "q": np.array([[0], [0], [0]], "int64")},
+        fetch_list=[pos, neg, neu])
+    assert float(pv) == 3.0 and float(nv) == 0.0 and float(uv) == 0.0
+
+
+def test_pool_exclusive_avg_with_ceil_mode_tail(rng):
+    """ceil_mode's implicit high padding must not dilute exclusive avg:
+    the partial tail window divides by its valid element count."""
+    x = layers.data("x", shape=[1, 1, 5], dtype="float32")
+    out = layers.pool2d(x, pool_size=[1, 2], pool_stride=[1, 2],
+                        pool_type="avg", ceil_mode=True, exclusive=True)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    xv = np.arange(5, dtype="float32").reshape(1, 1, 1, 5)
+    ov, = exe.run(feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(ov.reshape(-1), [0.5, 2.5, 4.0], rtol=1e-6)
+
+
+def test_generate_proposals_pads_when_fewer_anchors_than_post_n(rng):
+    """post_nms_top_n larger than the anchor count must still emit the
+    declared static [B, post_n, 4] shape (zero-padded tail)."""
+    scores = layers.data("scores", shape=[6], dtype="float32")
+    deltas = layers.data("deltas", shape=[6, 4], dtype="float32")
+    iminfo = layers.data("iminfo", shape=[3], dtype="float32")
+    anchors_in = layers.data("anch", shape=[4], dtype="float32")
+    rois, probs, nums = detection.generate_proposals(
+        scores, deltas, iminfo, anchors_in, post_nms_top_n=10)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    x1 = rng.uniform(0, 10, (6,))
+    av = np.stack([x1, x1, x1 + 5, x1 + 5], -1).astype("float32")
+    rv, pv, nv = exe.run(
+        feed={"scores": rng.rand(1, 6).astype("float32"),
+              "deltas": np.zeros((1, 6, 4), "float32"),
+              "iminfo": np.array([[20, 20, 1.0]], "float32"),
+              "anch": av},
+        fetch_list=[rois, probs, nums])
+    assert rv.shape == (1, 10, 4) and pv.shape == (1, 10, 1)
+    assert int(nv[0]) <= 6
+
+
+def test_rpn_target_assign_no_gt_image_samples_negatives(rng):
+    """An image whose gt list is all padding must still produce background
+    samples (not all-ignore), or empty images silently drop out of the RPN
+    classification loss."""
+    anchors = layers.data("anchors", shape=[4], dtype="float32")
+    gt = layers.data("gt", shape=[4], dtype="float32")
+    labels, _, _ = detection.rpn_target_assign(
+        anchors, gt, rpn_batch_size_per_im=8)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    x1 = rng.uniform(0, 0.5, (16,))
+    av = np.stack([x1, x1, x1 + 0.3, x1 + 0.3], -1).astype("float32")
+    lv, = exe.run(feed={"anchors": av,
+                        "gt": np.zeros((3, 4), "float32")},
+                  fetch_list=[labels])
+    assert (lv == 0).sum() == 8      # full negative batch
+    assert (lv == 1).sum() == 0

@@ -1051,6 +1051,84 @@ SPECS.update({
                        "GTLabel": (_ints(r, (1, 2), 2) + 1),
                        "PriorBox": _boxes(r, 4)},
         grad=[]),
+    "rpn_target_assign": dict(
+        ins=lambda r: {"Anchor": _boxes(r, 16), "GtBox": _boxes(r, 3)},
+        attrs={"rpn_batch_size_per_im": 8, "rpn_fg_fraction": 0.5,
+               "rpn_positive_overlap": 0.6, "rpn_negative_overlap": 0.3},
+        check=lambda got, i, a: (
+            _assert(set(np.unique(got["Labels"][0])) <= {-1, 0, 1},
+                    "labels in {-1,0,1}"),
+            _assert((got["Labels"][0] == 1).sum() >= 1,
+                    "every gt owns at least one fg anchor"),
+            _assert((got["Labels"][0] != -1).sum() <= 8,
+                    "sampled set capped at rpn_batch_size_per_im")),
+        grad=[]),
+    "generate_proposals": dict(
+        ins=lambda r: {"Scores": r.rand(2, 12).astype("float32"),
+                       "BboxDeltas": (r.randn(2, 12, 4) * 0.1)
+                       .astype("float32"),
+                       "Anchors": _boxes(r, 12) * 20,
+                       "ImInfo": np.array([[20, 20, 1.0], [20, 20, 1.0]],
+                                          "float32")},
+        attrs={"pre_nms_top_n": 8, "post_nms_top_n": 4,
+               "nms_thresh": 0.7, "min_size": 0.1},
+        check=lambda got, i, a: (
+            _assert(got["RpnRois"][0].shape == (2, 4, 4), "roi shape"),
+            _assert((got["RpnRoisNum"][0] >= 1).all(),
+                    "at least one proposal per image")),
+        grad=[]),
+    "detection_map": dict(
+        # detections == ground truth -> mAP must be exactly 1
+        ins=lambda r: {"DetectRes": np.array(
+            [[[1, 0.9, .1, .1, .4, .4], [2, 0.8, .5, .5, .9, .9]]],
+            "float32"),
+            "Label": np.array(
+            [[[1, .1, .1, .4, .4], [2, .5, .5, .9, .9]]], "float32")},
+        attrs={"class_num": 3, "overlap_threshold": 0.5},
+        check=lambda got, i, a: _assert(
+            abs(float(got["MAP"][0]) - 1.0) < 1e-6, "perfect mAP"),
+        grad=[]),
+    "positive_negative_pair": dict(
+        # query 0: pairs (s=.9,l=2)>(s=.1,l=0) correct, (s=.5,l=1)>(.1,0)
+        # correct, (.9,2)>(.5,1) correct -> 3 positive; query 1: one
+        # inverted pair -> 1 negative
+        ins=lambda r: {"Score": np.array(
+            [[.9], [.5], [.1], [.2], [.7]], "float32"),
+            "Label": np.array([[2], [1], [0], [1], [0]], "float32"),
+            "QueryID": np.array([[0], [0], [0], [1], [1]], "int64")},
+        ref=lambda i, a: {"PositivePair": np.array([3.0], "float32"),
+                          "NegativePair": np.array([1.0], "float32"),
+                          "NeutralPair": np.array([0.0], "float32")},
+        grad=[]),
+})
+
+# -- 3-D conv/pool + sequence tail -------------------------------------------
+SPECS.update({
+    "conv3d_transpose": dict(
+        ins=lambda r: {"Input": _away(r, (1, 2, 3, 3, 3)),
+                       "Filter": _away(r, (2, 3, 2, 2, 2)) * 0.3},
+        attrs={"strides": [2, 2, 2], "paddings": [0, 0, 0]},
+        grad=["Input", "Filter"], out_slot="Output"),
+    "pool3d": dict(
+        ins=lambda r: {"X": r.rand(1, 2, 4, 4, 4).astype("float32")},
+        attrs={"pooling_type": "avg", "ksize": [2, 2, 2],
+               "strides": [2, 2, 2], "paddings": [0, 0, 0]},
+        ref=lambda i, a: {"Out": i["X"][0].reshape(
+            1, 2, 2, 2, 2, 2, 2, 2).mean(axis=(3, 5, 7))},
+        grad=["X"]),
+    "dynamic_lstmp": dict(
+        ins=lambda r: {"Input": _away(r, (2, 3, 16)),
+                       "Weight": _away(r, (3, 16)) * 0.3,
+                       "ProjWeight": _away(r, (4, 3)) * 0.3,
+                       "SeqLen": np.array([3, 2], "int32")},
+        grad=["Input", "Weight", "ProjWeight"], out_slot="Projection"),
+    "sequence_reshape": dict(
+        ins=lambda r: {"X": _away(r, (2, 4, 6)),
+                       "SeqLen": np.array([4, 2], "int32")},
+        attrs={"new_dim": 3},
+        ref=lambda i, a: {"Out": i["X"][0].reshape(2, 8, 3),
+                          "SeqLenOut": np.array([8, 4], "int32")},
+        grad=["X"]),
 })
 
 

@@ -277,9 +277,19 @@ def conv3d_transpose(input, num_filters, filter_size=None, output_size=None,
                 "conv3d_transpose needs filter_size or output_size",
                 exc=InvalidArgumentError)
         output_size = _triple(output_size)
-        filter_size = [
-            output_size[i] - (spatial_in[i] - 1) * stride[i] + 2 * padding[i]
-            if spatial_in[i] != -1 else 1 for i in range(3)]
+        # invert out = (in-1)*s - 2p + d*(k-1) + 1 for k
+        filter_size = []
+        for i in range(3):
+            if spatial_in[i] == -1:
+                filter_size.append(1)
+                continue
+            span = (output_size[i] - (spatial_in[i] - 1) * stride[i]
+                    + 2 * padding[i] - 1)
+            enforce(span % dilation[i] == 0,
+                    f"output_size[{i}]={output_size[i]} unreachable with "
+                    f"stride={stride[i]} padding={padding[i]} "
+                    f"dilation={dilation[i]}", exc=InvalidArgumentError)
+            filter_size.append(span // dilation[i] + 1)
     else:
         filter_size = _triple(filter_size)
     w = helper.create_parameter(param_attr,

@@ -93,7 +93,15 @@ def dynamic_lstmp(input, size, proj_size, h_0=None, c_0=None,
     weight = helper.create_parameter(param_attr,
                                      shape=[proj_size, 4 * hidden_size],
                                      dtype=dtype)
-    proj_weight = helper.create_parameter(param_attr,
+    # the projection weight must NOT alias the recurrent weight when the
+    # caller names param_attr (create_parameter returns the existing var for
+    # a repeated name) — derive a distinct name
+    from ..param_attr import ParamAttr
+    proj_attr = param_attr
+    if isinstance(param_attr, ParamAttr) and param_attr.name:
+        proj_attr = ParamAttr(name=param_attr.name + "_proj",
+                              initializer=param_attr.initializer)
+    proj_weight = helper.create_parameter(proj_attr,
                                           shape=[hidden_size, proj_size],
                                           dtype=dtype)
     bias = helper.create_parameter(
@@ -104,10 +112,15 @@ def dynamic_lstmp(input, size, proj_size, h_0=None, c_0=None,
     proj = helper.create_tmp_variable(dtype=dtype, shape=[b, t, proj_size])
     cell = helper.create_tmp_variable(dtype=dtype,
                                       shape=[b, t, hidden_size])
+    inputs = {"Input": [input], "Weight": [weight],
+              "ProjWeight": [proj_weight], "Bias": [bias],
+              "SeqLen": [seqlen]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if c_0 is not None:
+        inputs["C0"] = [c_0]
     helper.append_op(type="dynamic_lstmp",
-                     inputs={"Input": [input], "Weight": [weight],
-                             "ProjWeight": [proj_weight], "Bias": [bias],
-                             "SeqLen": [seqlen]},
+                     inputs=inputs,
                      outputs={"Projection": [proj], "Cell": [cell]},
                      attrs={"use_peepholes": use_peepholes,
                             "is_reverse": is_reverse,

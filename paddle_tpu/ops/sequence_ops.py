@@ -235,8 +235,9 @@ def _dynamic_lstmp(ctx, ins, attrs):
     if reverse:
         x = jnp.flip(x, axis=1)
     steps = jnp.arange(t)
-    r0 = jnp.zeros((b, p_dim), x.dtype)
-    c0 = jnp.zeros((b, h), x.dtype)
+    # H0 here is the initial PROJECTED state [B, P] (the recurrent input)
+    r0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((b, p_dim), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((b, h), x.dtype)
 
     def step(carry, inp):
         r_prev, c_prev = carry

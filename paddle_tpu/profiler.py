@@ -44,20 +44,37 @@ class _Event:
         return (self.end - self.start) * 1e3
 
 
+_device_tracing = False
+
+
 class RecordEvent:
     """RAII scope annotation (≙ platform::RecordEvent, profiler.h:73).
-    Nesting shows up in the Chrome trace via overlapping ts/dur spans."""
+    Nesting shows up in the Chrome trace via overlapping ts/dur spans.
+
+    While a device (XPlane) trace is active, the same name is additionally
+    entered as a jax.profiler.TraceAnnotation, so it appears ON the device
+    timeline correlated with the XLA ops dispatched inside the scope — the
+    RecordEvent→device correlation the reference gets from CUPTI
+    correlation ids (device_tracer.h:49 + tools/timeline.py:45)."""
 
     def __init__(self, name: str):
         self.name = name
         self._start = None
+        self._annotation = None
 
     def __enter__(self):
         if _enabled:
             self._start = time.perf_counter()
+            if _device_tracing:
+                import jax
+                self._annotation = jax.profiler.TraceAnnotation(self.name)
+                self._annotation.__enter__()
         return self
 
     def __exit__(self, *exc):
+        if self._annotation is not None:
+            self._annotation.__exit__(*exc)
+            self._annotation = None
         if self._start is not None:
             ev = _Event(self.name, threading.get_ident(), self._start,
                         time.perf_counter())
@@ -84,7 +101,7 @@ def start_profiler(state: str = "All", tracer_option: Optional[str] = None):
     ≙ EnableProfiler (reference profiler.h:116; states CPU/GPU/All map to
     host-only vs host+device here).
     """
-    global _enabled, _trace_dir
+    global _enabled, _trace_dir, _device_tracing
     enforce(state in ("CPU", "GPU", "All", "TPU"),
             f"invalid profiler state {state!r}", exc=InvalidArgumentError)
     _enabled = True
@@ -94,6 +111,7 @@ def start_profiler(state: str = "All", tracer_option: Optional[str] = None):
             import jax
             try:
                 jax.profiler.start_trace(trace_dir)
+                _device_tracing = True
             except RuntimeError:
                 pass  # already tracing
 
@@ -103,17 +121,22 @@ def stop_profiler(sorted_key: Optional[str] = None,
     """Disable recording, print the per-event summary table, optionally dump
     a Chrome trace JSON to profile_path (≙ DisableProfiler profiler.h:119 +
     tools/timeline.py)."""
-    global _enabled
+    global _enabled, _device_tracing
     if not _enabled:
         return
     _enabled = False
+    was_device = _device_tracing
+    _device_tracing = False
     import jax
     try:
         jax.profiler.stop_trace()
     except RuntimeError:
         pass
     if profile_path:
-        export_chrome_tracing(profile_path)
+        export_chrome_tracing(
+            profile_path,
+            device_trace_dir=(_trace_dir or os.environ.get("PTPU_TRACE_DIR"))
+            if was_device else None)
     print_profiler_summary(sorted_key or "default")
 
 
@@ -148,10 +171,41 @@ def print_profiler_summary(sorted_key: str = "default"):
     print("-" * len(hdr))
 
 
-def export_chrome_tracing(path: str):
-    """Write recorded host events as a Chrome trace (catapult) JSON —
-    the host-side half of tools/timeline.py (device side comes from the
-    jax.profiler XPlane dump)."""
+def _collect_device_trace_events(trace_dir: str):
+    """Pull the device timeline out of a jax.profiler dump: the profiler
+    writes a Chrome-format *.trace.json.gz under
+    <dir>/plugins/profile/<run>/ — merge its events (annotated with the
+    RecordEvent names via TraceAnnotation) rather than asking users to
+    open TensorBoard separately. ≙ tools/timeline.py merging the CUPTI
+    device records into one timeline."""
+    import glob
+    import gzip
+    pats = sorted(glob.glob(os.path.join(
+        trace_dir, "plugins", "profile", "*", "*.trace.json.gz")))
+    if not pats:
+        return []
+    with gzip.open(pats[-1], "rt") as f:
+        data = json.load(f)
+    out = []
+    for ev in data.get("traceEvents", []):
+        if not isinstance(ev, dict):
+            continue
+        # keep metadata ('M': process/thread names) AND timed events; shift
+        # every device pid up by 1 so lanes never collide with the host
+        # (pid 0) while distinct planes stay distinct
+        if "ts" not in ev and ev.get("ph") != "M":
+            continue
+        ev = dict(ev)
+        ev["cat"] = ev.get("cat", "device")
+        ev["pid"] = int(ev.get("pid", 0)) + 1
+        out.append(ev)
+    return out
+
+
+def export_chrome_tracing(path: str, device_trace_dir: Optional[str] = None):
+    """Write recorded host events — and, when a device trace dir is given,
+    the jax.profiler device timeline — as ONE Chrome trace (catapult) JSON
+    (≙ tools/timeline.py, which merges host + CUPTI device records)."""
     with _events_lock:
         events = list(_completed)
     trace = {"traceEvents": [], "displayTimeUnit": "ms"}
@@ -161,6 +215,9 @@ def export_chrome_tracing(path: str):
             "ts": ev.start * 1e6, "dur": (ev.end - ev.start) * 1e6,
             "pid": 0, "tid": ev.thread_id,
         })
+    if device_trace_dir:
+        trace["traceEvents"].extend(
+            _collect_device_trace_events(device_trace_dir))
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     with open(path, "w") as f:
@@ -193,11 +250,14 @@ def profiler(state: str = "All", sorted_key: str = "default",
 def device_tracer(log_dir: str):
     """Capture a device (XPlane) trace to log_dir for TensorBoard — the
     TPU analogue of the CUPTI DeviceTracer (device_tracer.h:49)."""
+    global _device_tracing
     import jax
     jax.profiler.start_trace(log_dir)
+    _device_tracing = True
     try:
         yield
     finally:
+        _device_tracing = False
         jax.profiler.stop_trace()
 
 

@@ -1,0 +1,126 @@
+"""Per-pass numerical-equivalence verification on REAL models.
+
+≙ the reference's per-pass inference tests (inference/tests/book/,
+inference/analysis/analyzer_tester.cc): every Analyzer/transpiler rewrite
+must leave the program numerically equivalent (or boundedly close, for
+quantization) on an actual model, not just a toy block.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, models
+
+
+def test_bn_fold_pass_preserves_resnet_cifar10_logits(rng):
+    """BN-fold on resnet_cifar10 inference: logits identical (atol) after
+    batch_norm ops are folded into the preceding convolutions."""
+    from paddle_tpu import Analyzer
+
+    loss, acc, logits = models.resnet.resnet_cifar10(depth=20)
+    train_prog = pt.default_main_program()
+    pt.optimizer.MomentumOptimizer(learning_rate=0.01,
+                                   momentum=0.9).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    # a few train steps so BN moving stats move off their init values
+    feed = {"img": rng.rand(4, 32, 32, 3).astype("float32"),
+            "label": rng.randint(0, 10, (4, 1)).astype("int64")}
+    for _ in range(3):
+        exe.run(feed=feed, fetch_list=[loss])
+
+    infer = train_prog.clone(for_test=True).prune([logits.name])
+    base, = exe.run(infer, feed={"img": feed["img"]}, fetch_list=[logits])
+
+    folded = Analyzer(passes=["bn_fold_pass"]).run(
+        infer, pt.global_scope(), targets=[logits])
+    types = [op.type for op in folded.global_block().ops]
+    assert "batch_norm" not in types, "pass did not fold the BN ops"
+    got, = exe.run(folded, feed={"img": feed["img"]}, fetch_list=[logits])
+    np.testing.assert_allclose(got, base, atol=2e-3, rtol=2e-3)
+
+
+def test_memory_optimize_remat_preserves_transformer_train_step(rng):
+    """Rematerialization on transformer_lm: the rewritten program's loss AND
+    updated parameters match the unoptimized run exactly — remat may only
+    trade FLOPs for memory, never change math."""
+    from paddle_tpu.core import unique_name
+
+    def build_and_step(remat_level):
+        pt.reset_default_programs()
+        pt.reset_global_scope()
+        with unique_name.guard():
+            loss, _ = models.transformer.transformer_lm(
+                vocab=64, max_len=8, d_model=32, d_inner=64, num_heads=2,
+                num_layers=2)
+            pt.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+        prog = pt.default_main_program()
+        if remat_level is not None:
+            from paddle_tpu.transpiler import memory_optimization
+            memory_optimization.memory_optimize(prog, level=remat_level)
+            assert any(op.attrs.get("remat")
+                       for op in prog.global_block().ops
+                       if op.type == "vjp_region"), "remat not applied"
+        exe = pt.Executor()
+        pt.default_startup_program().random_seed = 7
+        exe.run(pt.default_startup_program())
+        rng2 = np.random.RandomState(3)
+        tok = rng2.randint(0, 64, (4, 8)).astype("int64")
+        tgt = rng2.randint(0, 64, (4, 8)).astype("int64")
+        sl = np.full((4,), 8, dtype="int32")
+        lv = exe.run(feed={"tokens": tok, "tokens@SEQLEN": sl,
+                           "targets": tgt}, fetch_list=[loss])[0]
+        params = {p.name: np.asarray(pt.global_scope().get(p.name))
+                  for p in prog.all_parameters()}
+        return float(lv), params
+
+    base_loss, base_params = build_and_step(None)
+    for level in (0, 1):
+        remat_loss, remat_params = build_and_step(level)
+        assert abs(base_loss - remat_loss) < 1e-5, (level, base_loss,
+                                                    remat_loss)
+        assert base_params.keys() == remat_params.keys()
+        for name in base_params:
+            np.testing.assert_allclose(
+                remat_params[name], base_params[name], atol=1e-5,
+                rtol=1e-4, err_msg=f"level={level} param {name}")
+
+
+def test_quant_freeze_round_trip_mlp(rng):
+    """QAT -> train -> freeze: the frozen program's outputs match the
+    QAT program's outputs (freezing bakes the SAME quantization the fake
+    ops already simulate, so outputs agree to rounding tolerance)."""
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.transpiler import QuantizeTranspiler
+
+    with unique_name.guard():
+        img = layers.data("img", shape=[16], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(img, size=16, act="relu")
+        logits = layers.fc(h, size=4)
+        loss = layers.reduce_mean(
+            layers.softmax_with_cross_entropy(logits, label))
+
+    qt = QuantizeTranspiler(weight_bits=8, activation_bits=8)
+    qt.training_transpile()
+    pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    feed = {"img": rng.rand(8, 16).astype("float32"),
+            "label": rng.randint(0, 4, (8, 1)).astype("int64")}
+    for _ in range(5):
+        exe.run(feed=feed, fetch_list=[loss])
+
+    qat_prog = pt.default_main_program().clone(for_test=True).prune(
+        [logits.name])
+    qat_out, = exe.run(qat_prog, feed={"img": feed["img"]},
+                       fetch_list=[logits])
+
+    frozen = qt.freeze_program(qat_prog, scope=pt.global_scope())
+    froz_out, = exe.run(frozen, feed={"img": feed["img"]},
+                        fetch_list=[logits])
+    np.testing.assert_allclose(froz_out, qat_out, atol=2e-2, rtol=2e-2)
+    # and the quantization is real: int8 grid has visible granularity vs
+    # an unquantized float run of the same weights
+    assert np.abs(froz_out - qat_out).max() < np.abs(qat_out).max()

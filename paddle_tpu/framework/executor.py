@@ -205,16 +205,15 @@ class Executor:
             fetches, new_state = compiled.fn(feed_vals, ro_vals, rw_vals, seed)
             if _prof.profiler_enabled():
                 jax.block_until_ready(fetches)
-        for name, val in zip(compiled.state_out_names, new_state):
-            scope.set_var(name, val)
         if flags.get_flag("check_nan_inf") and jax.default_backend() != "cpu":
             # TPU fallback for the in-graph nan guard (which needs host
             # callbacks and so no-ops off-CPU, lowering.py _nan_guard):
-            # sweep every fetch and updated state for non-finite values at
-            # fetch time. Coarser than the per-op guard — it tells you WHICH
-            # var went bad but not which op; rerun under JAX_PLATFORMS=cpu
-            # to localize. ≙ reference CheckTensorNANOrInf
-            # (framework/operator.cc:726-736).
+            # sweep every fetch and updated state for non-finite values
+            # BEFORE the scope write-back, so the last-good parameters stay
+            # checkpointable when the step diverges. Coarser than the per-op
+            # guard — it names WHICH var went bad but not which op; rerun
+            # under JAX_PLATFORMS=cpu to localize. ≙ reference
+            # CheckTensorNANOrInf (framework/operator.cc:726-736).
             for name, val in list(zip(compiled.fetch_names, fetches)) + \
                     list(zip(compiled.state_out_names, new_state)):
                 if hasattr(val, "dtype") and jnp.issubdtype(
@@ -224,6 +223,8 @@ class Executor:
                             f"NaN/Inf detected in {name!r} (fetch-time "
                             f"sweep; rerun under JAX_PLATFORMS=cpu with "
                             f"PTPU_CHECK_NAN_INF=1 to localize the op)")
+        for name, val in zip(compiled.state_out_names, new_state):
+            scope.set_var(name, val)
         if flags.get_flag("benchmark"):
             jax.block_until_ready(fetches)
             print(f"[benchmark] program run took {time.time() - t0:.4f}s")

@@ -94,7 +94,8 @@ class LayerHelper:
 
     # -- bias / activation (≙ LayerHelper.append_bias_op/append_activation) --
     def append_bias_op(self, input_var: Variable, dim_start: int = 1,
-                       dim_end: Optional[int] = None) -> Variable:
+                       dim_end: Optional[int] = None,
+                       use_bf16: bool = False) -> Variable:
         bias_attr = ParamAttr._to_attr(self.kwargs.get("bias_attr"))
         if bias_attr is None:
             return input_var
@@ -104,10 +105,12 @@ class LayerHelper:
                                   is_bias=True)
         out = self.create_tmp_variable(dtype=dtype_name(input_var.dtype),
                                        shape=input_var.shape)
+        # use_bf16: the add casts the fp32 bias down to the activation dtype
+        # instead of promoting the whole tensor back to fp32
         self.append_op(type="elementwise_add",
                        inputs={"X": [input_var], "Y": [b]},
                        outputs={"Out": [out]},
-                       attrs={"axis": dim_start})
+                       attrs={"axis": dim_start, "use_bf16": use_bf16})
         return out
 
     def append_activation(self, input_var: Variable) -> Variable:

@@ -58,7 +58,8 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
             dtype=dtype_name(inputs[0].dtype), shape=mul_results[0].shape)
         helper.append_op(type="sum", inputs={"X": mul_results},
                          outputs={"Out": [pre_bias]})
-    pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims)
+    pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims,
+                                    use_bf16=use_bf16)
     return helper.append_activation(pre_act)
 
 
@@ -139,7 +140,7 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
                             "dilations": dilation, "groups": groups,
                             "data_format": data_format, "use_bf16": use_bf16})
     pre_act = helper.append_bias_op(out, dim_start=c_axis,
-                                    dim_end=c_axis + 1)
+                                    dim_end=c_axis + 1, use_bf16=use_bf16)
     return helper.append_activation(pre_act)
 
 
@@ -256,7 +257,7 @@ def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
                             "dilations": dilation, "groups": groups,
                             "data_format": data_format, "use_bf16": use_bf16})
     pre_act = helper.append_bias_op(out, dim_start=c_axis,
-                                    dim_end=c_axis + 1)
+                                    dim_end=c_axis + 1, use_bf16=use_bf16)
     return helper.append_activation(pre_act)
 
 

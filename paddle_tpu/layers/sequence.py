@@ -95,12 +95,14 @@ def dynamic_lstmp(input, size, proj_size, h_0=None, c_0=None,
                                      dtype=dtype)
     # the projection weight must NOT alias the recurrent weight when the
     # caller names param_attr (create_parameter returns the existing var for
-    # a repeated name) — derive a distinct name
+    # a repeated name) — derive a distinct name, keeping every other attr
+    # (trainable/regularizer/lr/clip/sharding)
+    import copy as _copy
     from ..param_attr import ParamAttr
     proj_attr = param_attr
     if isinstance(param_attr, ParamAttr) and param_attr.name:
-        proj_attr = ParamAttr(name=param_attr.name + "_proj",
-                              initializer=param_attr.initializer)
+        proj_attr = _copy.copy(param_attr)
+        proj_attr.name = param_attr.name + "_proj"
     proj_weight = helper.create_parameter(proj_attr,
                                           shape=[hidden_size, proj_size],
                                           dtype=dtype)

@@ -29,6 +29,13 @@ def _broadcast_y(x, y, axis):
 def _binary(fn):
     def lower(ctx, ins, attrs):
         x, y = ins["X"][0], ins["Y"][0]
+        if attrs.get("use_bf16", False) and x.dtype != y.dtype and \
+                str(x.dtype) == "bfloat16":
+            # bias/residual add on the bf16 activation path: cast the fp32
+            # side down instead of letting jnp promotion lift the whole
+            # activation tensor back to fp32 (which would undo the bf16
+            # pipeline right after every matmul/conv bias)
+            y = y.astype(x.dtype)
         y = _broadcast_y(x, y, attrs.get("axis", -1))
         return {"Out": [fn(x, y)]}
     return lower

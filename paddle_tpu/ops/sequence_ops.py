@@ -235,8 +235,13 @@ def _dynamic_lstmp(ctx, ins, attrs):
     if reverse:
         x = jnp.flip(x, axis=1)
     steps = jnp.arange(t)
-    # H0 here is the initial PROJECTED state [B, P] (the recurrent input)
-    r0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((b, p_dim), x.dtype)
+    # H0 is the HIDDEN state [B, H] as in lstmp_op.cc — it enters the
+    # recurrence through the projection, like every other step's hidden
+    if ins.get("H0"):
+        proj_act0 = _ACTS[attrs.get("proj_activation", "identity")]
+        r0 = proj_act0(jnp.dot(ins["H0"][0], w_proj))
+    else:
+        r0 = jnp.zeros((b, p_dim), x.dtype)
     c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((b, h), x.dtype)
 
     def step(carry, inp):

@@ -116,9 +116,14 @@ class StaticRNN:
         self._sub = self.program._create_block()
         try:
             yield
-        finally:
+        except BaseException:
+            # a failing step body must surface ITS error — finalizing a
+            # half-built block would mask it behind "every memory needs
+            # update_memory"
             self.program._rollback()
-            self._finalize()
+            raise
+        self.program._rollback()
+        self._finalize()
 
     # -- inside-step API --------------------------------------------------
     def step_input(self, x: Variable) -> Variable:

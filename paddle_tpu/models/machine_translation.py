@@ -115,56 +115,24 @@ def infer_net(src, src_lens, dict_size=10000, embed_dim=64, hidden_dim=128,
     dec_init = layers.fc(layers.sequence_last_step(enc_out),
                          size=hidden_dim, act="tanh", name="dec_init")
 
-    K = beam_size
-    # expand to beams: [B, K, H]
-    state0 = layers.expand(layers.unsqueeze(dec_init, axes=[1]),
-                           expand_times=[1, K, 1])
-    ids0 = layers.fill_constant_batch_size_like(
-        src, shape=[-1, K], dtype="int64", value=bos_id)
-    # beam 0 live, beams 1..K-1 muted so step 1 expands one hypothesis
-    mute = layers.fill_constant_batch_size_like(
-        src, shape=[-1, K], dtype="float32", value=-1e9)
-    live0 = layers.fill_constant_batch_size_like(
-        src, shape=[-1, 1], dtype="float32", value=0.0)
-    scores0 = layers.concat(
-        [live0, layers.slice(mute, axes=[1], starts=[1], ends=[K])], axis=1)
+    from ..contrib.decoder import BeamSearchDecoder
 
-    dummy = layers.fill_constant_batch_size_like(
-        src, shape=[-1, max_len, 1], dtype="float32", value=0.0)
+    decoder = BeamSearchDecoder(beam_size=beam_size, bos_id=bos_id,
+                                eos_id=eos_id, max_len=max_len)
 
-    rnn = layers.StaticRNN(name="beam_decoder")
-    with rnn.step():
-        rnn.step_input(dummy)                          # drives max_len steps
-        h_prev = rnn.memory(init=state0)               # [B, K, H]
-        ids_prev = rnn.memory(init=ids0)               # [B, K]
-        sc_prev = rnn.memory(init=scores0)             # [B, K]
-
-        w = layers.embedding(ids_prev, size=[dict_size, embed_dim],
+    def step(states, ids_prev):
+        h_prev = states["h"]                                        # [B,K,H]
+        # ids as [B, K, 1]: with beam_size=1 a bare [B, 1] would be read as
+        # an index COLUMN by the embedding convention, squeezing the beam dim
+        w = layers.embedding(layers.unsqueeze(ids_prev, axes=[2]),
+                             size=[dict_size, embed_dim],
                              param_attr=ParamAttr(name="tgt_emb"))  # [B,K,E]
         ctx = _attention(h_prev, enc_out, src_mask, "att")          # [B,K,H]
         inp = layers.concat([w, ctx], axis=2)
         h = _gru_cell(inp, h_prev, hidden_dim, "dec_gru")           # [B,K,H]
         logits = layers.fc(h, size=dict_size, num_flatten_dims=2,
                            name="readout")
-        logp = layers.log_softmax(logits)              # [B, K, V]
-        sel_ids, sel_scores, parent = layers.beam_search(
-            ids_prev, sc_prev, logp, beam_size=K, end_id=eos_id)
-        # reorder the recurrent state by each survivor's parent beam
-        h_re = _gather_beams(h, parent)
-        rnn.update_memory(h_prev, h_re)
-        rnn.update_memory(ids_prev, sel_ids)
-        rnn.update_memory(sc_prev, sel_scores)
-        rnn.step_output(sel_ids)
-        rnn.step_output(parent)
-    ids_seq, parent_seq = rnn()                        # [B, T, K] each
-    final_scores = rnn.final_memories()[2]             # [B, K] (sc_prev)
-    seqs = layers.beam_search_decode(ids_seq, parent_seq)
-    return seqs, final_scores
+        return {"h": h}, layers.log_softmax(logits)     # [B, K, V]
 
-
-def _gather_beams(x, parent):
-    """Reorder beam-major state x [B, K, ...] by parent indices [B, K]."""
-    # one_hot route keeps it a single batched matmul (MXU-friendly)
-    k = x.shape[1]
-    onehot = layers.one_hot(parent, depth=k)           # [B, K, K]
-    return layers.matmul(onehot, x)                    # [B, K, ...]
+    return decoder.decode(src, {"h": decoder.expand_to_beams(dec_init)},
+                          step)                    # [B, K, ...]

@@ -244,3 +244,64 @@ def test_rpn_target_assign_no_gt_image_samples_negatives(rng):
                   fetch_list=[labels])
     assert (lv == 0).sum() == 8      # full negative batch
     assert (lv == 1).sum() == 0
+
+
+def test_contrib_beam_search_decoder_greedy_equivalence(rng):
+    """contrib.BeamSearchDecoder with beam_size=1 must reproduce the greedy
+    argmax chain of a deterministic next-token model (≙ reference
+    contrib/decoder/beam_search_decoder.py)."""
+    from paddle_tpu.contrib.decoder import BeamSearchDecoder
+
+    vocab, hidden, max_len = 7, 5, 6
+    x = layers.data("x", shape=[hidden], dtype="float32")
+
+    decoder = BeamSearchDecoder(beam_size=1, bos_id=0, eos_id=vocab - 1,
+                                max_len=max_len)
+
+    def step(states, ids_prev):
+        # ids as [B, K, 1] — with K=1 a bare [B, 1] would be read as an
+        # index COLUMN by the embedding convention and squeeze the beam dim
+        emb = layers.embedding(layers.unsqueeze(ids_prev, axes=[2]),
+                               size=[vocab, hidden],
+                               param_attr=pt.ParamAttr(name="dec_emb"))
+        h = layers.fc(layers.concat([states["h"], emb], axis=2),
+                      size=hidden, num_flatten_dims=2, act="tanh",
+                      name="dec_cell")
+        logits = layers.fc(h, size=vocab, num_flatten_dims=2,
+                           name="dec_out")
+        return {"h": h}, layers.log_softmax(logits)
+
+    seqs, scores = decoder.decode(
+        x, {"h": decoder.expand_to_beams(layers.fc(x, size=hidden,
+                                                   name="dec_init"))},
+        step)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    xv = rng.rand(3, hidden).astype("float32")
+    sv, scv = exe.run(feed={"x": xv}, fetch_list=[seqs, scores])
+    assert sv.shape == (3, max_len, 1) and scv.shape == (3, 1)
+
+    # greedy reference in numpy using the trained params
+    emb_w = np.asarray(pt.global_scope().get("dec_emb"))
+    cw = np.asarray(pt.global_scope().get("dec_cell.w_0"))
+    cb = np.asarray(pt.global_scope().get("dec_cell.w_1"))
+    ow = np.asarray(pt.global_scope().get("dec_out.w_0"))
+    ob = np.asarray(pt.global_scope().get("dec_out.w_1"))
+    iw = np.asarray(pt.global_scope().get("dec_init.w_0"))
+    ib = np.asarray(pt.global_scope().get("dec_init.w_1"))
+    h = xv @ iw + ib
+    ids = np.zeros(3, dtype=np.int64)
+    done = np.zeros(3, dtype=bool)
+    for t in range(max_len):
+        z = np.concatenate([h, emb_w[ids]], axis=1)
+        h_new = np.tanh(z @ cw + cb)
+        logits = h_new @ ow + ob
+        nxt = logits.argmax(axis=1)
+        for b in range(3):
+            if not done[b]:
+                assert sv[b, t, 0] == nxt[b], (b, t, sv[b, :, 0], nxt)
+        done |= nxt == vocab - 1
+        h = np.where(done[:, None], h, h_new)
+        ids = nxt
+        if done.all():
+            break

@@ -16,6 +16,46 @@ from ..core.dtypes import convert_dtype
 from ..framework.program import Program, Variable, default_main_program
 
 
+def staging_specs(program: Program = None) -> Dict[str, tuple]:
+    """Collect {var_name: (wire_dtype, device_scale)} for every data var
+    declared with a staging dtype (layers.data(staging_dtype=...))."""
+    program = program or default_main_program()
+    out = {}
+    for b in program.blocks:
+        for v in b.vars.values():
+            if getattr(v, "staging", None) is not None:
+                out[v.name] = v.staging
+    return out
+
+
+def stage_array(arr: np.ndarray, spec: tuple) -> np.ndarray:
+    """Convert one host array to its byte-lean wire dtype. The device side
+    inverts this inside the compiled step (executor feed staging): for a
+    float var staged uint8 with device scale s, host stores round(x/s)
+    so device x' = uint8 * s reproduces x to 1/2-ulp-of-s accuracy."""
+    wire_dtype, scale = spec
+    wire_dtype = convert_dtype(wire_dtype)  # canonical np.dtype
+    if np.asarray(arr).dtype == wire_dtype:
+        return np.asarray(arr)  # already on the wire grid: don't re-quantize
+    if scale is not None:
+        info = np.iinfo(wire_dtype) if np.issubdtype(
+            wire_dtype, np.integer) else None
+        x = np.rint(np.asarray(arr, np.float32) / scale)
+        if info is not None:
+            x = np.clip(x, info.min, info.max)
+        return x.astype(wire_dtype)
+    return np.asarray(arr).astype(wire_dtype)
+
+
+def stage_batch(feed: Dict[str, np.ndarray],
+                specs: Dict[str, tuple]) -> Dict[str, np.ndarray]:
+    """Apply stage_array to every feed entry with a staging spec."""
+    if not specs:
+        return feed
+    return {k: stage_array(v, specs[k]) if k in specs else v
+            for k, v in feed.items()}
+
+
 class DataFeeder:
     def __init__(self, feed_list: Sequence, place=None, program: Program = None):
         program = program or default_main_program()

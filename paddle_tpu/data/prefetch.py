@@ -22,13 +22,23 @@ class DevicePrefetcher:
     _END = object()
 
     def __init__(self, feed_iter_fn: Callable[[], Iterator[Dict]],
-                 capacity: int = 2, device=None, sharding=None):
+                 capacity: int = 2, device=None, sharding=None,
+                 staging: Optional[Dict] = None):
+        """staging: {var_name: (wire_dtype, device_scale)} — convert those
+        entries to their byte-lean wire dtype on the worker thread before
+        staging (see data.feeder.staging_specs / layers.data staging_dtype).
+        Through a bandwidth-limited host->device link this is the difference
+        between 1/4 and full fp32 bytes per image batch."""
         self._fn = feed_iter_fn
         self._capacity = capacity
         self._device = device
         self._sharding = sharding
+        self._staging = staging or {}
 
     def _put(self, batch: Dict):
+        if self._staging:
+            from .feeder import stage_batch
+            batch = stage_batch(batch, self._staging)
         target = self._sharding or self._device
         if target is None:
             return {k: jax.device_put(v) for k, v in batch.items()}

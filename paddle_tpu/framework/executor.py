@@ -127,7 +127,20 @@ class Executor:
             env: Dict[str, Any] = {}
             env.update(zip(ro, ro_vals))
             env.update(zip(rw, rw_vals))
-            env.update(zip(feed_names, feed_vals))
+            for name, val in zip(feed_names, feed_vals):
+                # byte-lean staging: a data var declared with a staging
+                # dtype may be fed compact (e.g. uint8); de-quantize on
+                # device so only wire_dtype bytes cross the host->device
+                # link (≙ reference buffered_reader.h:27 whose job is
+                # keeping the device fed)
+                var = block.vars.get(name)
+                if (var is not None and var.staging is not None
+                        and hasattr(val, "dtype")
+                        and str(val.dtype) != str(var.dtype)):
+                    val = val.astype(var.dtype)
+                    if var.staging[1] is not None:
+                        val = val * jnp.asarray(var.staging[1], var.dtype)
+                env[name] = val
             run_plan(plan, env, block, ctx)
             fetches = tuple(env[n] for n in fetch_names)
             new_state = tuple(env[n] for n in state_out_names)

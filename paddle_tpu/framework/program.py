@@ -54,6 +54,13 @@ class Variable:
         self.is_data = is_data
         self.trainable = trainable
         self.op = None  # producer op, set by Block.append_op
+        # Optional byte-lean staging spec for data vars: (wire_dtype, scale).
+        # The host stages `wire_dtype` bytes (e.g. uint8 images at 1/4 the
+        # fp32 footprint) and the compiled step casts to `self.dtype` and
+        # multiplies by `scale` on device — the TPU translation of the
+        # reference's buffered_reader keeping the device fed (reference
+        # paddle/fluid/operators/reader/buffered_reader.h:27).
+        self.staging = None
 
     # -- numpy-style conveniences (≙ math_op_patch.py operator overloads) --
     def __repr__(self):

@@ -9,13 +9,24 @@ reader ops.
 
 from __future__ import annotations
 
+import numpy as np
+
+from ..core.dtypes import convert_dtype
 from ..framework.program import default_main_program
 
 
 def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
-         stop_gradient=True):
+         stop_gradient=True, staging_dtype=None, staging_scale=None):
     """Declare an input variable (≙ fluid.layers.data, reference
-    layers/io.py:38). append_batch_size prepends -1."""
+    layers/io.py:38). append_batch_size prepends -1.
+
+    staging_dtype declares a byte-lean wire dtype: the host may feed this
+    var as `staging_dtype` (e.g. uint8 images — 4x fewer bytes over the
+    host->device link than fp32) and the compiled step casts to `dtype` and
+    multiplies by `staging_scale` (default 1/255 for uint8->float) on
+    device. Feeding the declared `dtype` directly remains valid — the cast
+    is keyed off the fed dtype at compile time.
+    """
     full_shape = list(shape)
     if append_batch_size:
         full_shape = [-1] + full_shape
@@ -25,6 +36,14 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
     var = block.create_var(name=name, shape=full_shape, dtype=dtype,
                            lod_level=lod_level, is_data=True,
                            stop_gradient=stop_gradient)
+    if staging_dtype is not None:
+        # canonicalize (accepts "uint8", np.uint8, np.dtype("uint8"), ...)
+        # so the uint8 default-scale rule and downstream dtype comparisons
+        # can't be defeated by the spelling of the dtype
+        wire = convert_dtype(staging_dtype)
+        if staging_scale is None and wire == np.dtype(np.uint8):
+            staging_scale = 1.0 / 255.0
+        var.staging = (wire, staging_scale)
     if lod_level > 0:
         # companion sequence-length variable (static-shape LoD translation)
         block.create_var(name=name + "@SEQLEN", shape=[-1], dtype="int32",

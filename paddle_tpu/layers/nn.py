@@ -1203,15 +1203,30 @@ def log_softmax(x, axis=-1, name=None):
     return out
 
 
-def fused_attention(q, k, v, scale=None, causal=False, name=None):
+def fused_attention(q, k, v, scale=None, causal=False, segment_ids=None,
+                    kv_segment_ids=None, name=None):
     """Fused scaled-dot-product attention over [B, H, T, D] tensors —
     flash kernel (Pallas) on TPU, XLA composite elsewhere
-    (≙ nets.py scaled_dot_product_attention, kernelized)."""
+    (≙ nets.py scaled_dot_product_attention, kernelized).
+
+    segment_ids ([B, T] int var) enables packed-batch masking — multiple
+    sequences share one row and attend only within their own segment (the
+    static-shape LoD translation, SURVEY §5); kv_segment_ids defaults to
+    segment_ids (self-attention). Composes with `causal`."""
     helper = LayerHelper("fused_attention", name=name)
     out = helper.create_tmp_variable(dtype=dtype_name(q.dtype),
                                      shape=list(q.shape))
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if kv_segment_ids is not None and segment_ids is None:
+        raise ValueError(
+            "fused_attention: kv_segment_ids requires segment_ids (the "
+            "query-side ids); pass both for cross-attention masking")
+    if segment_ids is not None:
+        inputs["QSeg"] = [segment_ids]
+        inputs["KVSeg"] = [kv_segment_ids if kv_segment_ids is not None
+                           else segment_ids]
     helper.append_op(type="fused_attention",
-                     inputs={"Q": [q], "K": [k], "V": [v]},
+                     inputs=inputs,
                      outputs={"Out": [out]},
                      attrs={"scale": scale, "causal": causal})
     return out

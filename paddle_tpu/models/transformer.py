@@ -29,11 +29,17 @@ def positional_encoding_table(max_len, d_model):
 
 
 def multi_head_attention(q_in, k_in, v_in, d_model, num_heads, dropout=0.0,
-                         is_test=False, causal=False, name=None):
+                         is_test=False, causal=False, segment_ids=None,
+                         name=None):
     """Multi-head attention with explicit head split (≙ nets.py:332 composite
     generalized with masking). All projections are single fused matmuls so
     XLA maps them onto the MXU as large GEMMs; head dim stays last for lane
-    alignment."""
+    alignment.
+
+    segment_ids ([B, T] int32 var): packed-batch masking through the flash
+    kernel (tokens attend only within their own segment — the static-shape
+    LoD translation). Requires the fused path (attention-weight dropout
+    off), which is also the only path that scales to long sequences."""
     b, t_q = q_in.shape[0], q_in.shape[1]
     t_k = k_in.shape[1]
     d_head = d_model // num_heads
@@ -51,12 +57,18 @@ def multi_head_attention(q_in, k_in, v_in, d_model, num_heads, dropout=0.0,
     q = split_heads(q, t_q)
     k = split_heads(k, t_k)
     v = split_heads(v, t_k)
+    if segment_ids is not None and dropout and not is_test:
+        raise NotImplementedError(
+            "packed batches (segment_ids) require the fused attention "
+            "path; set attention dropout to 0 (residual/ffn dropout is "
+            "unaffected)")
     if not dropout or is_test:
         # fused flash-attention op: Pallas kernel on TPU (O(T) memory),
         # XLA composite elsewhere — see ops/pallas_kernels.py
         ctx = layers.fused_attention(q, k, v,
                                      scale=float(d_head) ** -0.5,
-                                     causal=causal)
+                                     causal=causal,
+                                     segment_ids=segment_ids)
         if dropout and is_test:
             # downgrade_in_infer: training scaled attention weights by the
             # keep mask; inference must scale by (1-p) to keep the
@@ -118,14 +130,20 @@ def decoder_layer(x, enc_out, d_model, num_heads, d_inner, dropout, is_test,
     return _add_norm(f, x, dropout, is_test)
 
 
-def _embed(tokens, vocab_size, d_model, max_len, name):
+def _embed(tokens, vocab_size, d_model, max_len, name, positions=None):
+    """positions ([B, T] int32 var): per-token positional-encoding index.
+    Packed batches use position-within-segment so a sequence embeds the
+    same wherever it lands in the pack; default is the row position."""
     emb = layers.embedding(
         input=tokens, size=[vocab_size, d_model],
         param_attr=ParamAttr(name=name + "_emb",
                              initializer=NormalInitializer(0., d_model ** -0.5)))
     emb = layers.scale(emb, scale=float(d_model) ** 0.5)
-    pos = layers.assign(
-        positional_encoding_table(max_len, d_model)[None, :, :])
+    table = positional_encoding_table(max_len, d_model)
+    if positions is not None:
+        pos = layers.gather(layers.assign(table), positions)
+    else:
+        pos = layers.assign(table[None, :, :])
     return layers.elementwise_add(emb, pos)
 
 
@@ -182,21 +200,39 @@ def transformer(src=None, tgt=None, label=None, src_vocab=30000,
 
 def transformer_lm(tokens=None, label=None, vocab=32000, max_len=128,
                    d_model=512, d_inner=2048, num_heads=8, num_layers=6,
-                   dropout=0.0, is_test=False):
+                   dropout=0.0, is_test=False, packed=False):
     """Decoder-only causal LM — the flagship config used by
-    __graft_entry__ (simplest shape that exercises dp/tp/sp sharding)."""
+    __graft_entry__ (simplest shape that exercises dp/tp/sp sharding).
+
+    packed=True: each batch row holds MULTIPLE sequences back to back,
+    described by a `segments` int32 input ([B, max_len]; 0 = padding,
+    1..N = sequence index — see data.packing.pack_sequences). Attention is
+    segment-masked through the flash kernel and the loss counts only
+    non-pad tokens. This is the throughput idiom for ragged corpora: no
+    compute wasted on padding (≙ the reference's LoD batches whose whole
+    point is padding-free ragged training, lod_tensor.h:58)."""
     if tokens is None:
         tokens = layers.data(name="tokens", shape=[max_len], dtype="int64",
-                             lod_level=1)
+                             lod_level=0 if packed else 1)
     if label is None:
         label = layers.data(name="targets", shape=[max_len], dtype="int64")
-    seqlen = layers.sequence.get_seqlen(tokens)
-    x = _embed(tokens, vocab, d_model, max_len, "tok")
+    segments = positions = None
+    if packed:
+        segments = layers.data(name="segments", shape=[max_len],
+                               dtype="int32")
+        positions = layers.data(name="positions", shape=[max_len],
+                                dtype="int32")
+    else:
+        seqlen = layers.sequence.get_seqlen(tokens)
+    x = _embed(tokens, vocab, d_model, max_len, "tok", positions=positions)
     if dropout:
         x = layers.dropout(x, dropout_prob=dropout, is_test=is_test)
     for i in range(num_layers):
-        attn = multi_head_attention(x, x, x, d_model, num_heads, dropout,
-                                    is_test, causal=True, name=f"l{i}_attn")
+        attn = multi_head_attention(x, x, x, d_model, num_heads,
+                                    0.0 if packed else dropout,
+                                    is_test, causal=True,
+                                    segment_ids=segments,
+                                    name=f"l{i}_attn")
         x = _add_norm(attn, x, dropout, is_test)
         f = ffn(x, d_model, d_inner, dropout, is_test, name=f"l{i}_ffn")
         x = _add_norm(f, x, dropout, is_test)
@@ -204,7 +240,21 @@ def transformer_lm(tokens=None, label=None, vocab=32000, max_len=128,
                        name="lm_head")
     label3 = layers.unsqueeze(label, axes=[2])
     token_loss = layers.softmax_with_cross_entropy(logits, label3)
-    mask = layers.sequence_mask(seqlen, maxlen=max_len)
+    if packed:
+        # a token trains iff it is non-pad AND its successor belongs to
+        # the same segment (the last token of each packed sequence has no
+        # valid next-token target)
+        seg_next = layers.concat([
+            layers.slice(segments, axes=[1], starts=[1], ends=[max_len]),
+            layers.fill_constant_batch_size_like(segments, [-1, 1],
+                                                 "int32", 0)], axis=1)
+        nonpad = layers.greater_than(
+            segments, layers.fill_constant([1], "int32", 0))
+        same = layers.equal(segments, seg_next)
+        mask = layers.elementwise_mul(layers.cast(nonpad, "float32"),
+                                      layers.cast(same, "float32"))
+    else:
+        mask = layers.sequence_mask(seqlen, maxlen=max_len)
     mask = layers.unsqueeze(mask, axes=[2])
     masked = layers.elementwise_mul(token_loss, mask)
     loss = layers.reduce_sum(masked) / layers.reduce_sum(mask)

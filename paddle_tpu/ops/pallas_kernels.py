@@ -31,28 +31,76 @@ def _auto_backend():
     return "pallas" if jax.default_backend() == "tpu" else "xla"
 
 
-def _attention_reference(q, k, v, scale, causal):
+def _normalize_segment_ids(segment_ids, q, k):
+    """Accept a single [B, Tq] array (self-attention; Tq must equal Tk) or
+    a (q_ids [B, Tq], kv_ids [B, Tk]) pair. Returns (q_ids, kv_ids) int32
+    or (None, None). Same semantics as parallel.ring_attention: a query
+    attends a key iff their ids are equal — the static-shape translation
+    of the reference's LoD ragged batches (SURVEY §5 long-context row)."""
+    if segment_ids is None:
+        return None, None
+    if isinstance(segment_ids, (tuple, list)):
+        q_ids, kv_ids = segment_ids
+    else:
+        q_ids = kv_ids = segment_ids
+    q_ids = jnp.asarray(q_ids, jnp.int32)
+    kv_ids = jnp.asarray(kv_ids, jnp.int32)
+    B, _, Tq, _ = q.shape
+    Tk = k.shape[2]
+    if q_ids.shape != (B, Tq) or kv_ids.shape != (B, Tk):
+        raise ValueError(
+            f"segment_ids shapes {q_ids.shape}/{kv_ids.shape} do not match "
+            f"q [B={B}, Tq={Tq}] / k [B={B}, Tk={Tk}]")
+    return q_ids, kv_ids
+
+
+def _attention_reference(q, k, v, scale, causal, segment_ids=None):
     """Naive composite (the XLA fallback path). q/k/v: [B, H, T, D].
     Causal masking is bottom-right aligned (query i sees keys up to
     i + Tk - Tq — the incremental-decode convention). A query row with NO
-    visible keys (causal T > Tk head rows) outputs zeros — the flash
-    kernels' semantics — rather than softmax's uniform-weights artifact,
-    so every backend computes identical values and gradients."""
+    visible keys (causal T > Tk head rows, or a segment id matching no
+    key) outputs zeros — the flash kernels' semantics — rather than
+    softmax's uniform-weights artifact, so every backend computes
+    identical values and gradients."""
+    q_ids, kv_ids = _normalize_segment_ids(segment_ids, q, k)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    tq, tk = s.shape[-2], s.shape[-1]
+    mask = jnp.ones((1, tq, tk), bool)
     if causal:
-        tq, tk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)
-        s = jnp.where(mask, s, _NEG_INF)
+        mask &= jnp.tril(jnp.ones((tq, tk), bool), tk - tq)[None]
+    if q_ids is not None:
+        mask &= q_ids[:, :, None] == kv_ids[:, None, :]      # [B, tq, tk]
+    if causal or q_ids is not None:
+        s = jnp.where(mask[:, None], s, _NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
-        any_key = jnp.any(mask, axis=-1)          # [tq]
-        p = jnp.where(any_key[None, None, :, None], p, 0.0)
+        any_key = jnp.any(mask, axis=-1)                     # [B?, tq]
+        p = jnp.where(any_key[:, None, :, None], p, 0.0)
     else:
         p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
-                  acc_ref, *, scale, causal, block_q, block_k,
+def _segment_mask(qseg_ref, kvseg_ref, block_k):
+    """[bq, bk] equality mask from the staged segment-id blocks.
+
+    Layout (mirrors jax's own TPU flash kernel): q ids ride broadcast over
+    128 lanes as a [bq, 128] block, kv ids ride broadcast over 8 sublanes
+    as an [8, bk] block — Mosaic-legal tilings for what are logically 1-D
+    vectors."""
+    if block_k <= 128:
+        q_ids = qseg_ref[0][:, :block_k]           # [bq, bk] (lane slice)
+    else:
+        repeats, rem = divmod(block_k, 128)
+        if rem:
+            raise NotImplementedError("block_k must be a multiple of 128 "
+                                      "when segment ids are used")
+        q_ids = jnp.tile(qseg_ref[0], (1, repeats))  # [bq, bk]
+    kv_ids = kvseg_ref[0][:1]                      # [1, bk]
+    return q_ids == kv_ids
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, qseg_ref, kvseg_ref, o_ref, lse_ref,
+                  m_ref, l_ref, acc_ref, *, scale, causal, block_q, block_k,
                   num_k_blocks, causal_offset, true_tk):
     """One (batch·head, q-block, k-block) grid step of flash attention.
 
@@ -81,6 +129,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
         jnp.int32, (block_q, block_k), 1)
     # padded key columns (from rounding Tk up to the block size) are dead
     s = jnp.where(k_pos < true_tk, s, _NEG_INF)
+    if qseg_ref is not None:
+        s = jnp.where(_segment_mask(qseg_ref, kvseg_ref, block_k), s,
+                      _NEG_INF)
     if causal:
         qi = pl.program_id(1)
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
@@ -124,11 +175,30 @@ def _pad_to(x, axis, target):
     return jnp.pad(x, pad) if target != x.shape[axis] else x
 
 
+def _stage_segment_ids(q_ids, kv_ids, H, Tp, Tkp, bq, bk):
+    """Broadcast + pad segment ids into their Mosaic-legal layouts and
+    build (inputs, specs) for a grid whose leading dim is B*H. Padding
+    rows/columns carry id 0, which is harmless: padded key columns are
+    killed by the true_tk position guard and padded query rows are sliced
+    off (fwd) / killed by the true_tq guard (bwd) regardless of id."""
+    from jax.experimental import pallas as pl
+
+    B = q_ids.shape[0]
+    qseg = jnp.broadcast_to(
+        _pad_to(q_ids, 1, Tp)[:, :, None], (B, Tp, 128))
+    kvseg = jnp.broadcast_to(
+        _pad_to(kv_ids, 1, Tkp)[:, None, :], (B, 8, Tkp))
+    qseg_spec = pl.BlockSpec((1, bq, 128), lambda b, i, j, H=H: (b // H, i, 0))
+    kvseg_spec = pl.BlockSpec((1, 8, bk), lambda b, i, j, H=H: (b // H, 0, j))
+    return (qseg, kvseg), (qseg_spec, kvseg_spec)
+
+
 def _flash_attention_pallas(q, k, v, scale, causal, block_q, block_k,
-                            interpret, with_lse=False):
+                            interpret, with_lse=False, segment_ids=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    q_ids, kv_ids = _normalize_segment_ids(segment_ids, q, k)
     B, H, T, D = q.shape
     Tk = k.shape[2]
     bq = min(block_q, T)
@@ -142,6 +212,19 @@ def _flash_attention_pallas(q, k, v, scale, causal, block_q, block_k,
     vf = _pad_to(v.reshape(B * H, Tk, D), 1, Tkp)
     nq, nk = Tp // bq, Tkp // bk
 
+    inputs = [qf, kf, vf]
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+    ]
+    has_seg = q_ids is not None
+    if has_seg:
+        seg_inputs, seg_specs = _stage_segment_ids(
+            q_ids, kv_ids, H, Tp, Tkp, bq, bk)
+        inputs += list(seg_inputs)
+        in_specs += list(seg_specs)
+
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
         num_k_blocks=nk, causal_offset=Tk - T, true_tk=Tk)
@@ -152,19 +235,23 @@ def _flash_attention_pallas(q, k, v, scale, causal, block_q, block_k,
             pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)))
         out_shape.append(
             jax.ShapeDtypeStruct((B * H, Tp, 128), jnp.float32))
-    else:
-        # inference path: don't compute/write the residual it won't use
-        def kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
-                   _k=kernel):
-            _k(q_ref, k_ref, v_ref, o_ref, None, m_ref, l_ref, acc_ref)
+    # adapt the kernel's (fixed) signature to the optional refs actually
+    # staged: segment refs when packed, lse only on the training path.
+    # pallas passes refs positionally (inputs, outputs, scratch), so one
+    # generic splicer covers every combination.
+    n_in, n_out = len(in_specs), len(out_specs)
+
+    def body(*refs, _k=kernel):
+        ins, outs = refs[:n_in], refs[n_in:n_in + n_out]
+        scratch = refs[n_in + n_out:]
+        qs_ref, ks_ref = (ins[3], ins[4]) if has_seg else (None, None)
+        lse_ref = outs[1] if with_lse else None
+        _k(ins[0], ins[1], ins[2], qs_ref, ks_ref, outs[0], lse_ref,
+           *scratch)
     res = pl.pallas_call(
-        kernel,
+        body,
         grid=(B * H, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[
@@ -173,7 +260,7 @@ def _flash_attention_pallas(q, k, v, scale, causal, block_q, block_k,
             pltpu.VMEM((bq, D), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf)
+    )(*inputs)
     out = res[0][:, :T].reshape(B, H, T, D)
     if with_lse:
         return out, res[1][:, :T, 0].reshape(B, H, T)
@@ -186,7 +273,7 @@ def _flash_attention_pallas(q, k, v, scale, causal, block_q, block_k,
 # ---------------------------------------------------------------------------
 
 def _bwd_masks(qi, j, block_q, block_k, causal, causal_offset,
-               true_tq, true_tk):
+               true_tq, true_tk, qseg_ref=None, kvseg_ref=None):
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     k_pos = j * block_k + jax.lax.broadcasted_iota(
@@ -194,13 +281,15 @@ def _bwd_masks(qi, j, block_q, block_k, causal, causal_offset,
     valid = (q_pos < true_tq) & (k_pos < true_tk)
     if causal:
         valid &= q_pos + causal_offset >= k_pos
+    if qseg_ref is not None:
+        valid &= _segment_mask(qseg_ref, kvseg_ref, block_k)
     return valid
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, acc_ref, *, scale, causal, block_q,
-                         block_k, num_k_blocks, causal_offset, true_tq,
-                         true_tk):
+                         qseg_ref, kvseg_ref, dq_ref, acc_ref, *, scale,
+                         causal, block_q, block_k, num_k_blocks,
+                         causal_offset, true_tq, true_tk):
     from jax.experimental import pallas as pl
 
     j = pl.program_id(2)
@@ -219,7 +308,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     valid = _bwd_masks(qi, j, block_q, block_k, causal,
-                       causal_offset, true_tq, true_tk)
+                       causal_offset, true_tq, true_tk, qseg_ref, kvseg_ref)
     p = jnp.where(valid, jnp.exp(s - lse), 0.0)    # [bq, bk]
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
@@ -234,9 +323,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                          block_q, block_k, num_q_blocks, causal_offset,
-                          true_tq, true_tk):
+                          qseg_ref, kvseg_ref, dk_ref, dv_ref, dk_acc,
+                          dv_acc, *, scale, causal, block_q, block_k,
+                          num_q_blocks, causal_offset, true_tq, true_tk):
     from jax.experimental import pallas as pl
 
     i = pl.program_id(2)      # inner: q blocks
@@ -256,7 +345,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     valid = _bwd_masks(i, ki, block_q, block_k, causal,
-                       causal_offset, true_tq, true_tk)
+                       causal_offset, true_tq, true_tk, qseg_ref, kvseg_ref)
     p = jnp.where(valid, jnp.exp(s - lse), 0.0)    # [bq, bk]
     dv_acc[:] += jax.lax.dot_general(
         p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -275,10 +364,12 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_attention_bwd_pallas(q, k, v, o, lse, do, scale, causal,
-                                block_q, block_k, interpret):
+                                block_q, block_k, interpret,
+                                segment_ids=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    q_ids, kv_ids = _normalize_segment_ids(segment_ids, q, k)
     B, H, T, D = q.shape
     Tk = k.shape[2]
     bq = min(block_q, T)
@@ -304,35 +395,69 @@ def _flash_attention_bwd_pallas(q, k, v, o, lse, do, scale, causal,
 
     common = dict(scale=scale, causal=causal, block_q=bq, block_k=bk,
                   causal_offset=Tk - T, true_tq=T, true_tk=Tk)
+    has_seg = q_ids is not None
     q_spec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
     r_spec = pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0))
     k_spec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0))
 
+    def _splice_seg(kernel, n_in):
+        """Generic adapter: insert (None, None) for the segment refs when
+        no segment inputs are staged (pallas passes refs positionally:
+        inputs, outputs, scratch)."""
+        if has_seg:
+            return kernel
+
+        def body(*refs, _k=kernel):
+            return _k(*refs[:n_in], None, None, *refs[n_in:])
+        return body
+
+    dq_inputs = [qf, kf, vf, dof, lsef, deltaf]
+    dq_specs = [q_spec, k_spec, k_spec, q_spec, r_spec, r_spec]
+    dq_kernel = functools.partial(_flash_bwd_dq_kernel, num_k_blocks=nk,
+                                  **common)
+    if has_seg:
+        seg_inputs, seg_specs = _stage_segment_ids(
+            q_ids, kv_ids, H, Tp, Tkp, bq, bk)
+        dq_inputs += list(seg_inputs)
+        dq_specs += list(seg_specs)
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, num_k_blocks=nk, **common),
+        _splice_seg(dq_kernel, 6),
         grid=(B * H, nq, nk),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        in_specs=dq_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((B * H, Tp, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, deltaf)
+    )(*dq_inputs)
 
     # dk/dv: k blocks are the outer (revisited) dim, q blocks stream inner
     qi_spec = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0))
     ri_spec = pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0))
     kj_spec = pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0))
+    dkv_inputs = [qf, kf, vf, dof, lsef, deltaf]
+    dkv_specs = [qi_spec, kj_spec, kj_spec, qi_spec, ri_spec, ri_spec]
+    dkv_kernel = functools.partial(_flash_bwd_dkv_kernel, num_q_blocks=nq,
+                                   **common)
+    if has_seg:
+        # grid order here is (b, k-block j, q-block i): swap the index-map
+        # arguments accordingly
+        qsegf, kvsegf = seg_inputs
+        dkv_inputs += [qsegf, kvsegf]
+        dkv_specs += [
+            pl.BlockSpec((1, bq, 128), lambda b, j, i, H=H: (b // H, i, 0)),
+            pl.BlockSpec((1, 8, bk), lambda b, j, i, H=H: (b // H, 0, j)),
+        ]
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, num_q_blocks=nq, **common),
+        _splice_seg(dkv_kernel, 6),
         grid=(B * H, nk, nq),
-        in_specs=[qi_spec, kj_spec, kj_spec, qi_spec, ri_spec, ri_spec],
+        in_specs=dkv_specs,
         out_specs=[kj_spec, kj_spec],
         out_shape=[jax.ShapeDtypeStruct((B * H, Tkp, D), k.dtype),
                    jax.ShapeDtypeStruct((B * H, Tkp, D), v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, deltaf)
+    )(*dkv_inputs)
 
     return (dq[:, :T].reshape(B, H, T, D),
             dk[:, :Tk].reshape(B, H, Tk, D),
@@ -340,57 +465,66 @@ def _flash_attention_bwd_pallas(q, k, v, o, lse, do, scale, causal,
 
 
 def flash_attention(q, k, v, scale=None, causal=False, block_q=128,
-                    block_k=128, backend=None):
+                    block_k=128, backend=None, segment_ids=None):
     """Fused multi-head attention. q/k/v: [B, H, T, D].
 
     backend: None = auto (pallas on TPU, XLA composite elsewhere);
     "pallas_interpret" forces the kernel through the pallas interpreter
     (CPU-testable); "xla" forces the composite.
+
+    segment_ids: packed-batch masking (the LoD translation, SURVEY §5) —
+    a [B, T] int array (self-attention) or a (q_ids, kv_ids) pair; a query
+    attends a key iff their ids are equal, matching
+    parallel.ring_attention's semantics. Composes with `causal`.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if backend is None:
         backend = _auto_backend()
-    return _fused_attention(q, k, v, scale, causal, backend, block_q,
-                            block_k)
+    return _fused_attention(q, k, v, segment_ids, scale, causal, backend,
+                            block_q, block_k)
 
 
 # ---------------------------------------------------------------------------
 # differentiable wrapper + op registration
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _fused_attention(q, k, v, scale, causal, backend, block_q=128,
-                     block_k=128):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _fused_attention(q, k, v, segment_ids, scale, causal, backend,
+                     block_q=128, block_k=128):
     if backend == "xla":
-        return _attention_reference(q, k, v, scale, causal)
+        return _attention_reference(q, k, v, scale, causal, segment_ids)
     return _flash_attention_pallas(q, k, v, scale, causal, block_q, block_k,
-                                   interpret=(backend == "pallas_interpret"))
+                                   interpret=(backend == "pallas_interpret"),
+                                   segment_ids=segment_ids)
 
 
-def _fused_attention_fwd(q, k, v, scale, causal, backend, block_q=128,
-                         block_k=128):
+def _fused_attention_fwd(q, k, v, segment_ids, scale, causal, backend,
+                         block_q=128, block_k=128):
     if backend == "xla":
-        out = _attention_reference(q, k, v, scale, causal)
-        return out, (q, k, v, None, None)
+        out = _attention_reference(q, k, v, scale, causal, segment_ids)
+        return out, (q, k, v, segment_ids, None, None)
     out, lse = _flash_attention_pallas(
         q, k, v, scale, causal, block_q, block_k,
-        interpret=(backend == "pallas_interpret"), with_lse=True)
-    return out, (q, k, v, out, lse)
+        interpret=(backend == "pallas_interpret"), with_lse=True,
+        segment_ids=segment_ids)
+    return out, (q, k, v, segment_ids, out, lse)
 
 
 def _fused_attention_bwd(scale, causal, backend, block_q, block_k, res, g):
-    q, k, v, o, lse = res
+    q, k, v, segment_ids, o, lse = res
     if backend == "xla":
         _, vjp = jax.vjp(
             lambda q_, k_, v_: _attention_reference(q_, k_, v_, scale,
-                                                    causal), q, k, v)
-        return vjp(g)
+                                                    causal, segment_ids),
+            q, k, v)
+        return vjp(g) + (None,)
     # flash backward: recompute P tiles from (q, k, lse) in VMEM — the
     # [T, T] score matrix never exists in HBM in either direction
     return _flash_attention_bwd_pallas(
         q, k, v, o, lse, g, scale, causal, block_q, block_k,
-        interpret=(backend == "pallas_interpret"))
+        interpret=(backend == "pallas_interpret"),
+        segment_ids=segment_ids) + (None,)
 
 
 _fused_attention.defvjp(_fused_attention_fwd, _fused_attention_bwd)
@@ -409,7 +543,12 @@ def _register():
         q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
         scale = attrs.get("scale") or 1.0 / (q.shape[-1] ** 0.5)
         backend = attrs.get("backend") or _auto_backend()
-        out = _fused_attention(q, k, v, scale,
+        seg = None
+        if ins.get("QSeg"):
+            q_ids = ins["QSeg"][0]
+            kv_ids = ins["KVSeg"][0] if ins.get("KVSeg") else q_ids
+            seg = (q_ids, kv_ids)
+        out = _fused_attention(q, k, v, seg, scale,
                                attrs.get("causal", False), backend)
         return {"Out": [out]}
 

@@ -1,0 +1,123 @@
+"""Packed-batch transformer path: pack_sequences + segment-masked flash
+attention + in-graph loss masking (VERDICT r2 #3: route the packed path
+through the kernel).
+
+Ground truth for the whole pipeline: per-token losses of sequences trained
+PACKED (several per row, segment ids) must equal the same sequences trained
+PADDED (one per row) — if any cross-segment attention or mis-masked loss
+leaked in, these diverge immediately.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.data.packing import pack_lm_batch, pack_sequences
+
+
+class TestPackSequences:
+    def test_first_fit_packs_tightly(self):
+        seqs = [np.arange(1, 9), np.arange(1, 5), np.arange(1, 4)]
+        tokens, segments, _ = pack_sequences(seqs, max_len=16)
+        assert tokens.shape == (1, 16)          # 8+4+3 = 15 <= 16: one row
+        assert segments.max() == 3
+        np.testing.assert_array_equal(segments[0, :8], 1)
+        np.testing.assert_array_equal(segments[0, 8:12], 2)
+        np.testing.assert_array_equal(segments[0, 12:15], 3)
+        np.testing.assert_array_equal(segments[0, 15:], 0)
+
+    def test_overflow_opens_new_row(self):
+        seqs = [np.ones(10, np.int64), np.ones(10, np.int64)]
+        tokens, segments, _ = pack_sequences(seqs, max_len=16)
+        assert tokens.shape == (2, 16)
+        assert segments[0].max() == 1 and segments[1].max() == 1
+
+    def test_truncation(self):
+        tokens, segments, _ = pack_sequences([np.arange(100)], max_len=8)
+        assert tokens.shape == (1, 8)
+        np.testing.assert_array_equal(tokens[0], np.arange(8))
+
+    def test_lm_batch_targets_shifted(self):
+        seqs = [np.array([5, 6, 7, 8], np.int64)]
+        b = pack_lm_batch(seqs, max_len=8)
+        np.testing.assert_array_equal(b["targets"][0, :3], [6, 7, 8])
+
+
+class TestPackedTransformerLM:
+    def _run_losses(self, feed, packed, vocab=31, max_len=24, steps=1):
+        from paddle_tpu.models import transformer
+        pt.reset_default_programs()
+        pt.reset_global_scope()
+        with pt.core.unique_name.guard():
+            loss, logits = transformer.transformer_lm(
+                vocab=vocab, max_len=max_len, d_model=16, num_heads=2,
+                num_layers=1, d_inner=32, dropout=0.0, packed=packed)
+            exe = pt.Executor()
+            exe.run(pt.default_startup_program())
+            return float(exe.run(feed=feed, fetch_list=[loss])[0])
+
+    def test_packed_loss_equals_padded_loss(self, rng):
+        """Same sequences, same (seeded) init: mean per-token loss packed
+        == mean per-token loss padded."""
+        max_len = 24
+        seqs = [rng.randint(1, 30, (L,)).astype(np.int64)
+                for L in (10, 7, 6, 14, 9)]
+        packed_feed = pack_lm_batch(seqs, max_len)
+
+        # padded variant: one sequence per row
+        B = len(seqs)
+        toks = np.zeros((B, max_len), np.int64)
+        tgts = np.zeros((B, max_len), np.int64)
+        sl = np.zeros((B,), np.int32)
+        for i, s in enumerate(seqs):
+            toks[i, :len(s)] = s
+            tgts[i, :len(s) - 1] = s[1:]
+            # loss mask counts the first len-1 positions (next-token)
+            sl[i] = len(s) - 1
+        padded_feed = {"tokens": toks, "tokens@SEQLEN": sl,
+                       "targets": tgts}
+
+        # identical init: both builds create the same parameter set in the
+        # same order from fresh (seed-0) programs, so the startup program
+        # produces bit-identical weights
+        l_packed = self._run_losses(packed_feed, packed=True,
+                                    max_len=max_len)
+        l_padded = self._run_losses(padded_feed, packed=False,
+                                    max_len=max_len)
+        np.testing.assert_allclose(l_packed, l_padded, rtol=1e-4)
+
+    def test_packed_lm_trains(self, rng):
+        from paddle_tpu.models import transformer
+        max_len = 32
+        loss, _ = transformer.transformer_lm(
+            vocab=50, max_len=max_len, d_model=16, num_heads=2,
+            num_layers=1, d_inner=32, dropout=0.0, packed=True)
+        types = [op.type
+                 for op in pt.default_main_program().global_block().ops]
+        assert "fused_attention" in types
+        pt.optimizer.AdamOptimizer(learning_rate=2e-3).minimize(loss)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        seqs = [rng.randint(1, 50, (L,)).astype(np.int64)
+                for L in (12, 9, 17, 6, 20, 8)]
+        feed = pack_lm_batch(seqs, max_len)
+        l0 = exe.run(feed=feed, fetch_list=[loss])[0]
+        for _ in range(8):
+            l1 = exe.run(feed=feed, fetch_list=[loss])[0]
+        assert np.isfinite(l1).all() and l1 < l0
+
+    def test_packed_rejects_attention_dropout(self):
+        from paddle_tpu.models import transformer
+        with pytest.raises(NotImplementedError):
+            transformer.multi_head_attention(
+                pt.layers.data(name="x", shape=[8, 16]),
+                pt.layers.data(name="x", shape=[8, 16]),
+                pt.layers.data(name="x", shape=[8, 16]),
+                d_model=16, num_heads=2, dropout=0.5, causal=True,
+                segment_ids=pt.layers.data(name="s", shape=[8],
+                                           dtype="int32"))
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-x", "-q"]))

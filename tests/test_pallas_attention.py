@@ -74,7 +74,7 @@ class TestFusedOpAndGrad:
         scale = 1.0 / np.sqrt(8)
 
         def via_fused(q_, k_, v_):
-            return jnp.sum(_fused_attention(q_, k_, v_, scale, True, "xla"))
+            return jnp.sum(_fused_attention(q_, k_, v_, None, scale, True, "xla"))
 
         def via_ref(q_, k_, v_):
             return jnp.sum(_attention_reference(q_, k_, v_, scale, True))
@@ -153,7 +153,7 @@ class TestFlashBackwardKernels:
         def f(backend):
             def fn(q_, k_, v_):
                 return jnp.vdot(
-                    _fused_attention(q_, k_, v_, scale, causal, backend), g)
+                    _fused_attention(q_, k_, v_, None, scale, causal, backend), g)
             return jax.grad(fn, argnums=(0, 1, 2))(
                 jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
 
@@ -186,11 +186,11 @@ class TestFlashBackwardKernels:
         outs, grads = {}, {}
         for backend in ("xla", "pallas_interpret"):
             outs[backend] = _fused_attention(
-                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), scale, True,
-                backend)
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), None, scale,
+                True, backend)
             grads[backend] = jax.grad(
                 lambda q_, k_, v_: jnp.sum(_fused_attention(
-                    q_, k_, v_, scale, True, backend) ** 2),
+                    q_, k_, v_, None, scale, True, backend) ** 2),
                 argnums=(0, 1, 2))(jnp.asarray(q), jnp.asarray(k),
                                    jnp.asarray(v))
         # rows 0..T-Tk-1 see no keys: zero output
@@ -200,3 +200,133 @@ class TestFlashBackwardKernels:
                                    atol=2e-5)
         for a, b in zip(grads["xla"], grads["pallas_interpret"]):
             np.testing.assert_allclose(a, b, atol=3e-5, rtol=3e-5)
+
+
+class TestSegmentIds:
+    """Packed-batch (segment-id) masking in the flash kernel — the
+    static-shape LoD translation (SURVEY §5). Semantics must match
+    parallel.ring_attention: attend iff ids equal; composes with causal."""
+
+    @staticmethod
+    def _ragged_pack(rng, B, T, n_seqs=3):
+        """Segment ids like [0,0,0,1,1,2,2,2,...] per row — a ragged pack
+        of n_seqs sequences of uneven lengths."""
+        ids = np.zeros((B, T), np.int32)
+        for b in range(B):
+            cuts = np.sort(rng.choice(np.arange(1, T), n_seqs - 1,
+                                      replace=False))
+            ids[b] = np.searchsorted(cuts, np.arange(T), side="right")
+        return ids
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_values_match_composite(self, rng, causal):
+        q, k, v = _qkv(rng, B=2, H=2, T=64, D=16)
+        seg = self._ragged_pack(rng, 2, 64)
+        ref = flash_attention(q, k, v, causal=causal, backend="xla",
+                              segment_ids=seg)
+        got = flash_attention(q, k, v, causal=causal, block_q=32,
+                              block_k=32, backend="pallas_interpret",
+                              segment_ids=seg)
+        np.testing.assert_allclose(got, ref, atol=2e-5, rtol=2e-5)
+
+    def test_segment_isolation_vs_separate_calls(self, rng):
+        """Ground truth, not just backend parity: a packed row [seq A | seq
+        B] must equal attending A and B separately."""
+        D = 8
+        qa, ka, va = _qkv(rng, B=1, H=1, T=24, D=D)
+        qb, kb, vb = _qkv(rng, B=1, H=1, T=40, D=D)
+        q = np.concatenate([qa, qb], axis=2)
+        k = np.concatenate([ka, kb], axis=2)
+        v = np.concatenate([va, vb], axis=2)
+        seg = np.concatenate([np.zeros((1, 24), np.int32),
+                              np.ones((1, 40), np.int32)], axis=1)
+        scale = 1.0 / np.sqrt(D)
+        packed = flash_attention(q, k, v, scale=scale, causal=True,
+                                 block_q=16, block_k=16,
+                                 backend="pallas_interpret",
+                                 segment_ids=seg)
+        outa = flash_attention(qa, ka, va, scale=scale, causal=True,
+                               backend="xla")
+        outb = flash_attention(qb, kb, vb, scale=scale, causal=True,
+                               backend="xla")
+        np.testing.assert_allclose(packed[:, :, :24], outa, atol=2e-5,
+                                   rtol=2e-5)
+        np.testing.assert_allclose(packed[:, :, 24:], outb, atol=2e-5,
+                                   rtol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_composite_ragged(self, rng, causal):
+        from paddle_tpu.ops.pallas_kernels import _fused_attention
+        B, H, T, D = 2, 2, 48, 8
+        q = (rng.randn(B, H, T, D) * 0.5).astype("float32")
+        k = (rng.randn(B, H, T, D) * 0.5).astype("float32")
+        v = rng.randn(B, H, T, D).astype("float32")
+        g = jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+        seg = jnp.asarray(self._ragged_pack(rng, B, T))
+        scale = 1.0 / np.sqrt(D)
+
+        def f(backend):
+            def fn(q_, k_, v_):
+                return jnp.vdot(_fused_attention(
+                    q_, k_, v_, seg, scale, causal, backend, 16, 16), g)
+            return jax.grad(fn, argnums=(0, 1, 2))(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+
+        for a, b in zip(f("xla"), f("pallas_interpret")):
+            np.testing.assert_allclose(a, b, atol=3e-5, rtol=3e-5)
+
+    def test_matches_ring_attention_semantics(self, rng):
+        """The kernel and parallel.ring_attention implement the same
+        packed-batch contract: compare on an unsharded single 'ring'."""
+        from paddle_tpu.parallel.ring_attention import _block_attn
+        B, H, T, D = 1, 2, 32, 8
+        q, k, v = _qkv(rng, B=B, H=H, T=T, D=D)
+        seg = self._ragged_pack(rng, B, T)
+        scale = 1.0 / np.sqrt(D)
+        out = flash_attention(q, k, v, scale=scale, backend="xla",
+                              segment_ids=seg)
+        # ring-style reference: one block, segment bias applied
+        same = seg[:, :, None] == seg[:, None, :]
+        bias = np.where(same[:, None], 0.0, -1e30).astype("float32")
+        import jax.numpy as jnp_
+        m0 = jnp_.full((B, H, T), -1e30)
+        l0 = jnp_.zeros((B, H, T))
+        o0 = jnp_.zeros((B, T, H, D))
+        qt = jnp_.asarray(q.transpose(0, 2, 1, 3))
+        kt = jnp_.asarray(k.transpose(0, 2, 1, 3))
+        vt = jnp_.asarray(v.transpose(0, 2, 1, 3))
+        m, l, o = _block_attn(qt, kt, vt, jnp_.asarray(bias), m0, l0, o0,
+                              scale)
+        ring_out = (o / jnp_.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
+                    ).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(out, ring_out, atol=2e-5, rtol=2e-5)
+
+    def test_cross_attention_segment_pair(self, rng):
+        """(q_ids, kv_ids) pair with Tq != Tk."""
+        D = 8
+        q = (rng.randn(1, 1, 16, D) * 0.5).astype("float32")
+        k = (rng.randn(1, 1, 32, D) * 0.5).astype("float32")
+        v = rng.randn(1, 1, 32, D).astype("float32")
+        q_ids = np.repeat(np.array([[0, 1]], np.int32), 8, axis=1)
+        kv_ids = np.repeat(np.array([[0, 1]], np.int32), 16, axis=1)
+        ref = flash_attention(q, k, v, backend="xla",
+                              segment_ids=(q_ids, kv_ids))
+        got = flash_attention(q, k, v, block_q=8, block_k=16,
+                              backend="pallas_interpret",
+                              segment_ids=(q_ids, kv_ids))
+        np.testing.assert_allclose(got, ref, atol=2e-5, rtol=2e-5)
+
+    def test_layer_routes_segment_ids(self, rng):
+        """layers.fused_attention(segment_ids=...) lowers and runs."""
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        q = layers.data(name="q", shape=[2, 32, 8])
+        seg = layers.data(name="seg", shape=[32], dtype="int32")
+        out = layers.fused_attention(q, q, q, causal=True, segment_ids=seg)
+        exe = pt.Executor()
+        qv = (rng.randn(1, 2, 32, 8) * 0.5).astype("float32")
+        segv = self._ragged_pack(rng, 1, 32)
+        got = exe.run(feed={"q": qv, "seg": segv}, fetch_list=[out])[0]
+        ref = flash_attention(qv, qv, qv, causal=True, backend="xla",
+                              segment_ids=segv)
+        np.testing.assert_allclose(got, np.asarray(ref), atol=1e-5)

@@ -1,0 +1,140 @@
+"""Round-3 MFU attribution, part 2: roofline + phase split.
+
+    env PYTHONPATH=/root/.axon_site:/root/repo python tools/profile_resnet2.py
+
+Experiments:
+  roofline      XLA cost-analysis bytes-accessed of the full train step ->
+                HBM-bound vs MXU-bound verdict at 819 GB/s / 197 TFLOP/s
+  fwd_only      forward+loss only (no grads/update): is the bwd pass
+                disproportionately slow?
+  stem_conv     conv1 (7x7/s2 over C=3) fwd+bwd alone: the known
+                MXU-hostile layer, candidate for space-to-depth
+  body_conv     a representative 3x3 bottleneck conv (C=128, 28x28):
+                what efficiency does the MXU-friendly bulk reach?
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+HBM_GBPS = 819.0     # v5e spec
+PEAK_TFLOPS = 197.0  # v5e bf16
+
+
+def _realize(x):
+    return float(np.asarray(x).ravel()[0])
+
+
+def _timed(fn, *args, iters=10):
+    out = fn(*args)
+    _realize(out[0] if isinstance(out, (tuple, list)) else out)
+    t0 = time.time()
+    for _ in range(iters):
+        out = fn(*args)
+    _realize(out[0] if isinstance(out, (tuple, list)) else out)
+    return (time.time() - t0) / iters
+
+
+def roofline_and_fwd(batch=256):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu import models
+
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    loss, acc, _ = models.resnet.resnet_imagenet(
+        depth=50, is_test=False, data_format="NHWC", use_bf16=True)
+    opt = pt.optimizer.MomentumOptimizer(learning_rate=3e-3, momentum=0.9)
+    opt.minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+
+    rng = np.random.RandomState(0)
+    feed = {
+        "img": jnp.asarray(rng.rand(batch, 224, 224, 3).astype("float32")),
+        "label": jnp.asarray(rng.randint(0, 1000, (batch, 1)).astype("int64")),
+    }
+    ca = exe.cost_analysis(feed=feed, fetch_list=[loss])
+    flops = float(ca.get("flops", 0.0))
+    baw = float(ca.get("bytes accessed", 0.0))
+    out_b = float(ca.get("bytes accessed output", 0.0))
+    t_flops_ms = flops / (PEAK_TFLOPS * 1e12) * 1e3
+    t_hbm_ms = baw / (HBM_GBPS * 1e9) * 1e3
+    print(json.dumps({
+        "exp": "roofline_train_step", "flops": flops,
+        "bytes_accessed": baw, "bytes_accessed_output": out_b,
+        "ideal_compute_ms": round(t_flops_ms, 1),
+        "ideal_hbm_ms": round(t_hbm_ms, 1),
+        "arithmetic_intensity": round(flops / max(baw, 1), 1),
+    }), flush=True)
+
+    # fwd only: fresh program without backward/update
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    loss2, acc2, _ = models.resnet.resnet_imagenet(
+        depth=50, is_test=False, data_format="NHWC", use_bf16=True)
+    exe2 = pt.Executor()
+    exe2.run(pt.default_startup_program())
+    dt = _timed(lambda: exe2.run(feed=feed, fetch_list=[loss2],
+                                 return_numpy=False)[0])
+    ca2 = exe2.cost_analysis(feed=feed, fetch_list=[loss2])
+    f2 = float(ca2.get("flops", 0.0))
+    print(json.dumps({
+        "exp": "fwd_only_bs256", "step_ms": round(dt * 1e3, 2),
+        "flops": f2,
+        "implied_tflops": round(f2 / dt / 1e12, 1),
+    }), flush=True)
+
+
+def conv_micro(name, x_shape, k_shape, stride, padding):
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(*x_shape).astype(np.float32),
+                    dtype=jnp.bfloat16)
+    k = jnp.asarray(rng.randn(*k_shape).astype(np.float32),
+                    dtype=jnp.bfloat16)
+
+    def f(x, k):
+        out = jax.lax.conv_general_dilated(
+            x, k, (stride, stride), padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32)
+        return jnp.sum(out.astype(jnp.float32))
+
+    g = jax.jit(jax.grad(f, argnums=(0, 1)))
+    dt = _timed(lambda: g(x, k)[0][0, 0, 0, 0])
+    n, h, w, _ = x_shape
+    kh, kw, ci, co = k_shape
+    oh = (h + sum(padding[0]) - kh) // stride + 1
+    ow = (w + sum(padding[1]) - kw) // stride + 1
+    flops = 3 * 2 * n * oh * ow * kh * kw * ci * co  # fwd+2 bwd convs
+    print(json.dumps({
+        "exp": name, "ms": round(dt * 1e3, 2),
+        "tflops_attained": round(flops / dt / 1e12, 1),
+        "pct_peak": round(flops / dt / 1e12 / PEAK_TFLOPS * 100, 1),
+    }), flush=True)
+
+
+def main():
+    import jax
+    print(json.dumps({"devices": [str(d) for d in jax.devices()]}),
+          flush=True)
+    roofline_and_fwd()
+    conv_micro("stem_conv7x7s2_c3", (256, 224, 224, 3), (7, 7, 3, 64), 2,
+               ((3, 3), (3, 3)))
+    conv_micro("stem_s2d_conv4x4s1_c12", (256, 112, 112, 12),
+               (4, 4, 12, 64), 1, ((1, 2), (1, 2)))
+    conv_micro("body_conv3x3_c128", (256, 28, 28, 128), (3, 3, 128, 128), 1,
+               ((1, 1), (1, 1)))
+    conv_micro("body_conv3x3_c256_14", (256, 14, 14, 256),
+               (3, 3, 256, 256), 1, ((1, 1), (1, 1)))
+
+
+if __name__ == "__main__":
+    main()

@@ -187,16 +187,58 @@ def load_params(executor=None, dirname: str = "", main_program=None,
 
 
 def save_persistables(executor=None, dirname: str = "", main_program=None,
-                      filename=None, scope=None, save_as_bf16=False):
+                      filename=None, scope=None, save_as_bf16=False,
+                      sharded: bool = False):
     """≙ fluid.io.save_persistables (reference io.py:252) — parameters AND
-    optimizer state/moving stats, i.e. everything needed to resume."""
+    optimizer state/moving stats, i.e. everything needed to resume.
+
+    sharded=True: each process writes only its addressable shards plus a
+    manifest (sharded_checkpoint.save_sharded) — ZeRO-1/EP state that does
+    not fit one host checkpoints without a gather, and restore can re-shard
+    onto a different mesh (≙ SURVEY §5 "jittable sharded checkpoint
+    (tensorstore-style)"; reference trainer.py:641 per-shard pserver
+    checkpoints)."""
+    if sharded:
+        from .sharded_checkpoint import save_sharded
+        enforce(filename is None and not save_as_bf16,
+                "sharded=True does not combine with filename/save_as_bf16 "
+                "(shards go to per-process .pts containers in the native "
+                "dtypes of the arrays)", exc=InvalidArgumentError)
+        program = main_program or default_main_program()
+        scope = scope or global_scope()
+        vars = _select_vars(program, _is_persistable)
+        arrays = {}
+        for v in vars:
+            if not scope.has_var(v.name):
+                raise NotFoundError(
+                    f"variable {v.name!r} not found in scope — run the "
+                    f"startup program before saving")
+            arrays[v.name] = scope.get(v.name)
+        save_sharded(dirname, arrays)
+        return sorted(arrays)
     return save_vars(executor, dirname, main_program=main_program,
                      predicate=_is_persistable, filename=filename,
                      scope=scope, save_as_bf16=save_as_bf16)
 
 
 def load_persistables(executor=None, dirname: str = "", main_program=None,
-                      filename=None, scope=None):
+                      filename=None, scope=None, sharded: bool = False,
+                      shardings=None):
+    """sharded=True restores from a sharded_checkpoint directory;
+    `shardings` optionally maps var name -> jax Sharding to re-shard onto
+    the CURRENT mesh (unlisted vars restore as host-resident arrays)."""
+    if sharded:
+        from .sharded_checkpoint import restore_sharded
+        enforce(filename is None, "sharded=True does not combine with "
+                "filename", exc=InvalidArgumentError)
+        program = main_program or default_main_program()
+        scope = scope or global_scope()
+        vars = _select_vars(program, _is_persistable)
+        restored = restore_sharded(dirname, shardings=shardings,
+                                   names=[v.name for v in vars])
+        for name, val in restored.items():
+            scope.set_var(name, val)
+        return sorted(restored)
     return load_vars(executor, dirname, main_program=main_program,
                      predicate=_is_persistable, filename=filename,
                      scope=scope)

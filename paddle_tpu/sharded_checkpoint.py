@@ -1,0 +1,256 @@
+"""Sharded (per-process) checkpointing: tensorstore-style save/restore.
+
+Each process writes ONLY its addressable shards — a native tensor-store
+container (`shard-<p>.pts`) plus a JSON manifest (`manifest-<p>.json`)
+mapping each variable chunk to its global-offset slice. Restore re-shards
+onto whatever mesh is current: a host reads just the chunks intersecting
+its addressable slices, so state that does not fit one host (ZeRO-1
+optimizer shards, expert/embedding partitions) round-trips without ever
+being gathered.
+
+Capability translation (SURVEY §5 checkpoint row: "jittable sharded
+checkpoint (tensorstore-style)"): the reference checkpoints pserver-side
+state per shard by construction (reference
+paddle/fluid/operators/listen_and_serv_op.cc checkpoint handler;
+python/paddle/fluid/trainer.py:641 _save_checkpoint with per-trainer and
+per-pserver artifacts); on TPU the sharding lives on the arrays
+themselves, so the per-process slice map comes from
+`jax.Array.addressable_shards`.
+
+Layout of a checkpoint directory:
+    shard-0.pts      chunks owned by process 0 (native container)
+    manifest-0.json  {var: {shape, dtype, chunks: [{start, shape, file,
+                      key}]}}
+    shard-1.pts, manifest-1.json, ...
+
+A chunk is recorded once per distinct slice (replica_id == 0 dedupe), so
+replicated axes do not bloat the checkpoint.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .core.enforce import InvalidArgumentError, NotFoundError, enforce
+
+MANIFEST_PREFIX = "manifest-"
+SHARD_PREFIX = "shard-"
+
+
+def _slice_starts(index, shape) -> List[int]:
+    """Normalize a Shard.index (tuple of slices) to absolute start offsets."""
+    starts = []
+    for sl, dim in zip(index, shape):
+        start, _, step = sl.indices(dim)
+        enforce(step == 1, "strided shards are not supported",
+                exc=InvalidArgumentError)
+        starts.append(int(start))
+    # scalar / rank-0 arrays have an empty index
+    return starts
+
+
+def save_sharded(dirname: str, arrays: Dict[str, object],
+                 process_index: Optional[int] = None,
+                 world_size: Optional[int] = None,
+                 only_devices=None) -> str:
+    """Write this process's addressable shards of `arrays` to dirname.
+
+    world_size (default jax.process_count()) is recorded in the manifest;
+    the reader refuses a directory whose manifest count does not match it,
+    so a re-save from a SMALLER world over an old checkpoint directory
+    errors instead of silently stitching stale shard files in.
+
+    only_devices: restrict to shards living on these devices — used by
+    single-process tests to emulate the per-host split of a multi-host
+    save (in a real multi-host world addressable_shards IS that split).
+    Returns the manifest path.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    pid = jax.process_index() if process_index is None else int(process_index)
+    world = jax.process_count() if world_size is None else int(world_size)
+    os.makedirs(dirname, exist_ok=True)
+    chunks: Dict[str, np.ndarray] = {}
+    manifest: Dict[str, dict] = {"__meta__": {"world_size": world}}
+    shard_file = f"{SHARD_PREFIX}{pid}.pts"
+    for name, arr in arrays.items():
+        if not hasattr(arr, "addressable_shards"):
+            # host array: keep its exact numpy dtype (jnp.asarray would
+            # silently narrow int64/float64 under default jax config)
+            data = np.asarray(arr)
+            key = name + "@" + ",".join("0" for _ in data.shape)
+            chunks[key] = data
+            manifest[name] = {
+                "shape": list(data.shape), "dtype": str(data.dtype),
+                "chunks": [{"start": [0] * data.ndim,
+                            "shape": list(data.shape),
+                            "file": shard_file, "key": key}]}
+            continue
+        arr = jnp.asarray(arr)
+        entry = {"shape": list(arr.shape), "dtype": str(arr.dtype),
+                 "chunks": []}
+        for sh in arr.addressable_shards:
+            if sh.replica_id != 0:
+                continue  # one writer per distinct slice
+            if only_devices is not None and sh.device not in only_devices:
+                continue
+            starts = _slice_starts(sh.index, arr.shape)
+            data = np.asarray(sh.data)
+            key = name + "@" + ",".join(map(str, starts))
+            chunks[key] = data
+            entry["chunks"].append({"start": starts,
+                                    "shape": list(data.shape),
+                                    "file": shard_file, "key": key})
+        if entry["chunks"]:
+            manifest[name] = entry
+    from .data.tensor_store import save_tensors
+    save_tensors(os.path.join(dirname, shard_file), chunks)
+    mpath = os.path.join(dirname, f"{MANIFEST_PREFIX}{pid}.json")
+    tmp = mpath + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, mpath)  # atomic: a crash never clobbers a good manifest
+    return mpath
+
+
+class ShardedCheckpoint:
+    """Reader over all manifests of a checkpoint directory. Chunk data is
+    loaded lazily per (file, key) and cached, so restoring a slice touches
+    only the containers that hold intersecting chunks."""
+
+    def __init__(self, dirname: str):
+        self.dirname = dirname
+        paths = sorted(glob.glob(
+            os.path.join(dirname, MANIFEST_PREFIX + "*.json")))
+        if not paths:
+            raise NotFoundError(f"no sharded checkpoint under {dirname!r} "
+                                f"(no {MANIFEST_PREFIX}*.json)")
+        self.vars: Dict[str, dict] = {}
+        world_sizes = set()
+        for p in paths:
+            with open(p) as f:
+                m = json.load(f)
+            meta = m.pop("__meta__", None)
+            if meta is not None:
+                world_sizes.add(int(meta.get("world_size", len(paths))))
+            for name, entry in m.items():
+                known = self.vars.get(name)
+                if known is None:
+                    self.vars[name] = {"shape": entry["shape"],
+                                       "dtype": entry["dtype"],
+                                       "chunks": list(entry["chunks"])}
+                else:
+                    enforce(known["shape"] == entry["shape"] and
+                            known["dtype"] == entry["dtype"],
+                            f"manifests disagree on {name!r}",
+                            exc=InvalidArgumentError)
+                    known["chunks"].extend(entry["chunks"])
+        if world_sizes:
+            enforce(len(world_sizes) == 1 and
+                    world_sizes == {len(paths)},
+                    f"checkpoint dir {dirname!r} holds {len(paths)} "
+                    f"manifest(s) but the save recorded world_size"
+                    f"={sorted(world_sizes)} — stale files from an earlier "
+                    f"save with a different process count? Save into a "
+                    f"fresh directory.", exc=InvalidArgumentError)
+        self._cache: Dict[tuple, np.ndarray] = {}
+
+    def names(self) -> List[str]:
+        return sorted(self.vars)
+
+    def _chunk(self, c) -> np.ndarray:
+        key = (c["file"], c["key"])
+        if key not in self._cache:
+            from .data.tensor_store import load_tensors
+            got = load_tensors(os.path.join(self.dirname, c["file"]),
+                               [c["key"]])
+            self._cache[key] = got[c["key"]]
+        return self._cache[key]
+
+    def read_slice(self, name: str, index) -> np.ndarray:
+        """Assemble the sub-array `index` (tuple of slices in global
+        coordinates) of var `name` from every intersecting chunk."""
+        if name not in self.vars:
+            raise NotFoundError(f"{name!r} not in checkpoint")
+        entry = self.vars[name]
+        shape = entry["shape"]
+        import ml_dtypes  # registers bfloat16 with numpy
+        del ml_dtypes
+        dtype = np.dtype(entry["dtype"]) if entry["dtype"] != "bfloat16" \
+            else np.dtype("bfloat16")
+        starts, stops = [], []
+        for sl, dim in zip(index, shape):
+            a, b, step = sl.indices(dim)
+            enforce(step == 1, "strided restore not supported",
+                    exc=InvalidArgumentError)
+            starts.append(a)
+            stops.append(b)
+        out = np.empty([b - a for a, b in zip(starts, stops)], dtype)
+        filled = np.zeros(out.shape, bool) if entry["chunks"] else None
+        for c in entry["chunks"]:
+            c_start = c["start"] or [0] * len(shape)
+            c_stop = [s + d for s, d in zip(c_start, c["shape"])]
+            inter_a = [max(a, ca) for a, ca in zip(starts, c_start)]
+            inter_b = [min(b, cb) for b, cb in zip(stops, c_stop)]
+            if any(a >= b for a, b in zip(inter_a, inter_b)) and out.ndim:
+                continue
+            dst = tuple(slice(a - o, b - o)
+                        for a, b, o in zip(inter_a, inter_b, starts))
+            src = tuple(slice(a - o, b - o)
+                        for a, b, o in zip(inter_a, inter_b, c_start))
+            if out.ndim == 0:
+                out[...] = np.asarray(self._chunk(c)).reshape(())
+            else:
+                out[dst] = self._chunk(c)[src]
+            if filled is not None:
+                filled[dst] = True
+        if filled is not None and not filled.all():
+            raise NotFoundError(
+                f"checkpoint chunks do not cover the requested slice of "
+                f"{name!r} (a shard file from another process is missing?)")
+        return out
+
+    def read(self, name: str) -> np.ndarray:
+        entry = self.vars[name]
+        return self.read_slice(
+            name, tuple(slice(0, d) for d in entry["shape"]))
+
+
+def restore_array(ckpt: ShardedCheckpoint, name: str, sharding=None):
+    """Materialize var `name` from the checkpoint.
+
+    sharding=None: full host (numpy) array in the exact saved dtype — not
+    run through jnp.asarray, which would narrow int64/float64 under the
+    default jax config. With a jax Sharding: build the
+    (possibly distributed) array via make_array_from_callback — each
+    process reads ONLY the chunks its addressable slices intersect, which
+    is what lets a restore re-shard onto a different mesh/device count
+    without any host ever holding the full state."""
+    import jax
+
+    entry = ckpt.vars.get(name)
+    if entry is None:
+        raise NotFoundError(f"{name!r} not in checkpoint")
+    if sharding is None:
+        return ckpt.read(name)
+    shape = tuple(entry["shape"])
+    return jax.make_array_from_callback(
+        shape, sharding, lambda idx: ckpt.read_slice(name, idx))
+
+
+def restore_sharded(dirname: str, shardings: Optional[Dict] = None,
+                    names: Optional[Sequence[str]] = None) -> Dict[str, object]:
+    """Restore {name: array} for `names` (default: everything saved).
+    shardings maps name -> jax Sharding (missing/None -> host array)."""
+    ckpt = ShardedCheckpoint(dirname)
+    shardings = shardings or {}
+    out = {}
+    for name in (names if names is not None else ckpt.names()):
+        out[name] = restore_array(ckpt, name, shardings.get(name))
+    return out

@@ -1,0 +1,217 @@
+"""Sharded checkpoint: per-process shard files + manifest, re-shard restore.
+
+≙ SURVEY §5 checkpoint translation ("jittable sharded checkpoint,
+tensorstore-style"); reference per-shard pserver checkpoints
+(trainer.py:641, listen_and_serv_op.cc checkpoint handler). VERDICT r2 #5.
+
+The 8-device CPU mesh stands in for a pod slice; the multi-host split is
+emulated with save_sharded(only_devices=...) — in a real multi-host world
+`addressable_shards` IS that split, same code path.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu.sharded_checkpoint import (ShardedCheckpoint, restore_array,
+                                           restore_sharded, save_sharded)
+
+
+def _mesh(shape, names):
+    devs = np.array(jax.devices()[:int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, names)
+
+
+class TestSaveRestoreRoundTrip:
+    def test_plain_arrays_round_trip(self, tmp_path):
+        rng = np.random.RandomState(0)
+        arrays = {
+            "w": rng.randn(16, 8).astype(np.float32),
+            "b": rng.randn(8).astype(np.float32),
+            "step": np.asarray(7, np.int64),
+        }
+        save_sharded(str(tmp_path), {k: jnp.asarray(v)
+                                     for k, v in arrays.items()})
+        back = restore_sharded(str(tmp_path))
+        assert sorted(back) == ["b", "step", "w"]
+        for k in arrays:
+            np.testing.assert_array_equal(np.asarray(back[k]), arrays[k])
+
+    def test_bf16_round_trip(self, tmp_path):
+        x = jnp.linspace(0, 1, 64, dtype=jnp.bfloat16).reshape(8, 8)
+        save_sharded(str(tmp_path), {"xb": x})
+        back = restore_sharded(str(tmp_path))["xb"]
+        assert str(back.dtype) == "bfloat16"
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+
+    def test_sharded_array_dedupes_replicas(self, tmp_path):
+        """dp-replicated tp-sharded array: only ONE copy of each distinct
+        slice is written (replica_id == 0), not one per device."""
+        mesh = _mesh((4, 2), ("dp", "tp"))
+        x = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+        xs = jax.device_put(x, NamedSharding(mesh, P(None, "tp")))
+        save_sharded(str(tmp_path), {"x": xs})
+        ckpt = ShardedCheckpoint(str(tmp_path))
+        assert len(ckpt.vars["x"]["chunks"]) == 2  # tp=2 slices, dp deduped
+        np.testing.assert_array_equal(ckpt.read("x"), np.asarray(x))
+
+
+class TestMultiProcessEmulation:
+    def test_two_process_split_and_restore(self, tmp_path):
+        """Each 'process' writes only its half of a dp-sharded array; the
+        reader stitches both manifests; a missing shard file is detected."""
+        mesh = _mesh((8,), ("dp",))
+        rng = np.random.RandomState(1)
+        w = rng.randn(16, 4).astype(np.float32)
+        acc = rng.randn(16, 4).astype(np.float32)  # ZeRO-1-style accumulator
+        ws = jax.device_put(jnp.asarray(w), NamedSharding(mesh, P("dp")))
+        accs = jax.device_put(jnp.asarray(acc), NamedSharding(mesh, P("dp")))
+        devs = jax.devices()
+        save_sharded(str(tmp_path), {"w": ws, "acc": accs},
+                     process_index=0, world_size=2,
+                     only_devices=set(devs[:4]))
+        save_sharded(str(tmp_path), {"w": ws, "acc": accs},
+                     process_index=1, world_size=2,
+                     only_devices=set(devs[4:]))
+
+        ckpt = ShardedCheckpoint(str(tmp_path))
+        assert len(ckpt.vars["w"]["chunks"]) == 8
+        np.testing.assert_array_equal(ckpt.read("w"), w)
+        np.testing.assert_array_equal(ckpt.read("acc"), acc)
+
+    def test_stale_manifest_world_mismatch_rejected(self, tmp_path):
+        """Regression: re-saving from a smaller world over an old
+        checkpoint dir must error, not silently stitch stale shards."""
+        mesh = _mesh((8,), ("dp",))
+        w = jnp.arange(32, dtype=jnp.float32).reshape(16, 2)
+        ws = jax.device_put(w, NamedSharding(mesh, P("dp")))
+        devs = jax.devices()
+        save_sharded(str(tmp_path), {"w": ws}, process_index=0,
+                     world_size=2, only_devices=set(devs[:4]))
+        save_sharded(str(tmp_path), {"w": ws}, process_index=1,
+                     world_size=2, only_devices=set(devs[4:]))
+        # later: a 1-process world re-saves into the same directory
+        save_sharded(str(tmp_path), {"w": ws}, process_index=0,
+                     world_size=1)
+        with pytest.raises(Exception) as ei:
+            ShardedCheckpoint(str(tmp_path))
+        assert "stale" in str(ei.value) or "world_size" in str(ei.value)
+
+    def test_int64_scalar_dtype_preserved(self, tmp_path):
+        """Regression: host int64 values (global step counters) must not
+        be narrowed to int32 by a jnp round-trip on save or restore."""
+        big = np.asarray(5_000_000_000, np.int64)
+        save_sharded(str(tmp_path), {"global_step": big})
+        back = restore_sharded(str(tmp_path))["global_step"]
+        assert back.dtype == np.int64
+        assert int(back) == 5_000_000_000
+
+    def test_missing_shard_detected(self, tmp_path):
+        mesh = _mesh((8,), ("dp",))
+        w = jnp.arange(32, dtype=jnp.float32).reshape(16, 2)
+        ws = jax.device_put(w, NamedSharding(mesh, P("dp")))
+        devs = jax.devices()
+        save_sharded(str(tmp_path), {"w": ws}, process_index=0,
+                     only_devices=set(devs[:4]))
+        ckpt = ShardedCheckpoint(str(tmp_path))
+        with pytest.raises(Exception) as ei:
+            ckpt.read("w")
+        assert "cover" in str(ei.value)
+
+
+class TestReshardRestore:
+    def test_restore_onto_different_mesh_shape(self, tmp_path):
+        """Save sharded over dp=8, restore sharded over (dp=2, tp=2) on a
+        4-device mesh — the elastic world-resize story."""
+        rng = np.random.RandomState(2)
+        w = rng.randn(16, 8).astype(np.float32)
+        mesh8 = _mesh((8,), ("dp",))
+        ws = jax.device_put(jnp.asarray(w),
+                            NamedSharding(mesh8, P("dp", None)))
+        save_sharded(str(tmp_path), {"w": ws})
+
+        mesh4 = _mesh((2, 2), ("dp", "tp"))
+        target = NamedSharding(mesh4, P("dp", "tp"))
+        ckpt = ShardedCheckpoint(str(tmp_path))
+        restored = restore_array(ckpt, "w", target)
+        assert restored.sharding == target
+        np.testing.assert_array_equal(np.asarray(restored), w)
+
+    def test_restore_slice_crosses_chunk_boundaries(self, tmp_path):
+        """A target shard spanning several saved chunks assembles from all
+        of them (save dp=8 -> restore dp=2: each restored shard covers 4
+        saved chunks)."""
+        mesh8 = _mesh((8,), ("dp",))
+        w = np.arange(64, dtype=np.float32).reshape(16, 4)
+        ws = jax.device_put(jnp.asarray(w), NamedSharding(mesh8, P("dp")))
+        save_sharded(str(tmp_path), {"w": ws})
+        ckpt = ShardedCheckpoint(str(tmp_path))
+        got = ckpt.read_slice("w", (slice(2, 14), slice(0, 4)))
+        np.testing.assert_array_equal(got, w[2:14])
+
+
+class TestIoIntegration:
+    def test_save_load_persistables_sharded(self, tmp_path):
+        """io.save_persistables(sharded=True) end to end through a real
+        trained program, restore into a fresh scope, same fetch values."""
+        from paddle_tpu import layers
+        x = layers.data(name="x", shape=[4])
+        y = layers.fc(x, size=3)
+        loss = layers.reduce_mean(y)
+        pt.optimizer.MomentumOptimizer(learning_rate=0.1,
+                                       momentum=0.9).minimize(loss)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        feed = {"x": np.ones((2, 4), np.float32)}
+        for _ in range(3):
+            exe.run(feed=feed, fetch_list=[loss])
+
+        saved = pt.io.save_persistables(dirname=str(tmp_path), sharded=True)
+        assert any(n.endswith(".w_0") or "fc" in n for n in saved)
+        names = list(saved)
+        vals = {n: np.asarray(pt.global_scope().get(n)) for n in names}
+        # every run IS a train step (minimize appended): the reference is
+        # the step-4 loss from the saved state, taken AFTER saving
+        ref = exe.run(feed=feed, fetch_list=[loss])[0]
+
+        # wipe and restore (momentum accumulators included -> the next
+        # step reproduces exactly)
+        pt.reset_global_scope()
+        # scope is empty now; program still exists
+        pt.io.load_persistables(dirname=str(tmp_path), sharded=True,
+                                scope=pt.global_scope())
+        for n in names:
+            np.testing.assert_array_equal(
+                np.asarray(pt.global_scope().get(n)), vals[n])
+        exe2 = pt.Executor()
+        got = exe2.run(feed=feed, fetch_list=[loss])[0]
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_load_persistables_sharded_with_shardings(self, tmp_path):
+        from paddle_tpu import layers
+        x = layers.data(name="x", shape=[8])
+        y = layers.fc(x, size=8, name="shfc")
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        pt.io.save_persistables(dirname=str(tmp_path), sharded=True)
+        w_name = [n for n in pt.global_scope().local_var_names()
+                  if "shfc" in n and "w" in n][0]
+        ref = np.asarray(pt.global_scope().get(w_name))
+
+        mesh = _mesh((4,), ("tp",))
+        sh = NamedSharding(mesh, P(None, "tp"))
+        pt.reset_global_scope()
+        pt.io.load_persistables(dirname=str(tmp_path), sharded=True,
+                                shardings={w_name: sh})
+        got = pt.global_scope().get(w_name)
+        assert got.sharding == sh
+        np.testing.assert_array_equal(np.asarray(got), ref)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-x", "-q"]))

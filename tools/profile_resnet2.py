@@ -103,8 +103,7 @@ def conv_micro(name, x_shape, k_shape, stride, padding):
     def f(x, k):
         out = jax.lax.conv_general_dilated(
             x, k, (stride, stride), padding,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32)
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
         return jnp.sum(out.astype(jnp.float32))
 
     g = jax.jit(jax.grad(f, argnums=(0, 1)))

@@ -1,0 +1,99 @@
+"""Round-3 MFU attribution, part 3: where do the 77 GB/step go?
+
+Dumps the optimized HLO of the compiled ResNet-50 train step and
+summarizes traffic suspects: copies, transposes, big fp32 buffers,
+select-and-scatter (maxpool bwd), plus per-category byte totals from the
+cost analysis.
+
+    env PYTHONPATH=/root/.axon_site:/root/repo python tools/profile_resnet3.py
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import re
+
+import numpy as np
+
+
+def main():
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu import models
+
+    batch = 256
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    loss, acc, _ = models.resnet.resnet_imagenet(
+        depth=50, is_test=False, data_format="NHWC", use_bf16=True)
+    opt = pt.optimizer.MomentumOptimizer(learning_rate=3e-3, momentum=0.9)
+    opt.minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+
+    rng = np.random.RandomState(0)
+    feed = {
+        "img": jnp.asarray(rng.rand(batch, 224, 224, 3).astype("float32")),
+        "label": jnp.asarray(rng.randint(0, 1000, (batch, 1)).astype("int64")),
+    }
+    compiled = exe._lookup_or_compile(
+        pt.default_main_program(), feed, [loss.name], pt.global_scope())
+    feed_vals = tuple(jnp.asarray(feed[n]) for n in compiled.feed_names)
+    scope = pt.global_scope()
+    ro_vals = tuple(scope.get(n) for n in compiled.ro_names)
+    rw_vals = tuple(scope.get(n) for n in compiled.rw_names)
+    ex = compiled.fn.lower(feed_vals, ro_vals, rw_vals,
+                           np.uint32(0)).compile()
+    hlo = ex.as_text()
+    with open("/tmp/resnet_train_optimized.hlo", "w") as f:
+        f.write(hlo)
+
+    # shape -> bytes
+    def shape_bytes(sh):
+        m = re.match(r"(bf16|f32|f16|s32|u32|s8|u8|pred|s64|u64)\[([0-9,]*)\]",
+                     sh)
+        if not m:
+            return 0
+        it = {"bf16": 2, "f16": 2, "f32": 4, "s32": 4, "u32": 4, "s8": 1,
+              "u8": 1, "pred": 1, "s64": 8, "u64": 8}[m.group(1)]
+        dims = m.group(2)
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        return n * it
+
+    op_bytes = collections.Counter()
+    op_count = collections.Counter()
+    big_f32 = []
+    for line in hlo.splitlines():
+        m = re.search(r"=\s+((?:bf16|f32|f16|s32|u32|s8|u8|pred|s64|u64)"
+                      r"\[[0-9,]*\][^ ]*)\s+([a-z\-]+)", line)
+        if not m:
+            continue
+        sh, op = m.group(1), m.group(2)
+        b = shape_bytes(sh)
+        op_bytes[op] += b
+        op_count[op] += 1
+        if sh.startswith("f32") and b > 50e6:
+            big_f32.append((round(b / 1e6), op, line.strip()[:140]))
+
+    top = op_bytes.most_common(15)
+    print(json.dumps({
+        "exp": "hlo_output_bytes_by_op",
+        "top": [(op, round(b / 1e9, 2), op_count[op]) for op, b in top],
+    }), flush=True)
+    big_f32.sort(reverse=True)
+    print(json.dumps({"exp": "big_f32_buffers",
+                      "top10": big_f32[:10]}), flush=True)
+    ca = ex.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    keys = {k: v for k, v in ca.items()
+            if "bytes" in k and isinstance(v, float) and v > 1e9}
+    print(json.dumps({"exp": "cost_analysis_byte_keys", "keys": keys}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

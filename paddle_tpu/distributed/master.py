@@ -250,6 +250,11 @@ class MasterClient:
     def heartbeat(self) -> float:
         return self._proxy.heartbeat(self.worker_id)
 
+    def live_workers(self, horizon_s: float = 30.0) -> List[str]:
+        """Workers with a heartbeat inside the horizon — lets a chief-side
+        FailureDetector watch peers through the master from any process."""
+        return self._proxy.live_workers(horizon_s)
+
     def stats(self) -> dict:
         return self._proxy.stats()
 

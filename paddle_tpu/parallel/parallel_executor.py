@@ -25,6 +25,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Sequence, Union
 
+import jax
 import numpy as np
 from jax.sharding import NamedSharding
 
@@ -131,6 +132,36 @@ class ParallelExecutor(Executor):
             out_shardings=(fetch_shard, state_out_shard),
             analysis=analysis)
 
+    # -- multi-process state/feed placement -------------------------------
+    def _spans_processes(self) -> bool:
+        return jax.process_count() > 1
+
+    def _globalize_state(self, program: Program, scope: Scope):
+        """Place persistable state onto the global mesh (≙
+        BCastParamsToDevices, reference parallel_executor.cc:210): after a
+        plain Executor ran the startup program, state lives as
+        process-local arrays; a cross-process mesh needs it as global
+        arrays. Every process computed IDENTICAL host values (seeded
+        startup program), so placement is a device_put of the host value
+        with the state's global sharding — each process materializes only
+        its addressable shards. Runs once per (program version, scope):
+        afterwards every state output of the compiled step is already
+        global."""
+        from ..io import _is_persistable, _select_vars
+        key = (id(program), program._version, id(scope))
+        if key in getattr(self, "_globalized", ()):
+            return
+        for v in _select_vars(program, _is_persistable):
+            if not scope.has_var(v.name):
+                continue
+            val = scope.get(v.name)
+            sh = getattr(val, "sharding", None)
+            if sh is not None and not sh.is_fully_addressable:
+                continue  # already a global array
+            target = self._state_sharding(program, v.name)
+            scope.set_var(v.name, jax.device_put(np.asarray(val), target))
+        self._globalized = getattr(self, "_globalized", set()) | {key}
+
     # -- run --------------------------------------------------------------
     def run(self,
             fetch_list: Optional[Sequence[Union[str, Variable]]] = None,
@@ -154,6 +185,23 @@ class ParallelExecutor(Executor):
         # stash shapes so _compile can build feed shardings without
         # re-plumbing the Executor.run signature.
         self._feed_shapes = {n: np.shape(v) for n, v in feed.items()}
+        if self._spans_processes():
+            self._globalize_state(program, scope)
+            # feeds carry the GLOBAL batch (identical on every process —
+            # the reference's nccl2-mode trainers likewise each construct
+            # their portion deterministically); device_put materializes
+            # each process's addressable shards of the dp split. Values
+            # that are ALREADY global jax arrays (e.g. built with
+            # make_array_from_process_local_data for per-process-distinct
+            # data) pass through untouched.
+            def _place(n, v):
+                sh = getattr(v, "sharding", None)
+                if sh is not None and not sh.is_fully_addressable:
+                    return v
+                return jax.device_put(
+                    np.asarray(v),
+                    self._feed_sharding(program, n, np.shape(v)))
+            feed = {n: _place(n, v) for n, v in feed.items()}
         return super().run(program=program, feed=feed, fetch_list=fetch_list,
                            scope=scope, return_numpy=return_numpy)
 

@@ -118,6 +118,132 @@ def test_two_process_jax_distributed_bootstrap(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# 1b. multi-process ParallelExecutor: the framework's OWN PE program runs
+#     across two processes (2 virtual devices each) on one global 4-device
+#     mesh, and its loss trajectory matches the single-process 4-device run.
+#     ≙ reference test_dist_base.py:27 proving the real trainer program
+#     multi-process over an nccl2 world (nccl_helper.h:118).
+# ---------------------------------------------------------------------------
+
+_PE_MODEL = r"""
+import numpy as np
+
+
+def build_and_train(steps=6, reduce_strategy=False):
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.parallel import (BuildStrategy, DeviceMesh,
+                                     ParallelExecutor, ReduceStrategy)
+    from paddle_tpu.core import unique_name
+    import jax
+
+    with unique_name.guard():
+        x = layers.data("x", shape=[8])
+        y = layers.data("y", shape=[1])
+        h = layers.fc(x, size=16, act="relu", name="pe_fc1")
+        pred = layers.fc(h, size=1, name="pe_fc2")
+        loss = layers.reduce_mean(layers.square(pred - y))
+        pt.optimizer.MomentumOptimizer(learning_rate=0.05,
+                                       momentum=0.9).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+
+    bs = BuildStrategy()
+    if reduce_strategy:
+        bs.reduce_strategy = ReduceStrategy.Reduce     # ZeRO-1 over dp
+    pe = ParallelExecutor(loss_name=loss.name,
+                          mesh=DeviceMesh(jax.devices()),
+                          build_strategy=bs)
+
+    r = np.random.RandomState(7)
+    W = r.randn(8, 1).astype("float32")
+    losses = []
+    for i in range(steps):
+        rb = np.random.RandomState(100 + i)
+        xb = rb.rand(16, 8).astype("float32")          # global batch
+        yb = (xb @ W).astype("float32")
+        losses.append(float(pe.run(feed={"x": xb, "y": yb},
+                                   fetch_list=[loss.name])[0]))
+    return losses
+"""
+
+_PE_SINGLE = r"""
+import json
+from pe_model import build_and_train
+out = {"plain": build_and_train(), }
+import paddle_tpu as pt
+pt.reset_default_programs(); pt.reset_global_scope()
+out["zero1"] = build_and_train(reduce_strategy=True)
+print(json.dumps(out), flush=True)
+"""
+
+_PE_MULTI = _BOOT + r"""
+import json
+import jax
+from paddle_tpu.distributed import init_parallel_env
+
+env = init_parallel_env()
+assert jax.process_count() == 2
+assert len(jax.devices()) == 4
+from pe_model import build_and_train
+out = {"rank": env.trainer_id, "plain": build_and_train()}
+import paddle_tpu as pt
+pt.reset_default_programs(); pt.reset_global_scope()
+out["zero1"] = build_and_train(reduce_strategy=True)
+print(json.dumps(out), flush=True)
+"""
+
+
+def test_multiprocess_parallel_executor_loss_parity(tmp_path):
+    with open(tmp_path / "pe_model.py", "w") as f:
+        f.write(_PE_MODEL)
+
+    # single-process reference: one child with 4 virtual devices
+    boot4 = _BOOT.replace('host_platform_device_count=2',
+                          'host_platform_device_count=4')
+    ref = subprocess.run(
+        [sys.executable, "-c", _script(boot4 + _PE_SINGLE)],
+        capture_output=True, text=True, timeout=300, cwd=str(tmp_path))
+    assert ref.returncode == 0, ref.stderr[-2000:]
+    ref_losses = json.loads(ref.stdout.strip().splitlines()[-1])
+
+    # two processes x 2 devices = the SAME 4-device global mesh
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINING_ROLE": "TRAINER",
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": "2",
+            "PADDLE_COORDINATOR_ENDPOINT": f"127.0.0.1:{port}",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _script(_PE_MULTI)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=str(tmp_path)))
+    results = {}
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, f"child failed:\n{err[-2500:]}"
+        rec = json.loads(out.strip().splitlines()[-1])
+        results[rec["rank"]] = rec
+
+    assert set(results) == {0, 1}
+    for variant in ("plain", "zero1"):
+        # both ranks observe the identical (replicated-fetch) trajectory
+        np.testing.assert_allclose(results[0][variant], results[1][variant],
+                                   rtol=1e-6)
+        # and it matches the single-process 4-device run: same global
+        # batch, same seeded init, same SPMD program — only the process
+        # split differs (collective reduction order -> tiny fp delta)
+        np.testing.assert_allclose(results[0][variant],
+                                   ref_losses[variant], rtol=2e-4)
+        # real training happened
+        assert results[0][variant][-1] < results[0][variant][0]
+
+
+# ---------------------------------------------------------------------------
 # 2. elastic: kill a trainer mid-lease, master requeues, survivor resumes
 #    from the shared checkpoint chain
 # ---------------------------------------------------------------------------
@@ -274,3 +400,269 @@ print(json.dumps(losses), flush=True)
     assert elastic_final < 0.05, elastic_final      # actually converged
     assert abs(elastic_final - baseline_final) < 0.05, (
         elastic_final, baseline_final)
+
+
+# ---------------------------------------------------------------------------
+# 3. elastic WORLD RESIZE: one of two jax.distributed processes is
+#    hard-killed; the chief detects the failure through master heartbeats
+#    (FailureDetector), re-execs itself into a 1-process world, restores
+#    from the SHARDED checkpoint written by both processes, and training
+#    continues with loss parity vs an uninterrupted run.
+#    ≙ SURVEY §5 failure-detection row + hard part #3 (XLA worlds are
+#    static -> checkpoint-restart elasticity); reference
+#    go/master/service.go:313 task requeue + etcd liveness.
+# ---------------------------------------------------------------------------
+
+_RESIZE_MODEL = r"""
+import numpy as np
+
+
+def build():
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.core import unique_name
+    with unique_name.guard():
+        x = layers.data("x", shape=[8])
+        y = layers.data("y", shape=[1])
+        h = layers.fc(x, size=16, act="relu", name="rz_fc1")
+        pred = layers.fc(h, size=1, name="rz_fc2")
+        loss = layers.reduce_mean(layers.square(pred - y))
+        pt.optimizer.MomentumOptimizer(learning_rate=0.05,
+                                       momentum=0.9).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    return exe, loss
+
+
+def global_batch(i):
+    r = np.random.RandomState(7)
+    W = r.randn(8, 1).astype("float32")
+    rb = np.random.RandomState(100 + i)
+    xb = rb.rand(16, 8).astype("float32")
+    return xb, (xb @ W).astype("float32")
+
+
+def pe_step(pe, loss, i):
+    xb, yb = global_batch(i)
+    return float(pe.run(feed={"x": xb, "y": yb},
+                        fetch_list=[loss.name])[0])
+"""
+
+_RESIZE_JOINT_STEPS = 4
+_RESIZE_TOTAL_STEPS = 8
+
+_RESIZE_CHIEF = _BOOT + r"""
+import glob, json, threading, time
+import numpy as np
+import jax
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import init_parallel_env, MasterClient
+from paddle_tpu.distributed.elastic import FailureDetector
+from paddle_tpu.parallel import DeviceMesh, ParallelExecutor
+from resize_model import build, pe_step
+
+WORK = os.environ["RESIZE_WORKDIR"]
+PHASE = os.environ.get("RESIZE_PHASE", "joint")
+MASTER = os.environ["RESIZE_MASTER"]
+
+client = MasterClient(MASTER, worker_id="chief")
+stop_hb = threading.Event()
+def hb():
+    while not stop_hb.is_set():
+        try:
+            client.heartbeat()
+        except Exception:
+            pass
+        time.sleep(0.2)
+threading.Thread(target=hb, daemon=True).start()
+
+def latest_complete_ckpt():
+    dirs = sorted(glob.glob(os.path.join(WORK, "ckpt", "step-*")))
+    best = None
+    for d in dirs:
+        if len(glob.glob(os.path.join(d, "manifest-*.json"))) == 2:
+            best = d
+    return best
+
+if PHASE == "joint":
+    env = init_parallel_env()
+    assert jax.process_count() == 2
+    exe, loss = build()
+    pe = ParallelExecutor(loss_name=loss.name,
+                          mesh=DeviceMesh(jax.devices()))
+    losses = []
+    for i in range(JOINT):
+        losses.append(pe_step(pe, loss, i))
+        d = os.path.join(WORK, "ckpt", f"step-{i}")
+        pt.io.save_persistables(dirname=d, sharded=True)
+        # wait until BOTH processes finished writing this step's shards
+        while len(glob.glob(os.path.join(d, "manifest-*.json"))) < 2:
+            time.sleep(0.05)
+    with open(os.path.join(WORK, "chief_joint.json"), "w") as f:
+        json.dump(losses, f)
+
+    # joint quota done: hold here, heartbeating, until the peer's death is
+    # DETECTED (not assumed) through the master heartbeat horizon
+    failed = threading.Event()
+    # own client: xmlrpc ServerProxy is not thread-safe, and the heartbeat
+    # thread is still using `client`
+    det_client = MasterClient(MASTER, worker_id="chief-detector")
+    det = FailureDetector(det_client, expected_workers={"peer"},
+                          horizon_s=1.5, poll_s=0.2, grace_s=60.0)
+    det.start(lambda dead: failed.set())
+    assert failed.wait(timeout=120), "peer death was never detected"
+    det.stop()
+
+    # restart-based elasticity (XLA worlds are static): re-exec into a
+    # 1-process world and resume from the sharded checkpoint
+    env2 = dict(os.environ)
+    env2.update({"RESIZE_PHASE": "solo", "PADDLE_TRAINERS_NUM": "1",
+                 "PADDLE_TRAINER_ID": "0"})
+    env2.pop("PADDLE_COORDINATOR_ENDPOINT", None)
+    stop_hb.set()
+    os.execve(sys.executable, [sys.executable, sys.argv[0]], env2)
+
+else:  # solo: fresh 1-process world over the local 2-device mesh
+    env = init_parallel_env()
+    assert jax.process_count() == 1
+    exe, loss = build()
+    ck = latest_complete_ckpt()
+    assert ck is not None
+    pt.io.load_persistables(dirname=ck, sharded=True)
+    resume_from = int(os.path.basename(ck).split("-")[1]) + 1
+    pe = ParallelExecutor(loss_name=loss.name,
+                          mesh=DeviceMesh(jax.devices()))
+    losses = []
+    for i in range(resume_from, TOTAL):
+        losses.append(pe_step(pe, loss, i))
+    with open(os.path.join(WORK, "chief_solo.json"), "w") as f:
+        json.dump({"resume_from": resume_from, "losses": losses}, f)
+""".replace("JOINT", str(_RESIZE_JOINT_STEPS)).replace(
+    "TOTAL", str(_RESIZE_TOTAL_STEPS))
+
+_RESIZE_PEER = _BOOT + r"""
+import glob, json, threading, time
+import jax
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import init_parallel_env, MasterClient
+from paddle_tpu.parallel import DeviceMesh, ParallelExecutor
+from resize_model import build, pe_step
+
+WORK = os.environ["RESIZE_WORKDIR"]
+client = MasterClient(os.environ["RESIZE_MASTER"], worker_id="peer")
+def hb():
+    while True:
+        try:
+            client.heartbeat()
+        except Exception:
+            pass
+        time.sleep(0.2)
+threading.Thread(target=hb, daemon=True).start()
+
+env = init_parallel_env()
+exe, loss = build()
+pe = ParallelExecutor(loss_name=loss.name, mesh=DeviceMesh(jax.devices()))
+for i in range(JOINT):
+    pe_step(pe, loss, i)
+    d = os.path.join(WORK, "ckpt", f"step-{i}")
+    pt.io.save_persistables(dirname=d, sharded=True)
+    while len(glob.glob(os.path.join(d, "manifest-*.json"))) < 2:
+        time.sleep(0.05)
+with open(os.path.join(WORK, "peer_done"), "w") as f:
+    f.write("ok")
+time.sleep(600)   # idle (heartbeating) until the parent SIGKILLs us
+""".replace("JOINT", str(_RESIZE_JOINT_STEPS))
+
+_RESIZE_REF = _BOOT + r"""
+import json
+from resize_model import build, pe_step
+import jax
+from paddle_tpu.parallel import DeviceMesh, ParallelExecutor
+exe, loss = build()
+pe = ParallelExecutor(loss_name=loss.name, mesh=DeviceMesh(jax.devices()))
+print(json.dumps([pe_step(pe, loss, i) for i in range(TOTAL)]), flush=True)
+""".replace("TOTAL", str(_RESIZE_TOTAL_STEPS))
+
+
+def test_elastic_world_resize(tmp_path):
+    import signal as _signal
+
+    from paddle_tpu.distributed import Master
+
+    with open(tmp_path / "resize_model.py", "w") as f:
+        f.write(_RESIZE_MODEL)
+    (tmp_path / "ckpt").mkdir()
+
+    m = Master(timeout_s=5.0)
+    server, _ = m.serve_forever()
+    host, port = server.server_address
+    master_ep = f"{host}:{port}"
+
+    # uninterrupted reference: single process, 4 virtual devices
+    boot4 = _BOOT.replace('host_platform_device_count=2',
+                          'host_platform_device_count=4')
+    ref = subprocess.run(
+        [sys.executable, "-c", _script(boot4 + _RESIZE_REF.split(_BOOT)[1])],
+        capture_output=True, text=True, timeout=300, cwd=str(tmp_path))
+    assert ref.returncode == 0, ref.stderr[-2000:]
+    ref_losses = json.loads(ref.stdout.strip().splitlines()[-1])
+
+    coord_port = _free_port()
+    chief_path = tmp_path / "chief.py"
+    with open(chief_path, "w") as f:
+        f.write(_script(_RESIZE_CHIEF))
+
+    def env_for(rank):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINING_ROLE": "TRAINER",
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": "2",
+            "PADDLE_COORDINATOR_ENDPOINT": f"127.0.0.1:{coord_port}",
+            "RESIZE_WORKDIR": str(tmp_path),
+            "RESIZE_MASTER": master_ep,
+        })
+        return env
+
+    chief = subprocess.Popen(
+        [sys.executable, str(chief_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env_for(0), cwd=str(tmp_path))
+    peer = subprocess.Popen(
+        [sys.executable, "-c", _script(_RESIZE_PEER)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env_for(1), cwd=str(tmp_path))
+
+    # wait for the peer to finish its joint quota, then murder it
+    deadline = time.time() + 240
+    while not (tmp_path / "peer_done").exists():
+        assert time.time() < deadline, "joint phase never completed"
+        if peer.poll() is not None:
+            _, perr = peer.communicate()
+            raise AssertionError(f"peer died early:\n{perr[-2000:]}")
+        if chief.poll() is not None:
+            _, cerr = chief.communicate()
+            raise AssertionError(f"chief died early:\n{cerr[-2000:]}")
+        time.sleep(0.2)
+    peer.send_signal(_signal.SIGKILL)
+    peer.wait(timeout=30)
+
+    out, err = chief.communicate(timeout=300)
+    server.shutdown()
+    assert chief.returncode == 0, f"chief failed:\n{err[-3000:]}"
+
+    with open(tmp_path / "chief_joint.json") as f:
+        joint = json.load(f)
+    with open(tmp_path / "chief_solo.json") as f:
+        solo = json.load(f)
+
+    # detection -> resize really happened where expected
+    assert solo["resume_from"] == _RESIZE_JOINT_STEPS
+    full = joint + solo["losses"]
+    assert len(full) == _RESIZE_TOTAL_STEPS
+    # same global batches, same math, different world shape: parity with
+    # the uninterrupted run within collective-reorder tolerance
+    np.testing.assert_allclose(full, ref_losses, rtol=2e-4)
+    assert full[-1] < full[0]

@@ -26,6 +26,7 @@ from .param_attr import ParamAttr  # noqa: F401
 from . import nets  # noqa: F401,E402
 from . import models  # noqa: F401,E402
 from . import io  # noqa: F401,E402
+from . import sharded_checkpoint  # noqa: F401,E402
 from .inferencer import Inferencer, Predictor  # noqa: F401,E402
 from . import metrics  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402

@@ -5,10 +5,14 @@ reader-op pipeline (SURVEY §1 L10). The in-graph reader ops translate to a
 host-side prefetching pipeline feeding compiled steps.
 """
 
+from . import common  # noqa: F401
 from . import datasets  # noqa: F401
+from .common import download, md5file  # noqa: F401
 from .decorator import (batch, buffered, chain, compose, firstn,  # noqa: F401
                         map_readers, shuffle, xmap_readers)
-from .feeder import DataFeeder  # noqa: F401
+from .feeder import (DataFeeder, stage_array, stage_batch,  # noqa: F401
+                     staging_specs)
+from .packing import pack_lm_batch, pack_sequences  # noqa: F401
 from .prefetch import DevicePrefetcher  # noqa: F401
 from .recordio import (ParallelRecordLoader, RecordIOScanner,  # noqa: F401
                        RecordIOWriter, read_numpy_records,

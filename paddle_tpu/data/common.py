@@ -1,0 +1,102 @@
+"""Dataset download/cache helpers.
+
+≙ reference python/paddle/dataset/common.py:1 (DATA_HOME, download with
+md5 verification and retry, md5file). This environment usually has no
+network egress, so `download` is strictly opt-in: datasets use it only
+when the file is absent and a URL fetch is possible; everything else
+falls back to the synthetic generators (datasets.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+from typing import Optional
+
+from ..core.enforce import InvalidArgumentError, enforce
+
+
+def data_home() -> str:
+    """Current cache root (env PTPU_DATA_HOME; datasets.DATA_HOME mirrors
+    it for back-compat)."""
+    from . import datasets
+    return datasets.DATA_HOME
+
+
+def md5file(path: str) -> str:
+    """≙ common.md5file — streaming md5 of a file."""
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url: str, module: str, md5sum: Optional[str] = None,
+             save_name: Optional[str] = None, retries: int = 3) -> str:
+    """≙ common.download: fetch `url` into <DATA_HOME>/<module>/, verify
+    md5, reuse the cached copy when it already matches. Supports file://
+    URLs (used by offline tests and air-gapped mirrors)."""
+    directory = os.path.join(data_home(), module)
+    os.makedirs(directory, exist_ok=True)
+    filename = os.path.join(directory,
+                            save_name or url.split("/")[-1].split("?")[0])
+    if os.path.exists(filename) and (md5sum is None
+                                     or md5file(filename) == md5sum):
+        return filename
+
+    import urllib.request
+    last_err = None
+    for _ in range(max(1, retries)):
+        try:
+            tmp = filename + ".part"
+            with urllib.request.urlopen(url, timeout=60) as r, \
+                    open(tmp, "wb") as f:
+                shutil.copyfileobj(r, f)
+            if md5sum is not None and md5file(tmp) != md5sum:
+                os.remove(tmp)
+                last_err = InvalidArgumentError(
+                    f"md5 mismatch downloading {url}")
+                continue
+            os.replace(tmp, filename)
+            return filename
+        except Exception as e:  # noqa: BLE001 — retried, then re-raised
+            last_err = e
+    raise InvalidArgumentError(
+        f"could not download {url} after {retries} attempts "
+        f"(no network egress? place the file at {filename} manually): "
+        f"{last_err}")
+
+
+def cached_path(module: str, filename: str) -> str:
+    return os.path.join(data_home(), module, filename)
+
+
+def exists(module: str, filename: str) -> bool:
+    return os.path.exists(cached_path(module, filename))
+
+
+def tokenize(text: str):
+    """≙ reference imdb.tokenize: lowercase, strip punctuation, split."""
+    import re
+    return re.sub(r"[^a-z0-9\s]", "", text.lower()).split()
+
+
+def build_word_dict(corpus_iter, min_word_freq: int = 0,
+                    unk_token: str = "<unk>"):
+    """Frequency-sorted word -> id dict (≙ imdb.build_dict /
+    imikolov.build_dict): most frequent word gets id 0; words under
+    min_word_freq drop out; unk_token appended last."""
+    enforce(min_word_freq >= 0, "min_word_freq must be >= 0",
+            exc=InvalidArgumentError)
+    freq: dict = {}
+    for tokens in corpus_iter:
+        for t in tokens:
+            freq[t] = freq.get(t, 0) + 1
+    items = [(w, c) for w, c in freq.items()
+             if c >= min_word_freq and w != unk_token]
+    items.sort(key=lambda wc: (-wc[1], wc[0]))
+    word_idx = {w: i for i, (w, _) in enumerate(items)}
+    word_idx[unk_token] = len(word_idx)
+    return word_idx

@@ -77,19 +77,64 @@ class mnist:
         return _synthetic_images(1024, (784,), 10, seed=8)
 
 
+def _read_cifar_tar(tar_path, member_substr, label_key=b"labels"):
+    """Parse the REAL CIFAR python pickle format: a tar.gz whose members
+    hold pickled dicts {b'data': [N, 3072] uint8, b'labels'/b'fine_labels':
+    [N]} (≙ reference dataset/cifar.py reader_creator). Images normalize
+    to float32 / 255."""
+    import pickle
+    import tarfile
+
+    def reader():
+        with tarfile.open(tar_path, "r:*") as tf:
+            for m in sorted(tf.getnames()):
+                if member_substr not in os.path.basename(m):
+                    continue
+                f = tf.extractfile(m)
+                if f is None:
+                    continue
+                batch = pickle.loads(f.read(), encoding="bytes")
+                data = np.asarray(batch[b"data"], np.uint8)
+                labels = batch.get(label_key, batch.get(b"labels"))
+                for x, y in zip(data, labels):
+                    yield x.astype(np.float32) / 255.0, int(y)
+
+    return reader
+
+
+def _cifar_tar(name):
+    p = os.path.join(DATA_HOME, "cifar", name)
+    return p if os.path.exists(p) else None
+
+
 class cifar:
-    """≙ paddle.dataset.cifar — 3x32x32 images."""
+    """≙ paddle.dataset.cifar — 3x32x32 images. Real CIFAR-10/100 python
+    pickle tars are parsed when present under <DATA_HOME>/cifar/ (or
+    fetched via data.common.download where network exists); synthetic
+    stand-ins otherwise."""
+
+    TAR10 = "cifar-10-python.tar.gz"
+    TAR100 = "cifar-100-python.tar.gz"
 
     @staticmethod
     def train10():
+        tar = _cifar_tar(cifar.TAR10)
+        if tar:
+            return _read_cifar_tar(tar, "data_batch")
         return _synthetic_images(8192, (3 * 32 * 32,), 10, seed=17)
 
     @staticmethod
     def test10():
+        tar = _cifar_tar(cifar.TAR10)
+        if tar:
+            return _read_cifar_tar(tar, "test_batch")
         return _synthetic_images(1024, (3 * 32 * 32,), 10, seed=18)
 
     @staticmethod
     def train100():
+        tar = _cifar_tar(cifar.TAR100)
+        if tar:
+            return _read_cifar_tar(tar, "train", label_key=b"fine_labels")
         return _synthetic_images(8192, (3 * 32 * 32,), 100, seed=19)
 
 
@@ -124,14 +169,72 @@ class uci_housing:
         return reader
 
 
+def _imdb_tar():
+    p = os.path.join(DATA_HOME, "imdb", "aclImdb_v1.tar.gz")
+    return p if os.path.exists(p) else None
+
+
+def _read_imdb_tar(tar_path, pattern, word_dict):
+    """Parse the REAL aclImdb layout: tar.gz of <split>/<pos|neg>/<id>.txt
+    review files (≙ reference dataset/imdb.py reader_creator). pos -> 0,
+    neg -> 1, as in the reference."""
+    import re
+    import tarfile
+
+    from .common import tokenize
+    unk = word_dict.get("<unk>", len(word_dict) - 1)
+    rx = re.compile(pattern)
+
+    def reader():
+        with tarfile.open(tar_path, "r:*") as tf:
+            for m in sorted(tf.getnames()):
+                if not rx.search(m):
+                    continue
+                f = tf.extractfile(m)
+                if f is None:
+                    continue
+                toks = tokenize(f.read().decode("utf-8", "replace"))
+                ids = np.asarray([word_dict.get(t, unk) for t in toks],
+                                 np.int64)
+                if ids.size == 0:
+                    continue
+                yield ids, (0 if "/pos/" in m else 1)
+
+    return reader
+
+
+def _imdb_build_dict(tar_path, min_word_freq=5):
+    import re
+    import tarfile
+
+    from .common import build_word_dict, tokenize
+
+    def corpus():
+        rx = re.compile(r"train/(pos|neg)/.*\.txt$")
+        with tarfile.open(tar_path, "r:*") as tf:
+            for m in tf.getnames():
+                if rx.search(m):
+                    f = tf.extractfile(m)
+                    if f is not None:
+                        yield tokenize(f.read().decode("utf-8", "replace"))
+
+    return build_word_dict(corpus(), min_word_freq=min_word_freq)
+
+
 class imdb:
     """≙ paddle.dataset.imdb — variable-length word-id sequences, binary
-    label. Synthetic: class-dependent unigram distributions."""
+    label. The real aclImdb tar is parsed when present under
+    <DATA_HOME>/imdb/ (word dict built from the train split, frequency
+    sorted, ≙ reference imdb.build_dict); synthetic class-dependent
+    unigram distributions otherwise."""
 
     word_dict_size = 5148
 
     @staticmethod
-    def word_dict():
+    def word_dict(min_word_freq=5):
+        tar = _imdb_tar()
+        if tar:
+            return _imdb_build_dict(tar, min_word_freq)
         return {i: i for i in range(imdb.word_dict_size)}
 
     @staticmethod
@@ -151,20 +254,62 @@ class imdb:
 
     @staticmethod
     def train(word_dict=None):
+        tar = _imdb_tar()
+        if tar:
+            wd = word_dict if word_dict is not None else imdb.word_dict()
+            return _read_imdb_tar(tar, r"train/(pos|neg)/.*\.txt$", wd)
         return imdb._make(11, 2048)
 
     @staticmethod
     def test(word_dict=None):
+        tar = _imdb_tar()
+        if tar:
+            wd = word_dict if word_dict is not None else imdb.word_dict()
+            return _read_imdb_tar(tar, r"test/(pos|neg)/.*\.txt$", wd)
         return imdb._make(12, 512)
 
 
+def _imikolov_file(split):
+    p = os.path.join(DATA_HOME, "imikolov", f"ptb.{split}.txt")
+    return p if os.path.exists(p) else None
+
+
+def _read_imikolov_text(path, word_dict, n):
+    """Parse the REAL PTB text format: one sentence per line, wrapped in
+    <s>/<e> markers, emitted as sliding n-grams of word ids (≙ reference
+    dataset/imikolov.py reader_creator with DataType.NGRAM)."""
+    unk = word_dict.get("<unk>", len(word_dict) - 1)
+
+    def reader():
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                words = ["<s>"] + line.split() + ["<e>"]
+                ids = [word_dict.get(w, unk) for w in words]
+                for i in range(n, len(ids) + 1):
+                    yield tuple(ids[i - n:i])
+
+    return reader
+
+
 class imikolov:
-    """≙ paddle.dataset.imikolov — PTB-style n-gram language model data."""
+    """≙ paddle.dataset.imikolov — PTB-style n-gram language model data.
+    Real ptb.<split>.txt files are parsed when present under
+    <DATA_HOME>/imikolov/; synthetic markov-ish n-grams otherwise."""
 
     vocab_size = 2074
 
     @staticmethod
     def build_dict(min_word_freq=50):
+        path = _imikolov_file("train")
+        if path:
+            from .common import build_word_dict
+
+            def corpus():
+                with open(path, encoding="utf-8") as f:
+                    for line in f:
+                        yield ["<s>"] + line.split() + ["<e>"]
+
+            return build_word_dict(corpus(), min_word_freq=min_word_freq)
         return {i: i for i in range(imikolov.vocab_size)}
 
     @staticmethod
@@ -182,10 +327,20 @@ class imikolov:
 
     @staticmethod
     def train(word_dict=None, n=5):
+        path = _imikolov_file("train")
+        if path:
+            wd = word_dict if word_dict is not None \
+                else imikolov.build_dict()
+            return _read_imikolov_text(path, wd, n)
         return imikolov._make(21, 4096, n)
 
     @staticmethod
     def test(word_dict=None, n=5):
+        path = _imikolov_file("valid")
+        if path:
+            wd = word_dict if word_dict is not None \
+                else imikolov.build_dict()
+            return _read_imikolov_text(path, wd, n)
         return imikolov._make(22, 512, n)
 
 

@@ -253,7 +253,8 @@ def save_inference_model(dirname: str,
                          params_filename: Optional[str] = None,
                          scope: Optional[Scope] = None,
                          save_as_bf16: bool = False,
-                         export: bool = False):
+                         export: bool = False,
+                         native: bool = False):
     """≙ fluid.io.save_inference_model (reference io.py:561): prune the
     program to the fetch targets, switch to test mode, serialize program +
     parameters. With export=True additionally emits a serialized
@@ -287,7 +288,8 @@ def save_inference_model(dirname: str,
               save_as_bf16=save_as_bf16)
     if export:
         export_inference_model(dirname, feeded_var_names, target_names,
-                               inference_program, scope=scope)
+                               inference_program, scope=scope,
+                               native=native)
     return target_names
 
 
@@ -314,6 +316,8 @@ def load_inference_model(dirname: str,
 
 EXPORTED_ARTIFACT_FILE = "__exported__.bin"
 EXPORTED_META_FILE = "__exported__.json"
+NATIVE_ARTIFACT_FILE = "__exported_native__.stablehlo"
+NATIVE_META_FILE = "__exported_native__.meta"
 
 
 def export_inference_model(dirname: str,
@@ -321,7 +325,8 @@ def export_inference_model(dirname: str,
                            target_names: Sequence[str],
                            inference_program: Program,
                            scope: Optional[Scope] = None,
-                           platforms: Sequence[str] = ("cpu", "tpu")):
+                           platforms: Sequence[str] = ("cpu", "tpu"),
+                           native: bool = False):
     """Emit a serialized jax.export (StableHLO) artifact next to the JSON
     program: the whole pruned inference function — parameters baked in as
     constants — in a form a serving process loads and calls COLD, with no
@@ -382,6 +387,34 @@ def export_inference_model(dirname: str,
     with open(os.path.join(dirname, EXPORTED_META_FILE), "w") as f:
         json.dump({"feed_names": feed_names, "fetch_names": target_names,
                    "platforms": list(platforms)}, f)
+
+    if not native:
+        return
+    # native (C++) serving artifact: a SINGLE-platform cpu export whose raw
+    # StableHLO bytecode a C++ process executes directly (native/
+    # ptpu_predict.cc) — no Python, no tracer, no op registry. ≙ the
+    # reference's C++-loadable predictor unit
+    # (inference/api/paddle_inference_api.h:1, api_impl.cc:126). Single
+    # platform keeps main() free of the platform_index argument.
+    native = jax_export.export(jax.jit(fn), platforms=("cpu",))(*args)
+    with open(os.path.join(dirname, NATIVE_ARTIFACT_FILE), "wb") as f:
+        f.write(native.mlir_module_serialized)
+
+    def _dims(aval):
+        return " ".join(str(d) if isinstance(d, int) else "-1"
+                        for d in aval.shape)
+
+    kept = list(native.module_kept_var_idx)
+    lines = [f"version {native.calling_convention_version}",
+             f"nin {len(kept)}"]
+    for i in kept:
+        aval = native.in_avals[i]
+        lines.append(f"in {feed_names[i]} {aval.dtype} {_dims(aval)}".rstrip())
+    lines.append(f"nout {len(native.out_avals)}")
+    for name, aval in zip(target_names, native.out_avals):
+        lines.append(f"out {name} {aval.dtype} {_dims(aval)}".rstrip())
+    with open(os.path.join(dirname, NATIVE_META_FILE), "w") as f:
+        f.write("\n".join(lines) + "\n")
 
 
 def load_exported_model(dirname: str):

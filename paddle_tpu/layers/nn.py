@@ -354,6 +354,10 @@ def image_resize(input, out_shape=None, scale=None, name=None,
         enforce(scale is not None, "image_resize needs out_shape or scale",
                 exc=InvalidArgumentError)
         out_h, out_w = int(h * scale), int(w * scale)
+        enforce(out_h > 0 and out_w > 0,
+                f"image_resize with scale= needs static spatial dims "
+                f"(got H={h}, W={w}); pass out_shape for dynamic inputs",
+                exc=InvalidArgumentError)
     else:
         out_h, out_w = int(out_shape[0]), int(out_shape[1])
     out = helper.create_tmp_variable(

@@ -13,7 +13,8 @@ from .. import layers
 
 
 def deepfm(feat_ids=None, feat_vals=None, label=None, num_fields=39,
-           vocab_size=100000, embed_dim=16, fc_sizes=(400, 400, 400)):
+           vocab_size=100000, embed_dim=16, fc_sizes=(400, 400, 400),
+           is_sparse=False):
     """DeepFM: linear term + FM second-order term + DNN over concatenated
     field embeddings.
 
@@ -29,12 +30,15 @@ def deepfm(feat_ids=None, feat_vals=None, label=None, num_fields=39,
         label = layers.data(name="label", shape=[1])
 
     # first-order: per-feature scalar weight
-    w1 = layers.embedding(input=feat_ids, size=[vocab_size, 1])       # [B,F,1]
+    w1 = layers.embedding(input=feat_ids, size=[vocab_size, 1],
+                      is_sparse=is_sparse)       # [B,F,1]
     vals3 = layers.unsqueeze(feat_vals, axes=[2])                     # [B,F,1]
     first = layers.reduce_sum(layers.elementwise_mul(w1, vals3), dim=[1])
 
     # second-order FM: 0.5 * ((sum v)^2 - sum v^2)
-    emb = layers.embedding(input=feat_ids, size=[vocab_size, embed_dim])
+    emb = layers.embedding(input=feat_ids,
+                       size=[vocab_size, embed_dim],
+                       is_sparse=is_sparse)
     emb = layers.elementwise_mul(emb, vals3)                          # [B,F,E]
     sum_v = layers.reduce_sum(emb, dim=[1])                           # [B,E]
     sum_sq = layers.elementwise_mul(sum_v, sum_v)

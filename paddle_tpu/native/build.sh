@@ -3,4 +3,23 @@
 # import (paddle_tpu/data/recordio.py) when the .so is missing or stale.
 set -e
 cd "$(dirname "$0")"
-g++ -O2 -std=c++17 -fPIC -shared -o libptpu_native.so recordio.cc tensor_store.cc -lz -lpthread
+# skip the base compile when the .so is already newer than its sources
+if [ ! -f libptpu_native.so ] || [ recordio.cc -nt libptpu_native.so ] \
+   || [ tensor_store.cc -nt libptpu_native.so ]; then
+  g++ -O2 -std=c++17 -fPIC -shared -o libptpu_native.so recordio.cc tensor_store.cc -lz -lpthread
+fi
+
+# Native serving entry (ptpu_predict): links the TensorFlow C API for its
+# XlaCallModule/XLA:CPU runtime. Built only on request ("./build.sh
+# predict" or PTPU_BUILD_PREDICT=1) so the automatic import-time library
+# build stays fast.
+if [ "$1" = "predict" ] || [ -n "$PTPU_BUILD_PREDICT" ]; then
+  TF_DIR="${PTPU_TF_DIR:-$(python3 -c 'import tensorflow, os; print(os.path.dirname(tensorflow.__file__))' 2>/dev/null || true)}"
+  if [ -n "$TF_DIR" ] && [ -f "$TF_DIR/libtensorflow_cc.so.2" ]; then
+    g++ -O2 -std=c++17 -I "$TF_DIR/include" -o ptpu_predict ptpu_predict.cc \
+        "$TF_DIR/libtensorflow_cc.so.2" "$TF_DIR/libtensorflow_framework.so.2" \
+        -Wl,-rpath,"$TF_DIR"
+  else
+    echo "build.sh: TF C++ libs not found; skipping ptpu_predict" >&2
+  fi
+fi

@@ -1,0 +1,279 @@
+// ptpu_predict: native (C++) serving entry for exported paddle_tpu models.
+//
+// Loads the single-platform StableHLO artifact written by
+// io.export_inference_model (__exported_native__.stablehlo +
+// __exported_native__.meta), feeds it .npy input tensors, executes it
+// through the TensorFlow eager C API's XlaCallModule kernel (which JIT
+// compiles the module with XLA:CPU in-process), and writes each output as
+// out<i>.npy.
+//
+// Capability equivalent of the reference's C++ inference stack: the
+// deployable unit a C++ server loads with no Python anywhere in the
+// process (reference paddle/fluid/inference/api/paddle_inference_api.h:1,
+// api_impl.cc:126 NativePaddlePredictor::Run, inference/io.cc Load).
+// The runtime library here is libtensorflow_cc's exported C API — chosen
+// because this environment ships no standalone PJRT plugin .so; the
+// XlaCallModule path is the same one jax2tf serving uses in production.
+//
+// Usage:
+//   ptpu_predict <export_dir> <input0.npy> [<input1.npy> ...] [--out DIR]
+//
+// Inputs are positional in the meta's `in` order. Symbolic (-1) dims are
+// refined from the actual inputs by the kernel.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "tensorflow/c/c_api.h"
+#include "tensorflow/c/eager/c_api.h"
+
+namespace {
+
+[[noreturn]] void Die(const std::string& msg) {
+  std::fprintf(stderr, "ptpu_predict: %s\n", msg.c_str());
+  std::exit(1);
+}
+
+void CheckOk(TF_Status* s, const char* what) {
+  if (TF_GetCode(s) != TF_OK) {
+    Die(std::string(what) + ": " + TF_Message(s));
+  }
+}
+
+std::string ReadFile(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) Die("cannot open " + path);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+// -- dtype mapping ---------------------------------------------------------
+
+struct DType {
+  TF_DataType tf;
+  const char* npy;    // .npy descr (little-endian)
+  size_t size;
+};
+
+DType DTypeByName(const std::string& name) {
+  if (name == "float32") return {TF_FLOAT, "<f4", 4};
+  if (name == "float64") return {TF_DOUBLE, "<f8", 8};
+  if (name == "int32") return {TF_INT32, "<i4", 4};
+  if (name == "int64") return {TF_INT64, "<i8", 8};
+  if (name == "uint8") return {TF_UINT8, "|u1", 1};
+  if (name == "int8") return {TF_INT8, "|i1", 1};
+  if (name == "bool") return {TF_BOOL, "|b1", 1};
+  Die("unsupported dtype " + name);
+}
+
+// -- minimal .npy v1 reader/writer (C-order, little-endian) ----------------
+
+struct Npy {
+  std::string descr;
+  std::vector<int64_t> shape;
+  std::string data;
+};
+
+Npy ReadNpy(const std::string& path) {
+  std::string raw = ReadFile(path);
+  if (raw.size() < 10 || raw.compare(0, 6, "\x93NUMPY") != 0)
+    Die(path + " is not a .npy file");
+  int major = static_cast<unsigned char>(raw[6]);
+  size_t hlen, hoff;
+  if (major == 1) {
+    hlen = static_cast<unsigned char>(raw[8]) |
+           (static_cast<unsigned char>(raw[9]) << 8);
+    hoff = 10;
+  } else {
+    hlen = 0;
+    for (int i = 0; i < 4; ++i)
+      hlen |= static_cast<size_t>(static_cast<unsigned char>(raw[8 + i]))
+              << (8 * i);
+    hoff = 12;
+  }
+  std::string header = raw.substr(hoff, hlen);
+  Npy out;
+  size_t d = header.find("'descr':");
+  size_t q1 = header.find('\'', d + 8);
+  size_t q2 = header.find('\'', q1 + 1);
+  out.descr = header.substr(q1 + 1, q2 - q1 - 1);
+  if (header.find("'fortran_order': False") == std::string::npos)
+    Die(path + ": fortran_order arrays are not supported");
+  size_t sh = header.find("'shape':");
+  size_t p1 = header.find('(', sh);
+  size_t p2 = header.find(')', p1);
+  std::string dims = header.substr(p1 + 1, p2 - p1 - 1);
+  std::stringstream ss(dims);
+  std::string tok;
+  while (std::getline(ss, tok, ',')) {
+    if (tok.find_first_not_of(" \t") == std::string::npos) continue;
+    out.shape.push_back(std::stoll(tok));
+  }
+  out.data = raw.substr(hoff + hlen);
+  return out;
+}
+
+void WriteNpy(const std::string& path, const std::string& descr,
+              const std::vector<int64_t>& shape, const void* data,
+              size_t nbytes) {
+  std::ostringstream hd;
+  hd << "{'descr': '" << descr << "', 'fortran_order': False, 'shape': (";
+  for (size_t i = 0; i < shape.size(); ++i) hd << shape[i] << ",";
+  hd << "), }";
+  std::string header = hd.str();
+  size_t total = 10 + header.size() + 1;
+  size_t pad = (64 - total % 64) % 64;
+  header += std::string(pad, ' ');
+  header += '\n';
+  std::ofstream f(path, std::ios::binary);
+  if (!f) Die("cannot write " + path);
+  f << "\x93NUMPY" << '\x01' << '\x00';
+  uint16_t hlen = static_cast<uint16_t>(header.size());
+  f.write(reinterpret_cast<const char*>(&hlen), 2);
+  f << header;
+  f.write(static_cast<const char*>(data), nbytes);
+}
+
+// -- meta file (key-value lines written by io.export_inference_model) -----
+
+struct TensorSpec {
+  std::string name;
+  std::string dtype;
+  std::vector<int64_t> dims;
+};
+
+struct Meta {
+  int version = 9;
+  std::vector<TensorSpec> ins, outs;
+};
+
+Meta ReadMeta(const std::string& path) {
+  std::ifstream f(path);
+  if (!f) Die("cannot open " + path);
+  Meta m;
+  std::string line;
+  while (std::getline(f, line)) {
+    std::stringstream ss(line);
+    std::string key;
+    ss >> key;
+    if (key == "version") {
+      ss >> m.version;
+    } else if (key == "in" || key == "out") {
+      TensorSpec t;
+      ss >> t.name >> t.dtype;
+      int64_t d;
+      while (ss >> d) t.dims.push_back(d);
+      (key == "in" ? m.ins : m.outs).push_back(t);
+    }
+  }
+  if (m.outs.empty()) Die("no outputs in " + path);
+  return m;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr,
+                 "usage: %s <export_dir> <input0.npy> [...] [--out DIR]\n",
+                 argv[0]);
+    return 2;
+  }
+  std::string dir = argv[1];
+  std::string out_dir = ".";
+  std::vector<std::string> input_paths;
+  for (int i = 2; i < argc; ++i) {
+    if (std::strcmp(argv[i], "--out") == 0 && i + 1 < argc) {
+      out_dir = argv[++i];
+    } else {
+      input_paths.push_back(argv[i]);
+    }
+  }
+
+  Meta meta = ReadMeta(dir + "/__exported_native__.meta");
+  std::string module = ReadFile(dir + "/__exported_native__.stablehlo");
+  if (input_paths.size() != meta.ins.size())
+    Die("expected " + std::to_string(meta.ins.size()) + " inputs, got " +
+        std::to_string(input_paths.size()));
+
+  TF_Status* s = TF_NewStatus();
+  TFE_ContextOptions* copts = TFE_NewContextOptions();
+  TFE_Context* ctx = TFE_NewContext(copts, s);
+  CheckOk(s, "TFE_NewContext");
+
+  // stage inputs
+  std::vector<TFE_TensorHandle*> handles;
+  std::vector<TF_DataType> tin;
+  for (size_t i = 0; i < input_paths.size(); ++i) {
+    Npy npy = ReadNpy(input_paths[i]);
+    DType dt = DTypeByName(meta.ins[i].dtype);
+    if (npy.descr != dt.npy)
+      Die(input_paths[i] + ": dtype " + npy.descr + " but model expects " +
+          meta.ins[i].dtype + " (" + dt.npy + ")");
+    TF_Tensor* t = TF_AllocateTensor(dt.tf, npy.shape.data(),
+                                     static_cast<int>(npy.shape.size()),
+                                     npy.data.size());
+    std::memcpy(TF_TensorData(t), npy.data.data(), npy.data.size());
+    handles.push_back(TFE_NewTensorHandle(t, s));
+    CheckOk(s, "TFE_NewTensorHandle");
+    tin.push_back(dt.tf);
+  }
+
+  // one XlaCallModule op = the whole model (params are constants inside)
+  TFE_Op* op = TFE_NewOp(ctx, "XlaCallModule", s);
+  CheckOk(s, "TFE_NewOp(XlaCallModule)");
+  TFE_OpSetAttrString(op, "module", module.data(), module.size());
+  TFE_OpSetAttrInt(op, "version", meta.version);
+  TFE_OpSetAttrTypeList(op, "Tin", tin.data(),
+                        static_cast<int>(tin.size()));
+  std::vector<TF_DataType> tout;
+  std::vector<const int64_t*> sout;
+  std::vector<int> sout_ndims;
+  for (const auto& o : meta.outs) {
+    tout.push_back(DTypeByName(o.dtype).tf);
+    sout.push_back(o.dims.data());
+    sout_ndims.push_back(static_cast<int>(o.dims.size()));
+  }
+  TFE_OpSetAttrTypeList(op, "Tout", tout.data(),
+                        static_cast<int>(tout.size()));
+  TFE_OpSetAttrShapeList(op, "Sout", sout.data(), sout_ndims.data(),
+                         static_cast<int>(sout.size()), s);
+  CheckOk(s, "Sout");
+  const void* plat[1] = {"CPU"};
+  size_t plat_len[1] = {3};
+  TFE_OpSetAttrStringList(op, "platforms", plat, plat_len, 1);
+  TFE_OpSetAttrStringList(op, "dim_args_spec", nullptr, nullptr, 0);
+  TFE_OpSetAttrStringList(op, "disabled_checks", nullptr, nullptr, 0);
+  TFE_OpSetAttrFunctionList(op, "function_list", nullptr, 0);
+  TFE_OpSetAttrBool(op, "has_token_input_output", 0);
+  for (auto* h : handles) {
+    TFE_OpAddInput(op, h, s);
+    CheckOk(s, "TFE_OpAddInput");
+  }
+
+  std::vector<TFE_TensorHandle*> outs(meta.outs.size(), nullptr);
+  int nout = static_cast<int>(outs.size());
+  TFE_Execute(op, outs.data(), &nout, s);
+  CheckOk(s, "TFE_Execute");
+
+  for (int i = 0; i < nout; ++i) {
+    TF_Tensor* t = TFE_TensorHandleResolve(outs[i], s);
+    CheckOk(s, "TFE_TensorHandleResolve");
+    std::vector<int64_t> shape(TF_NumDims(t));
+    for (size_t d = 0; d < shape.size(); ++d)
+      shape[d] = TF_Dim(t, static_cast<int>(d));
+    DType dt = DTypeByName(meta.outs[i].dtype);
+    std::string path = out_dir + "/out" + std::to_string(i) + ".npy";
+    WriteNpy(path, dt.npy, shape, TF_TensorData(t), TF_TensorByteSize(t));
+    std::printf("%s %s -> %s\n", meta.outs[i].name.c_str(),
+                meta.outs[i].dtype.c_str(), path.c_str());
+  }
+  return 0;
+}

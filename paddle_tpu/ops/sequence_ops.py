@@ -216,7 +216,9 @@ def _dynamic_lstmp(ctx, ins, attrs):
     """≙ lstmp_op.cc: LSTM with a recurrent projection layer. Input
     [B, T, 4H] pre-projected; Weight [P, 4H] recurrent (acts on the
     PROJECTED state); ProjWeight [H, P]. Emits Projection [B, T, P] and
-    Cell [B, T, H]."""
+    Cell [B, T, H]. With use_peepholes (default), Bias carries 7H values
+    and the peephole weights w_ic/w_fc ⊙ c_{t-1} and w_oc ⊙ c_t enter the
+    gates as in the reference."""
     x = ins["Input"][0]
     w = ins["Weight"][0]          # [P, 4H]
     w_proj = ins["ProjWeight"][0]  # [H, P]
@@ -225,8 +227,17 @@ def _dynamic_lstmp(ctx, ins, attrs):
     p_dim = w_proj.shape[1]
     b, t, _ = x.shape
     bias = ins["Bias"][0] if ins.get("Bias") else None
+    # use_peepholes: bias is [7H] = 4H gate bias + w_ic/w_fc/w_oc peephole
+    # weights, which enter the i/f gates via c_{t-1} and the o gate via c_t
+    # (≙ reference lstmp_op.h ComputeGate peephole connections)
+    w_ic = w_fc = w_oc = None
     if bias is not None:
-        x = x + bias.reshape(1, 1, -1)[:, :, :4 * h]
+        flat = bias.reshape(-1)
+        x = x + flat[:4 * h].reshape(1, 1, -1)
+        if attrs.get("use_peepholes", True) and flat.shape[0] == 7 * h:
+            w_ic = flat[4 * h:5 * h]
+            w_fc = flat[5 * h:6 * h]
+            w_oc = flat[6 * h:7 * h]
     gate_act = _ACTS[attrs.get("gate_activation", "sigmoid")]
     cell_act = _ACTS[attrs.get("cell_activation", "tanh")]
     cand_act = _ACTS[attrs.get("candidate_activation", "tanh")]
@@ -249,8 +260,14 @@ def _dynamic_lstmp(ctx, ins, attrs):
         xt, it = inp
         gates = xt + jnp.dot(r_prev, w)
         i, f, c_hat, o = jnp.split(gates, 4, axis=-1)
-        i, f, o = gate_act(i), gate_act(f), gate_act(o)
+        if w_ic is not None:
+            i = i + w_ic * c_prev
+            f = f + w_fc * c_prev
+        i, f = gate_act(i), gate_act(f)
         c_new = f * c_prev + i * cand_act(c_hat)
+        if w_oc is not None:
+            o = o + w_oc * c_new
+        o = gate_act(o)
         h_new = o * cell_act(c_new)
         r_new = proj_act(jnp.dot(h_new, w_proj))
         tpos = it if not reverse else (t - 1 - it)

@@ -8,6 +8,10 @@ import numpy as np
 import paddle_tpu as pt
 from paddle_tpu import layers
 
+import pytest
+
+pytestmark = pytest.mark.quick  # run_ci.sh quick smoke tier
+
 
 def test_two_losses_shared_trunk(rng):
     """Two vjp_regions whose forward segments share the earliest op must both

@@ -1,0 +1,221 @@
+"""Real-format dataset ingestion (VERDICT r2 #7).
+
+Each test writes a tiny VALID file in the real on-disk format — MNIST IDX
+gzip, CIFAR python-pickle tar.gz, aclImdb tar.gz of review .txt files,
+PTB sentence text — and parses it back through the dataset classes
+(≙ reference python/paddle/dataset/{mnist,cifar,imdb,imikolov}.py +
+common.py download/cache, which these modules translate).
+"""
+
+import gzip
+import io
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.data import common, datasets
+
+pytestmark = pytest.mark.quick  # run_ci.sh quick smoke tier
+
+
+@pytest.fixture()
+def data_home(tmp_path, monkeypatch):
+    monkeypatch.setattr(datasets, "DATA_HOME", str(tmp_path))
+    return tmp_path
+
+
+def _write_idx(tmp, images, labels, prefix):
+    """Write REAL IDX format: >II magic 2049 + labels, >IIII magic 2051 +
+    images, both gzipped."""
+    n, rows, cols = images.shape
+    with gzip.open(tmp / f"{prefix}-labels-idx1-ubyte.gz", "wb") as f:
+        f.write(struct.pack(">II", 2049, n) + labels.astype(np.uint8)
+                .tobytes())
+    with gzip.open(tmp / f"{prefix}-images-idx3-ubyte.gz", "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, rows, cols) +
+                images.astype(np.uint8).tobytes())
+
+
+class TestMnistIdx:
+    def test_idx_files_parse(self, data_home):
+        d = data_home / "mnist"
+        d.mkdir()
+        rng = np.random.RandomState(0)
+        imgs = rng.randint(0, 256, (5, 28, 28)).astype(np.uint8)
+        labels = np.array([3, 1, 4, 1, 5], np.uint8)
+        _write_idx(d, imgs, labels, "train")
+        samples = list(datasets.mnist.train()())
+        assert len(samples) == 5
+        x0, y0 = samples[0]
+        assert x0.shape == (784,) and x0.dtype == np.float32
+        assert [y for _, y in samples] == [3, 1, 4, 1, 5]
+        # reference normalization: uint8 -> [-1, 1]
+        np.testing.assert_allclose(
+            x0, imgs[0].reshape(-1).astype(np.float32) / 127.5 - 1.0)
+
+    def test_end_to_end_train_from_idx_fixture(self, data_home):
+        """Book-style flow on a REAL-format fixture: IDX files -> reader ->
+        feeder -> train step, loss decreases."""
+        from paddle_tpu import layers
+        from paddle_tpu.data.feeder import DataFeeder
+
+        d = data_home / "mnist"
+        d.mkdir()
+        rng = np.random.RandomState(1)
+        # learnable data: label = brightest quadrant (0..3)
+        imgs = np.zeros((64, 28, 28), np.uint8)
+        labels = rng.randint(0, 4, 64).astype(np.uint8)
+        for i, y in enumerate(labels):
+            r0, c0 = (y // 2) * 14, (y % 2) * 14
+            imgs[i, r0:r0 + 14, c0:c0 + 14] = 200
+        _write_idx(d, imgs, labels, "train")
+
+        img = layers.data(name="img", shape=[784])
+        label = layers.data(name="label", shape=[1], dtype="int64")
+        logits = layers.fc(img, size=4)
+        loss = layers.reduce_mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        pt.optimizer.SGDOptimizer(learning_rate=0.5).minimize(loss)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        feeder = DataFeeder(feed_list=[img, label])
+
+        reader = datasets.mnist.train()
+        losses = []
+        for _ in range(6):
+            batch = [(x, [int(y)]) for x, y in reader()][:32]
+            losses.append(float(exe.run(feed=feeder.feed(batch),
+                                        fetch_list=[loss])[0]))
+        assert losses[-1] < losses[0]
+
+
+class TestCifarPickle:
+    def _write_tar(self, path, members):
+        with tarfile.open(path, "w:gz") as tf:
+            for name, obj in members.items():
+                raw = pickle.dumps(obj)
+                info = tarfile.TarInfo(name)
+                info.size = len(raw)
+                tf.addfile(info, io.BytesIO(raw))
+
+    def test_cifar10_tar_parses(self, data_home):
+        d = data_home / "cifar"
+        d.mkdir()
+        rng = np.random.RandomState(2)
+        data1 = rng.randint(0, 256, (3, 3072)).astype(np.uint8)
+        data2 = rng.randint(0, 256, (2, 3072)).astype(np.uint8)
+        self._write_tar(d / datasets.cifar.TAR10, {
+            "cifar-10-batches-py/data_batch_1":
+                {b"data": data1, b"labels": [0, 5, 9]},
+            "cifar-10-batches-py/data_batch_2":
+                {b"data": data2, b"labels": [2, 7]},
+            "cifar-10-batches-py/test_batch":
+                {b"data": data1[:1], b"labels": [1]},
+        })
+        train = list(datasets.cifar.train10()())
+        assert len(train) == 5
+        x, y = train[0]
+        assert x.shape == (3072,) and x.dtype == np.float32
+        np.testing.assert_allclose(x, data1[0].astype(np.float32) / 255.0)
+        assert [y for _, y in train] == [0, 5, 9, 2, 7]
+        test = list(datasets.cifar.test10()())
+        assert len(test) == 1 and test[0][1] == 1
+
+    def test_cifar100_fine_labels(self, data_home):
+        d = data_home / "cifar"
+        d.mkdir()
+        data = np.arange(2 * 3072, dtype=np.uint8).reshape(2, 3072)
+        self._write_tar(d / datasets.cifar.TAR100, {
+            "cifar-100-python/train":
+                {b"data": data, b"fine_labels": [42, 99]},
+        })
+        train = list(datasets.cifar.train100()())
+        assert [y for _, y in train] == [42, 99]
+
+
+class TestImdbText:
+    def _write_acl_tar(self, path, reviews):
+        with tarfile.open(path, "w:gz") as tf:
+            for name, text in reviews.items():
+                raw = text.encode()
+                info = tarfile.TarInfo(name)
+                info.size = len(raw)
+                tf.addfile(info, io.BytesIO(raw))
+
+    def test_word_dict_and_readers(self, data_home):
+        d = data_home / "imdb"
+        d.mkdir()
+        self._write_acl_tar(d / "aclImdb_v1.tar.gz", {
+            "aclImdb/train/pos/0_9.txt": "great great movie",
+            "aclImdb/train/neg/0_2.txt": "bad movie, bad!",
+            "aclImdb/test/pos/0_8.txt": "great fun",
+            "aclImdb/test/neg/0_3.txt": "awful",
+        })
+        wd = datasets.imdb.word_dict(min_word_freq=0)
+        # train split only: bad/great/movie all freq 2, alphabetical
+        # tie-break -> bad=0, great=1, movie=2
+        assert wd["bad"] == 0 and wd["great"] == 1 and wd["movie"] == 2
+        assert "<unk>" in wd
+
+        train = list(datasets.imdb.train(wd)())
+        assert len(train) == 2
+        ids, label = train[0]          # sorted order: neg before pos
+        assert label == 1              # neg -> 1 (reference convention)
+        # "bad movie bad" -> [bad, movie, bad]
+        assert ids[0] == wd["bad"] and ids[2] == wd["bad"]
+        test = list(datasets.imdb.test(wd)())
+        assert len(test) == 2
+        # unseen word 'awful' maps to <unk>
+        neg_ids = [s for s, l in test if l == 1][0]
+        assert neg_ids[0] == wd["<unk>"]
+
+
+class TestImikolovText:
+    def test_ngrams_from_ptb_text(self, data_home):
+        d = data_home / "imikolov"
+        d.mkdir()
+        (d / "ptb.train.txt").write_text(
+            "the cat sat\nthe dog sat\n")
+        (d / "ptb.valid.txt").write_text("the cat sat\n")
+        wd = datasets.imikolov.build_dict(min_word_freq=0)
+        assert wd["<s>"] == wd.get("<s>")      # present
+        assert wd["the"] < wd["cat"]           # freq 2 before freq 1
+        grams = list(datasets.imikolov.train(wd, n=3)())
+        # per line: <s> w1 w2 w3 <e> -> 3 trigrams
+        assert len(grams) == 6
+        assert grams[0] == (wd["<s>"], wd["the"], wd["cat"])
+        valid = list(datasets.imikolov.test(wd, n=3)())
+        assert len(valid) == 3
+
+
+class TestDownloadCache:
+    def test_download_file_url_md5_and_cache(self, data_home, tmp_path):
+        src = tmp_path / "payload.bin"
+        src.write_bytes(b"hello dataset")
+        md5 = common.md5file(str(src))
+        url = "file://" + str(src)
+        got = common.download(url, "probe", md5sum=md5)
+        assert os.path.exists(got)
+        assert open(got, "rb").read() == b"hello dataset"
+        # cached: removing the source must not matter
+        src.unlink()
+        got2 = common.download(url, "probe", md5sum=md5)
+        assert got2 == got
+
+    def test_download_md5_mismatch_raises(self, data_home, tmp_path):
+        src = tmp_path / "payload2.bin"
+        src.write_bytes(b"corrupt")
+        with pytest.raises(Exception) as ei:
+            common.download("file://" + str(src), "probe2",
+                            md5sum="0" * 32, retries=1)
+        assert "md5" in str(ei.value) or "download" in str(ei.value)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-x", "-q"]))

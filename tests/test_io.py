@@ -7,6 +7,8 @@ import pytest
 import paddle_tpu as pt
 from paddle_tpu import layers, models
 
+pytestmark = pytest.mark.quick  # run_ci.sh quick smoke tier
+
 
 def _train_mlp(rng, steps=15):
     loss, acc, logits = models.mnist.mlp(hidden_sizes=(32,), class_num=10)

@@ -97,6 +97,50 @@ def test_dynamic_lstmp_shapes_and_masking(rng):
     np.testing.assert_allclose(rv[1, 4], rv[1, 2], rtol=1e-6)
 
 
+def test_dynamic_lstmp_peephole_numerics(rng):
+    """Ground truth for the peephole connections (ADVICE r2): run the op
+    with a 7H bias and compare against a hand-rolled numpy recurrence with
+    w_ic/w_fc on c_{t-1} and w_oc on c_t (≙ reference lstmp_op.h)."""
+    from op_test import run_op
+
+    B, T, H, P = 2, 3, 4, 3
+    x = (rng.rand(B, T, 4 * H) - 0.5).astype("float32")
+    w = ((rng.rand(P, 4 * H) - 0.5) * 0.5).astype("float32")
+    w_proj = ((rng.rand(H, P) - 0.5) * 0.5).astype("float32")
+    bias = ((rng.rand(7 * H) - 0.5) * 0.5).astype("float32")
+    seqlen = np.array([T, T], "int32")
+
+    out = run_op("dynamic_lstmp",
+                 {"Input": x, "Weight": w, "ProjWeight": w_proj,
+                  "Bias": bias, "SeqLen": seqlen},
+                 attrs={"use_peepholes": True})
+    got_r = np.asarray(out["Projection"][0])
+    got_c = np.asarray(out["Cell"][0])
+
+    def sigmoid(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    b4, w_ic, w_fc, w_oc = (bias[:4 * H], bias[4 * H:5 * H],
+                            bias[5 * H:6 * H], bias[6 * H:])
+    r_prev = np.zeros((B, P), "float32")
+    c_prev = np.zeros((B, H), "float32")
+    ref_r = np.zeros((B, T, P), "float32")
+    ref_c = np.zeros((B, T, H), "float32")
+    for t in range(T):
+        gates = x[:, t] + b4 + r_prev @ w
+        i, f, ch, o = np.split(gates, 4, axis=-1)
+        i = sigmoid(i + w_ic * c_prev)
+        f = sigmoid(f + w_fc * c_prev)
+        c_new = f * c_prev + i * np.tanh(ch)
+        o = sigmoid(o + w_oc * c_new)
+        r_prev = (o * np.tanh(c_new)) @ w_proj
+        c_prev = c_new
+        ref_r[:, t] = r_prev
+        ref_c[:, t] = c_new
+    np.testing.assert_allclose(got_r, ref_r, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(got_c, ref_c, atol=1e-5, rtol=1e-5)
+
+
 def test_sequence_reshape_roundtrip(rng):
     x = layers.data("x", shape=[4, 6], dtype="float32", lod_level=1)
     out = layers.sequence.sequence_reshape(x, new_dim=3)

@@ -9,6 +9,10 @@ import numpy as np
 import paddle_tpu as pt
 from paddle_tpu import layers
 
+import pytest
+
+pytestmark = pytest.mark.quick  # run_ci.sh quick smoke tier
+
 
 def _synthetic_mnist(rng, n=512):
     x = rng.rand(n, 784).astype(np.float32)

@@ -9,6 +9,8 @@ import pytest
 
 from op_test import check_grad, check_output, run_op
 
+pytestmark = pytest.mark.quick  # run_ci.sh quick smoke tier
+
 
 class TestElementwise:
     def test_add_forward_and_grad(self, rng):

@@ -17,6 +17,8 @@ import paddle_tpu as pt
 from paddle_tpu import layers
 from paddle_tpu.data.feeder import stage_array, stage_batch, staging_specs
 
+pytestmark = pytest.mark.quick  # run_ci.sh quick smoke tier
+
 
 def _build_staged_net():
     img = layers.data(name="img", shape=[8, 8, 3], staging_dtype="uint8")

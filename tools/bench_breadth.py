@@ -1,0 +1,141 @@
+"""Benchmark breadth: driver configs #3-#5 with the SAME audit fields as
+the ResNet headline (VERDICT r2 #8; ≙ reference
+benchmark/fluid/fluid_benchmark.py:299 printing throughput for all five
+models).
+
+Run on the real TPU and commit the output:
+
+    env PYTHONPATH=/root/.axon_site:/root/repo \
+        python tools/bench_breadth.py | tee BENCH_BREADTH_r03.json
+
+Sync discipline: host-value realization of the last fetched loss is the
+only trusted barrier through the remote tunnel (see bench.py).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+_CHIP_SPECS = (("v5 lite", 197.0), ("v5e", 197.0), ("v5p", 459.0),
+               ("v6", 918.0), ("v4", 275.0))
+
+
+def _peak(dev):
+    kind = (getattr(dev, "device_kind", "") or "").lower()
+    for sub, p in _CHIP_SPECS:
+        if sub in kind:
+            return p
+    return None
+
+
+def _measure(name, build, unit, iters=20):
+    """build(rng) -> (loss_var, feed, units_per_step, optimizer)."""
+    import jax
+    import paddle_tpu as pt
+
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    rng = np.random.RandomState(0)
+    with pt.core.unique_name.guard():
+        loss, feed, units, opt = build(rng)
+        opt.minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+
+    out = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
+    float(np.asarray(out[0]).ravel()[0])  # compile + drain
+
+    fetched = []
+    t0 = time.time()
+    for _ in range(iters):
+        out = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
+        fetched.append(out[0])
+    float(np.asarray(fetched[-1]).ravel()[0])
+    dt = time.time() - t0
+    losses = [float(np.asarray(x).ravel()[0]) for x in fetched]
+
+    ca = exe.cost_analysis(feed=feed, fetch_list=[loss])
+    flops = float(ca.get("flops", 0.0)) if ca else 0.0
+    dev = jax.devices()[0]
+    peak = _peak(dev)
+    implied = flops * iters / dt / 1e12 if flops else None
+    rec = {
+        "model": name,
+        "value": round(units * iters / dt, 2),
+        "unit": unit,
+        "evidence": {
+            "device_kind": getattr(dev, "device_kind", str(dev)),
+            "step_ms": round(dt / iters * 1e3, 2),
+            "flops_per_step_xla": flops,
+            "implied_tflops": round(implied, 2) if implied else None,
+            "mfu": (round(implied / peak, 4) if implied and peak else None),
+            "loss_first": round(losses[0], 4),
+            "loss_last": round(losses[-1], 4),
+            "loss_decreased": bool(losses[-1] < losses[0]),
+        },
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def build_stacked_lstm(rng):
+    import paddle_tpu as pt
+    from paddle_tpu.models import stacked_lstm
+    b, t = 64, 64
+    loss, acc, _ = stacked_lstm.stacked_lstm_net(
+        dict_dim=10000, emb_dim=256, hid_dim=256, max_len=t)
+    feed = {"words": rng.randint(0, 10000, (b, t)).astype("int64"),
+            "words@SEQLEN": np.full((b,), t, "int32"),
+            "label": rng.randint(0, 2, (b, 1)).astype("int64")}
+    opt = pt.optimizer.AdamOptimizer(learning_rate=1e-3)
+    return loss, feed, b * t, opt
+
+
+def build_transformer(rng):
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer
+    b, t = 16, 512
+    loss, _ = transformer.transformer_lm(
+        vocab=32000, max_len=t, d_model=512, d_inner=2048, num_heads=8,
+        num_layers=6, dropout=0.0)   # dropout 0 -> flash-attention path
+    feed = {"tokens": rng.randint(0, 32000, (b, t)).astype("int64"),
+            "tokens@SEQLEN": np.full((b,), t, "int32"),
+            "targets": rng.randint(0, 32000, (b, t)).astype("int64")}
+    opt = pt.optimizer.AdamOptimizer(learning_rate=1e-4)
+    return loss, feed, b * t, opt
+
+
+def build_deepfm(rng):
+    import paddle_tpu as pt
+    from paddle_tpu.models import deepfm
+    b = 4096
+    loss, _ = deepfm.deepfm(num_fields=39, vocab_size=1000000,
+                            is_sparse=True)
+    feed = {"feat_ids": rng.randint(0, 1000000, (b, 39)).astype("int64"),
+            "feat_vals": rng.rand(b, 39).astype("float32"),
+            "label": rng.randint(0, 2, (b, 1)).astype("float32")}
+    opt = pt.optimizer.AdamOptimizer(learning_rate=1e-3)
+    return loss, feed, b, opt
+
+
+def main():
+    import jax
+    on_accel = jax.devices()[0].platform != "cpu"
+    iters = 20 if on_accel else 2
+    recs = [
+        _measure("stacked_lstm_bs64_T64", build_stacked_lstm,
+                 "tokens/sec", iters),
+        _measure("transformer_lm_6l_512d_bs16_T512_flash",
+                 build_transformer, "tokens/sec", iters),
+        _measure("deepfm_bs4096_vocab1M_sparse", build_deepfm,
+                 "examples/sec", iters),
+    ]
+    ok = all(r["evidence"]["loss_decreased"] for r in recs)
+    print(json.dumps({"all_losses_decreased": ok}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -108,13 +108,12 @@ class Executor:
         out_only = sorted(set(state_written) - set(state_in))
         return ro, rw, out_only
 
-    def _compile(self, program: Program, scope: Scope, feed_names, fetch_names,
-                 in_shardings=None, out_shardings=None, analysis=None):
+    def _build_step_fn(self, program: Program, feed_names, fetch_names,
+                       ro, rw, state_out_names):
+        """The pure per-step function both the single-step compile and the
+        scan-fused run_steps build on."""
         block = program.global_block()
         plan = build_plan(block)
-        ro, rw, out_only = analysis or self._analyze_state(
-            program, scope, feed_names, fetch_names)
-        state_out_names = sorted(set(rw) | set(out_only))
         fetch_names = list(fetch_names)
         feed_names = list(feed_names)
 
@@ -146,6 +145,18 @@ class Executor:
             new_state = tuple(env[n] for n in state_out_names)
             return fetches, new_state
 
+        return step
+
+    def _compile(self, program: Program, scope: Scope, feed_names, fetch_names,
+                 in_shardings=None, out_shardings=None, analysis=None):
+        ro, rw, out_only = analysis or self._analyze_state(
+            program, scope, feed_names, fetch_names)
+        state_out_names = sorted(set(rw) | set(out_only))
+        fetch_names = list(fetch_names)
+        feed_names = list(feed_names)
+        step = self._build_step_fn(program, feed_names, fetch_names, ro, rw,
+                                   state_out_names)
+
         flags.vlog(1, "compiling program id=%s version=%s feeds=%s "
                    "fetches=%s", id(program), program._version,
                    list(feed_names), list(fetch_names))
@@ -159,13 +170,7 @@ class Executor:
         compiled.state_out_names = state_out_names
         return compiled
 
-    def _lookup_or_compile(self, program: Program, feed: Dict[str, Any],
-                           fetch_names, scope: Scope) -> _CompiledStep:
-        """Validate fetch targets and return the cached compiled step for
-        (program, feed signature, fetches, scope contents), compiling on
-        miss. The cache key includes which persistable vars currently exist
-        in the scope: compiling before the startup program ran must not
-        poison the cache for post-initialization runs."""
+    def _validate_fetches(self, program: Program, feed, fetch_names):
         block = program.global_block()
         defined = set(feed)
         for op in block.ops:
@@ -175,6 +180,15 @@ class Executor:
                 raise NotFoundError(
                     f"fetch target {name!r} is not produced by the program "
                     f"and not fed")
+
+    def _lookup_or_compile(self, program: Program, feed: Dict[str, Any],
+                           fetch_names, scope: Scope) -> _CompiledStep:
+        """Validate fetch targets and return the cached compiled step for
+        (program, feed signature, fetches, scope contents), compiling on
+        miss. The cache key includes which persistable vars currently exist
+        in the scope: compiling before the startup program ran must not
+        poison the cache for post-initialization runs."""
+        self._validate_fetches(program, feed, fetch_names)
         avail_key = self._scope_avail_key(program, scope)
         key = (id(program), program._version, _feed_signature(feed),
                tuple(fetch_names), id(scope), avail_key)
@@ -241,6 +255,114 @@ class Executor:
         if flags.get_flag("benchmark"):
             jax.block_until_ready(fetches)
             print(f"[benchmark] program run took {time.time() - t0:.4f}s")
+        if return_numpy:
+            return [as_numpy(f) for f in fetches]
+        return list(fetches)
+
+    def run_steps(self,
+                  feed_list: Sequence[Dict[str, Any]],
+                  fetch_list: Optional[Sequence[Union[str, Variable]]] = None,
+                  program: Optional[Program] = None,
+                  scope: Optional[Scope] = None,
+                  return_numpy: bool = True):
+        """Run len(feed_list) train steps as ONE compiled XLA execution
+        (lax.scan over the stacked feeds): the in-graph training loop.
+
+        ≙ the reference's py_reader-driven executor loop (reference
+        layers/io.py:474 + executor hot loop), where the device consumes a
+        queue without a Python round-trip per step. On a remote/tunneled
+        device this amortizes every per-call cost; on any device it lets
+        XLA overlap adjacent steps' host interaction.
+
+        All feeds must share one signature. Returns a list over
+        fetch_list of arrays STACKED over steps (e.g. the per-step loss
+        curve). Updated persistable state is written back once, from the
+        final step.
+        """
+        feed_list = [dict(f) for f in feed_list]
+        enforce(len(feed_list) >= 1, "run_steps needs at least one feed",
+                exc=InvalidArgumentError)
+        sig0 = _feed_signature(feed_list[0])
+        for f in feed_list[1:]:
+            enforce(_feed_signature(f) == sig0,
+                    "run_steps feeds must share one signature "
+                    "(same names, shapes, dtypes)",
+                    exc=InvalidArgumentError)
+        program = program or default_main_program()
+        scope = scope or global_scope()
+        fetch_names = [f.name if isinstance(f, Variable) else f
+                       for f in (fetch_list or [])]
+
+        k = len(feed_list)
+        self._validate_fetches(program, feed_list[0], fetch_names)
+        avail_key = self._scope_avail_key(program, scope)
+        key = ("scan", k, id(program), program._version, sig0,
+               tuple(fetch_names), id(scope), avail_key)
+        compiled = self._cache.get(key)
+        if compiled is None:
+            ro, rw, out_only = self._analyze_state(
+                program, scope, list(feed_list[0].keys()), fetch_names)
+            state_out_names = sorted(set(rw) | set(out_only))
+            feed_names = list(feed_list[0].keys())
+            step = self._build_step_fn(program, feed_names, fetch_names,
+                                       ro, rw, state_out_names)
+            rw_idx = {n: state_out_names.index(n) for n in rw}
+            oo_idx = {n: state_out_names.index(n) for n in out_only}
+
+            def loop(feed_stacks, ro_vals, rw_vals, seed):
+                def body(carry, xs):
+                    rw_vals, i = carry
+                    fetches, new_state = step(xs, ro_vals, rw_vals,
+                                              seed + i)
+                    new_rw = tuple(new_state[rw_idx[n]] for n in rw)
+                    # only the write-only slots ride the stacked ys — the
+                    # big read-write state (params, accumulators) stays in
+                    # the carry so the loop holds ONE copy, not K
+                    oo = tuple(new_state[oo_idx[n]] for n in out_only)
+                    return (new_rw, i + 1), (fetches, oo)
+
+                (rw_final, _), (fetches, oo_stack) = jax.lax.scan(
+                    body, (rw_vals, jnp.uint32(0)), feed_stacks)
+                by_name = dict(zip(rw, rw_final))
+                by_name.update({n: s[-1] for n, s in zip(out_only,
+                                                         oo_stack)})
+                final_state = tuple(by_name[n] for n in state_out_names)
+                return fetches, final_state
+
+            fn = jax.jit(loop, donate_argnums=(2,))
+            compiled = _CompiledStep(fn, ro, rw,
+                                     list(feed_list[0].keys()), fetch_names)
+            compiled.state_out_names = state_out_names
+            self._cache[key] = compiled
+
+        feed_stacks = tuple(
+            jnp.stack([jnp.asarray(f[n]) for f in feed_list])
+            for n in compiled.feed_names)
+        ro_vals = tuple(scope.get(n) for n in compiled.ro_names)
+        rw_vals = tuple(scope.get(n) for n in compiled.rw_names)
+        # k seeds are consumed (seed+0 .. seed+k-1): advance the counter by
+        # k so neither the next run_steps nor a plain run() reuses them
+        seed = np.uint32((program.random_seed * 1000003
+                          + self._run_counter + 1) % (2 ** 31))
+        self._run_counter += k
+        fetches, final_state = compiled.fn(feed_stacks, ro_vals, rw_vals,
+                                           seed)
+        if flags.get_flag("check_nan_inf") and jax.default_backend() != "cpu":
+            # same contract as run(): sweep BEFORE the scope write-back so
+            # the last-good parameters stay checkpointable when a step in
+            # the fused window diverges
+            for name, val in list(zip(compiled.fetch_names, fetches)) + \
+                    list(zip(compiled.state_out_names, final_state)):
+                if hasattr(val, "dtype") and jnp.issubdtype(
+                        val.dtype, jnp.floating):
+                    if not bool(jnp.isfinite(val).all()):
+                        raise FloatingPointError(
+                            f"NaN/Inf detected in {name!r} during "
+                            f"run_steps (fetch-time sweep; rerun the "
+                            f"window step-by-step under JAX_PLATFORMS=cpu "
+                            f"with PTPU_CHECK_NAN_INF=1 to localize)")
+        for name, val in zip(compiled.state_out_names, final_state):
+            scope.set_var(name, val)
         if return_numpy:
             return [as_numpy(f) for f in fetches]
         return list(fetches)

@@ -14,12 +14,19 @@ from .. import layers
 
 def deepfm(feat_ids=None, feat_vals=None, label=None, num_fields=39,
            vocab_size=100000, embed_dim=16, fc_sizes=(400, 400, 400),
-           is_sparse=False):
+           is_sparse=False, fuse_first_order=True):
     """DeepFM: linear term + FM second-order term + DNN over concatenated
     field embeddings.
 
     feat_ids: [B, num_fields] int64; feat_vals: [B, num_fields] float32;
     label: [B, 1] float32 in {0, 1}.
+
+    fuse_first_order (TPU optimization, on by default): the first-order
+    scalar weights live as column 0 of ONE [vocab, 1 + embed_dim] table
+    instead of a separate [vocab, 1] table. Identical model capacity, but
+    half the table lookups/scatter-updates per step — on TPU those
+    small-row gathers/scatters are tile-granularity-bound and dominate
+    sparse-CTR step time (round-3 profiling: ~5-10 ms device time each).
     """
     if feat_ids is None:
         feat_ids = layers.data(name="feat_ids", shape=[num_fields],
@@ -29,16 +36,26 @@ def deepfm(feat_ids=None, feat_vals=None, label=None, num_fields=39,
     if label is None:
         label = layers.data(name="label", shape=[1])
 
-    # first-order: per-feature scalar weight
-    w1 = layers.embedding(input=feat_ids, size=[vocab_size, 1],
-                      is_sparse=is_sparse)       # [B,F,1]
     vals3 = layers.unsqueeze(feat_vals, axes=[2])                     # [B,F,1]
+    if fuse_first_order:
+        # one table, one lookup: [:, :, 0:1] is the linear weight, the
+        # rest is the FM/DNN embedding
+        fused = layers.embedding(input=feat_ids,
+                                 size=[vocab_size, 1 + embed_dim],
+                                 is_sparse=is_sparse)                 # [B,F,1+E]
+        w1 = layers.slice(fused, axes=[2], starts=[0], ends=[1])
+        emb = layers.slice(fused, axes=[2], starts=[1],
+                           ends=[1 + embed_dim])
+    else:
+        # first-order: per-feature scalar weight
+        w1 = layers.embedding(input=feat_ids, size=[vocab_size, 1],
+                              is_sparse=is_sparse)                    # [B,F,1]
+        emb = layers.embedding(input=feat_ids,
+                               size=[vocab_size, embed_dim],
+                               is_sparse=is_sparse)
     first = layers.reduce_sum(layers.elementwise_mul(w1, vals3), dim=[1])
 
     # second-order FM: 0.5 * ((sum v)^2 - sum v^2)
-    emb = layers.embedding(input=feat_ids,
-                       size=[vocab_size, embed_dim],
-                       is_sparse=is_sparse)
     emb = layers.elementwise_mul(emb, vals3)                          # [B,F,E]
     sum_v = layers.reduce_sum(emb, dim=[1])                           # [B,E]
     sum_sq = layers.elementwise_mul(sum_v, sum_v)

@@ -56,7 +56,8 @@ class CheckpointConfig:
     def __init__(self, checkpoint_dir: Optional[str] = None,
                  max_num_checkpoints: int = 3,
                  epoch_interval: int = 1,
-                 step_interval: int = 10):
+                 step_interval: int = 10,
+                 sharded: bool = False):
         self.checkpoint_dir = checkpoint_dir or \
             os.path.join(os.getcwd(), "checkpoint")
         enforce(epoch_interval >= 1 and step_interval >= 1,
@@ -65,6 +66,9 @@ class CheckpointConfig:
         self.max_num_checkpoints = max_num_checkpoints
         self.epoch_interval = epoch_interval
         self.step_interval = step_interval
+        # sharded=True: per-process shard files via sharded_checkpoint —
+        # the at-scale mode (ZeRO-1/EP state never gathered to one host)
+        self.sharded = sharded
         self.epoch_id = 0
         self.step_id = 0
         self.load_serial: Optional[int] = None
@@ -99,47 +103,93 @@ def get_latest_checkpoint_serial(root: str) -> int:
     return serials[-1] if serials else -1
 
 
+def _global_barrier(tag: str):
+    """No-op in a single-process world; in a jax.distributed world, block
+    until every process reaches the same tag (the multi-phase commit
+    protocol below depends on it)."""
+    import jax
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(tag)
+
+
 def save_checkpoint(executor: Executor, checkpoint_dir: str,
                     main_program: Program,
                     trainer_args: Optional[dict] = None,
                     max_num_checkpoints: int = 3,
-                    scope: Optional[Scope] = None) -> int:
+                    scope: Optional[Scope] = None,
+                    sharded: bool = False,
+                    serial: Optional[int] = None) -> int:
     """Write persistables + trainer args into the next serial dir; commit via
     the `_SUCCESS` marker only after all state hit disk (crash-safe: readers
     ignore marker-less dirs); then scroll-delete old serials
-    (≙ trainer.save_checkpoint :641 + _scroll_delete :1168)."""
-    serial = get_latest_checkpoint_serial(checkpoint_dir) + 1
+    (≙ trainer.save_checkpoint :641 + _scroll_delete :1168).
+
+    sharded=True routes through sharded_checkpoint: each process writes
+    only its addressable shards. Multi-process commit protocol (all
+    phases separated by a global barrier so the marker really means
+    "complete"): the chief clears leftovers from a preempted attempt ->
+    everyone writes shards -> the CHIEF ALONE writes trainer args +
+    _SUCCESS. Every process must call save_checkpoint at the same point
+    in the program; `serial` may be passed explicitly (all processes
+    agree trivially since the barrier orders them; by default each reads
+    the same directory state after the barrier)."""
+    import jax
+    chief = jax.process_index() == 0
+    multi = jax.process_count() > 1 and sharded
+    if multi:
+        # order every process behind the same view of the directory
+        _global_barrier("ptpu_ckpt_enter")
+    if serial is None:
+        serial = get_latest_checkpoint_serial(checkpoint_dir) + 1
     cur = _serial_dir(checkpoint_dir, serial)
-    if os.path.isdir(cur):
+    if chief and os.path.isdir(cur):
         shutil.rmtree(cur)  # incomplete leftovers from a preempted run
     os.makedirs(cur, exist_ok=True)
+    if multi:
+        _global_barrier("ptpu_ckpt_cleaned")   # nobody writes into leftovers
     _io.save_persistables(executor, cur, main_program=main_program,
-                          scope=scope)
-    if trainer_args is not None:
-        with open(os.path.join(cur, TRAINER_ARGS_FILE), "w") as f:
-            json.dump(trainer_args, f)
-    with open(os.path.join(cur, SUCCESS_MARKER), "w") as f:
-        f.write("")
-    # retention
-    serials = _list_serials(checkpoint_dir)
-    for old in serials[:-max_num_checkpoints]:
-        shutil.rmtree(_serial_dir(checkpoint_dir, old), ignore_errors=True)
+                          scope=scope, sharded=sharded)
+    if multi:
+        _global_barrier("ptpu_ckpt_written")   # all shards are on disk
+    if chief or not multi:
+        if trainer_args is not None:
+            with open(os.path.join(cur, TRAINER_ARGS_FILE), "w") as f:
+                json.dump(trainer_args, f)
+        with open(os.path.join(cur, SUCCESS_MARKER), "w") as f:
+            f.write("")
+        # retention
+        serials = _list_serials(checkpoint_dir)
+        for old in serials[:-max_num_checkpoints]:
+            shutil.rmtree(_serial_dir(checkpoint_dir, old),
+                          ignore_errors=True)
+    if multi:
+        # nobody returns until the marker exists — otherwise a fast
+        # non-chief process could enter the NEXT save, read a stale
+        # directory state, and compute a different serial (split-brain
+        # checkpoint dirs)
+        _global_barrier("ptpu_ckpt_committed")
     return serial
 
 
 def load_checkpoint(executor: Executor, checkpoint_dir: str,
                     main_program: Program,
                     serial: Optional[int] = None,
-                    scope: Optional[Scope] = None) -> Optional[dict]:
+                    scope: Optional[Scope] = None,
+                    sharded: bool = False,
+                    shardings=None) -> Optional[dict]:
     """Restore persistables from the given (default: latest complete)
-    serial; returns the saved trainer args or None if no checkpoint."""
+    serial; returns the saved trainer args or None if no checkpoint.
+    sharded/shardings: restore a sharded checkpoint, re-sharding onto the
+    current mesh (see io.load_persistables)."""
     if serial is None:
         serial = get_latest_checkpoint_serial(checkpoint_dir)
     if serial < 0:
         return None
     cur = _serial_dir(checkpoint_dir, serial)
     _io.load_persistables(executor, cur, main_program=main_program,
-                          scope=scope)
+                          scope=scope, sharded=sharded,
+                          shardings=shardings)
     args_path = os.path.join(cur, TRAINER_ARGS_FILE)
     if os.path.exists(args_path):
         with open(args_path) as f:
@@ -200,7 +250,8 @@ class Trainer:
         if self.checkpoint_cfg:
             args = load_checkpoint(self.exe,
                                    self.checkpoint_cfg.checkpoint_dir,
-                                   self.train_program, scope=self.scope)
+                                   self.train_program, scope=self.scope,
+                                   sharded=self.checkpoint_cfg.sharded)
             if args:
                 self.checkpoint_cfg.epoch_id = int(args.get("epoch_id", 0))
                 self.checkpoint_cfg.step_id = int(args.get("step_id", 0))
@@ -295,4 +346,4 @@ class Trainer:
             self.exe, self.checkpoint_cfg.checkpoint_dir, self.train_program,
             trainer_args={"epoch_id": resume_epoch, "step_id": resume_step},
             max_num_checkpoints=self.checkpoint_cfg.max_num_checkpoints,
-            scope=self.scope)
+            scope=self.scope, sharded=self.checkpoint_cfg.sharded)

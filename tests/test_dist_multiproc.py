@@ -666,3 +666,77 @@ def test_elastic_world_resize(tmp_path):
     # the uninterrupted run within collective-reorder tolerance
     np.testing.assert_allclose(full, ref_losses, rtol=2e-4)
     assert full[-1] < full[0]
+
+
+# ---------------------------------------------------------------------------
+# 4. multi-process sharded save_checkpoint: the barrier-separated commit
+#    protocol (chief cleans -> all write shards -> chief marks _SUCCESS)
+#    produces exactly one complete serial dir that load_checkpoint restores.
+# ---------------------------------------------------------------------------
+
+_CKPT_SCRIPT = _BOOT + r"""
+import json
+import numpy as np
+import jax
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.distributed import init_parallel_env
+from paddle_tpu.trainer import (get_latest_checkpoint_serial,
+                                load_checkpoint, save_checkpoint)
+
+env = init_parallel_env()
+root = os.environ["CKPT_ROOT"]
+
+x = layers.data("x", shape=[4])
+w_out = layers.fc(x, size=2, name="mpfc")
+exe = pt.Executor()
+exe.run(pt.default_startup_program())
+
+serial = save_checkpoint(exe, root, pt.default_main_program(),
+                         trainer_args={"step": 5}, sharded=True)
+# both processes agree on the serial and see a COMPLETE checkpoint
+assert serial == 0, serial
+assert get_latest_checkpoint_serial(root) == 0
+
+w_before = np.asarray(pt.global_scope().get("mpfc.w_0"))
+pt.reset_global_scope()
+args = load_checkpoint(exe, root, pt.default_main_program(), sharded=True)
+assert args == {"step": 5}, args
+np.testing.assert_array_equal(
+    np.asarray(pt.global_scope().get("mpfc.w_0")), w_before)
+
+# a second save lands in serial 1 on every process (no split-brain dirs)
+serial2 = save_checkpoint(exe, root, pt.default_main_program(),
+                          trainer_args={"step": 9}, sharded=True)
+assert serial2 == 1, serial2
+print(json.dumps({"rank": env.trainer_id, "ok": True}), flush=True)
+"""
+
+
+def test_multiprocess_sharded_save_checkpoint(tmp_path):
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINING_ROLE": "TRAINER",
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": "2",
+            "PADDLE_COORDINATOR_ENDPOINT": f"127.0.0.1:{port}",
+            "CKPT_ROOT": str(tmp_path / "ck"),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _script(_CKPT_SCRIPT)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=str(tmp_path)))
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, f"child failed:\n{err[-2500:]}"
+        assert json.loads(out.strip().splitlines()[-1])["ok"]
+    # one complete dir per serial, two manifests each (one per process)
+    import glob
+    for serial in (0, 1):
+        d = tmp_path / "ck" / f"checkpoint_{serial}"
+        assert (d / "_SUCCESS").exists()
+        assert len(glob.glob(str(d / "manifest-*.json"))) == 2

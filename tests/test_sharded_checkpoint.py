@@ -191,6 +191,28 @@ class TestIoIntegration:
         got = exe2.run(feed=feed, fetch_list=[loss])[0]
         np.testing.assert_allclose(got, ref, rtol=1e-6)
 
+    def test_trainer_checkpoint_sharded_round_trip(self, tmp_path):
+        """trainer.save_checkpoint/load_checkpoint(sharded=True): serial
+        dirs + _SUCCESS markers + trainer args compose with the sharded
+        container."""
+        from paddle_tpu.trainer import load_checkpoint, save_checkpoint
+        from paddle_tpu import layers
+        x = layers.data(name="x", shape=[4])
+        layers.fc(x, size=2, name="tsfc")
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        serial = save_checkpoint(exe, str(tmp_path),
+                                 pt.default_main_program(),
+                                 trainer_args={"step": 11}, sharded=True)
+        assert serial == 0
+        w = np.asarray(pt.global_scope().get("tsfc.w_0"))
+        pt.reset_global_scope()
+        args = load_checkpoint(exe, str(tmp_path),
+                               pt.default_main_program(), sharded=True)
+        assert args == {"step": 11}
+        np.testing.assert_array_equal(
+            np.asarray(pt.global_scope().get("tsfc.w_0")), w)
+
     def test_load_persistables_sharded_with_shardings(self, tmp_path):
         from paddle_tpu import layers
         x = layers.data(name="x", shape=[8])

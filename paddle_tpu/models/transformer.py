@@ -185,10 +185,18 @@ def transformer(src=None, tgt=None, label=None, src_vocab=30000,
                        use_bf16=True, name="proj")
     label3 = layers.unsqueeze(label, axes=[2])
     if label_smooth:
-        oh = layers.one_hot(label3, depth=tgt_vocab)
-        soft = layers.label_smooth(oh, epsilon=label_smooth)
-        token_loss = layers.softmax_with_cross_entropy(logits, soft,
-                                                       soft_label=True)
+        # uniform label smoothing decomposed (identical math, no [B,T,V]
+        # one-hot/smoothed-target materialization — those were measured as
+        # avoidable HBM traffic on the NMT step):
+        #   CE(smooth) = (1-eps)*CE(hard) + eps * mean_V(-log_softmax)
+        eps = float(label_smooth)
+        ce_hard = layers.softmax_with_cross_entropy(logits, label3)
+        lp = layers.log_softmax(logits)
+        uniform = layers.scale(
+            layers.reduce_mean(lp, dim=[2], keep_dim=True), scale=-1.0)
+        token_loss = layers.elementwise_add(
+            layers.scale(ce_hard, scale=1.0 - eps),
+            layers.scale(uniform, scale=eps))
     else:
         token_loss = layers.softmax_with_cross_entropy(logits, label3)
     mask = layers.sequence_mask(tgt_len, maxlen=max_len)

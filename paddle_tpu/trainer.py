@@ -143,7 +143,7 @@ def save_checkpoint(executor: Executor, checkpoint_dir: str,
     if serial is None:
         serial = get_latest_checkpoint_serial(checkpoint_dir) + 1
     cur = _serial_dir(checkpoint_dir, serial)
-    if chief and os.path.isdir(cur):
+    if (chief or not multi) and os.path.isdir(cur):
         shutil.rmtree(cur)  # incomplete leftovers from a preempted run
     os.makedirs(cur, exist_ok=True)
     if multi:
@@ -158,9 +158,11 @@ def save_checkpoint(executor: Executor, checkpoint_dir: str,
                 json.dump(trainer_args, f)
         with open(os.path.join(cur, SUCCESS_MARKER), "w") as f:
             f.write("")
-        # retention
-        serials = _list_serials(checkpoint_dir)
-        for old in serials[:-max_num_checkpoints]:
+        # retention: keep the most recent max_num_checkpoints, and never
+        # the serial just written (an explicit low `serial` override must
+        # not delete its own checkpoint)
+        serials = [s for s in _list_serials(checkpoint_dir) if s != serial]
+        for old in serials[:-(max_num_checkpoints - 1) or None]:
             shutil.rmtree(_serial_dir(checkpoint_dir, old),
                           ignore_errors=True)
     if multi:

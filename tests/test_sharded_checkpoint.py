@@ -213,6 +213,28 @@ class TestIoIntegration:
         np.testing.assert_array_equal(
             np.asarray(pt.global_scope().get("tsfc.w_0")), w)
 
+    def test_explicit_low_serial_not_deleted_by_retention(self, tmp_path):
+        """Regression: save_checkpoint(serial=0) with newer serials present
+        must not scroll-delete the checkpoint it just wrote."""
+        from paddle_tpu.trainer import load_checkpoint, save_checkpoint
+        from paddle_tpu import layers
+        x = layers.data(name="x", shape=[4])
+        layers.fc(x, size=2, name="rlfc")
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        prog = pt.default_main_program()
+        for expected in (0, 1, 2, 3):
+            s = save_checkpoint(exe, str(tmp_path), prog,
+                                trainer_args={"s": expected},
+                                max_num_checkpoints=3, sharded=True)
+            assert s == expected
+        # overwrite serial 0 explicitly — it must survive its own save
+        save_checkpoint(exe, str(tmp_path), prog, trainer_args={"s": 99},
+                        max_num_checkpoints=3, sharded=True, serial=0)
+        args = load_checkpoint(exe, str(tmp_path), prog, serial=0,
+                               sharded=True)
+        assert args == {"s": 99}
+
     def test_load_persistables_sharded_with_shardings(self, tmp_path):
         from paddle_tpu import layers
         x = layers.data(name="x", shape=[8])

@@ -115,6 +115,22 @@ def build_transformer(rng):
     return loss, feed, b * t, opt
 
 
+def build_transformer_nmt(rng):
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer
+    b, t = 16, 256
+    loss, _ = transformer.transformer(
+        src_vocab=16000, tgt_vocab=16000, max_len=t, d_model=512,
+        d_inner=2048, num_heads=8, num_layers=4, dropout=0.0)
+    feed = {"src": rng.randint(1, 16000, (b, t)).astype("int64"),
+            "src@SEQLEN": np.full((b,), t, "int32"),
+            "tgt": rng.randint(1, 16000, (b, t)).astype("int64"),
+            "tgt@SEQLEN": np.full((b,), t, "int32"),
+            "lbl": rng.randint(1, 16000, (b, t)).astype("int64")}
+    opt = pt.optimizer.AdamOptimizer(learning_rate=1e-4)
+    return loss, feed, b * t, opt
+
+
 def build_deepfm(rng):
     import paddle_tpu as pt
     from paddle_tpu.models import deepfm
@@ -137,6 +153,8 @@ def main():
                  "tokens/sec", iters),
         _measure("transformer_lm_6l_512d_bs16_T512_flash",
                  build_transformer, "tokens/sec", iters),
+        _measure("transformer_nmt_4l_512d_bs16_T256_flash",
+                 build_transformer_nmt, "tokens/sec", iters),
         _measure("deepfm_bs4096_vocab1M_sparse", build_deepfm,
                  "examples/sec", iters),
     ]

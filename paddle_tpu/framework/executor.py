@@ -170,6 +170,12 @@ class Executor:
         compiled.state_out_names = state_out_names
         return compiled
 
+    def _scan_shardings(self, program, feed_names, fetch_names, ro, rw,
+                        state_out_names):
+        """Hook for subclasses (ParallelExecutor) to shard the scan-fused
+        run_steps executable; None = let jax place everything locally."""
+        return None
+
     def _validate_fetches(self, program: Program, feed, fetch_names):
         block = program.global_block()
         defined = set(feed)
@@ -329,7 +335,13 @@ class Executor:
                 final_state = tuple(by_name[n] for n in state_out_names)
                 return fetches, final_state
 
-            fn = jax.jit(loop, donate_argnums=(2,))
+            jit_kwargs: Dict[str, Any] = {"donate_argnums": (2,)}
+            scan_sh = self._scan_shardings(program, feed_names, fetch_names,
+                                           ro, rw, state_out_names)
+            if scan_sh is not None:
+                jit_kwargs["in_shardings"] = scan_sh[0]
+                jit_kwargs["out_shardings"] = scan_sh[1]
+            fn = jax.jit(loop, **jit_kwargs)
             compiled = _CompiledStep(fn, ro, rw,
                                      list(feed_list[0].keys()), fetch_names)
             compiled.state_out_names = state_out_names

@@ -27,7 +27,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 
 import jax
 import numpy as np
-from jax.sharding import NamedSharding
+from jax.sharding import NamedSharding, PartitionSpec
 
 from ..core.enforce import InvalidArgumentError, enforce
 from ..framework.executor import Executor
@@ -111,12 +111,12 @@ class ParallelExecutor(Executor):
         return self.mesh.sharding(DATA_AXIS, *([None] * (len(shape) - 1)))
 
     # -- compile with shardings ------------------------------------------
-    def _compile(self, program: Program, scope: Scope, feed_names, fetch_names,
-                 in_shardings=None, out_shardings=None, analysis=None):
-        analysis = analysis or self._analyze_state(program, scope, feed_names,
-                                                   fetch_names)
-        ro, rw, out_only = analysis
-        state_out_names = sorted(set(rw) | set(out_only))
+    def _step_shardings(self, program, feed_names, fetch_names, ro, rw,
+                        state_out_names):
+        """The ONE place per-name placement policy lives: shardings for a
+        single step's (feeds, ro, rw, seed) inputs and (fetches, state)
+        outputs — both the single-step compile and the scan-fused
+        run_steps derive from it."""
         feed_shard = tuple(self._feed_sharding(program, n,
                                                self._feed_shapes.get(n))
                            for n in feed_names)
@@ -126,11 +126,66 @@ class ParallelExecutor(Executor):
         fetch_shard = tuple(repl for _ in fetch_names)
         state_out_shard = tuple(self._state_sharding(program, n)
                                 for n in state_out_names)
+        return ((feed_shard, ro_shard, rw_shard, repl),
+                (fetch_shard, state_out_shard))
+
+    def _compile(self, program: Program, scope: Scope, feed_names, fetch_names,
+                 in_shardings=None, out_shardings=None, analysis=None):
+        analysis = analysis or self._analyze_state(program, scope, feed_names,
+                                                   fetch_names)
+        ro, rw, out_only = analysis
+        state_out_names = sorted(set(rw) | set(out_only))
+        in_sh, out_sh = self._step_shardings(program, feed_names,
+                                             fetch_names, ro, rw,
+                                             state_out_names)
         return super()._compile(
             program, scope, feed_names, fetch_names,
-            in_shardings=(feed_shard, ro_shard, rw_shard, repl),
-            out_shardings=(fetch_shard, state_out_shard),
-            analysis=analysis)
+            in_shardings=in_sh, out_shardings=out_sh, analysis=analysis)
+
+    def _check_dp_divisible(self, feed):
+        for name, val in feed.items():
+            if np.ndim(val) >= 1:
+                bs = np.shape(val)[0]
+                enforce(bs % self._dp == 0,
+                        f"feed var {name!r} batch size {bs} is not divisible "
+                        f"by data-parallel degree {self._dp} "
+                        f"(≙ SplitLoDTensor batch split)",
+                        exc=InvalidArgumentError)
+
+    # -- scan-fused multi-step loop (run_steps) ---------------------------
+    def _scan_shardings(self, program, feed_names, fetch_names, ro, rw,
+                        state_out_names):
+        """Shardings for the run_steps executable: the single-step policy
+        (_step_shardings) with a replicated leading K (steps) axis shifted
+        onto the stacked feeds/fetches."""
+        def shift(ns: NamedSharding) -> NamedSharding:
+            return NamedSharding(self.mesh.jax_mesh,
+                                 PartitionSpec(None, *ns.spec))
+
+        ((feed_sh, ro_sh, rw_sh, seed_sh),
+         (fetch_sh, state_out_sh)) = self._step_shardings(
+            program, feed_names, fetch_names, ro, rw, state_out_names)
+        return ((tuple(shift(f) for f in feed_sh), ro_sh, rw_sh, seed_sh),
+                (tuple(shift(f) for f in fetch_sh), state_out_sh))
+
+    def run_steps(self, feed_list, fetch_list=None, program=None,
+                  scope=None, return_numpy=True):
+        """Scan-fused K-step loop over the mesh (see Executor.run_steps);
+        each step's feed batch is dp-sharded exactly as in run()."""
+        if self._spans_processes():
+            raise NotImplementedError(
+                "run_steps across processes is not supported yet — use "
+                "per-step ParallelExecutor.run in multi-process worlds")
+        program = program or self.main_program or default_main_program()
+        scope = scope or self.scope
+        enforce(len(feed_list) >= 1, "run_steps needs at least one feed",
+                exc=InvalidArgumentError)
+        self._check_dp_divisible(feed_list[0])
+        self._feed_shapes = {n: np.shape(v)
+                             for n, v in feed_list[0].items()}
+        return super().run_steps(feed_list, fetch_list=fetch_list,
+                                 program=program, scope=scope,
+                                 return_numpy=return_numpy)
 
     # -- multi-process state/feed placement -------------------------------
     def _spans_processes(self) -> bool:
@@ -174,14 +229,7 @@ class ParallelExecutor(Executor):
         program = program or self.main_program or default_main_program()
         scope = scope or self.scope
         feed = dict(feed or {})
-        for name, val in feed.items():
-            if np.ndim(val) >= 1:
-                bs = np.shape(val)[0]
-                enforce(bs % self._dp == 0,
-                        f"feed var {name!r} batch size {bs} is not divisible "
-                        f"by data-parallel degree {self._dp} "
-                        f"(≙ SplitLoDTensor batch split)",
-                        exc=InvalidArgumentError)
+        self._check_dp_divisible(feed)
         # stash shapes so _compile can build feed shardings without
         # re-plumbing the Executor.run signature.
         self._feed_shapes = {n: np.shape(v) for n, v in feed.items()}

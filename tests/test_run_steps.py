@@ -100,6 +100,49 @@ class TestRunSteps:
         assert curve[-1] < curve[0]
 
 
+class TestParallelExecutorRunSteps:
+    def test_pe_run_steps_matches_pe_sequential(self):
+        import jax
+        from paddle_tpu.parallel import DeviceMesh, ParallelExecutor
+        feeds = _feeds(6)
+        loss = _build_net()
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        pe = ParallelExecutor(loss_name=loss.name,
+                              mesh=DeviceMesh(jax.devices()))
+        seq = [float(pe.run(feed=f, fetch_list=[loss.name])[0])
+               for f in feeds]
+        w_seq = np.asarray(pt.global_scope().get("rs_fc1.w_0"))
+
+        pt.reset_global_scope()
+        exe2 = pt.Executor()
+        exe2.run(pt.default_startup_program())
+        pe2 = ParallelExecutor(loss_name=loss.name,
+                               mesh=DeviceMesh(jax.devices()))
+        fused = pe2.run_steps(feeds, fetch_list=[loss.name])[0]
+        np.testing.assert_allclose(fused, seq, rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(pt.global_scope().get("rs_fc1.w_0")), w_seq,
+            rtol=1e-5)
+        # the fused-loop state really lives sharded/replicated on the mesh
+        w = pt.global_scope().get("rs_fc1.w_0")
+        assert len(w.sharding.device_set) == len(jax.devices())
+
+    def test_pe_run_steps_rejects_indivisible_batch(self):
+        import jax
+        from paddle_tpu.parallel import DeviceMesh, ParallelExecutor
+        loss = _build_net()
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        pe = ParallelExecutor(loss_name=loss.name,
+                              mesh=DeviceMesh(jax.devices()))
+        bad = [{"x": np.ones((7, 6), np.float32),
+                "y": np.ones((7, 1), np.float32)}]
+        with pytest.raises(Exception) as ei:
+            pe.run_steps(bad, fetch_list=[loss.name])
+        assert "divisible" in str(ei.value)
+
+
 if __name__ == "__main__":
     import sys
     sys.exit(pytest.main([__file__, "-x", "-q"]))

@@ -129,6 +129,61 @@ def _resnet_throughput(batch: int, iters: int):
             (exe, loss))
 
 
+def _best_of(n_windows: int, window_fn):
+    """max of n timing windows (tunnel load swings ~2x between sessions;
+    the fastest window is the least-interfered estimate of the chip)."""
+    best = None
+    for _ in range(n_windows):
+        rate = window_fn()
+        best = rate if best is None else max(best, rate)
+    return best
+
+
+def _resnet_infer_throughput(batch: int = 16, iters: int = 30):
+    """Inference img/s (is_test graph, batch-stat-free BN): the reference
+    publishes ResNet-50 INFER bs16 = 217.69 img/s as its best in-repo
+    number (reference benchmark/IntelOptimizedPaddle.md:81-87).
+
+    Sync discipline: inference steps have no parameter-update chain, so a
+    data dependency is created explicitly — step k's input derives from
+    step k-1's output — making the final realization bound every timed
+    step (same reasoning as the train bench; independent dispatches
+    through the tunnel must not be trusted to complete in order)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from paddle_tpu import models
+
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    img = pt.layers.data(name="img", shape=[224, 224, 3],
+                         staging_dtype="uint8")
+    loss, acc, logits = models.resnet.resnet_imagenet(
+        img=img, depth=50, is_test=True, data_format="NHWC", use_bf16=True)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(3)
+    img0 = jnp.asarray(rng.rand(batch, 224, 224, 3).astype("float32"))
+    label = jnp.asarray(rng.randint(0, 1000, (batch, 1)).astype("int64"))
+    out = exe.run(feed={"img": img0, "label": label}, fetch_list=[logits],
+                  return_numpy=False)
+    float(out[0][0, 0])
+
+    def window():
+        cur = img0
+        t0 = time.time()
+        out = None
+        for _ in range(iters):
+            out = exe.run(feed={"img": cur, "label": label},
+                          fetch_list=[logits], return_numpy=False)
+            # negligible (1e-30-scaled) but real dependency on the output
+            cur = img0 + out[0][0, 0].astype(jnp.float32) * 1e-30
+        float(out[0][0, 0])
+        return batch * iters / (time.time() - t0)
+
+    return _best_of(3, window)
+
+
 def _h2d_bandwidth_mbps(batch: int) -> float:
     """Host->device staging bandwidth for one image batch (the prefetcher
     variant is bounded by this; through the dev tunnel it is network-limited,
@@ -254,6 +309,7 @@ def main():
         alt_bs, iters)
     pf_imgs_s = _resnet_prefetcher_throughput(alt_bs, iters, alt_exe,
                                               alt_loss)
+    infer_bs16 = _resnet_infer_throughput(16, 30 if on_accel else 3)
     h2d_mbps = _h2d_bandwidth_mbps(alt_bs)
     flash_speedup = _flash_attention_speedup() if on_accel else None
 
@@ -298,6 +354,13 @@ def main():
         "step_time_breakdown": breakdown,
         f"images_per_sec_bs{alt_bs}": round(alt_imgs_s, 2),
         f"prefetcher_fed_images_per_sec_bs{alt_bs}": round(pf_imgs_s, 2),
+        # the framework-controlled part of the fed number (the link speed
+        # h2d_staging_MBps below varies wildly session to session on the
+        # dev tunnel): uint8 staging ships 1/4 of the fp32 bytes per image
+        "staged_wire_bytes_per_image": 224 * 224 * 3,
+        "fp32_wire_bytes_per_image": 224 * 224 * 3 * 4,
+        "infer_images_per_sec_bs16": round(infer_bs16, 2),
+        "infer_vs_reference_best_217.69": round(infer_bs16 / 217.69, 3),
         "h2d_staging_MBps": round(h2d_mbps, 1),
         "flash_attention_fwd_bwd_speedup_vs_xla_T8192": flash_speedup,
     }

@@ -1,0 +1,119 @@
+"""Classic-CNN training throughput vs the reference's OWN published
+baselines (reference benchmark/IntelOptimizedPaddle.md:29-65 — its best
+in-repo training numbers): VGG-19 30.44 img/s and GoogLeNet 269.50 img/s,
+both bs256 on a 2-socket Xeon 6148.
+
+    env PYTHONPATH=/root/.axon_site:/root/repo \
+        python tools/bench_classics.py | tee BENCH_CLASSICS_r03.json
+
+Same audit fields + sync discipline as bench.py / bench_breadth.py.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+_REFERENCE_BEST = {"vgg19": 30.44, "googlenet": 269.50}
+
+
+def _measure_cnn(name, build_loss, batch, img_shape, iters=15):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    rng = np.random.RandomState(0)
+    with pt.core.unique_name.guard():
+        loss = build_loss()
+        pt.optimizer.MomentumOptimizer(learning_rate=3e-3,
+                                       momentum=0.9).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    feed = {
+        "img": jnp.asarray(rng.rand(*img_shape).astype("float32")),
+        "label": jnp.asarray(rng.randint(0, 1000, (batch, 1))
+                             .astype("int64")),
+    }
+    out = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
+    float(np.asarray(out[0]).ravel()[0])
+
+    # shared best-of-N discipline (bench._best_of); losses tracked across
+    # ALL windows so the work-verification property holds
+    import sys as _sys
+    import os as _os
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+    from bench import _best_of
+
+    losses = []
+
+    def window():
+        fetched = []
+        t0 = time.time()
+        for _ in range(iters):
+            out = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
+            fetched.append(out[0])
+        float(np.asarray(fetched[-1]).ravel()[0])
+        w = time.time() - t0
+        losses.extend(float(np.asarray(x).ravel()[0]) for x in fetched)
+        return iters / w  # steps/sec; best window = least interference
+
+    steps_per_sec = _best_of(3, window)
+    dt = iters / steps_per_sec
+
+    ca = exe.cost_analysis(feed=feed, fetch_list=[loss])
+    flops = float(ca.get("flops", 0.0)) if ca else 0.0
+    dev = jax.devices()[0]
+    imgs_s = batch * iters / dt
+    ref = _REFERENCE_BEST.get(name)
+    rec = {
+        "model": f"{name}_train_bs{batch}",
+        "value": round(imgs_s, 2),
+        "unit": "images/sec",
+        "vs_reference_best": round(imgs_s / ref, 2) if ref else None,
+        "evidence": {
+            "device_kind": getattr(dev, "device_kind", str(dev)),
+            "reference_best_images_per_sec": ref,
+            "step_ms": round(dt / iters * 1e3, 2),
+            "flops_per_step_xla": flops,
+            "implied_tflops": round(flops * iters / dt / 1e12, 2),
+            "loss_first": round(losses[0], 4),
+            "loss_last": round(losses[-1], 4),
+            "loss_decreased": bool(losses[-1] < losses[0]),
+        },
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def main():
+    import jax
+    from paddle_tpu import models
+    on_accel = jax.devices()[0].platform != "cpu"
+    batch = 128 if on_accel else 4
+    iters = 15 if on_accel else 2
+
+    def vgg():
+        # vgg builds NCHW fp32 (the model's reference-mirroring layout)
+        loss, acc, _ = models.vgg.vgg(depth=19, is_test=False)
+        return loss
+
+    def goog():
+        loss, acc, _ = models.googlenet.googlenet_imagenet(
+            is_test=False, data_format="NHWC", use_bf16=True)
+        return loss
+
+    recs = [_measure_cnn("vgg19", vgg, batch, (batch, 3, 224, 224), iters),
+            _measure_cnn("googlenet", goog, batch, (batch, 224, 224, 3),
+                         iters)]
+    print(json.dumps({"all_losses_decreased":
+                      all(r["evidence"]["loss_decreased"] for r in recs)}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -176,6 +176,11 @@ class Executor:
         run_steps executable; None = let jax place everything locally."""
         return None
 
+    def _place_feed_stack(self, program, name, vals):
+        """Hook: stack K per-step feed values for run_steps. Subclasses
+        override to place the stack on a (possibly cross-process) mesh."""
+        return jnp.stack([jnp.asarray(v) for v in vals])
+
     def _validate_fetches(self, program: Program, feed, fetch_names):
         block = program.global_block()
         defined = set(feed)
@@ -348,7 +353,7 @@ class Executor:
             self._cache[key] = compiled
 
         feed_stacks = tuple(
-            jnp.stack([jnp.asarray(f[n]) for f in feed_list])
+            self._place_feed_stack(program, n, [f[n] for f in feed_list])
             for n in compiled.feed_names)
         ro_vals = tuple(scope.get(n) for n in compiled.ro_names)
         rw_vals = tuple(scope.get(n) for n in compiled.rw_names)

@@ -26,6 +26,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
@@ -153,29 +154,32 @@ class ParallelExecutor(Executor):
                         exc=InvalidArgumentError)
 
     # -- scan-fused multi-step loop (run_steps) ---------------------------
+    def _shift_scan_axis(self, ns: NamedSharding) -> NamedSharding:
+        """Per-step sharding -> stacked sharding: replicated leading K
+        (steps) axis. The ONE place the scan-axis placement lives."""
+        return NamedSharding(self.mesh.jax_mesh,
+                             PartitionSpec(None, *ns.spec))
+
     def _scan_shardings(self, program, feed_names, fetch_names, ro, rw,
                         state_out_names):
         """Shardings for the run_steps executable: the single-step policy
-        (_step_shardings) with a replicated leading K (steps) axis shifted
-        onto the stacked feeds/fetches."""
-        def shift(ns: NamedSharding) -> NamedSharding:
-            return NamedSharding(self.mesh.jax_mesh,
-                                 PartitionSpec(None, *ns.spec))
-
+        (_step_shardings) with the scan axis shifted onto the stacked
+        feeds/fetches."""
         ((feed_sh, ro_sh, rw_sh, seed_sh),
          (fetch_sh, state_out_sh)) = self._step_shardings(
             program, feed_names, fetch_names, ro, rw, state_out_names)
+        shift = self._shift_scan_axis
         return ((tuple(shift(f) for f in feed_sh), ro_sh, rw_sh, seed_sh),
                 (tuple(shift(f) for f in fetch_sh), state_out_sh))
 
     def run_steps(self, feed_list, fetch_list=None, program=None,
                   scope=None, return_numpy=True):
         """Scan-fused K-step loop over the mesh (see Executor.run_steps);
-        each step's feed batch is dp-sharded exactly as in run()."""
-        if self._spans_processes():
-            raise NotImplementedError(
-                "run_steps across processes is not supported yet — use "
-                "per-step ParallelExecutor.run in multi-process worlds")
+        each step's feed batch is dp-sharded exactly as in run(). Works
+        across processes too: state is globalized first and each stacked
+        feed (the K global batches, identical on every process) is placed
+        with its scan sharding, each process materializing only its
+        addressable shards."""
         program = program or self.main_program or default_main_program()
         scope = scope or self.scope
         enforce(len(feed_list) >= 1, "run_steps needs at least one feed",
@@ -183,9 +187,32 @@ class ParallelExecutor(Executor):
         self._check_dp_divisible(feed_list[0])
         self._feed_shapes = {n: np.shape(v)
                              for n, v in feed_list[0].items()}
+        if self._spans_processes():
+            self._globalize_state(program, scope)
         return super().run_steps(feed_list, fetch_list=fetch_list,
                                  program=program, scope=scope,
                                  return_numpy=return_numpy)
+
+    def _place_feed_stack(self, program, name, vals):
+        """Stack K per-step feed values; in a cross-process world place the
+        (identical-on-every-process) host stack with its scan sharding so
+        each process materializes only its addressable shards. Local runs
+        keep the base (device-side) stacking — no host round trip."""
+        if not self._spans_processes():
+            return super()._place_feed_stack(program, name, vals)
+        for v in vals:
+            sh = getattr(v, "sharding", None)
+            if sh is not None and not sh.is_fully_addressable:
+                raise NotImplementedError(
+                    f"run_steps feed {name!r} is already a global array; "
+                    f"feed host values (the global batch, identical on "
+                    f"every process) or use per-step run() for "
+                    f"pre-placed feeds")
+        stack = np.stack([np.asarray(v) for v in vals])
+        return jax.device_put(
+            stack,
+            self._shift_scan_axis(self._feed_sharding(
+                program, name, self._feed_shapes.get(name))))
 
     # -- multi-process state/feed placement -------------------------------
     def _spans_processes(self) -> bool:

@@ -129,7 +129,7 @@ _PE_MODEL = r"""
 import numpy as np
 
 
-def build_and_train(steps=6, reduce_strategy=False):
+def build_and_train(steps=6, reduce_strategy=False, fused=False):
     import paddle_tpu as pt
     from paddle_tpu import layers
     from paddle_tpu.parallel import (BuildStrategy, DeviceMesh,
@@ -157,14 +157,17 @@ def build_and_train(steps=6, reduce_strategy=False):
 
     r = np.random.RandomState(7)
     W = r.randn(8, 1).astype("float32")
-    losses = []
+    feeds = []
     for i in range(steps):
         rb = np.random.RandomState(100 + i)
         xb = rb.rand(16, 8).astype("float32")          # global batch
-        yb = (xb @ W).astype("float32")
-        losses.append(float(pe.run(feed={"x": xb, "y": yb},
-                                   fetch_list=[loss.name])[0]))
-    return losses
+        feeds.append({"x": xb, "y": (xb @ W).astype("float32")})
+    if fused:
+        # scan-fused multi-step loop over the cross-process mesh
+        return [float(v) for v in
+                pe.run_steps(feeds, fetch_list=[loss.name])[0]]
+    return [float(pe.run(feed=f, fetch_list=[loss.name])[0])
+            for f in feeds]
 """
 
 _PE_SINGLE = r"""
@@ -190,6 +193,8 @@ out = {"rank": env.trainer_id, "plain": build_and_train()}
 import paddle_tpu as pt
 pt.reset_default_programs(); pt.reset_global_scope()
 out["zero1"] = build_and_train(reduce_strategy=True)
+pt.reset_default_programs(); pt.reset_global_scope()
+out["fused"] = build_and_train(fused=True)
 print(json.dumps(out), flush=True)
 """
 
@@ -230,6 +235,11 @@ def test_multiprocess_parallel_executor_loss_parity(tmp_path):
         results[rec["rank"]] = rec
 
     assert set(results) == {0, 1}
+    # the scan-fused multi-process loop matches the per-step trajectory
+    np.testing.assert_allclose(results[0]["fused"], results[0]["plain"],
+                               rtol=2e-4)
+    np.testing.assert_allclose(results[0]["fused"], results[1]["fused"],
+                               rtol=1e-6)
     for variant in ("plain", "zero1"):
         # both ranks observe the identical (replicated-fetch) trajectory
         np.testing.assert_allclose(results[0][variant], results[1][variant],

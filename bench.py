@@ -141,6 +141,19 @@ def _best_of(n_windows: int, window_fn):
     return best
 
 
+def interleaved_best(runners: dict, rounds: int = 3) -> dict:
+    """{name: run_fn} -> {name: min seconds} over alternating rounds.
+    Tunnel throughput drifts between windows; interleaving + per-side best
+    keeps A/B comparisons fair (shared by the flash micro and
+    tools/bench_longctx.py)."""
+    best = {k: None for k in runners}
+    for _ in range(rounds):
+        for name, run in runners.items():
+            dt = run()
+            best[name] = dt if best[name] is None else min(best[name], dt)
+    return best
+
+
 def _resnet_infer_throughput(batch: int = 16, iters: int = 30):
     """Inference img/s (is_test graph, batch-stat-free BN): the reference
     publishes ResNet-50 INFER bs16 = 217.69 img/s as its best in-repo
@@ -270,26 +283,44 @@ def _flash_attention_speedup(seq_len: int = 8192, heads: int = 8,
         return jnp.sum(pk._attention_reference(q, k, v, scale, causal=True)
                        .astype(jnp.float32))
 
-    def timed(fn):
+    def make(fn):
         g = jax.jit(jax.grad(fn, argnums=(0, 1, 2)))
         out = g(q, k, v)
         float(out[0][0, 0, 0, 0])  # compile + drain (realization barrier)
-        t0 = time.time()
-        for _ in range(5):
-            out = g(q, k, v)
-        float(out[0][0, 0, 0, 0])  # device queue is FIFO: bounds all 5
-        return (time.time() - t0) / 5
+
+        def run():
+            t0 = time.time()
+            for _ in range(5):
+                out = g(q, k, v)
+            float(out[0][0, 0, 0, 0])  # device queue FIFO: bounds all 5
+            return (time.time() - t0) / 5
+        return run
 
     try:
-        t_flash = timed(loss_flash)
+        run_flash = make(loss_flash)
     except Exception as e:
         # surface the failure in the evidence — a broken kernel must not
         # silently read as "unavailable on this backend"
         return f"flash_error: {e!r:.120}"
     try:
-        t_ref = timed(loss_ref)
+        run_ref = make(loss_ref)
     except Exception:
         return "xla_oom"  # composite cannot even run at this T
+    # interleaved rounds: tunnel throughput drifts between windows, and a
+    # sequential flash-then-composite measurement can flip the ratio in
+    # either direction; alternating rounds + per-side best cancels it
+    t_flash = t_ref = None
+    try:
+        for _ in range(3):
+            tf, tr = run_flash(), run_ref()
+            t_flash = tf if t_flash is None else min(t_flash, tf)
+            t_ref = tr if t_ref is None else min(t_ref, tr)
+    except Exception:
+        # a mid-measurement OOM (allocation drift) must degrade to the
+        # documented marker, not abort the whole benchmark
+        if t_flash is None:
+            return "flash_error: runtime"
+        return "xla_oom"
     return round(t_ref / t_flash, 3)
 
 

@@ -169,6 +169,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, qseg_ref, kvseg_ref, o_ref, lse_ref,
             lse_ref[0] = jnp.broadcast_to(lse, lse_ref[0].shape)
 
 
+
+def _clamp_block(block, t):
+    """Block size actually used for length t: the requested block, clamped
+    to t rounded UP to a 128 multiple. Keeps every block shape
+    Mosaic-legal (128 | bq, bk) for ANY sequence length — the sequence is
+    padded up to the block multiple and the padding masked/sliced — and
+    guarantees the segment-id tiling precondition (128 | bk) by
+    construction."""
+    return min(block, -(-t // 128) * 128)
+
+
 def _pad_to(x, axis, target):
     pad = [(0, 0)] * x.ndim
     pad[axis] = (0, target - x.shape[axis])
@@ -201,8 +212,8 @@ def _flash_attention_pallas(q, k, v, scale, causal, block_q, block_k,
     q_ids, kv_ids = _normalize_segment_ids(segment_ids, q, k)
     B, H, T, D = q.shape
     Tk = k.shape[2]
-    bq = min(block_q, T)
-    bk = min(block_k, Tk)
+    bq = _clamp_block(block_q, T)
+    bk = _clamp_block(block_k, Tk)
     # round sequence lengths up to block multiples: padded queries are
     # sliced off, padded keys are masked dead inside the kernel
     Tp = -(-T // bq) * bq
@@ -372,8 +383,8 @@ def _flash_attention_bwd_pallas(q, k, v, o, lse, do, scale, causal,
     q_ids, kv_ids = _normalize_segment_ids(segment_ids, q, k)
     B, H, T, D = q.shape
     Tk = k.shape[2]
-    bq = min(block_q, T)
-    bk = min(block_k, Tk)
+    bq = _clamp_block(block_q, T)
+    bk = _clamp_block(block_k, Tk)
     Tp = -(-T // bq) * bq
     Tkp = -(-Tk // bk) * bk
     nq, nk = Tp // bq, Tkp // bk
@@ -464,8 +475,8 @@ def _flash_attention_bwd_pallas(q, k, v, o, lse, do, scale, causal,
             dv[:, :Tk].reshape(B, H, Tk, D))
 
 
-def flash_attention(q, k, v, scale=None, causal=False, block_q=128,
-                    block_k=128, backend=None, segment_ids=None):
+def flash_attention(q, k, v, scale=None, causal=False, block_q=512,
+                    block_k=1024, backend=None, segment_ids=None):
     """Fused multi-head attention. q/k/v: [B, H, T, D].
 
     backend: None = auto (pallas on TPU, XLA composite elsewhere);
@@ -491,7 +502,7 @@ def flash_attention(q, k, v, scale=None, causal=False, block_q=128,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def _fused_attention(q, k, v, segment_ids, scale, causal, backend,
-                     block_q=128, block_k=128):
+                     block_q=512, block_k=1024):
     if backend == "xla":
         return _attention_reference(q, k, v, scale, causal, segment_ids)
     return _flash_attention_pallas(q, k, v, scale, causal, block_q, block_k,
@@ -500,7 +511,7 @@ def _fused_attention(q, k, v, segment_ids, scale, causal, backend,
 
 
 def _fused_attention_fwd(q, k, v, segment_ids, scale, causal, backend,
-                         block_q=128, block_k=128):
+                         block_q=512, block_k=1024):
     if backend == "xla":
         out = _attention_reference(q, k, v, scale, causal, segment_ids)
         return out, (q, k, v, segment_ids, None, None)

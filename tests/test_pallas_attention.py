@@ -316,6 +316,25 @@ class TestSegmentIds:
                               segment_ids=(q_ids, kv_ids))
         np.testing.assert_allclose(got, ref, atol=2e-5, rtol=2e-5)
 
+    def test_default_blocks_with_midrange_lengths(self, rng):
+        """Regression: the 512/1024 default blocks must clamp to
+        128-multiples for sequence lengths like 200/300 (a raw min() gave
+        Mosaic-illegal ragged block shapes and broke the segment-id
+        tiling precondition)."""
+        from paddle_tpu.ops.pallas_kernels import _clamp_block
+        assert _clamp_block(512, 300) == 384      # 128-multiple, >= T
+        assert _clamp_block(1024, 200) == 256
+        assert _clamp_block(512, 8192) == 512     # big T: full block
+        assert _clamp_block(32, 64) == 32         # explicit small blocks
+        q, k, v = _qkv(rng, B=1, H=2, T=300, D=16)
+        seg = self._ragged_pack(rng, 1, 300)
+        ref = flash_attention(q, k, v, causal=True, backend="xla",
+                              segment_ids=seg)
+        # default (unspecified) blocks through the interpret kernel
+        got = flash_attention(q, k, v, causal=True,
+                              backend="pallas_interpret", segment_ids=seg)
+        np.testing.assert_allclose(got, ref, atol=2e-5, rtol=2e-5)
+
     def test_layer_routes_segment_ids(self, rng):
         """layers.fused_attention(segment_ids=...) lowers and runs."""
         import paddle_tpu as pt

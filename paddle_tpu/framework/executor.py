@@ -27,7 +27,8 @@ from ..core import flags
 from ..core.enforce import InvalidArgumentError, NotFoundError, enforce
 from ..core.places import Place, default_place
 from .lowering import LowerCtx, build_plan, run_plan
-from .program import Program, Variable, default_main_program
+from .program import (BATCH_ROW_MASK_NAME, Program, Variable,
+                      default_main_program)
 from .scope import Scope, global_scope
 
 
@@ -136,6 +137,16 @@ class Executor:
                 if (var is not None and var.staging is not None
                         and hasattr(val, "dtype")
                         and str(val.dtype) != str(var.dtype)):
+                    # de-quantize ONLY the declared wire dtype; any other
+                    # mismatch is a caller bug and silently scaling it
+                    # (e.g. int32 ones -> 0.0039) would corrupt the feed.
+                    # float64 is exempt: jnp.asarray canonicalizes it to
+                    # float32 before the step ever sees it.
+                    if str(val.dtype) != str(var.staging[0]):
+                        raise TypeError(
+                            f"feed '{name}' has dtype {val.dtype} but the "
+                            f"var is declared {var.dtype} with staging "
+                            f"dtype {var.staging[0]}; feed either of those")
                     val = val.astype(var.dtype)
                     if var.staging[1] is not None:
                         val = val * jnp.asarray(var.staging[1], var.dtype)
@@ -192,6 +203,53 @@ class Executor:
                     f"fetch target {name!r} is not produced by the program "
                     f"and not fed")
 
+    _isfinite_all_jit = None
+
+    def _sweep_nonfinite(self, pairs, hint: str):
+        """Raise FloatingPointError if any floating value in (name, value)
+        pairs is non-finite. For global non-fully-addressable arrays
+        (multi-process worlds) the check is a tiny jitted SPMD reduction
+        that EVERY process executes and whose replicated result every
+        process reads — so all processes reach the same verdict and raise
+        together, instead of one process raising while its peers block in
+        the next step's collectives."""
+        cls = type(self)
+        for name, val in pairs:
+            if not (hasattr(val, "dtype")
+                    and jnp.issubdtype(val.dtype, jnp.floating)):
+                continue
+            if getattr(val, "is_fully_addressable", True):
+                ok = bool(jnp.isfinite(val).all())
+            else:
+                if cls._isfinite_all_jit is None:
+                    cls._isfinite_all_jit = jax.jit(
+                        lambda a: jnp.isfinite(a).all())
+                ok = bool(cls._isfinite_all_jit(val))
+            if not ok:
+                raise FloatingPointError(
+                    f"NaN/Inf detected in {name!r} (fetch-time sweep; "
+                    f"{hint})")
+
+    def _synthesize_batch_mask(self, program: Program,
+                               feed: Dict[str, Any]) -> Dict[str, Any]:
+        """If the program declares the reserved batch-row-mask data var
+        (layers.batch_row_mask) and the caller didn't feed it, feed all-ones
+        of the batch length: every row of a directly-run batch is real.
+        ParallelExecutor overrides the synthesized value with zeros on rows
+        it pads for dp divisibility."""
+        block = program.global_block()
+        if (BATCH_ROW_MASK_NAME not in block.vars
+                or BATCH_ROW_MASK_NAME in feed):
+            return feed
+        bs = None
+        for v in feed.values():
+            if np.ndim(v) >= 1:
+                bs = np.shape(v)[0]
+                break
+        if bs is not None:
+            feed[BATCH_ROW_MASK_NAME] = np.ones((bs,), np.float32)
+        return feed
+
     def _lookup_or_compile(self, program: Program, feed: Dict[str, Any],
                            fetch_names, scope: Scope) -> _CompiledStep:
         """Validate fetch targets and return the cached compiled step for
@@ -222,7 +280,7 @@ class Executor:
         """≙ Executor.run (reference executor.py:374-473). Missing fetch vars
         raise; feed arrays are validated against declared var dtypes."""
         program = program or default_main_program()
-        feed = dict(feed or {})
+        feed = self._synthesize_batch_mask(program, dict(feed or {}))
         fetch_list = list(fetch_list or [])
         scope = scope or global_scope()
         fetch_names = [f.name if isinstance(f, Variable) else f
@@ -252,15 +310,11 @@ class Executor:
             # guard — it names WHICH var went bad but not which op; rerun
             # under JAX_PLATFORMS=cpu to localize. ≙ reference
             # CheckTensorNANOrInf (framework/operator.cc:726-736).
-            for name, val in list(zip(compiled.fetch_names, fetches)) + \
-                    list(zip(compiled.state_out_names, new_state)):
-                if hasattr(val, "dtype") and jnp.issubdtype(
-                        val.dtype, jnp.floating):
-                    if not bool(jnp.isfinite(val).all()):
-                        raise FloatingPointError(
-                            f"NaN/Inf detected in {name!r} (fetch-time "
-                            f"sweep; rerun under JAX_PLATFORMS=cpu with "
-                            f"PTPU_CHECK_NAN_INF=1 to localize the op)")
+            self._sweep_nonfinite(
+                list(zip(compiled.fetch_names, fetches)) +
+                list(zip(compiled.state_out_names, new_state)),
+                "rerun under JAX_PLATFORMS=cpu with PTPU_CHECK_NAN_INF=1 "
+                "to localize the op")
         for name, val in zip(compiled.state_out_names, new_state):
             scope.set_var(name, val)
         if flags.get_flag("benchmark"):
@@ -290,7 +344,9 @@ class Executor:
         curve). Updated persistable state is written back once, from the
         final step.
         """
-        feed_list = [dict(f) for f in feed_list]
+        program = program or default_main_program()
+        feed_list = [self._synthesize_batch_mask(program, dict(f))
+                     for f in feed_list]
         enforce(len(feed_list) >= 1, "run_steps needs at least one feed",
                 exc=InvalidArgumentError)
         sig0 = _feed_signature(feed_list[0])
@@ -299,7 +355,6 @@ class Executor:
                     "run_steps feeds must share one signature "
                     "(same names, shapes, dtypes)",
                     exc=InvalidArgumentError)
-        program = program or default_main_program()
         scope = scope or global_scope()
         fetch_names = [f.name if isinstance(f, Variable) else f
                        for f in (fetch_list or [])]
@@ -368,16 +423,11 @@ class Executor:
             # same contract as run(): sweep BEFORE the scope write-back so
             # the last-good parameters stay checkpointable when a step in
             # the fused window diverges
-            for name, val in list(zip(compiled.fetch_names, fetches)) + \
-                    list(zip(compiled.state_out_names, final_state)):
-                if hasattr(val, "dtype") and jnp.issubdtype(
-                        val.dtype, jnp.floating):
-                    if not bool(jnp.isfinite(val).all()):
-                        raise FloatingPointError(
-                            f"NaN/Inf detected in {name!r} during "
-                            f"run_steps (fetch-time sweep; rerun the "
-                            f"window step-by-step under JAX_PLATFORMS=cpu "
-                            f"with PTPU_CHECK_NAN_INF=1 to localize)")
+            self._sweep_nonfinite(
+                list(zip(compiled.fetch_names, fetches)) +
+                list(zip(compiled.state_out_names, final_state)),
+                "rerun the window step-by-step under JAX_PLATFORMS=cpu "
+                "with PTPU_CHECK_NAN_INF=1 to localize")
         for name, val in zip(compiled.state_out_names, final_state):
             scope.set_var(name, val)
         if return_numpy:

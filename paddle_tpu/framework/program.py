@@ -29,6 +29,14 @@ from ..core.dtypes import convert_dtype, dtype_name
 from ..core.enforce import (AlreadyExistsError, InvalidArgumentError,
                             NotFoundError, enforce)
 
+# Reserved data-var name for the per-row batch validity mask (1.0 = real row,
+# 0.0 = padding added to make a partial batch dp-divisible). Declared via
+# layers.batch_row_mask(); the Executor feeds all-ones when the program
+# declares it and the caller didn't feed it, and ParallelExecutor zeroes the
+# rows it pads (≙ reference details/data_balance_op_handle.cc, whose job is
+# making uneven last batches runnable across devices).
+BATCH_ROW_MASK_NAME = "@batch_row_mask"
+
 
 class Variable:
     """A named tensor slot in a block (≙ VarDesc + fluid.framework.Variable,

@@ -11,7 +11,7 @@ from .control_flow import (DynamicRNN, IfElse, StaticRNN, Switch,  # noqa: F401
                            While, cond, equal, greater_equal, greater_than,
                            increment, less_equal, less_than, not_equal)
 from .device import get_places  # noqa: F401
-from .io import data  # noqa: F401
+from .io import batch_row_mask, data  # noqa: F401
 from .sequence import (chunk_eval, crf_decoding,  # noqa: F401
                        ctc_greedy_decoder, dynamic_gru, dynamic_lstm,
                        linear_chain_crf, sequence_concat,

@@ -12,7 +12,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..core.dtypes import convert_dtype
-from ..framework.program import default_main_program
+from ..framework.program import BATCH_ROW_MASK_NAME, default_main_program
 
 
 def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
@@ -49,6 +49,24 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
         block.create_var(name=name + "@SEQLEN", shape=[-1], dtype="int32",
                          is_data=True, stop_gradient=True)
     return var
+
+
+def batch_row_mask():
+    """Declare the per-row batch validity mask: [batch] float32, 1.0 for
+    real rows, 0.0 for rows a ParallelExecutor padded to make a partial
+    last batch dp-divisible (≙ reference details/data_balance_op_handle.cc,
+    which redistributes uneven reader batches so every device can run).
+
+    Feeding is automatic: Executor feeds all-ones when the caller doesn't;
+    ParallelExecutor zeroes padded rows. Weight per-example losses with it —
+    ``loss = reduce_sum(per_ex * mask) / reduce_sum(mask)`` — so padded rows
+    contribute exactly nothing to the gradient."""
+    block = default_main_program().current_block()
+    if BATCH_ROW_MASK_NAME in block.vars:
+        return block.vars[BATCH_ROW_MASK_NAME]
+    return block.create_var(name=BATCH_ROW_MASK_NAME, shape=[-1],
+                            dtype="float32", is_data=True,
+                            stop_gradient=True)
 
 
 # ---------------------------------------------------------------------------

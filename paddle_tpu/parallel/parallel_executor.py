@@ -143,15 +143,76 @@ class ParallelExecutor(Executor):
             program, scope, feed_names, fetch_names,
             in_shardings=in_sh, out_shardings=out_sh, analysis=analysis)
 
-    def _check_dp_divisible(self, feed):
+    def _pad_for_dp(self, program, feed):
+        """Make a partial batch runnable: pad every batch-dim feed up to the
+        next dp multiple by wrapping real rows (in-distribution values — no
+        NaN bait), and zero the padded rows of the batch-row mask so a
+        mask-weighted loss counts real rows only (≙ reference
+        details/data_balance_op_handle.cc redistributing uneven reader
+        batches). Returns (feed, real_rows, padded_rows) — real==padded
+        means the feed was already divisible and untouched."""
+        from ..framework.program import BATCH_ROW_MASK_NAME
+        sizes = {np.shape(v)[0] for v in feed.values() if np.ndim(v) >= 1}
+        if not sizes:
+            return feed, None, None
+        enforce(len(sizes) == 1,
+                f"feed batch dims disagree across vars: {sorted(sizes)} "
+                f"(≙ SplitLoDTensor batch split needs one batch size)",
+                exc=InvalidArgumentError)
+        b = sizes.pop()
+        if b % self._dp == 0:
+            return feed, b, b
+        enforce(BATCH_ROW_MASK_NAME in program.global_block().vars,
+                f"feed batch size {b} is not divisible by data-parallel "
+                f"degree {self._dp}, and the program does not declare "
+                f"layers.batch_row_mask() — padding without a mask would "
+                f"silently bias an unweighted mean loss (wrapped rows "
+                f"counted twice). Either make the batch dp-divisible or "
+                f"declare the mask and weight the loss by it "
+                f"(loss = reduce_sum(per_ex*mask)/reduce_sum(mask))",
+                exc=InvalidArgumentError)
+        p = ((b + self._dp - 1) // self._dp) * self._dp
+        idx = np.arange(p) % b
+        out = {}
         for name, val in feed.items():
-            if np.ndim(val) >= 1:
-                bs = np.shape(val)[0]
-                enforce(bs % self._dp == 0,
-                        f"feed var {name!r} batch size {bs} is not divisible "
-                        f"by data-parallel degree {self._dp} "
-                        f"(≙ SplitLoDTensor batch split)",
-                        exc=InvalidArgumentError)
+            if np.ndim(val) >= 1 and np.shape(val)[0] == b:
+                out[name] = np.take(np.asarray(val), idx, axis=0)
+            else:
+                out[name] = val
+        # a caller-fed mask was wrap-padded above — keep its real-row
+        # weights and only zero the rows WE added; synthesize 1/0 otherwise
+        if BATCH_ROW_MASK_NAME in out:
+            mask = np.asarray(out[BATCH_ROW_MASK_NAME],
+                              np.float32).copy()
+        else:
+            mask = np.ones((p,), np.float32)
+        mask[b:] = 0.0
+        out[BATCH_ROW_MASK_NAME] = mask
+        return out, b, p
+
+    def _batch_led_fetches(self, program, fetch_list):
+        """Which fetch targets are declared batch-led ([-1, ...] leading
+        dim)? Only those get pad rows stripped — a fetch whose CONCRETE
+        leading dim merely coincides with the padded size (e.g. a [16, k]
+        parameter) must come back whole."""
+        out = []
+        for f in fetch_list or []:
+            name = f.name if isinstance(f, Variable) else f
+            v = self._find_var(program, name)
+            shape = getattr(v, "shape", None) if v is not None else None
+            out.append(bool(shape) and shape[0] == -1)
+        return out
+
+    def _slice_padded_fetches(self, fetches, batch_led, real, stacked=False):
+        """Strip pad rows from per-row fetch outputs. `stacked`: run_steps
+        fetches carry a leading K (steps) axis; the batch axis is axis 1."""
+        out = []
+        for f, led in zip(fetches, batch_led):
+            if led and hasattr(f, "ndim") and f.ndim >= (2 if stacked else 1):
+                out.append(f[:, :real] if stacked else f[:real])
+            else:
+                out.append(f)
+        return out
 
     # -- scan-fused multi-step loop (run_steps) ---------------------------
     def _shift_scan_axis(self, ns: NamedSharding) -> NamedSharding:
@@ -184,14 +245,26 @@ class ParallelExecutor(Executor):
         scope = scope or self.scope
         enforce(len(feed_list) >= 1, "run_steps needs at least one feed",
                 exc=InvalidArgumentError)
-        self._check_dp_divisible(feed_list[0])
+        padded_list = []
+        real_b = padded_b = None
+        for f in feed_list:
+            f2, rb, pb = self._pad_for_dp(program, dict(f))
+            padded_list.append(f2)
+            real_b, padded_b = rb, pb  # uniform: signatures must match
+        feed_list = padded_list
         self._feed_shapes = {n: np.shape(v)
                              for n, v in feed_list[0].items()}
         if self._spans_processes():
             self._globalize_state(program, scope)
-        return super().run_steps(feed_list, fetch_list=fetch_list,
-                                 program=program, scope=scope,
-                                 return_numpy=return_numpy)
+        fetches = super().run_steps(feed_list, fetch_list=fetch_list,
+                                    program=program, scope=scope,
+                                    return_numpy=return_numpy)
+        if real_b is not None and padded_b != real_b:
+            # stacked fetches are [K, batch, ...]: strip pad rows on axis 1
+            fetches = self._slice_padded_fetches(
+                fetches, self._batch_led_fetches(program, fetch_list),
+                real_b, stacked=True)
+        return fetches
 
     def _place_feed_stack(self, program, name, vals):
         """Stack K per-step feed values; in a cross-process world place the
@@ -255,8 +328,12 @@ class ParallelExecutor(Executor):
         Argument order follows the reference (fetch_list first)."""
         program = program or self.main_program or default_main_program()
         scope = scope or self.scope
-        feed = dict(feed or {})
-        self._check_dp_divisible(feed)
+        feed, real_b, padded_b = self._pad_for_dp(program, dict(feed or {}))
+        # synthesize the batch-row mask BEFORE multi-process placement: the
+        # base Executor would otherwise inject a host numpy array after the
+        # _place loop, which jit cannot auto-place onto a non-addressable
+        # global sharding
+        feed = self._synthesize_batch_mask(program, feed)
         # stash shapes so _compile can build feed shardings without
         # re-plumbing the Executor.run signature.
         self._feed_shapes = {n: np.shape(v) for n, v in feed.items()}
@@ -277,8 +354,14 @@ class ParallelExecutor(Executor):
                     np.asarray(v),
                     self._feed_sharding(program, n, np.shape(v)))
             feed = {n: _place(n, v) for n, v in feed.items()}
-        return super().run(program=program, feed=feed, fetch_list=fetch_list,
-                           scope=scope, return_numpy=return_numpy)
+        fetches = super().run(program=program, feed=feed,
+                              fetch_list=fetch_list, scope=scope,
+                              return_numpy=return_numpy)
+        if real_b is not None and padded_b != real_b:
+            fetches = self._slice_padded_fetches(
+                fetches, self._batch_led_fetches(program, fetch_list),
+                real_b)
+        return fetches
 
     @property
     def device_count(self) -> int:

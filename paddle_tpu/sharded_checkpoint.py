@@ -110,7 +110,13 @@ def save_sharded(dirname: str, arrays: Dict[str, object],
         if entry["chunks"]:
             manifest[name] = entry
     from .data.tensor_store import save_tensors
-    save_tensors(os.path.join(dirname, shard_file), chunks)
+    # write-then-replace: re-saving into an existing checkpoint dir must not
+    # clobber the shard container the still-valid old manifest points to if
+    # we crash mid-write (the manifest swap below is only atomic if the data
+    # it references is too)
+    spath = os.path.join(dirname, shard_file)
+    save_tensors(spath + ".tmp", chunks)
+    os.replace(spath + ".tmp", spath)
     mpath = os.path.join(dirname, f"{MANIFEST_PREFIX}{pid}.json")
     tmp = mpath + ".tmp"
     with open(tmp, "w") as f:

@@ -1,0 +1,222 @@
+"""Uneven (non-dp-divisible) batch handling in ParallelExecutor.
+
+≙ reference details/data_balance_op_handle.cc: the reference redistributes
+uneven reader batches across devices so the last partial batch of an epoch
+can run. The TPU translation pads the batch to the next dp multiple
+(wrapping real rows) and zeroes those rows in the reserved batch-row mask
+(layers.batch_row_mask), so a mask-weighted loss counts real rows exactly.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core.enforce import InvalidArgumentError
+from paddle_tpu.framework.program import BATCH_ROW_MASK_NAME
+from paddle_tpu.parallel import ParallelExecutor
+
+
+def _build_masked_net():
+    """Per-example CE weighted by the batch-row mask: padded rows contribute
+    exactly nothing to loss or gradient."""
+    img = layers.data(name="img", shape=[16], dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    mask = layers.batch_row_mask()
+    h = layers.fc(img, size=32, act="relu")
+    logits = layers.fc(h, size=10)
+    per_ex = layers.softmax_with_cross_entropy(logits, label)  # [B, 1]
+    m = layers.reshape(mask, shape=[-1, 1])
+    loss = layers.reduce_sum(per_ex * m) / layers.reduce_sum(m)
+    return loss, logits
+
+
+def _startup():
+    pt.Executor().run(pt.default_startup_program())
+
+
+class TestUnevenBatch:
+    def test_partial_batch_loss_matches_single_device(self, rng):
+        """PE loss on a padded 5-row batch == plain Executor loss on the
+        same 5 rows (the mask must cancel the 3 wrapped pad rows)."""
+        loss, _ = _build_masked_net()
+        _startup()
+        x = rng.rand(5, 16).astype("float32")
+        y = rng.randint(0, 10, (5, 1)).astype("int64")
+
+        exe = pt.Executor()
+        ref, = exe.run(feed={"img": x, "label": y}, fetch_list=[loss])
+
+        pe = ParallelExecutor(loss_name=loss.name)
+        assert pe.device_count == 8
+        got, = pe.run(fetch_list=[loss], feed={"img": x, "label": y})
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_partial_batch_per_row_fetch_sliced(self, rng):
+        """Per-row fetches come back with the REAL batch size, pad rows
+        stripped."""
+        loss, logits = _build_masked_net()
+        _startup()
+        x = rng.rand(5, 16).astype("float32")
+        y = rng.randint(0, 10, (5, 1)).astype("int64")
+        pe = ParallelExecutor(loss_name=loss.name)
+        lg, = pe.run(fetch_list=[logits], feed={"img": x, "label": y})
+        assert np.asarray(lg).shape == (5, 10)
+
+    def test_epoch_with_partial_last_batch_trains(self, rng):
+        """A full epoch whose last batch is partial runs end-to-end and the
+        gradient of the partial batch matches the unpadded single-device
+        gradient (loss parity after the update step)."""
+        loss, _ = _build_masked_net()
+        opt = pt.optimizer.SGDOptimizer(learning_rate=1e-1)
+        opt.minimize(loss)
+        _startup()
+
+        n, bs = 21, 8  # batches of 8, 8, 5
+        xs = rng.rand(n, 16).astype("float32")
+        ys = rng.randint(0, 10, (n, 1)).astype("int64")
+        batches = [(xs[i:i + bs], ys[i:i + bs]) for i in range(0, n, bs)]
+        assert batches[-1][0].shape[0] == 5
+
+        # single-device reference epoch
+        ref_losses = []
+        exe = pt.Executor()
+        for x, y in batches:
+            out, = exe.run(feed={"img": x, "label": y}, fetch_list=[loss])
+            ref_losses.append(float(np.asarray(out).ravel()[0]))
+
+        # fresh params, same epoch through PE with dp=8
+        pt.reset_global_scope()
+        _startup()
+        pe = ParallelExecutor(loss_name=loss.name)
+        pe_losses = []
+        for x, y in batches:
+            out, = pe.run(fetch_list=[loss], feed={"img": x, "label": y})
+            pe_losses.append(float(np.asarray(out).ravel()[0]))
+
+        np.testing.assert_allclose(pe_losses, ref_losses, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_mask_autofeed_all_ones_on_plain_executor(self, rng):
+        """Plain Executor synthesizes an all-ones mask: masked loss equals
+        the unmasked mean."""
+        img = layers.data(name="img", shape=[4], dtype="float32")
+        label = layers.data(name="label", shape=[1], dtype="int64")
+        mask = layers.batch_row_mask()
+        logits = layers.fc(img, size=3)
+        per_ex = layers.softmax_with_cross_entropy(logits, label)
+        m = layers.reshape(mask, shape=[-1, 1])
+        wloss = layers.reduce_sum(per_ex * m) / layers.reduce_sum(m)
+        uloss = layers.mean(per_ex)
+        _startup()
+        x = rng.rand(6, 4).astype("float32")
+        y = rng.randint(0, 3, (6, 1)).astype("int64")
+        exe = pt.Executor()
+        a, b = exe.run(feed={"img": x, "label": y},
+                       fetch_list=[wloss, uloss])
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+    def test_divisible_batch_untouched(self, rng):
+        """dp-divisible feeds bypass padding entirely (no mask needed in
+        the program either)."""
+        img = layers.data(name="img", shape=[4], dtype="float32")
+        label = layers.data(name="label", shape=[1], dtype="int64")
+        logits = layers.fc(img, size=3)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        _startup()
+        pe = ParallelExecutor(loss_name=loss.name)
+        x = rng.rand(16, 4).astype("float32")
+        y = rng.randint(0, 3, (16, 1)).astype("int64")
+        out, = pe.run(fetch_list=[loss], feed={"img": x, "label": y})
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_uneven_without_mask_raises_with_guidance(self, rng):
+        """A program with a plain mean loss (no batch_row_mask) must NOT be
+        silently padded — wrapped rows would bias the mean. It raises and
+        names the fix."""
+        img = layers.data(name="img", shape=[4], dtype="float32")
+        label = layers.data(name="label", shape=[1], dtype="int64")
+        logits = layers.fc(img, size=3)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        _startup()
+        pe = ParallelExecutor(loss_name=loss.name)
+        x = rng.rand(5, 4).astype("float32")
+        y = rng.randint(0, 3, (5, 1)).astype("int64")
+        with pytest.raises(InvalidArgumentError, match="batch_row_mask"):
+            pe.run(fetch_list=[loss], feed={"img": x, "label": y})
+
+    def test_caller_fed_mask_respected_when_padding(self, rng):
+        """A caller-fed per-row weight mask keeps its real-row weights when
+        the batch is padded; only the pad rows are zeroed."""
+        loss, _ = _build_masked_net()
+        _startup()
+        x = rng.rand(5, 16).astype("float32")
+        y = rng.randint(0, 10, (5, 1)).astype("int64")
+        w = np.array([1.0, 1.0, 0.0, 1.0, 1.0], np.float32)  # drop row 2
+
+        exe = pt.Executor()
+        ref, = exe.run(feed={"img": x, "label": y,
+                             BATCH_ROW_MASK_NAME: w}, fetch_list=[loss])
+
+        pe = ParallelExecutor(loss_name=loss.name)
+        got, = pe.run(fetch_list=[loss],
+                      feed={"img": x, "label": y, BATCH_ROW_MASK_NAME: w})
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_concrete_dim_fetch_not_sliced(self, rng):
+        """A fetch whose concrete leading dim coincides with the padded
+        size (a [16, k] parameter when 5 pads to 16... here 8) must come
+        back whole — only declared batch-led ([-1,...]) fetches are
+        sliced."""
+        img = layers.data(name="img", shape=[16], dtype="float32")
+        label = layers.data(name="label", shape=[1], dtype="int64")
+        layers.batch_row_mask()
+        h = layers.fc(img, size=8, act="relu",
+                      param_attr=pt.ParamAttr(name="fc_w16"))
+        logits = layers.fc(h, size=10)
+        per_ex = layers.softmax_with_cross_entropy(logits, label)
+        m = layers.reshape(layers.batch_row_mask(), shape=[-1, 1])
+        loss = layers.reduce_sum(per_ex * m) / layers.reduce_sum(m)
+        _startup()
+        pe = ParallelExecutor(loss_name=loss.name)
+        x = rng.rand(5, 16).astype("float32")
+        y = rng.randint(0, 10, (5, 1)).astype("int64")
+        out = pe.run(fetch_list=[loss, "fc_w16"],
+                     feed={"img": x, "label": y})
+        # padded batch is 8; fc_w16 is [16, 8] — leading dim 16 != -1, so
+        # it must come back [16, 8] even though 16 == 2*padded etc.
+        assert np.asarray(out[1]).shape == (16, 8)
+
+    def test_run_steps_pads_and_strips_stacked_fetches(self, rng):
+        """run_steps pads each step's feed and strips pad rows from stacked
+        per-row fetches ([K, batch, ...] -> [K, real, ...])."""
+        loss, logits = _build_masked_net()
+        opt = pt.optimizer.SGDOptimizer(learning_rate=1e-2)
+        opt.minimize(loss)
+        _startup()
+        pe = ParallelExecutor(loss_name=loss.name)
+        feeds = []
+        for _ in range(3):
+            feeds.append({"img": rng.rand(5, 16).astype("float32"),
+                          "label": rng.randint(0, 10,
+                                               (5, 1)).astype("int64")})
+        out = pe.run_steps(feeds, fetch_list=[loss, logits])
+        assert np.asarray(out[0]).shape == (3,)
+        assert np.asarray(out[1]).shape == (3, 5, 10)
+
+    def test_mismatched_batch_dims_still_raise(self, rng):
+        img = layers.data(name="img", shape=[4], dtype="float32")
+        label = layers.data(name="label", shape=[1], dtype="int64")
+        logits = layers.fc(img, size=3)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        _startup()
+        pe = ParallelExecutor(loss_name=loss.name)
+        x = rng.rand(5, 4).astype("float32")
+        y = rng.randint(0, 3, (7, 1)).astype("int64")
+        with pytest.raises(InvalidArgumentError):
+            pe.run(fetch_list=[loss], feed={"img": x, "label": y})

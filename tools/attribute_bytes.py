@@ -1,0 +1,161 @@
+"""Per-phase HBM-traffic attribution of the flagship train step.
+
+Parses the optimized HLO (dumped by tools/profile_resnet4.py) and, for every
+top-level instruction of the entry computation, charges
+`sum(operand buffer bytes) + output bytes` — the fusion's real HBM traffic —
+to a logical phase derived from its op_name metadata. Aliasing pseudo-ops
+(get-tuple-element, bitcast, parameter, tuple) are skipped; async copy pairs
+are counted once.
+
+This is the per-buffer attribution table VERDICT r3 #1 asks for: each row is
+checkable against the structural minimum for this program shape.
+
+    python tools/attribute_bytes.py [/tmp/resnet_train_optimized.hlo]
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import re
+import sys
+
+_IT = {"bf16": 2, "f16": 2, "f32": 4, "s32": 4, "u32": 4, "s8": 1,
+       "u8": 1, "pred": 1, "s64": 8, "u64": 8}
+
+_SKIP = {"get-tuple-element", "bitcast", "parameter", "tuple", "constant",
+         "after-all", "copy-start", "async-start"}
+
+
+def shape_bytes(sh: str) -> int:
+    total = 0
+    for m in re.finditer(r"(bf16|f32|f16|s32|u32|s8|u8|pred|s64|u64)"
+                         r"\[([0-9,]*)\]", sh):
+        n = 1
+        for d in m.group(2).split(","):
+            if d:
+                n *= int(d)
+        total += n * _IT[m.group(1)]
+    return total
+
+
+def classify(op: str, meta: str, shape: str) -> str:
+    """Logical phase for one instruction, from opcode + jax op_name."""
+    bwd = "transpose(" in meta
+    if op in ("convolution", "custom-call") or "conv_general" in meta:
+        if not bwd:
+            return "conv_fwd"
+        # jax emits conv dgrad as conv(dy, w) and wgrad as conv(x, dy);
+        # metadata keeps the primitive name only, so split on output shape:
+        # activation grads are [B, H, W, C] with square spatial dims;
+        # weight grads are [Co, Ci, kh, kw] (kh==kw too, but tiny) — use
+        # spatial size >= 7 as the activation signature. Tuple outputs
+        # (weight-grad fused with the momentum update / BN-grad reductions)
+        # classify by their first element.
+        for dims in re.finditer(r"\[([0-9,]+)\]", shape):
+            d = [int(x) for x in dims.group(1).split(",")]
+            if len(d) == 4 and d[1] == d[2] and d[1] >= 7:
+                return "conv_dgrad_fused"
+        return "conv_wgrad_fused"
+    if "select_and_scatter" in meta or op == "select-and-scatter":
+        return "maxpool_bwd"
+    if "reduce_window" in meta:
+        return "maxpool_fwd"
+    if op == "fusion" or op in ("add", "subtract", "multiply", "divide",
+                                "maximum", "select", "compare", "convert",
+                                "reduce", "broadcast", "rsqrt", "exponential",
+                                "negate", "power", "sqrt", "scatter",
+                                "dynamic-update-slice", "transpose", "copy",
+                                "reshape", "slice", "concatenate", "pad",
+                                "iota", "dot", "map", "reduce-precision"):
+        if "sgd" in meta or "momentum" in meta or "adam" in meta \
+                or "apply" in meta:
+            return "optimizer"
+        if "softmax" in meta or "cross_entropy" in meta or "log" in meta \
+                or "one_hot" in meta or "mean" in meta and "pool" not in meta:
+            return "loss_head"
+        if "reduce_sum" in meta or "reduce(" in meta or "div" in meta \
+                and bwd:
+            return ("bn_or_reduce_bwd" if bwd else "bn_or_reduce_fwd")
+        if op == "copy":
+            return "layout_copy"
+        if "dot" in meta or op == "dot":
+            return "fc"
+        return "elementwise_bwd" if bwd else "elementwise_fwd"
+    if op in ("copy-done", "async-done"):
+        # memory-space-assignment VMEM prefetch: the HBM read happens here
+        # and the consumer then reads VMEM — the consumer's operand charge
+        # double-counts this traffic, so keep it in its own bucket
+        return "vmem_prefetch"
+    if op in ("rng", "rng-bit-generator"):
+        return "rng"
+    return "other:" + op
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else \
+        "/tmp/resnet_train_optimized.hlo"
+    hlo = open(path).read()
+
+    # instruction name -> output bytes (for operand lookups), per computation
+    cur = None
+    defs = {}
+    rows = []
+    for line in hlo.splitlines():
+        mc = re.match(r"(ENTRY )?%?([\w.\-]+)\s*\([^)]*\)\s*->", line)
+        if mc:
+            cur = "ENTRY" if mc.group(1) else mc.group(2)
+            continue
+        if cur != "ENTRY":
+            continue
+        m = re.match(r"\s+%?([\w.\-]+)\s*=\s*(\([^=]*?\)|\S+)\s+([a-z\-]+)",
+                     line)
+        if not m:
+            continue
+        name, sh, op = m.groups()
+        out_b = shape_bytes(sh)
+        defs[name] = (out_b, op)
+        if op in _SKIP:
+            continue
+        # operand list: %names inside the first (...) after the opcode
+        call = line[m.end():]
+        operands = re.findall(r"%([\w.\-]+)", call.split("metadata")[0])
+        in_b = 0
+        seen = set()
+        for o in operands:
+            if o in seen or o not in defs:
+                continue
+            seen.add(o)
+            ob, oop = defs[o]
+            # reading through a get-tuple-element/bitcast charges the
+            # element's own bytes (already its shape), fine as-is
+            in_b += ob
+        meta = ""
+        mm = re.search(r'op_name="([^"]*)"', line)
+        if mm:
+            meta = mm.group(1)
+        rows.append((in_b + out_b, op, sh, meta))
+
+    buckets = collections.Counter()
+    counts = collections.Counter()
+    for b, op, sh, meta in rows:
+        ph = classify(op, meta, sh)
+        buckets[ph] += b
+        counts[ph] += 1
+    total = sum(buckets.values())
+    print(json.dumps({
+        "exp": "traffic_by_phase_GB",
+        "total_GB": round(total / 1e9, 2),
+        "phases": [(ph, round(bb / 1e9, 2), counts[ph])
+                   for ph, bb in buckets.most_common()],
+    }), flush=True)
+    rows.sort(reverse=True)
+    print(json.dumps({
+        "exp": "top_instructions",
+        "top25": [(round(b / 1e6), op, classify(op, meta, sh), sh[:44],
+                   meta[:80]) for b, op, sh, meta in rows[:25]],
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

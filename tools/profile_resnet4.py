@@ -1,0 +1,124 @@
+"""Round-4 MFU attribution: materialized-buffer census of the optimized HLO.
+
+Unlike profile_resnet3 (which counted every instruction line, including ones
+living inside fusion bodies that never touch HBM), this parses computation
+boundaries and counts ONLY top-level instructions of the entry / while-body
+computations — the ones whose outputs are real buffers — bucketing output
+bytes by opcode and dtype, and listing the biggest buffers with metadata.
+
+    env PYTHONPATH=/root/.axon_site:/root/repo python tools/profile_resnet4.py
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import re
+import sys
+
+import numpy as np
+
+
+def shape_bytes(sh):
+    it = {"bf16": 2, "f16": 2, "f32": 4, "s32": 4, "u32": 4, "s8": 1,
+          "u8": 1, "pred": 1, "s64": 8, "u64": 8}
+    total = 0
+    for m in re.finditer(r"(bf16|f32|f16|s32|u32|s8|u8|pred|s64|u64)"
+                         r"\[([0-9,]*)\]", sh):
+        n = 1
+        for d in m.group(2).split(","):
+            if d:
+                n *= int(d)
+        total += n * it[m.group(1)]
+    return total
+
+
+def main():
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu import models
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    loss, acc, _ = models.resnet.resnet_imagenet(
+        depth=50, is_test=False, data_format="NHWC", use_bf16=True)
+    opt = pt.optimizer.MomentumOptimizer(learning_rate=3e-3, momentum=0.9)
+    opt.minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+
+    rng = np.random.RandomState(0)
+    feed = {
+        "img": jnp.asarray(rng.rand(batch, 224, 224, 3).astype("float32")),
+        "label": jnp.asarray(rng.randint(0, 1000, (batch, 1)).astype("int64")),
+    }
+    compiled = exe._lookup_or_compile(
+        pt.default_main_program(), feed, [loss.name], pt.global_scope())
+    feed_vals = tuple(jnp.asarray(feed[n]) for n in compiled.feed_names)
+    scope = pt.global_scope()
+    ro_vals = tuple(scope.get(n) for n in compiled.ro_names)
+    rw_vals = tuple(scope.get(n) for n in compiled.rw_names)
+    ex = compiled.fn.lower(feed_vals, ro_vals, rw_vals,
+                           np.uint32(0)).compile()
+    hlo = ex.as_text()
+    with open("/tmp/resnet_train_optimized.hlo", "w") as f:
+        f.write(hlo)
+
+    # walk computations; keep only instructions in the entry computation
+    # (jit program top level = the materialized buffers)
+    cur_comp = None
+    entry_ops = []
+    for line in hlo.splitlines():
+        mc = re.match(r"(ENTRY )?%?([\w.\-]+)\s*\([^)]*\)\s*->", line)
+        if mc:
+            cur_comp = ("ENTRY" if mc.group(1) else mc.group(2))
+            continue
+        if cur_comp != "ENTRY":
+            continue
+        m = re.match(r"\s+%?([\w.\-]+)\s*=\s*(\S+)\s+([a-z\-]+)", line)
+        if not m:
+            continue
+        name, sh, op = m.groups()
+        b = shape_bytes(sh)
+        meta = ""
+        mm = re.search(r'op_name="([^"]*)"', line)
+        if mm:
+            meta = mm.group(1)
+        entry_ops.append((b, op, sh, name, meta))
+
+    op_bytes = collections.Counter()
+    op_count = collections.Counter()
+    dtype_bytes = collections.Counter()
+    for b, op, sh, name, meta in entry_ops:
+        op_bytes[op] += b
+        op_count[op] += 1
+        md = re.match(r"(bf16|f32|f16|s32|u32|s8|u8|pred|s64|u64)", sh)
+        if md:
+            dtype_bytes[md.group(1)] += b
+    print(json.dumps({
+        "exp": "entry_output_bytes_by_op",
+        "total_GB": round(sum(op_bytes.values()) / 1e9, 2),
+        "top": [(op, round(bb / 1e9, 2), op_count[op])
+                for op, bb in op_bytes.most_common(18)],
+        "by_dtype_GB": {d: round(bb / 1e9, 2)
+                        for d, bb in dtype_bytes.most_common()},
+    }), flush=True)
+    big = sorted(entry_ops, reverse=True)[:20]
+    print(json.dumps({
+        "exp": "biggest_entry_buffers",
+        "top20": [(round(b / 1e6), op, sh[:48], meta[:90])
+                  for b, op, sh, name, meta in big],
+    }), flush=True)
+    ca = ex.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    print(json.dumps({
+        "exp": "cost_analysis",
+        "bytes_accessed_GB": round(float(ca.get("bytes accessed", 0)) / 1e9,
+                                   2),
+        "flops_G": round(float(ca.get("flops", 0)) / 1e9, 1),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

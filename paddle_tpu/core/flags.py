@@ -110,6 +110,14 @@ define_bool("disable_pallas", False,
             "kernels on TPU (escape hatch: PTPU_DISABLE_PALLAS=1).")
 # (num_iteration_per_drop_scope lives on ExecutionStrategy for API parity;
 # the functional executor has no per-iteration kid scopes to drop)
+define_int("sparse_dense_apply_max_bytes", 1 << 30,
+           "Lazy sparse optimizer updates (adam) switch from the "
+           "merged-rows path (sort + row gather/scatter, O(batch*dim) "
+           "touched) to a dense-MASKED apply (full-table elementwise, "
+           "identical lazy semantics) when the table is at most this many "
+           "bytes: on TPU the 160k-id sort alone costs ~12 ms while "
+           "elementwise passes over a <=1 GB table cost ~1-4 ms. Set 0 to "
+           "force the row path regardless of size (EP-scale tables).")
 define_int("_reserved_num_iteration_per_drop_scope", 1,
            "Iterations between temporary-scope cleanups "
            "(≙ ExecutionStrategy::num_iteration_per_drop_scope_).")

@@ -432,6 +432,123 @@ def load_exported_model(dirname: str):
     return exported, list(meta["feed_names"]), list(meta["fetch_names"])
 
 
+NATIVE_TRAIN_ARTIFACT_FILE = "__exported_train__.stablehlo"
+NATIVE_TRAIN_META_FILE = "__exported_train__.meta"
+
+
+def export_train_program(dirname: str,
+                         feeded_var_names: Sequence[str],
+                         loss_names: Sequence,
+                         main_program: Optional[Program] = None,
+                         scope: Optional[Scope] = None):
+    """Export ONE TRAIN STEP as a C++-executable StableHLO artifact:
+    (seed, batch..., params/state...) -> (losses..., updated state...).
+
+    ≙ the reference's pure-C++ training demo input (reference
+    train/demo/demo_trainer.cc:55-80: load a serialized ProgramDesc, loop
+    executor.Run). Where the reference C++ interprets the program op by op,
+    the TPU-native deployable unit is the fully-compiled train step:
+    parameters and optimizer accumulators are real ARGUMENTS (not baked
+    constants like the inference export), so a C++ driver
+    (native/ptpu_train.cc) carries the updated state across steps with no
+    Python in the process.
+
+    The meta file records, per kept input/output, name/dtype/dims, plus:
+      carry <out_idx> <in_idx>  — output to feed back as input next step
+      init <in_idx> <file.npy>  — initial value for a state input
+    The first input is always the int32 scalar `__seed__` (the step's RNG
+    seed; drives dropout etc.).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jax_export
+
+    from .framework.executor import Executor
+    from .framework.lowering import build_plan, run_plan
+    from .framework.registry import LowerCtx
+
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    block = program.global_block()
+    plan = build_plan(block)
+    feed_names = list(feeded_var_names)
+    fetch_names = [f.name if isinstance(f, Variable) else f
+                   for f in loss_names]
+
+    ro, rw, out_only = Executor()._analyze_state(program, scope, feed_names,
+                                                 fetch_names)
+    state_in = list(ro) + list(rw)
+    state_out = sorted(set(rw) | set(out_only))
+
+    def fn(seed, *args):
+        feeds = args[:len(feed_names)]
+        states = args[len(feed_names):]
+        ctx = LowerCtx(rng_key=jax.random.PRNGKey(seed),
+                       extras={"program": program,
+                               "fetch_names": tuple(fetch_names)})
+        env: Dict[str, object] = {}
+        env.update(zip(state_in, states))
+        env.update(zip(feed_names, feeds))
+        run_plan(plan, env, block, ctx)
+        return (tuple(env[n] for n in fetch_names)
+                + tuple(env[n] for n in state_out))
+
+    sym_scope = jax_export.SymbolicScope()
+    args = [jax.ShapeDtypeStruct((), jnp.int32)]
+    in_names = ["__seed__"]
+    for i, name in enumerate(feed_names):
+        v = block.var(name)
+        dt = jax.dtypes.canonicalize_dtype(np.dtype(v.dtype))
+        # the leading -1 is THE batch dim: one shared symbol across all
+        # feeds (x and its labels must agree or any x-vs-label op fails)
+        dims = [("b" if j == 0 else f"d{i}_{j}") if d == -1 else str(d)
+                for j, d in enumerate(v.shape)]
+        shape = jax_export.symbolic_shape(", ".join(dims), scope=sym_scope) \
+            if any(d == -1 for d in v.shape) else tuple(v.shape)
+        args.append(jax.ShapeDtypeStruct(shape, dt))
+        in_names.append(name)
+    init_vals = {}
+    for name in state_in:
+        val = np.asarray(as_numpy(scope.get(name)))
+        dt = jax.dtypes.canonicalize_dtype(val.dtype)
+        args.append(jax.ShapeDtypeStruct(val.shape, dt))
+        in_names.append(name)
+        init_vals[name] = val.astype(dt)
+
+    exported = jax_export.export(jax.jit(fn), platforms=("cpu",))(*args)
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, NATIVE_TRAIN_ARTIFACT_FILE), "wb") as f:
+        f.write(exported.mlir_module_serialized)
+
+    def _dims(aval):
+        return " ".join(str(d) if isinstance(d, int) else "-1"
+                        for d in aval.shape)
+
+    kept = list(exported.module_kept_var_idx)
+    out_names = fetch_names + state_out
+    lines = [f"version {exported.calling_convention_version}",
+             f"nfetch {len(fetch_names)}"]
+    kept_names = []
+    for i in kept:
+        aval = exported.in_avals[i]
+        nm = in_names[i]
+        kept_names.append(nm)
+        lines.append(f"in {nm} {aval.dtype} {_dims(aval)}".rstrip())
+    for nm, aval in zip(out_names, exported.out_avals):
+        lines.append(f"out {nm} {aval.dtype} {_dims(aval)}".rstrip())
+    for out_idx, nm in enumerate(out_names):
+        if nm in state_out and nm in rw and nm in kept_names:
+            lines.append(f"carry {out_idx} {kept_names.index(nm)}")
+    for in_idx, nm in enumerate(kept_names):
+        if nm in init_vals:
+            fname = f"train_state_{in_idx}.npy"
+            np.save(os.path.join(dirname, fname), init_vals[nm])
+            lines.append(f"init {in_idx} {fname}")
+    with open(os.path.join(dirname, NATIVE_TRAIN_META_FILE), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return fetch_names
+
+
 TRAIN_PROGRAM_FILE = "__train_program__"
 
 

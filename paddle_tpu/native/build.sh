@@ -23,3 +23,16 @@ if [ "$1" = "predict" ] || [ -n "$PTPU_BUILD_PREDICT" ]; then
     echo "build.sh: TF C++ libs not found; skipping ptpu_predict" >&2
   fi
 fi
+
+# Native training demo (ptpu_train): same runtime, drives K train steps
+# carrying params/optimizer state between XlaCallModule executions.
+if [ "$1" = "train" ] || [ -n "$PTPU_BUILD_TRAIN" ]; then
+  TF_DIR="${PTPU_TF_DIR:-$(python3 -c 'import tensorflow, os; print(os.path.dirname(tensorflow.__file__))' 2>/dev/null || true)}"
+  if [ -n "$TF_DIR" ] && [ -f "$TF_DIR/libtensorflow_cc.so.2" ]; then
+    g++ -O2 -std=c++17 -I "$TF_DIR/include" -o ptpu_train ptpu_train.cc \
+        "$TF_DIR/libtensorflow_cc.so.2" "$TF_DIR/libtensorflow_framework.so.2" \
+        -Wl,-rpath,"$TF_DIR"
+  else
+    echo "build.sh: TF C++ libs not found; skipping ptpu_train" >&2
+  fi
+fi

@@ -1,0 +1,355 @@
+// ptpu_train: native (C++) TRAINING entry for exported paddle_tpu train
+// steps.
+//
+// Loads the train-step StableHLO artifact written by
+// io.export_train_program (__exported_train__.stablehlo +
+// __exported_train__.meta + train_state_<i>.npy initial values), then
+// drives K optimization steps with NO Python in the process: each step
+// executes the module through the TensorFlow eager C API's XlaCallModule
+// kernel (XLA:CPU JIT), prints the fetch (loss) values, and feeds the
+// carried state outputs (updated parameters + optimizer accumulators)
+// back as next-step inputs per the meta's `carry` mapping. Final state is
+// written as state<i>.npy.
+//
+// Capability equivalent of the reference's pure-C++ trainer demo
+// (reference paddle/fluid/train/demo/demo_trainer.cc:55-80: load
+// startup+main ProgramDesc, run startup, loop executor.Run(main)). The
+// TPU-native deployable unit is the fully-compiled train step with
+// parameters as arguments, not an op-by-op interpreted program.
+//
+// Usage:
+//   ptpu_train <export_dir> <input0.npy> [...] --steps K [--out DIR]
+//
+// Inputs are positional in the meta's non-state `in` order (the batch,
+// reused every step — ≙ the demo trainer's fixed synthetic batch).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "tensorflow/c/c_api.h"
+#include "tensorflow/c/eager/c_api.h"
+
+namespace {
+
+[[noreturn]] void Die(const std::string& msg) {
+  std::fprintf(stderr, "ptpu_train: %s\n", msg.c_str());
+  std::exit(1);
+}
+
+void CheckOk(TF_Status* s, const char* what) {
+  if (TF_GetCode(s) != TF_OK) {
+    Die(std::string(what) + ": " + TF_Message(s));
+  }
+}
+
+std::string ReadFile(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) Die("cannot open " + path);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+struct DType {
+  TF_DataType tf;
+  const char* npy;
+  size_t size;
+};
+
+DType DTypeByName(const std::string& name) {
+  if (name == "float32") return {TF_FLOAT, "<f4", 4};
+  if (name == "float64") return {TF_DOUBLE, "<f8", 8};
+  if (name == "int32") return {TF_INT32, "<i4", 4};
+  if (name == "int64") return {TF_INT64, "<i8", 8};
+  if (name == "uint32") return {TF_UINT32, "<u4", 4};
+  if (name == "uint8") return {TF_UINT8, "|u1", 1};
+  if (name == "int8") return {TF_INT8, "|i1", 1};
+  if (name == "bool") return {TF_BOOL, "|b1", 1};
+  Die("unsupported dtype " + name);
+}
+
+struct Npy {
+  std::string descr;
+  std::vector<int64_t> shape;
+  std::string data;
+};
+
+Npy ReadNpy(const std::string& path) {
+  std::string raw = ReadFile(path);
+  if (raw.size() < 10 || raw.compare(0, 6, "\x93NUMPY") != 0)
+    Die(path + " is not a .npy file");
+  int major = static_cast<unsigned char>(raw[6]);
+  size_t hlen, hoff;
+  if (major == 1) {
+    hlen = static_cast<unsigned char>(raw[8]) |
+           (static_cast<unsigned char>(raw[9]) << 8);
+    hoff = 10;
+  } else {
+    hlen = 0;
+    for (int i = 0; i < 4; ++i)
+      hlen |= static_cast<size_t>(static_cast<unsigned char>(raw[8 + i]))
+              << (8 * i);
+    hoff = 12;
+  }
+  std::string header = raw.substr(hoff, hlen);
+  Npy out;
+  size_t d = header.find("'descr':");
+  size_t q1 = header.find('\'', d + 8);
+  size_t q2 = header.find('\'', q1 + 1);
+  out.descr = header.substr(q1 + 1, q2 - q1 - 1);
+  if (header.find("'fortran_order': False") == std::string::npos)
+    Die(path + ": fortran_order arrays are not supported");
+  size_t sh = header.find("'shape':");
+  size_t p1 = header.find('(', sh);
+  size_t p2 = header.find(')', p1);
+  std::string dims = header.substr(p1 + 1, p2 - p1 - 1);
+  std::stringstream ss(dims);
+  std::string tok;
+  while (std::getline(ss, tok, ',')) {
+    if (tok.find_first_not_of(" \t") == std::string::npos) continue;
+    out.shape.push_back(std::stoll(tok));
+  }
+  out.data = raw.substr(hoff + hlen);
+  return out;
+}
+
+void WriteNpy(const std::string& path, const std::string& descr,
+              const std::vector<int64_t>& shape, const void* data,
+              size_t nbytes) {
+  std::ostringstream hd;
+  hd << "{'descr': '" << descr << "', 'fortran_order': False, 'shape': (";
+  for (size_t i = 0; i < shape.size(); ++i) hd << shape[i] << ",";
+  hd << "), }";
+  std::string header = hd.str();
+  size_t total = 10 + header.size() + 1;
+  size_t pad = (64 - total % 64) % 64;
+  header += std::string(pad, ' ');
+  header += '\n';
+  std::ofstream f(path, std::ios::binary);
+  if (!f) Die("cannot write " + path);
+  f << "\x93NUMPY" << '\x01' << '\x00';
+  uint16_t hlen = static_cast<uint16_t>(header.size());
+  f.write(reinterpret_cast<const char*>(&hlen), 2);
+  f << header;
+  f.write(static_cast<const char*>(data), nbytes);
+}
+
+struct TensorSpec {
+  std::string name;
+  std::string dtype;
+  std::vector<int64_t> dims;
+};
+
+struct TrainMeta {
+  int version = 9;
+  int nfetch = 0;
+  std::vector<TensorSpec> ins, outs;
+  std::map<int, int> carry;          // out index -> in index
+  std::map<int, std::string> init;   // in index -> .npy file
+};
+
+TrainMeta ReadMeta(const std::string& path) {
+  std::ifstream f(path);
+  if (!f) Die("cannot open " + path);
+  TrainMeta m;
+  std::string line;
+  while (std::getline(f, line)) {
+    std::stringstream ss(line);
+    std::string key;
+    ss >> key;
+    if (key == "version") {
+      ss >> m.version;
+    } else if (key == "nfetch") {
+      ss >> m.nfetch;
+    } else if (key == "in" || key == "out") {
+      TensorSpec t;
+      ss >> t.name >> t.dtype;
+      int64_t d;
+      while (ss >> d) t.dims.push_back(d);
+      (key == "in" ? m.ins : m.outs).push_back(t);
+    } else if (key == "carry") {
+      int o, i;
+      ss >> o >> i;
+      m.carry[o] = i;
+    } else if (key == "init") {
+      int i;
+      std::string file;
+      ss >> i >> file;
+      m.init[i] = file;
+    }
+  }
+  if (m.outs.empty()) Die("no outputs in " + path);
+  return m;
+}
+
+TFE_TensorHandle* HandleFromNpy(const Npy& npy, const DType& dt,
+                                TF_Status* s) {
+  TF_Tensor* t = TF_AllocateTensor(dt.tf, npy.shape.data(),
+                                   static_cast<int>(npy.shape.size()),
+                                   npy.data.size());
+  std::memcpy(TF_TensorData(t), npy.data.data(), npy.data.size());
+  TFE_TensorHandle* h = TFE_NewTensorHandle(t, s);
+  CheckOk(s, "TFE_NewTensorHandle");
+  TF_DeleteTensor(t);
+  return h;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr,
+                 "usage: %s <export_dir> <input0.npy> [...] --steps K "
+                 "[--out DIR]\n", argv[0]);
+    return 2;
+  }
+  std::string dir = argv[1];
+  std::string out_dir = ".";
+  int steps = 1;
+  std::vector<std::string> input_paths;
+  for (int i = 2; i < argc; ++i) {
+    if (std::strcmp(argv[i], "--out") == 0 && i + 1 < argc) {
+      out_dir = argv[++i];
+    } else if (std::strcmp(argv[i], "--steps") == 0 && i + 1 < argc) {
+      steps = std::atoi(argv[++i]);
+    } else {
+      input_paths.push_back(argv[i]);
+    }
+  }
+
+  TrainMeta meta = ReadMeta(dir + "/__exported_train__.meta");
+  std::string module = ReadFile(dir + "/__exported_train__.stablehlo");
+
+  TF_Status* s = TF_NewStatus();
+  TFE_ContextOptions* copts = TFE_NewContextOptions();
+  TFE_Context* ctx = TFE_NewContext(copts, s);
+  CheckOk(s, "TFE_NewContext");
+
+  // slot assignment: in[0] is __seed__; state slots load from init files;
+  // the rest take the positional input .npy paths
+  size_t n_in = meta.ins.size();
+  std::vector<TFE_TensorHandle*> in_handles(n_in, nullptr);
+  size_t next_input = 0;
+  for (size_t i = 0; i < n_in; ++i) {
+    if (meta.ins[i].name == "__seed__") continue;  // per-step below
+    DType dt = DTypeByName(meta.ins[i].dtype);
+    auto it = meta.init.find(static_cast<int>(i));
+    if (it != meta.init.end()) {
+      Npy npy = ReadNpy(dir + "/" + it->second);
+      in_handles[i] = HandleFromNpy(npy, dt, s);
+    } else {
+      if (next_input >= input_paths.size())
+        Die("not enough input .npy files (need one per non-state input)");
+      Npy npy = ReadNpy(input_paths[next_input++]);
+      if (npy.descr != dt.npy)
+        Die(meta.ins[i].name + ": dtype " + npy.descr +
+            " but model expects " + meta.ins[i].dtype);
+      in_handles[i] = HandleFromNpy(npy, dt, s);
+    }
+  }
+  if (next_input != input_paths.size())
+    Die("too many input .npy files");
+
+  std::vector<TF_DataType> tin;
+  for (const auto& t : meta.ins) tin.push_back(DTypeByName(t.dtype).tf);
+  std::vector<TF_DataType> tout;
+  std::vector<const int64_t*> sout;
+  std::vector<int> sout_ndims;
+  for (const auto& o : meta.outs) {
+    tout.push_back(DTypeByName(o.dtype).tf);
+    sout.push_back(o.dims.data());
+    sout_ndims.push_back(static_cast<int>(o.dims.size()));
+  }
+
+  std::vector<TFE_TensorHandle*> outs(meta.outs.size(), nullptr);
+  for (int step = 0; step < steps; ++step) {
+    // fresh seed handle per step (step index = the seed)
+    for (size_t i = 0; i < n_in; ++i) {
+      if (meta.ins[i].name == "__seed__") {
+        int32_t seed = step;
+        TF_Tensor* t = TF_AllocateTensor(TF_INT32, nullptr, 0, 4);
+        std::memcpy(TF_TensorData(t), &seed, 4);
+        if (in_handles[i] != nullptr) TFE_DeleteTensorHandle(in_handles[i]);
+        in_handles[i] = TFE_NewTensorHandle(t, s);
+        CheckOk(s, "seed handle");
+        TF_DeleteTensor(t);
+      }
+    }
+
+    TFE_Op* op = TFE_NewOp(ctx, "XlaCallModule", s);
+    CheckOk(s, "TFE_NewOp(XlaCallModule)");
+    TFE_OpSetAttrString(op, "module", module.data(), module.size());
+    TFE_OpSetAttrInt(op, "version", meta.version);
+    TFE_OpSetAttrTypeList(op, "Tin", tin.data(),
+                          static_cast<int>(tin.size()));
+    TFE_OpSetAttrTypeList(op, "Tout", tout.data(),
+                          static_cast<int>(tout.size()));
+    TFE_OpSetAttrShapeList(op, "Sout", sout.data(), sout_ndims.data(),
+                           static_cast<int>(sout.size()), s);
+    CheckOk(s, "Sout");
+    const void* plat[1] = {"CPU"};
+    size_t plat_len[1] = {3};
+    TFE_OpSetAttrStringList(op, "platforms", plat, plat_len, 1);
+    TFE_OpSetAttrStringList(op, "dim_args_spec", nullptr, nullptr, 0);
+    TFE_OpSetAttrStringList(op, "disabled_checks", nullptr, nullptr, 0);
+    TFE_OpSetAttrFunctionList(op, "function_list", nullptr, 0);
+    TFE_OpSetAttrBool(op, "has_token_input_output", 0);
+    for (auto* h : in_handles) {
+      TFE_OpAddInput(op, h, s);
+      CheckOk(s, "TFE_OpAddInput");
+    }
+    int nout = static_cast<int>(outs.size());
+    TFE_Execute(op, outs.data(), &nout, s);
+    CheckOk(s, "TFE_Execute");
+    TFE_DeleteOp(op);
+
+    // print fetch (loss) values
+    for (int i = 0; i < meta.nfetch; ++i) {
+      TF_Tensor* t = TFE_TensorHandleResolve(outs[i], s);
+      CheckOk(s, "resolve fetch");
+      double v = 0.0;
+      if (TF_TensorType(t) == TF_FLOAT)
+        v = *static_cast<float*>(TF_TensorData(t));
+      else if (TF_TensorType(t) == TF_DOUBLE)
+        v = *static_cast<double*>(TF_TensorData(t));
+      std::printf("step %d %s %.8f\n", step, meta.outs[i].name.c_str(), v);
+      TF_DeleteTensor(t);
+    }
+
+    // carry updated state into the next step's inputs
+    for (const auto& [out_idx, in_idx] : meta.carry) {
+      TFE_DeleteTensorHandle(in_handles[in_idx]);
+      in_handles[in_idx] = outs[out_idx];
+      outs[out_idx] = nullptr;
+    }
+    for (auto*& h : outs) {
+      if (h != nullptr) {
+        TFE_DeleteTensorHandle(h);
+        h = nullptr;
+      }
+    }
+  }
+
+  // final carried state -> state<in_idx>.npy
+  for (const auto& [out_idx, in_idx] : meta.carry) {
+    TF_Tensor* t = TFE_TensorHandleResolve(in_handles[in_idx], s);
+    CheckOk(s, "resolve state");
+    std::vector<int64_t> shape(TF_NumDims(t));
+    for (size_t d = 0; d < shape.size(); ++d)
+      shape[d] = TF_Dim(t, static_cast<int>(d));
+    DType dt = DTypeByName(meta.ins[in_idx].dtype);
+    std::string path = out_dir + "/state" + std::to_string(in_idx) + ".npy";
+    WriteNpy(path, dt.npy, shape, TF_TensorData(t), TF_TensorByteSize(t));
+    std::printf("state %s -> %s\n", meta.ins[in_idx].name.c_str(),
+                path.c_str());
+    TF_DeleteTensor(t);
+  }
+  return 0;
+}

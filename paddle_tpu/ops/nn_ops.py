@@ -363,29 +363,82 @@ def _log_softmax(ctx, ins, attrs):
                                        axis=attrs.get("axis", -1))]}
 
 
+def _float0_zero(x):
+    import numpy as _np
+    return _np.zeros(x.shape, dtype=jax.dtypes.float0)
+
+
+@jax.custom_vjp
+def _ce_hard(logits, lbl, valid):
+    """Hard-label softmax cross entropy with a closed-form backward.
+
+    Plain autodiff stores the fp32 [rows, vocab] log-softmax as a residual
+    — on the transformer-LM bench config that is a 1 GB buffer (round-4
+    profile: the CE chain is ~20% of the step's HBM traffic). This VJP
+    saves only the bf16 logits (already live) + a [rows]-sized fp32 lse
+    and recomputes p = exp(logit - lse) inside the fused backward, so the
+    vocab-sized work stays at activation width in both directions."""
+    loss, _ = _ce_hard_fwd_math(logits, lbl, valid)
+    return loss
+
+
+def _ce_hard_fwd_math(logits, lbl, valid):
+    l32 = logits.astype(jnp.float32)
+    m = jnp.max(l32, axis=-1)
+    lse = m + jnp.log(jnp.sum(jnp.exp(l32 - m[..., None]), axis=-1))
+    logit_at = jnp.take_along_axis(l32, lbl[..., None], axis=-1)[..., 0]
+    nll = lse - logit_at
+    loss = jnp.where(valid, nll, 0.0)[..., None]
+    return loss, lse
+
+
+def _ce_hard_fwd(logits, lbl, valid):
+    loss, lse = _ce_hard_fwd_math(logits, lbl, valid)
+    return loss, (logits, lbl, valid, lse)
+
+
+def _ce_hard_bwd(res, dl):
+    logits, lbl, valid, lse = res
+    g = dl[..., 0] * valid
+    # p - onehot via an iota compare: fused elementwise, nothing
+    # vocab-sized materializes in fp32
+    p = jnp.exp(logits.astype(jnp.float32) - lse[..., None])
+    oh = (jax.lax.broadcasted_iota(lbl.dtype, logits.shape,
+                                   logits.ndim - 1) == lbl[..., None])
+    dlogits = ((p - oh.astype(jnp.float32))
+               * g[..., None]).astype(logits.dtype)
+    return dlogits, _float0_zero(lbl), _float0_zero(valid)
+
+
+_ce_hard.defvjp(_ce_hard_fwd, _ce_hard_bwd)
+
+
 @register_op("softmax_with_cross_entropy")
 def _softmax_with_cross_entropy(ctx, ins, attrs):
     """≙ softmax_with_cross_entropy_op.cc (fused, numerically stable)."""
     logits = ins["Logits"][0]
     label = ins["Label"][0]
-    if logits.dtype != jnp.float32 and jnp.issubdtype(logits.dtype,
-                                                      jnp.floating):
-        logits = logits.astype(jnp.float32)  # bf16 logits: loss in fp32
-    logp = jax.nn.log_softmax(logits, axis=-1)
     if attrs.get("soft_label", False):
+        l32 = logits.astype(jnp.float32) \
+            if logits.dtype != jnp.float32 else logits
+        logp = jax.nn.log_softmax(l32, axis=-1)
         loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
-    else:
-        lbl = label
-        if lbl.ndim == logits.ndim and lbl.shape[-1] == 1:
-            lbl = jnp.squeeze(lbl, axis=-1)
-        # labels equal to ignore_index (default -100, commonly -1 for
-        # padding) contribute zero loss and zero gradient
-        ignore = attrs.get("ignore_index", -100)
-        valid = (lbl != ignore)
-        safe = jnp.where(valid, lbl, 0)
-        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)
-        loss = jnp.where(valid[..., None], nll, 0.0)
-    return {"Loss": [loss], "Softmax": [jnp.exp(logp)]}
+        return {"Loss": [loss], "Softmax": [jnp.exp(logp)]}
+    lbl = label
+    if lbl.ndim == logits.ndim and lbl.shape[-1] == 1:
+        lbl = jnp.squeeze(lbl, axis=-1)
+    # labels equal to ignore_index (default -100, commonly -1 for
+    # padding) contribute zero loss and zero gradient
+    ignore = attrs.get("ignore_index", -100)
+    valid = (lbl != ignore)
+    safe = jnp.where(valid, lbl, 0)
+    loss = _ce_hard(logits, safe, valid)
+    # Softmax output: computed lazily from stop_gradient(logits) so it adds
+    # neither residuals nor traffic unless actually consumed (DCE'd away in
+    # the usual loss-only programs)
+    sm = jax.nn.softmax(
+        jax.lax.stop_gradient(logits).astype(jnp.float32), axis=-1)
+    return {"Loss": [loss], "Softmax": [sm]}
 
 
 @register_op("cross_entropy")

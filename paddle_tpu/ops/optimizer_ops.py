@@ -10,6 +10,7 @@ donation making the update in-place on device).
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..framework.registry import register_op
 from ..framework.selected_rows import TracedSelectedRows
@@ -18,17 +19,27 @@ from ..framework.selected_rows import TracedSelectedRows
 def _merge_sparse_rows(g: TracedSelectedRows):
     """Coalesce duplicate rows inside the trace (≙ math::scatter::MergeAdd,
     reference math/selected_rows_functor.cc). Returns (rows_u, values_u)
-    where padding entries carry row index == height — gather sites must clip
-    and scatter sites must use mode='drop'."""
+    where rows_u is SORTED and every entry UNIQUE: padding entries carry
+    DISTINCT out-of-bounds indices (height, height+1, ...), so gather sites
+    must clip, scatter sites must use mode='drop', and both may assert
+    indices_are_sorted/unique_indices — on TPU that lets XLA drop the
+    generic (serializing) scatter path, which round-4 profiling showed
+    dominating the sparse-embedding train step."""
     rows_u, inv = jnp.unique(g.rows, return_inverse=True,
                              size=g.rows.shape[0], fill_value=g.height)
     vals_u = jnp.zeros((rows_u.shape[0],) + tuple(g.value.shape[1:]),
                        dtype=g.value.dtype).at[inv.reshape(-1)].add(g.value)
+    # unique() pads the tail with `height` REPEATED — spread the padding
+    # over distinct OOB indices (still sorted: the tail is the maximum)
+    n = rows_u.shape[0]
+    pad = rows_u >= g.height
+    rows_u = jnp.where(pad, g.height + jnp.arange(n, dtype=rows_u.dtype),
+                       rows_u)
     return rows_u, vals_u
 
 
 def _gather_rows(x, rows, height):
-    return x[jnp.clip(rows, 0, height - 1)]
+    return x.at[jnp.clip(rows, 0, height - 1)].get(indices_are_sorted=True)
 
 
 @register_op("sgd")
@@ -55,11 +66,13 @@ def _momentum(ctx, ins, attrs):
         # (Unlike adam, momentum has no lazy reference mode — freezing
         # untouched rows would silently change training results.)
         rows, g_rows = _merge_sparse_rows(g)
-        v_out = (mu * v).at[rows].add(g_rows.astype(v.dtype), mode="drop")
+        flags = dict(mode="drop", unique_indices=True,
+                     indices_are_sorted=True)
+        v_out = (mu * v).at[rows].add(g_rows.astype(v.dtype), **flags)
         if attrs.get("use_nesterov", False):
             # dense form p - (g + mu*v_out)*lr with g zero off-rows
             p_out = (p - lr * mu * v_out).at[rows].add(
-                -(lr * g_rows).astype(p.dtype), mode="drop")
+                -(lr * g_rows).astype(p.dtype), **flags)
         else:
             p_out = p - lr * v_out
         return {"ParamOut": [p_out], "VelocityOut": [v_out]}
@@ -80,7 +93,32 @@ def _adam(ctx, ins, attrs):
     b1, b2, eps = attrs["beta1"], attrs["beta2"], attrs["epsilon"]
     if isinstance(g, TracedSelectedRows):
         # ≙ adam_op.h SparseAdamFunctor (lazy mode): only looked-up rows of
-        # param and both moments move; beta pows advance globally
+        # param and both moments move; beta pows advance globally.
+        from ..core import flags as _flags
+        table_bytes = int(np.prod(p.shape)) * p.dtype.itemsize
+        if table_bytes <= _flags.get_flag("sparse_dense_apply_max_bytes"):
+            # dense-MASKED lazy apply: scatter-add the raw duplicate rows
+            # (no sort — round-4 profiling: the merge's 160k-id sort alone
+            # is ~12 ms on a v5e while full-table elementwise passes over
+            # a sub-GB table are ~1-4 ms), then update under a touched-row
+            # mask. Semantics identical to the merged-rows path: untouched
+            # rows keep stale moments and do not move; duplicate grads sum
+            # BEFORE the nonlinear update.
+            g_sum = jnp.zeros(p.shape, g.value.dtype).at[g.rows].add(
+                g.value, mode="drop")
+            touched = jnp.zeros((p.shape[0],), jnp.bool_).at[g.rows].set(
+                True, mode="drop")[:, None]
+            m_new = b1 * m + (1 - b1) * g_sum
+            v_new = b2 * v + (1 - b2) * jnp.square(g_sum)
+            lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+            p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+            return {"ParamOut": [jnp.where(touched, p_new.astype(p.dtype),
+                                           p)],
+                    "Moment1Out": [jnp.where(touched,
+                                             m_new.astype(m.dtype), m)],
+                    "Moment2Out": [jnp.where(touched,
+                                             v_new.astype(v.dtype), v)],
+                    "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2]}
         rows, g_rows = _merge_sparse_rows(g)
         m_rows = b1 * _gather_rows(m, rows, g.height) + (1 - b1) * g_rows
         v_rows = (b2 * _gather_rows(v, rows, g.height)
@@ -88,12 +126,14 @@ def _adam(ctx, ins, attrs):
         lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
         p_rows = _gather_rows(p, rows, g.height) \
             - lr_t * m_rows / (jnp.sqrt(v_rows) + eps)
+        flags = dict(mode="drop", unique_indices=True,
+                     indices_are_sorted=True)
         return {"ParamOut": [p.at[rows].set(p_rows.astype(p.dtype),
-                                            mode="drop")],
+                                            **flags)],
                 "Moment1Out": [m.at[rows].set(m_rows.astype(m.dtype),
-                                              mode="drop")],
+                                              **flags)],
                 "Moment2Out": [v.at[rows].set(v_rows.astype(v.dtype),
-                                              mode="drop")],
+                                              **flags)],
                 "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2]}
     m_out = b1 * m + (1 - b1) * g
     v_out = b2 * v + (1 - b2) * jnp.square(g)

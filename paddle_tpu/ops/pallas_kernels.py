@@ -99,6 +99,34 @@ def _segment_mask(qseg_ref, kvseg_ref, block_k):
     return q_ids == kv_ids
 
 
+def _block_alive(q_blk_idx, k_blk_idx, block_q, block_k, causal,
+                 causal_offset, qseg_ref, kvseg_ref):
+    """Cheap scalar predicate: can ANY (query, key) pair in this
+    (q-block, k-block) tile be unmasked? False → the whole tile's matmuls,
+    exp and accumulator updates are skipped (pl.when), which at T=32768
+    causal halves the issued FLOPs and on packed batches skips most
+    cross-segment tiles. Two safe over-approximations compose:
+
+    - causal: alive iff the LAST query row of the block can see the FIRST
+      key column (bottom-right alignment).
+    - segments: alive iff the blocks' id RANGES overlap — exact as a
+      "no-pair-can-match" test for any id assignment (ranges disjoint ⇒ no
+      equality), merely conservative when ranges overlap without an exact
+      match; the per-element mask still zeroes those.
+    Returns None when nothing can be skipped (no causal, no segments)."""
+    alive = None
+    if causal:
+        alive = ((q_blk_idx + 1) * block_q - 1 + causal_offset
+                 >= k_blk_idx * block_k)
+    if qseg_ref is not None:
+        q_ids = qseg_ref[0]
+        kv_ids = kvseg_ref[0]
+        seg_alive = ((jnp.max(q_ids) >= jnp.min(kv_ids))
+                     & (jnp.min(q_ids) <= jnp.max(kv_ids)))
+        alive = seg_alive if alive is None else alive & seg_alive
+    return alive
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, qseg_ref, kvseg_ref, o_ref, lse_ref,
                   m_ref, l_ref, acc_ref, *, scale, causal, block_q, block_k,
                   num_k_blocks, causal_offset, true_tk):
@@ -106,11 +134,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, qseg_ref, kvseg_ref, o_ref, lse_ref,
 
     Grid iterates the k dimension innermost; m/l/acc scratch persists
     across those sequential iterations (TPU grid semantics), implementing
-    the online softmax.
+    the online softmax. Fully-masked tiles are skipped (_block_alive).
     """
     from jax.experimental import pallas as pl
 
     j = pl.program_id(2)
+    qi = pl.program_id(1)
 
     @pl.when(j == 0)
     def _init():
@@ -118,42 +147,52 @@ def _flash_kernel(q_ref, k_ref, v_ref, qseg_ref, kvseg_ref, o_ref, lse_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0]                                   # [bq, D]
-    k = k_ref[0]                                   # [bk, D]
-    v = v_ref[0]                                   # [bk, D]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale  # [bq, bk]
+    def _compute():
+        q = q_ref[0]                               # [bq, D]
+        k = k_ref[0]                               # [bk, D]
+        v = v_ref[0]                               # [bk, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
 
-    k_pos = j * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
-    # padded key columns (from rounding Tk up to the block size) are dead
-    s = jnp.where(k_pos < true_tk, s, _NEG_INF)
-    if qseg_ref is not None:
-        s = jnp.where(_segment_mask(qseg_ref, kvseg_ref, block_k), s,
-                      _NEG_INF)
-    if causal:
-        qi = pl.program_id(1)
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        # bottom-right alignment: matches _attention_reference for Tq != Tk
-        s = jnp.where(q_pos + causal_offset >= k_pos, s, _NEG_INF)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        # padded key columns (from rounding Tk up to the block size) are
+        # dead
+        s = jnp.where(k_pos < true_tk, s, _NEG_INF)
+        if qseg_ref is not None:
+            s = jnp.where(_segment_mask(qseg_ref, kvseg_ref, block_k), s,
+                          _NEG_INF)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            # bottom-right alignment: matches _attention_reference for
+            # Tq != Tk
+            s = jnp.where(q_pos + causal_offset >= k_pos, s, _NEG_INF)
 
-    m_prev = m_ref[:]                              # [bq, 1]
-    l_prev = l_ref[:]
-    m_cur = jnp.max(s, axis=1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)                         # [bq, bk]
-    # a fully-masked row has m == s == NEG_INF, making exp(s - m) == 1 for
-    # every DEAD entry — zero them so such rows output 0, not mean(v)
-    p = jnp.where(s > _NEG_INF / 2, p, 0.0)
-    l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
-    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    m_ref[:] = m_new
-    l_ref[:] = l_new
+        m_prev = m_ref[:]                          # [bq, 1]
+        l_prev = l_ref[:]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                     # [bq, bk]
+        # a fully-masked row has m == s == NEG_INF, making exp(s - m) == 1
+        # for every DEAD entry — zero them so such rows output 0, not
+        # mean(v)
+        p = jnp.where(s > _NEG_INF / 2, p, 0.0)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+        l_ref[:] = l_new
+
+    alive = _block_alive(qi, j, block_q, block_k, causal, causal_offset,
+                         qseg_ref, kvseg_ref)
+    if alive is None:
+        _compute()
+    else:
+        pl.when(alive)(_compute)
 
     @pl.when(j == num_k_blocks - 1)
     def _finalize():
@@ -310,23 +349,31 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0]
-    k = k_ref[0]
-    v = v_ref[0]
-    do = do_ref[0]
-    lse = lse_ref[0][:, :1]                        # [bq, 1] (128-lane bcast)
-    delta = delta_ref[0][:, :1]                    # [bq, 1]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    valid = _bwd_masks(qi, j, block_q, block_k, causal,
-                       causal_offset, true_tq, true_tk, qseg_ref, kvseg_ref)
-    p = jnp.where(valid, jnp.exp(s - lse), 0.0)    # [bq, bk]
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta) * scale
-    acc_ref[:] += jax.lax.dot_general(
-        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]                    # [bq, 1] (128-lane bcast)
+        delta = delta_ref[0][:, :1]                # [bq, 1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        valid = _bwd_masks(qi, j, block_q, block_k, causal, causal_offset,
+                           true_tq, true_tk, qseg_ref, kvseg_ref)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)  # [bq, bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        acc_ref[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    alive = _block_alive(qi, j, block_q, block_k, causal, causal_offset,
+                         qseg_ref, kvseg_ref)
+    if alive is None:
+        _compute()
+    else:
+        pl.when(alive)(_compute)
 
     @pl.when(j == num_k_blocks - 1)
     def _finalize():
@@ -347,26 +394,34 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    q = q_ref[0]
-    k = k_ref[0]
-    v = v_ref[0]
-    do = do_ref[0]
-    lse = lse_ref[0][:, :1]                        # [bq, 1] (128-lane bcast)
-    delta = delta_ref[0][:, :1]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    valid = _bwd_masks(i, ki, block_q, block_k, causal,
-                       causal_offset, true_tq, true_tk, qseg_ref, kvseg_ref)
-    p = jnp.where(valid, jnp.exp(s - lse), 0.0)    # [bq, bk]
-    dv_acc[:] += jax.lax.dot_general(
-        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)        # [bk, D]
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta) * scale                  # [bq, bk]
-    dk_acc[:] += jax.lax.dot_general(
-        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)        # [bk, D]
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]                    # [bq, 1] (128-lane bcast)
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        valid = _bwd_masks(i, ki, block_q, block_k, causal, causal_offset,
+                           true_tq, true_tk, qseg_ref, kvseg_ref)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)  # [bq, bk]
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [bk, D]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale              # [bq, bk]
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [bk, D]
+
+    alive = _block_alive(i, ki, block_q, block_k, causal, causal_offset,
+                         qseg_ref, kvseg_ref)
+    if alive is None:
+        _compute()
+    else:
+        pl.when(alive)(_compute)
 
     @pl.when(i == num_q_blocks - 1)
     def _finalize():

@@ -152,7 +152,19 @@ class ParallelExecutor(Executor):
         batches). Returns (feed, real_rows, padded_rows) — real==padded
         means the feed was already divisible and untouched."""
         from ..framework.program import BATCH_ROW_MASK_NAME
-        sizes = {np.shape(v)[0] for v in feed.values() if np.ndim(v) >= 1}
+
+        def _batch_led(name):
+            # pad ONLY feeds DECLARED batch-led ([-1, ...]): a fixed-shape
+            # auxiliary feed whose dim0 merely equals the batch size must
+            # not be wrapped (mirrors _batch_led_fetches on the fetch
+            # side). Undeclared feeds (sidecars like @SEQLEN) are batch-led
+            # by construction.
+            v = self._find_var(program, name)
+            shape = getattr(v, "shape", None) if v is not None else None
+            return shape is None or (bool(shape) and shape[0] == -1)
+
+        sizes = {np.shape(v)[0] for n, v in feed.items()
+                 if np.ndim(v) >= 1 and _batch_led(n)}
         if not sizes:
             return feed, None, None
         enforce(len(sizes) == 1,
@@ -175,7 +187,8 @@ class ParallelExecutor(Executor):
         idx = np.arange(p) % b
         out = {}
         for name, val in feed.items():
-            if np.ndim(val) >= 1 and np.shape(val)[0] == b:
+            if (np.ndim(val) >= 1 and np.shape(val)[0] == b
+                    and _batch_led(name)):
                 out[name] = np.take(np.asarray(val), idx, axis=0)
             else:
                 out[name] = val

@@ -1,0 +1,260 @@
+"""Server-mode predictor: long-lived serve loop with concurrent requests.
+
+≙ reference inference/api/api_impl.cc:126 (NativePaddlePredictor::Run — a
+long-lived predictor object fielding many requests) and :170 (::Clone — one
+shared-weights predictor per serving thread). The TPU translation:
+
+- PredictorServer accepts TCP connections; each connection is served by a
+  thread holding its own `predictor.clone()` (shared weights/executable
+  cache source, private executor caches) — the clone-per-thread contract.
+- The wire protocol is length-prefixed JSON + raw little-endian C-order
+  tensor bytes, so clients in any language can speak it.
+- A connection may pipeline requests (send several before reading): the
+  per-connection thread answers strictly in order while OTHER connections
+  run concurrently — XLA executions release the GIL, so concurrent
+  requests genuinely overlap on device.
+
+Protocol, per request:
+    u32  header length
+    JSON {"feeds": [{"name", "dtype", "shape"}...], "fetch": [...]? }
+    raw tensor bytes for each feed, in header order
+Response:
+    u32  header length
+    JSON {"outs": [{"name", "dtype", "shape"}...]}   (or {"error": msg})
+    raw tensor bytes for each out
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def _send_msg(sock: socket.socket, header: dict, buffers=()):
+    raw = json.dumps(header).encode()
+    sock.sendall(struct.pack("<I", len(raw)))
+    sock.sendall(raw)
+    for b in buffers:
+        sock.sendall(b)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        c = sock.recv(min(n, 1 << 20))
+        if not c:
+            raise ConnectionError("peer closed")
+        chunks.append(c)
+        n -= len(c)
+    return b"".join(chunks)
+
+
+def _recv_msg(sock: socket.socket):
+    try:
+        hlen, = struct.unpack("<I", _recv_exact(sock, 4))
+    except ConnectionError:
+        return None, None
+    header = json.loads(_recv_exact(sock, hlen))
+    buffers = []
+    for spec in header.get("feeds", header.get("outs", [])):
+        n = int(np.prod(spec["shape"])) * np.dtype(spec["dtype"]).itemsize
+        buffers.append(_recv_exact(sock, n))
+    return header, buffers
+
+
+class PredictorServer:
+    """Serve a Predictor (or ExportedPredictor) over TCP.
+
+    `predictor` needs .run(feed, fetch_names=None, return_numpy=True); if it
+    has .clone(), every connection thread gets its own clone (≙ reference
+    api_impl.cc:170), otherwise the single object is shared (safe for
+    ExportedPredictor, whose call is stateless).
+    """
+
+    def __init__(self, predictor, host: str = "127.0.0.1", port: int = 0):
+        self._base = predictor
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.address = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._conns: List[socket.socket] = []
+        self._lock = threading.Lock()
+        self._accept_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "PredictorServer":
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def shutdown(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        # close live connections so threads blocked in recv() exit NOW
+        # instead of eating the join timeout each
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *a):
+        self.shutdown()
+
+    # -- internals --------------------------------------------------------
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # socket closed by shutdown
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            with self._lock:
+                self._conns.append(conn)
+                self._threads = [x for x in self._threads if x.is_alive()]
+                self._threads.append(t)
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket):
+        """Reader thread + worker thread per connection. The reader ALWAYS
+        drains incoming requests into a queue and the worker executes +
+        responds in order: with both roles on one thread, a client that
+        pipelines faster than it reads would fill both TCP buffers and
+        deadlock the pair in sendall (server not reading because it is
+        writing). The queue is the explicit in-flight buffer instead."""
+        import queue as _q
+
+        # per-thread context reuse: ONE clone for the connection's lifetime,
+        # its executor caches warm across requests
+        predictor = (self._base.clone() if hasattr(self._base, "clone")
+                     else self._base)
+        requests: "_q.Queue" = _q.Queue()
+        _EOF = object()
+
+        def work():
+            try:
+                while True:
+                    item = requests.get()
+                    if item is _EOF:
+                        return
+                    header, buffers = item
+                    try:
+                        feed = {}
+                        for spec, raw in zip(header["feeds"], buffers):
+                            feed[spec["name"]] = np.frombuffer(
+                                raw, dtype=np.dtype(spec["dtype"])).reshape(
+                                    spec["shape"])
+                        outs = predictor.run(
+                            feed, fetch_names=header.get("fetch"),
+                            return_numpy=True)
+                        names = header.get("fetch") or getattr(
+                            predictor, "fetch_names",
+                            [f"out{i}" for i in range(len(outs))])
+                        outs = [np.ascontiguousarray(o) for o in outs]
+                        resp = {"outs": [
+                            {"name": n, "dtype": str(o.dtype),
+                             "shape": list(o.shape)}
+                            for n, o in zip(names, outs)]}
+                        _send_msg(conn, resp, [o.tobytes() for o in outs])
+                    except Exception as e:  # per-request error, keep going
+                        try:
+                            _send_msg(conn,
+                                      {"error": f"{type(e).__name__}: {e}"})
+                        except OSError:
+                            return
+            except (ConnectionError, OSError):
+                pass
+
+        worker = threading.Thread(target=work, daemon=True)
+        worker.start()
+        try:
+            while not self._stop.is_set():
+                header, buffers = _recv_msg(conn)
+                if header is None:
+                    return
+                requests.put((header, buffers))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            requests.put(_EOF)
+            worker.join(timeout=30)
+            conn.close()
+            with self._lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+
+
+class PredictorClient:
+    """Client for PredictorServer; supports request pipelining.
+
+    infer(feed) is the blocking RPC. For pipelined throughput, call
+    send(feed) repeatedly and then recv() for each — responses arrive in
+    order on one connection, so K in-flight requests hide the round trip.
+    """
+
+    def __init__(self, host: str, port: int):
+        self._sock = socket.create_connection((host, port))
+        self._pending = 0
+        self._lock = threading.Lock()
+
+    def send(self, feed: Dict[str, Any],
+             fetch: Optional[Sequence[str]] = None):
+        arrays = {n: np.ascontiguousarray(v) for n, v in feed.items()}
+        header = {"feeds": [{"name": n, "dtype": str(a.dtype),
+                             "shape": list(a.shape)}
+                            for n, a in arrays.items()]}
+        if fetch is not None:
+            header["fetch"] = list(fetch)
+        with self._lock:
+            _send_msg(self._sock, header,
+                      [a.tobytes() for a in arrays.values()])
+            self._pending += 1
+
+    def recv(self) -> List[np.ndarray]:
+        header, buffers = _recv_msg(self._sock)
+        if header is None:
+            raise ConnectionError("server closed the connection")
+        self._pending -= 1
+        if "error" in header:
+            raise RuntimeError(f"server error: {header['error']}")
+        return [np.frombuffer(raw, dtype=np.dtype(spec["dtype"]))
+                .reshape(spec["shape"])
+                for spec, raw in zip(header["outs"], buffers)]
+
+    def infer(self, feed: Dict[str, Any],
+              fetch: Optional[Sequence[str]] = None) -> List[np.ndarray]:
+        self.send(feed, fetch)
+        return self.recv()
+
+    def close(self):
+        self._sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()

@@ -1,0 +1,316 @@
+"""Four-process distributed depth tests (VERDICT r3 missing #2 / next #5).
+
+≙ reference test_dist_base.py:27 forking N-trainer worlds (N > 2) over
+nccl_helper.h:118's multi-rank bootstrap. Three capabilities the 2-process
+suite (test_dist_multiproc.py) cannot witness:
+
+1. a FOUR-process jax.distributed world (8 global devices);
+2. a dp×tp mesh whose TENSOR-parallel groups span process boundaries
+   (tp=4 over 2-device processes ⇒ every tp collective crosses processes),
+   with loss parity against the single-process 8-device run — plain,
+   scan-fused run_steps, and ZeRO-1;
+3. elastic resize 4→2: a 4-process world saves a sharded checkpoint
+   (4 per-process shard manifests), a FRESH 2-process world re-shards it
+   onto half the processes and finishes training with loss parity against
+   an uninterrupted single-process run.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_BOOT = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax._src.xla_bridge as _xb
+_xb._backend_factories.pop("axon", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, __REPO__)
+"""
+
+
+def _script(body):
+    return body.replace("__REPO__", repr(REPO))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_world(tmp_path, script, n, port, extra_env=None):
+    procs = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINING_ROLE": "TRAINER",
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(n),
+            "PADDLE_COORDINATOR_ENDPOINT": f"127.0.0.1:{port}",
+        })
+        env.update(extra_env or {})
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _script(script)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=str(tmp_path)))
+    return procs
+
+
+def _join_world(procs, timeout=420):
+    results = {}
+    for p in procs:
+        out, err = p.communicate(timeout=timeout)
+        assert p.returncode == 0, f"child failed:\n{err[-3000:]}"
+        rec = json.loads(out.strip().splitlines()[-1])
+        results[rec["rank"]] = rec
+    return results
+
+
+# ---------------------------------------------------------------------------
+# shared tp model: column-parallel fc -> row-parallel fc, tp groups span
+# process boundaries on the 4x2 world
+# ---------------------------------------------------------------------------
+
+_TP_MODEL = r"""
+import numpy as np
+
+
+def build_and_train(steps=5, fused=False, zero1=False, dp=2, tp=4):
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.parallel import (BuildStrategy, DeviceMesh,
+                                     ParallelExecutor, ReduceStrategy)
+
+    with unique_name.guard():
+        x = layers.data("x", shape=[8])
+        y = layers.data("y", shape=[1])
+        # column-parallel then row-parallel: the Megatron pair — forward
+        # needs one cross-process all-reduce on the row-parallel output
+        h = layers.fc(x, size=16, act="relu", name="tp_fc1",
+                      param_attr=pt.ParamAttr(name="tp_fc1.w",
+                                              sharding_spec=(None, "tp")))
+        pred = layers.fc(h, size=1, name="tp_fc2",
+                         param_attr=pt.ParamAttr(name="tp_fc2.w",
+                                                 sharding_spec=("tp", None)))
+        loss = layers.reduce_mean(layers.square(pred - y))
+        pt.optimizer.MomentumOptimizer(learning_rate=0.05,
+                                       momentum=0.9).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+
+    bs = BuildStrategy()
+    if zero1:
+        bs.reduce_strategy = ReduceStrategy.Reduce
+    mesh = DeviceMesh(jax.devices(), axes={"dp": dp, "tp": tp})
+    pe = ParallelExecutor(loss_name=loss.name, mesh=mesh,
+                          build_strategy=bs)
+
+    W = np.random.RandomState(7).randn(8, 1).astype("float32")
+    feeds = []
+    for i in range(steps):
+        rb = np.random.RandomState(100 + i)
+        xb = rb.rand(16, 8).astype("float32")          # global batch
+        feeds.append({"x": xb, "y": (xb @ W).astype("float32")})
+    if fused:
+        return [float(v) for v in
+                pe.run_steps(feeds, fetch_list=[loss.name])[0]]
+    return [float(pe.run(feed=f, fetch_list=[loss.name])[0])
+            for f in feeds]
+"""
+
+_TP_SINGLE = r"""
+import json
+import paddle_tpu as pt
+from tp_model import build_and_train
+out = {"plain": build_and_train()}
+pt.reset_default_programs(); pt.reset_global_scope()
+out["zero1"] = build_and_train(zero1=True)
+print(json.dumps(out), flush=True)
+"""
+
+_TP_MULTI = _BOOT + r"""
+import json
+import jax
+import paddle_tpu as pt
+from paddle_tpu.distributed import init_parallel_env
+from tp_model import build_and_train
+
+env = init_parallel_env()
+assert jax.process_count() == 4, jax.process_count()
+assert len(jax.devices()) == 8
+out = {"rank": env.trainer_id, "plain": build_and_train()}
+pt.reset_default_programs(); pt.reset_global_scope()
+out["zero1"] = build_and_train(zero1=True)
+pt.reset_default_programs(); pt.reset_global_scope()
+out["fused"] = build_and_train(fused=True)
+print(json.dumps(out), flush=True)
+"""
+
+
+def test_four_process_tp_spanning_parity(tmp_path):
+    with open(tmp_path / "tp_model.py", "w") as f:
+        f.write(_TP_MODEL)
+
+    # single-process reference: 8 virtual devices, same dp=2 x tp=4 mesh
+    boot8 = _BOOT.replace("host_platform_device_count=2",
+                          "host_platform_device_count=8")
+    ref = subprocess.run(
+        [sys.executable, "-c", _script(boot8 + _TP_SINGLE)],
+        capture_output=True, text=True, timeout=420, cwd=str(tmp_path))
+    assert ref.returncode == 0, ref.stderr[-3000:]
+    ref_losses = json.loads(ref.stdout.strip().splitlines()[-1])
+
+    procs = _spawn_world(tmp_path, _TP_MULTI, 4, _free_port())
+    results = _join_world(procs)
+
+    assert set(results) == {0, 1, 2, 3}
+    # scan-fused == per-step on the 4-process world
+    np.testing.assert_allclose(results[0]["fused"], results[0]["plain"],
+                               rtol=2e-4)
+    for variant in ("plain", "zero1"):
+        for rank in (1, 2, 3):
+            np.testing.assert_allclose(results[0][variant],
+                                       results[rank][variant], rtol=1e-6)
+        np.testing.assert_allclose(results[0][variant],
+                                   ref_losses[variant], rtol=2e-4)
+        assert results[0][variant][-1] < results[0][variant][0]
+
+
+# ---------------------------------------------------------------------------
+# elastic resize 4 -> 2 via sharded checkpoint re-shard
+# ---------------------------------------------------------------------------
+
+_RS_MODEL = r"""
+import numpy as np
+
+
+def build():
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.core import unique_name
+    with unique_name.guard():
+        x = layers.data("x", shape=[8])
+        y = layers.data("y", shape=[1])
+        h = layers.fc(x, size=16, act="relu", name="rs_fc1")
+        pred = layers.fc(h, size=1, name="rs_fc2")
+        loss = layers.reduce_mean(layers.square(pred - y))
+        pt.optimizer.MomentumOptimizer(learning_rate=0.05,
+                                       momentum=0.9).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    return exe, loss
+
+
+def step_feed(i):
+    W = np.random.RandomState(7).randn(8, 1).astype("float32")
+    rb = np.random.RandomState(100 + i)
+    xb = rb.rand(16, 8).astype("float32")
+    return {"x": xb, "y": (xb @ W).astype("float32")}
+"""
+
+_RS_PHASE_A = _BOOT + r"""
+import glob, json, time
+import jax
+import paddle_tpu as pt
+from paddle_tpu.distributed import init_parallel_env
+from paddle_tpu.parallel import DeviceMesh, ParallelExecutor
+from rs_model import build, step_feed
+
+env = init_parallel_env()
+assert jax.process_count() == 4
+exe, loss = build()
+pe = ParallelExecutor(loss_name=loss.name, mesh=DeviceMesh(jax.devices()))
+losses = []
+for i in range(3):
+    losses.append(float(pe.run(feed=step_feed(i),
+                               fetch_list=[loss.name])[0]))
+d = os.path.join(os.environ["RS_WORK"], "ckpt")
+pt.io.save_persistables(dirname=d, sharded=True)
+# a 4-process checkpoint is complete once all 4 manifests landed
+while len(glob.glob(os.path.join(d, "manifest-*.json"))) < 4:
+    time.sleep(0.05)
+print(json.dumps({"rank": env.trainer_id, "losses": losses}), flush=True)
+"""
+
+_RS_PHASE_B = _BOOT + r"""
+import json
+import jax
+import paddle_tpu as pt
+from paddle_tpu.distributed import init_parallel_env
+from paddle_tpu.parallel import DeviceMesh, ParallelExecutor
+from rs_model import build, step_feed
+
+env = init_parallel_env()
+assert jax.process_count() == 2          # the RESIZED world
+exe, loss = build()
+# restore the 4-process (8-way) checkpoint onto this 2-process (4-way)
+# world: ShardedCheckpoint re-assembles slices per var and re-shards
+pt.io.load_persistables(dirname=os.path.join(os.environ["RS_WORK"], "ckpt"),
+                        sharded=True)
+pe = ParallelExecutor(loss_name=loss.name, mesh=DeviceMesh(jax.devices()))
+losses = []
+for i in range(3, 6):
+    losses.append(float(pe.run(feed=step_feed(i),
+                               fetch_list=[loss.name])[0]))
+print(json.dumps({"rank": env.trainer_id, "losses": losses}), flush=True)
+"""
+
+_RS_REF = r"""
+import json
+from rs_model import build, step_feed
+import jax
+from paddle_tpu.parallel import DeviceMesh, ParallelExecutor
+exe, loss = build()
+pe = ParallelExecutor(loss_name=loss.name, mesh=DeviceMesh(jax.devices()))
+print(json.dumps([float(pe.run(feed=step_feed(i),
+                               fetch_list=[loss.name])[0])
+                  for i in range(6)]), flush=True)
+"""
+
+
+def test_elastic_resize_4_to_2(tmp_path):
+    with open(tmp_path / "rs_model.py", "w") as f:
+        f.write(_RS_MODEL)
+
+    # uninterrupted single-process reference (4 devices)
+    boot4 = _BOOT.replace("host_platform_device_count=2",
+                          "host_platform_device_count=4")
+    ref = subprocess.run(
+        [sys.executable, "-c", _script(boot4 + _RS_REF)],
+        capture_output=True, text=True, timeout=420, cwd=str(tmp_path))
+    assert ref.returncode == 0, ref.stderr[-3000:]
+    ref_losses = json.loads(ref.stdout.strip().splitlines()[-1])
+
+    extra = {"RS_WORK": str(tmp_path)}
+    a = _join_world(_spawn_world(tmp_path, _RS_PHASE_A, 4, _free_port(),
+                                 extra))
+    assert set(a) == {0, 1, 2, 3}
+    manifests = glob.glob(str(tmp_path / "ckpt" / "manifest-*.json"))
+    assert len(manifests) == 4       # one shard manifest per process
+
+    b = _join_world(_spawn_world(tmp_path, _RS_PHASE_B, 2, _free_port(),
+                                 extra))
+    assert set(b) == {0, 1}
+
+    full = a[0]["losses"] + b[0]["losses"]
+    np.testing.assert_allclose(b[0]["losses"], b[1]["losses"], rtol=1e-6)
+    np.testing.assert_allclose(full, ref_losses, rtol=2e-4)
+    assert full[-1] < full[0]

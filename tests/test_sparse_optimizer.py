@@ -170,13 +170,20 @@ def _table_op_kinds(mlir_text, vocab, dim):
 
 
 class TestCompiledSparsity:
-    def test_hlo_has_no_dense_table_update(self, rng):
-        """The compiled train step must touch the table only via gather and
+    def test_hlo_has_no_dense_table_update(self, rng, monkeypatch):
+        """With the dense-masked policy disabled (the EP-scale setting),
+        the compiled train step must touch the table only via gather and
         row-scatter: no [vocab, dim]-shaped elementwise update ops. This is
         the property that makes the update O(batch*dim) — asserted on the
         HLO so a regression to dense math fails CI even where wall-clock
         differences are masked by runtime overhead."""
         import jax.numpy as jnp
+
+        # force the row path (default policy dense-masks small tables
+        # because the merge SORT dominates on TPU — see optimizer_ops)
+        from paddle_tpu.core import flags as _flags
+        monkeypatch.setattr(_flags._REGISTRY["sparse_dense_apply_max_bytes"],
+                            "value", 0)
         big_v = 4096  # big enough that a dense update would be visible
         ids = layers.data("ids", shape=[3], dtype="int64")
         emb = layers.embedding(ids, size=[big_v, DIM], is_sparse=True,
@@ -202,6 +209,40 @@ class TestCompiledSparsity:
         assert not (kinds & banned), (
             f"dense table-shaped math leaked into the sparse step: "
             f"{sorted(kinds & banned)}")
+
+
+class TestDenseMaskedPolicy:
+    def test_dense_masked_matches_row_path(self, rng, monkeypatch):
+        """The size-thresholded dense-MASKED lazy adam (no sort — the
+        round-4 TPU win) must match the merged-rows path numerically,
+        including untouched rows staying bit-identical."""
+        from paddle_tpu.core import flags as _flags
+        ids_batches = [rng.randint(0, VOCAB, (4, 3)).astype("int64")
+                       for _ in range(3)]
+        ids_batches[1][0, :2] = ids_batches[1][0, 2]  # duplicates
+
+        def train(max_bytes, w0=None):
+            pt.reset_default_programs()
+            pt.reset_global_scope()
+            monkeypatch.setattr(
+                _flags._REGISTRY["sparse_dense_apply_max_bytes"],
+                "value", max_bytes)
+            exe, loss = _build(pt.optimizer.Adam(learning_rate=0.1))
+            if w0 is None:
+                w_init = _table()
+            else:
+                pt.global_scope().set_var("emb_w", w0)
+                w_init = w0
+            for ids in ids_batches:
+                exe.run(feed={"ids": ids}, fetch_list=[loss])
+            return w_init, _table()
+
+        w0, w_rows = train(0)
+        _, w_dense = train(1 << 30, w0=w0)
+        np.testing.assert_allclose(w_dense, w_rows, rtol=1e-6, atol=1e-7)
+        untouched = sorted(set(range(VOCAB))
+                           - set(np.concatenate(ids_batches).ravel()))
+        np.testing.assert_array_equal(w_dense[untouched], w0[untouched])
 
 
 class TestFallbacks:

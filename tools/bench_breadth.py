@@ -164,7 +164,7 @@ def build_deepfm(rng):
     from paddle_tpu.models import deepfm
     b = 4096
     loss, _ = deepfm.deepfm(num_fields=39, vocab_size=1000000,
-                            is_sparse=True)
+                            is_sparse=True, row_pad=128)
     # 8 distinct batches; each example's ids hit near-unique rows of the
     # 1M-row tables, so a single fixed batch is memorized through its own
     # embedding rows within a few visits — labels are instead a function of

@@ -39,6 +39,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.profiler",
     "paddle_tpu.trainer",
     "paddle_tpu.inferencer",
+    "paddle_tpu.serving",
     "paddle_tpu.nets",
     "paddle_tpu.concurrency",
     "paddle_tpu.transpiler",

@@ -1,0 +1,73 @@
+"""Attribute the transformer-LM bench config's step time on the TPU.
+
+    env PYTHONPATH=/root/.axon_site:/root/repo python tools/probe_lm.py
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main(b=16, t=512):
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer
+
+    sys.path.insert(0, "/root/repo")
+
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    rng = np.random.RandomState(0)
+    with pt.core.unique_name.guard():
+        loss, _ = transformer.transformer_lm(
+            vocab=32000, max_len=t, d_model=512, d_inner=2048, num_heads=8,
+            num_layers=6, dropout=0.0)
+        opt = pt.optimizer.AdamOptimizer(learning_rate=1e-4)
+        opt.minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    feed = {"tokens": jnp.asarray(
+                rng.randint(0, 32000, (b, t)).astype("int64")),
+            "tokens@SEQLEN": jnp.asarray(np.full((b,), t, "int32")),
+            "targets": jnp.asarray(
+                rng.randint(0, 32000, (b, t)).astype("int64"))}
+    prog, scope = pt.default_main_program(), pt.global_scope()
+    compiled = exe._lookup_or_compile(prog, feed, [loss.name], scope)
+    feed_vals = tuple(jnp.asarray(feed[n]) for n in compiled.feed_names)
+    ro_vals = tuple(scope.get(n) for n in compiled.ro_names)
+    rw_vals = tuple(scope.get(n) for n in compiled.rw_names)
+    ex = compiled.fn.lower(feed_vals, ro_vals, rw_vals,
+                           np.uint32(0)).compile()
+    with open("/tmp/lm_train.hlo", "w") as f:
+        f.write(ex.as_text())
+    ca = ex.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    bytes_acc = float(ca.get("bytes accessed", 0))
+    flops = float(ca.get("flops", 0))
+
+    o = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
+    float(np.asarray(o[0]).ravel()[0])
+    best = None
+    for _ in range(3):
+        t0 = time.time()
+        fetched = []
+        for _ in range(15):
+            o = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
+            fetched.append(o[0])
+        float(np.asarray(fetched[-1]).ravel()[0])
+        dt = (time.time() - t0) / 15
+        best = dt if best is None else min(best, dt)
+    print(json.dumps({
+        "step_ms": round(best * 1e3, 2),
+        "bytes_GB": round(bytes_acc / 1e9, 2),
+        "flops_G": round(flops / 1e9, 1),
+        "ideal_mxu_ms": round(flops / 197e12 * 1e3, 2),
+        "ideal_hbm_ms": round(bytes_acc / 819e9 * 1e3, 2),
+        "mfu": round(flops / best / 197e12, 4),
+        "tokens_per_s": round(b * t / best),
+    }))
+
+
+if __name__ == "__main__":
+    main()

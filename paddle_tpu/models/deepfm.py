@@ -61,6 +61,11 @@ def deepfm(feat_ids=None, feat_vals=None, label=None, num_fields=39,
         emb = layers.slice(fused, axes=[2], starts=[1],
                            ends=[1 + embed_dim])
     else:
+        if row_pad:
+            raise NotImplementedError(
+                "row_pad tile-aligns the FUSED table; with "
+                "fuse_first_order=False pass row_pad=None (the unfused "
+                "[vocab,1]/[vocab,E] tables keep their logical widths)")
         # first-order: per-feature scalar weight
         w1 = layers.embedding(input=feat_ids, size=[vocab_size, 1],
                               is_sparse=is_sparse)                    # [B,F,1]

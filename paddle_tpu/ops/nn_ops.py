@@ -433,11 +433,11 @@ def _softmax_with_cross_entropy(ctx, ins, attrs):
     valid = (lbl != ignore)
     safe = jnp.where(valid, lbl, 0)
     loss = _ce_hard(logits, safe, valid)
-    # Softmax output: computed lazily from stop_gradient(logits) so it adds
-    # neither residuals nor traffic unless actually consumed (DCE'd away in
-    # the usual loss-only programs)
-    sm = jax.nn.softmax(
-        jax.lax.stop_gradient(logits).astype(jnp.float32), axis=-1)
+    # Softmax output: a separate differentiable branch (distillation /
+    # entropy terms differentiate through it). Unused -> the whole branch
+    # is DCE'd, so the custom-vjp loss path stays residual-lean in the
+    # common loss-only programs.
+    sm = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     return {"Loss": [loss], "Softmax": [sm]}
 
 

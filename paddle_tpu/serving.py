@@ -152,7 +152,10 @@ class PredictorServer:
         # its executor caches warm across requests
         predictor = (self._base.clone() if hasattr(self._base, "clone")
                      else self._base)
-        requests: "_q.Queue" = _q.Queue()
+        # bounded: past 128 queued requests the reader stops reading and
+        # TCP backpressure reaches the client — a runaway pipeliner stalls
+        # itself instead of growing server memory without limit
+        requests: "_q.Queue" = _q.Queue(maxsize=128)
         _EOF = object()
 
         def work():
@@ -218,8 +221,7 @@ class PredictorClient:
 
     def __init__(self, host: str, port: int):
         self._sock = socket.create_connection((host, port))
-        self._pending = 0
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # serializes concurrent send()s
 
     def send(self, feed: Dict[str, Any],
              fetch: Optional[Sequence[str]] = None):
@@ -232,13 +234,11 @@ class PredictorClient:
         with self._lock:
             _send_msg(self._sock, header,
                       [a.tobytes() for a in arrays.values()])
-            self._pending += 1
 
     def recv(self) -> List[np.ndarray]:
         header, buffers = _recv_msg(self._sock)
         if header is None:
             raise ConnectionError("server closed the connection")
-        self._pending -= 1
         if "error" in header:
             raise RuntimeError(f"server error: {header['error']}")
         return [np.frombuffer(raw, dtype=np.dtype(spec["dtype"]))

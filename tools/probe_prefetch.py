@@ -1,0 +1,100 @@
+"""Probe: prefetcher link utilization vs the CONCURRENTLY-measured link.
+
+Measures (1) raw uint8 h2d staging bandwidth several times, (2) the
+DevicePrefetcher-fed ResNet bs128 train loop, (3) bandwidth again — so the
+fed rate can be judged against the link speed of the SAME session (the dev
+tunnel drifts ~2x between sessions; VERDICT r3 weak #1 was exactly a fed
+number divided by another window's link measure).
+
+    env PYTHONPATH=/root/.axon_site:/root/repo python tools/probe_prefetch.py
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def link_mbps(batch=128, reps=3):
+    import jax
+
+    x = (np.random.RandomState(0).rand(batch, 224, 224, 3) * 255
+         ).astype("uint8")
+    d = jax.device_put(x)
+    _ = np.asarray(d[0, 0, 0, 0])
+    best = None
+    for _ in range(reps):
+        t0 = time.time()
+        d = jax.device_put(x)
+        _ = np.asarray(d[0, 0, 0, 0])
+        dt = time.time() - t0
+        best = dt if best is None else min(best, dt)
+    return x.nbytes / best / 1e6
+
+
+def main(batch=128, iters=16):
+    import jax.numpy as jnp
+
+    sys.path.insert(0, "/root/repo")
+    import bench
+
+    link_before = link_mbps(batch)
+
+    exe, loss = bench._build_resnet_train(batch)
+    # warm the compiled step with a staged batch
+    rng = np.random.RandomState(0)
+    feed0 = {
+        "img": jnp.asarray((rng.rand(batch, 224, 224, 3) * 255)
+                           .astype("uint8")),
+        "label": jnp.asarray(rng.randint(0, 1000, (batch, 1))
+                             .astype("int64")),
+    }
+    out = exe.run(feed=feed0, fetch_list=[loss], return_numpy=False)
+    float(out[0])
+
+    from paddle_tpu.data.feeder import staging_specs
+    from paddle_tpu.data.prefetch import DevicePrefetcher
+
+    host_batches = [
+        {"img": rng.rand(batch, 224, 224, 3).astype("float32"),
+         "label": rng.randint(0, 1000, (batch, 1)).astype("int64")}
+        for _ in range(4)
+    ]
+    specs = staging_specs()
+
+    results = {}
+    for cap in (2, 4):
+        def feed_iter():
+            for i in range(iters + 2):
+                yield host_batches[i % len(host_batches)]
+
+        pf = iter(DevicePrefetcher(feed_iter, capacity=cap, staging=specs))
+        for _ in range(2):
+            out = exe.run(feed=next(pf), fetch_list=[loss],
+                          return_numpy=False)
+        float(out[0])
+        fetched = []
+        t0 = time.time()
+        for feed in pf:
+            out = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
+            fetched.append(out[0])
+        float(fetched[-1])
+        dt = time.time() - t0
+        rate = batch * len(fetched) / dt
+        results[f"cap{cap}_imgs_s"] = round(rate, 2)
+        results[f"cap{cap}_wire_MBps"] = round(
+            rate * 224 * 224 * 3 / 1e6, 2)
+
+    link_after = link_mbps(batch)
+    results["link_before_MBps"] = round(link_before, 1)
+    results["link_after_MBps"] = round(link_after, 1)
+    link = max(link_before, link_after)
+    results["utilization_cap2"] = round(
+        results["cap2_wire_MBps"] / link, 3)
+    results["utilization_cap4"] = round(
+        results["cap4_wire_MBps"] / link, 3)
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

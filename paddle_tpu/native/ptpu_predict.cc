@@ -2,10 +2,22 @@
 //
 // Loads the single-platform StableHLO artifact written by
 // io.export_inference_model (__exported_native__.stablehlo +
-// __exported_native__.meta), feeds it .npy input tensors, executes it
-// through the TensorFlow eager C API's XlaCallModule kernel (which JIT
-// compiles the module with XLA:CPU in-process), and writes each output as
-// out<i>.npy.
+// __exported_native__.meta) and executes it through the TensorFlow eager
+// C API's XlaCallModule kernel (which JIT compiles the module with XLA:CPU
+// in-process). Two modes:
+//
+//   ptpu_predict <export_dir> <input0.npy> [...] [--out DIR]
+//     one-shot CLI: feed .npy tensors, write each output as out<i>.npy
+//
+//   ptpu_predict <export_dir> --serve [PORT]
+//     server mode: long-lived TCP loop speaking the same length-prefixed
+//     JSON + raw-tensor protocol as paddle_tpu.serving.PredictorServer, so
+//     the Python PredictorClient (or any client of that protocol) talks to
+//     this process directly. Each connection is served by a thread holding
+//     its OWN TFE context over the shared module bytes — the
+//     clone-per-thread contract of the reference's NativePaddlePredictor
+//     (api_impl.cc:170 ::Clone), with a reader/worker split per connection
+//     so pipelining clients cannot deadlock the pair (≙ serving.py).
 //
 // Capability equivalent of the reference's C++ inference stack: the
 // deployable unit a C++ server loads with no Python anywhere in the
@@ -15,19 +27,30 @@
 // because this environment ships no standalone PJRT plugin .so; the
 // XlaCallModule path is the same one jax2tf serving uses in production.
 //
-// Usage:
-//   ptpu_predict <export_dir> <input0.npy> [<input1.npy> ...] [--out DIR]
-//
-// Inputs are positional in the meta's `in` order. Symbolic (-1) dims are
-// refined from the actual inputs by the kernel.
+// Inputs are positional in the meta's `in` order (CLI) or matched by name
+// (server). Symbolic (-1) dims are refined from the actual inputs by the
+// kernel.
 
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cctype>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <deque>
 #include <fstream>
+#include <map>
+#include <memory>
+#include <mutex>
 #include <sstream>
+#include <stdexcept>
 #include <string>
+#include <thread>
 #include <vector>
 
 #include "tensorflow/c/c_api.h"
@@ -36,8 +59,7 @@
 namespace {
 
 [[noreturn]] void Die(const std::string& msg) {
-  std::fprintf(stderr, "ptpu_predict: %s\n", msg.c_str());
-  std::exit(1);
+  throw std::runtime_error(msg);
 }
 
 void CheckOk(TF_Status* s, const char* what) {
@@ -177,103 +199,584 @@ Meta ReadMeta(const std::string& path) {
   return m;
 }
 
-}  // namespace
+// -- minimal JSON (objects/arrays/strings/numbers), just enough for the
+//    serving protocol's fixed request schema --------------------------------
 
-int main(int argc, char** argv) {
-  if (argc < 3) {
-    std::fprintf(stderr,
-                 "usage: %s <export_dir> <input0.npy> [...] [--out DIR]\n",
-                 argv[0]);
-    return 2;
+struct Json {
+  enum Kind { kNull, kBool, kNum, kStr, kArr, kObj } kind = kNull;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::vector<Json> arr;
+  std::map<std::string, Json> obj;
+
+  bool Has(const std::string& k) const { return obj.count(k) != 0; }
+  const Json& At(const std::string& k) const {
+    auto it = obj.find(k);
+    if (it == obj.end()) Die("missing JSON key '" + k + "'");
+    return it->second;
   }
-  std::string dir = argv[1];
-  std::string out_dir = ".";
-  std::vector<std::string> input_paths;
-  for (int i = 2; i < argc; ++i) {
-    if (std::strcmp(argv[i], "--out") == 0 && i + 1 < argc) {
-      out_dir = argv[++i];
-    } else {
-      input_paths.push_back(argv[i]);
+};
+
+class JsonParser {
+ public:
+  explicit JsonParser(const std::string& s) : s_(s) {}
+  Json Parse() {
+    Json v = Value();
+    Ws();
+    if (p_ != s_.size()) Die("trailing JSON content");
+    return v;
+  }
+
+ private:
+  void Ws() { while (p_ < s_.size() && std::isspace((unsigned char)s_[p_])) ++p_; }
+  char Peek() {
+    Ws();
+    if (p_ >= s_.size()) Die("unexpected end of JSON");
+    return s_[p_];
+  }
+  void Expect(char c) {
+    if (Peek() != c) Die(std::string("expected '") + c + "' in JSON");
+    ++p_;
+  }
+  Json Value() {
+    char c = Peek();
+    if (c == '{') return Object();
+    if (c == '[') return Array();
+    if (c == '"') { Json v; v.kind = Json::kStr; v.str = String(); return v; }
+    if (c == 't' || c == 'f') return Bool();
+    if (c == 'n') { Lit("null"); return Json{}; }
+    return Number();
+  }
+  void Lit(const char* lit) {
+    size_t n = std::strlen(lit);
+    if (s_.compare(p_, n, lit) != 0) Die("bad JSON literal");
+    p_ += n;
+  }
+  Json Bool() {
+    Json v; v.kind = Json::kBool;
+    if (s_[p_] == 't') { Lit("true"); v.b = true; } else { Lit("false"); }
+    return v;
+  }
+  Json Number() {
+    size_t start = p_;
+    while (p_ < s_.size() &&
+           (std::isdigit((unsigned char)s_[p_]) || std::strchr("+-.eE", s_[p_])))
+      ++p_;
+    Json v; v.kind = Json::kNum;
+    v.num = std::stod(s_.substr(start, p_ - start));
+    return v;
+  }
+  std::string String() {
+    Expect('"');
+    std::string out;
+    while (p_ < s_.size() && s_[p_] != '"') {
+      char c = s_[p_++];
+      if (c == '\\') {
+        if (p_ >= s_.size()) Die("bad JSON escape");
+        char e = s_[p_++];
+        switch (e) {
+          case 'n': out += '\n'; break;
+          case 't': out += '\t'; break;
+          case 'r': out += '\r'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'u': {  // BMP only; serving names are ASCII in practice
+            if (p_ + 4 > s_.size()) Die("bad \\u escape");
+            unsigned code = std::stoul(s_.substr(p_, 4), nullptr, 16);
+            p_ += 4;
+            if (code < 0x80) { out += (char)code; }
+            else if (code < 0x800) {
+              out += (char)(0xC0 | (code >> 6));
+              out += (char)(0x80 | (code & 0x3F));
+            } else {
+              out += (char)(0xE0 | (code >> 12));
+              out += (char)(0x80 | ((code >> 6) & 0x3F));
+              out += (char)(0x80 | (code & 0x3F));
+            }
+            break;
+          }
+          default: out += e;
+        }
+      } else {
+        out += c;
+      }
+    }
+    Expect('"');
+    return out;
+  }
+  Json Array() {
+    Expect('[');
+    Json v; v.kind = Json::kArr;
+    if (Peek() == ']') { ++p_; return v; }
+    while (true) {
+      v.arr.push_back(Value());
+      char c = Peek();
+      if (c == ',') { ++p_; continue; }
+      Expect(']');
+      return v;
+    }
+  }
+  Json Object() {
+    Expect('{');
+    Json v; v.kind = Json::kObj;
+    if (Peek() == '}') { ++p_; return v; }
+    while (true) {
+      std::string key = String();
+      Expect(':');
+      v.obj[key] = Value();
+      char c = Peek();
+      if (c == ',') { ++p_; continue; }
+      Expect('}');
+      return v;
     }
   }
 
-  Meta meta = ReadMeta(dir + "/__exported_native__.meta");
-  std::string module = ReadFile(dir + "/__exported_native__.stablehlo");
-  if (input_paths.size() != meta.ins.size())
-    Die("expected " + std::to_string(meta.ins.size()) + " inputs, got " +
-        std::to_string(input_paths.size()));
+  const std::string& s_;
+  size_t p_ = 0;
+};
 
-  TF_Status* s = TF_NewStatus();
-  TFE_ContextOptions* copts = TFE_NewContextOptions();
-  TFE_Context* ctx = TFE_NewContext(copts, s);
-  CheckOk(s, "TFE_NewContext");
+std::string JsonQuote(const std::string& s) {
+  std::string out = "\"";
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      case '\r': out += "\\r"; break;
+      default: out += c;
+    }
+  }
+  return out + "\"";
+}
 
-  // stage inputs
-  std::vector<TFE_TensorHandle*> handles;
-  std::vector<TF_DataType> tin;
-  for (size_t i = 0; i < input_paths.size(); ++i) {
-    Npy npy = ReadNpy(input_paths[i]);
-    DType dt = DTypeByName(meta.ins[i].dtype);
-    if (npy.descr != dt.npy)
-      Die(input_paths[i] + ": dtype " + npy.descr + " but model expects " +
-          meta.ins[i].dtype + " (" + dt.npy + ")");
-    TF_Tensor* t = TF_AllocateTensor(dt.tf, npy.shape.data(),
-                                     static_cast<int>(npy.shape.size()),
-                                     npy.data.size());
-    std::memcpy(TF_TensorData(t), npy.data.data(), npy.data.size());
-    handles.push_back(TFE_NewTensorHandle(t, s));
-    CheckOk(s, "TFE_NewTensorHandle");
-    tin.push_back(dt.tf);
+// -- module runner: one per thread/context (clone-per-thread) --------------
+
+struct HostTensor {
+  std::string name;
+  std::string dtype;
+  std::vector<int64_t> shape;
+  std::string data;
+};
+
+class Runner {
+ public:
+  Runner(const Meta& meta, const std::string& module)
+      : meta_(meta), module_(module), status_(TF_NewStatus()) {
+    TFE_ContextOptions* copts = TFE_NewContextOptions();
+    ctx_ = TFE_NewContext(copts, status_);
+    TFE_DeleteContextOptions(copts);
+    CheckOk(status_, "TFE_NewContext");
+  }
+  ~Runner() {
+    if (ctx_) TFE_DeleteContext(ctx_);
+    TF_DeleteStatus(status_);
+  }
+  Runner(const Runner&) = delete;
+  Runner& operator=(const Runner&) = delete;
+
+  // inputs in meta.ins order, dtypes already validated by the caller
+  std::vector<HostTensor> Run(const std::vector<HostTensor>& inputs) {
+    TF_Status* s = status_;
+    std::vector<TFE_TensorHandle*> handles;
+    std::vector<TF_Tensor*> tensors;
+    std::vector<TFE_TensorHandle*> outs;  // declared before cleanup binds it
+    std::vector<TF_DataType> tin;
+    TFE_Op* op = nullptr;
+    auto cleanup = [&]() {
+      for (auto* h : handles) TFE_DeleteTensorHandle(h);
+      for (auto* t : tensors) TF_DeleteTensor(t);
+      for (auto* o : outs)
+        if (o) TFE_DeleteTensorHandle(o);  // slots not yet consumed
+      if (op) TFE_DeleteOp(op);  // a CheckOk threw mid-op-construction
+    };
+    try {
+      for (size_t i = 0; i < inputs.size(); ++i) {
+        DType dt = DTypeByName(meta_.ins[i].dtype);
+        TF_Tensor* t = TF_AllocateTensor(
+            dt.tf, inputs[i].shape.data(),
+            static_cast<int>(inputs[i].shape.size()), inputs[i].data.size());
+        tensors.push_back(t);
+        std::memcpy(TF_TensorData(t), inputs[i].data.data(),
+                    inputs[i].data.size());
+        handles.push_back(TFE_NewTensorHandle(t, s));
+        CheckOk(s, "TFE_NewTensorHandle");
+        tin.push_back(dt.tf);
+      }
+
+      // one XlaCallModule op = the whole model (params are constants inside)
+      op = TFE_NewOp(ctx_, "XlaCallModule", s);
+      CheckOk(s, "TFE_NewOp(XlaCallModule)");
+      TFE_OpSetAttrString(op, "module", module_.data(), module_.size());
+      TFE_OpSetAttrInt(op, "version", meta_.version);
+      TFE_OpSetAttrTypeList(op, "Tin", tin.data(),
+                            static_cast<int>(tin.size()));
+      std::vector<TF_DataType> tout;
+      std::vector<const int64_t*> sout;
+      std::vector<int> sout_ndims;
+      for (const auto& o : meta_.outs) {
+        tout.push_back(DTypeByName(o.dtype).tf);
+        sout.push_back(o.dims.data());
+        sout_ndims.push_back(static_cast<int>(o.dims.size()));
+      }
+      TFE_OpSetAttrTypeList(op, "Tout", tout.data(),
+                            static_cast<int>(tout.size()));
+      TFE_OpSetAttrShapeList(op, "Sout", sout.data(), sout_ndims.data(),
+                             static_cast<int>(sout.size()), s);
+      CheckOk(s, "Sout");
+      const void* plat[1] = {"CPU"};
+      size_t plat_len[1] = {3};
+      TFE_OpSetAttrStringList(op, "platforms", plat, plat_len, 1);
+      TFE_OpSetAttrStringList(op, "dim_args_spec", nullptr, nullptr, 0);
+      TFE_OpSetAttrStringList(op, "disabled_checks", nullptr, nullptr, 0);
+      TFE_OpSetAttrFunctionList(op, "function_list", nullptr, 0);
+      TFE_OpSetAttrBool(op, "has_token_input_output", 0);
+      for (auto* h : handles) {
+        TFE_OpAddInput(op, h, s);
+        CheckOk(s, "TFE_OpAddInput");
+      }
+
+      outs.assign(meta_.outs.size(), nullptr);
+      int nout = static_cast<int>(outs.size());
+      TFE_Execute(op, outs.data(), &nout, s);
+      TFE_DeleteOp(op);
+      op = nullptr;
+      CheckOk(s, "TFE_Execute");
+
+      std::vector<HostTensor> results;
+      for (int i = 0; i < nout; ++i) {
+        TF_Tensor* t = TFE_TensorHandleResolve(outs[i], s);
+        TFE_DeleteTensorHandle(outs[i]);
+        outs[i] = nullptr;  // consumed; cleanup() frees the rest on throw
+        CheckOk(s, "TFE_TensorHandleResolve");
+        HostTensor ht;
+        ht.name = meta_.outs[i].name;
+        ht.dtype = meta_.outs[i].dtype;
+        ht.shape.resize(TF_NumDims(t));
+        for (size_t d = 0; d < ht.shape.size(); ++d)
+          ht.shape[d] = TF_Dim(t, static_cast<int>(d));
+        ht.data.assign(static_cast<const char*>(TF_TensorData(t)),
+                       TF_TensorByteSize(t));
+        TF_DeleteTensor(t);
+        results.push_back(std::move(ht));
+      }
+      cleanup();
+      return results;
+    } catch (...) {
+      cleanup();
+      throw;
+    }
   }
 
-  // one XlaCallModule op = the whole model (params are constants inside)
-  TFE_Op* op = TFE_NewOp(ctx, "XlaCallModule", s);
-  CheckOk(s, "TFE_NewOp(XlaCallModule)");
-  TFE_OpSetAttrString(op, "module", module.data(), module.size());
-  TFE_OpSetAttrInt(op, "version", meta.version);
-  TFE_OpSetAttrTypeList(op, "Tin", tin.data(),
-                        static_cast<int>(tin.size()));
-  std::vector<TF_DataType> tout;
-  std::vector<const int64_t*> sout;
-  std::vector<int> sout_ndims;
-  for (const auto& o : meta.outs) {
-    tout.push_back(DTypeByName(o.dtype).tf);
-    sout.push_back(o.dims.data());
-    sout_ndims.push_back(static_cast<int>(o.dims.size()));
-  }
-  TFE_OpSetAttrTypeList(op, "Tout", tout.data(),
-                        static_cast<int>(tout.size()));
-  TFE_OpSetAttrShapeList(op, "Sout", sout.data(), sout_ndims.data(),
-                         static_cast<int>(sout.size()), s);
-  CheckOk(s, "Sout");
-  const void* plat[1] = {"CPU"};
-  size_t plat_len[1] = {3};
-  TFE_OpSetAttrStringList(op, "platforms", plat, plat_len, 1);
-  TFE_OpSetAttrStringList(op, "dim_args_spec", nullptr, nullptr, 0);
-  TFE_OpSetAttrStringList(op, "disabled_checks", nullptr, nullptr, 0);
-  TFE_OpSetAttrFunctionList(op, "function_list", nullptr, 0);
-  TFE_OpSetAttrBool(op, "has_token_input_output", 0);
-  for (auto* h : handles) {
-    TFE_OpAddInput(op, h, s);
-    CheckOk(s, "TFE_OpAddInput");
-  }
+  const Meta& meta() const { return meta_; }
 
-  std::vector<TFE_TensorHandle*> outs(meta.outs.size(), nullptr);
-  int nout = static_cast<int>(outs.size());
-  TFE_Execute(op, outs.data(), &nout, s);
-  CheckOk(s, "TFE_Execute");
+ private:
+  const Meta& meta_;
+  const std::string& module_;
+  TF_Status* status_;
+  TFE_Context* ctx_ = nullptr;
+};
 
-  for (int i = 0; i < nout; ++i) {
-    TF_Tensor* t = TFE_TensorHandleResolve(outs[i], s);
-    CheckOk(s, "TFE_TensorHandleResolve");
-    std::vector<int64_t> shape(TF_NumDims(t));
-    for (size_t d = 0; d < shape.size(); ++d)
-      shape[d] = TF_Dim(t, static_cast<int>(d));
-    DType dt = DTypeByName(meta.outs[i].dtype);
-    std::string path = out_dir + "/out" + std::to_string(i) + ".npy";
-    WriteNpy(path, dt.npy, shape, TF_TensorData(t), TF_TensorByteSize(t));
-    std::printf("%s %s -> %s\n", meta.outs[i].name.c_str(),
-                meta.outs[i].dtype.c_str(), path.c_str());
+// -- server mode -----------------------------------------------------------
+
+ssize_t RecvExact(int fd, char* buf, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::recv(fd, buf + got, n - got, 0);
+    if (r <= 0) return r;  // 0 = peer closed, <0 = error
+    got += static_cast<size_t>(r);
+  }
+  return static_cast<ssize_t>(got);
+}
+
+bool SendAll(int fd, const char* buf, size_t n) {
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t r = ::send(fd, buf + sent, n - sent, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    sent += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct Request {
+  Json header;
+  std::vector<std::string> buffers;
+};
+
+// serving.py protocol: u32 header length, JSON header, raw tensor bytes for
+// each feed in header order. Returns false when the peer closed cleanly.
+bool RecvRequest(int fd, Request* out) {
+  char lenbuf[4];
+  ssize_t r = RecvExact(fd, lenbuf, 4);
+  if (r <= 0) return false;
+  uint32_t hlen;
+  std::memcpy(&hlen, lenbuf, 4);  // little-endian hosts only (x86/arm)
+  if (hlen > (64u << 20)) Die("unreasonable header length");
+  std::string hraw(hlen, '\0');
+  if (RecvExact(fd, hraw.data(), hlen) <= 0) return false;
+  out->header = JsonParser(hraw).Parse();
+  out->buffers.clear();
+  if (out->header.Has("feeds")) {
+    for (const auto& spec : out->header.At("feeds").arr) {
+      size_t n = DTypeByName(spec.At("dtype").str).size;
+      for (const auto& d : spec.At("shape").arr) {
+        // a concrete wire shape must be nonnegative integers (a negative
+        // or fractional dim would be UB under the unsigned cast and can
+        // CHECK-abort TF_AllocateTensor, killing every connection)
+        if (!(d.num >= 0) || !(d.num <= 2147483648.0) ||
+            d.num != static_cast<double>(static_cast<int64_t>(d.num)))
+          Die("invalid tensor dim in feed shape");
+        n *= static_cast<size_t>(d.num);
+        // bound INSIDE the loop: n stays <= 2^30 before each multiply and
+        // each dim <= 2^31, so the product fits 64 bits — a tail-of-loop
+        // check could be bypassed by overflow wrapping past 2^64
+        if (n > (1u << 30)) Die("unreasonable tensor size");
+      }
+      std::string buf(n, '\0');
+      if (n && RecvExact(fd, buf.data(), n) <= 0) return false;
+      out->buffers.push_back(std::move(buf));
+    }
+  }
+  return true;
+}
+
+bool SendResponse(int fd, const std::string& header_json,
+                  const std::vector<const HostTensor*>& outs) {
+  uint32_t hlen = static_cast<uint32_t>(header_json.size());
+  char lenbuf[4];
+  std::memcpy(lenbuf, &hlen, 4);
+  if (!SendAll(fd, lenbuf, 4)) return false;
+  if (!SendAll(fd, header_json.data(), header_json.size())) return false;
+  for (const auto* t : outs)
+    if (!SendAll(fd, t->data.data(), t->data.size())) return false;
+  return true;
+}
+
+bool SendError(int fd, const std::string& msg) {
+  return SendResponse(fd, "{\"error\": " + JsonQuote(msg) + "}", {});
+}
+
+void ServeConnection(int fd, const Meta& meta, const std::string& module) {
+  // per-connection clone: a private TFE context over the shared module
+  // bytes (weights are constants in the module, shared read-only) — the
+  // reference's Clone contract (api_impl.cc:170)
+  std::unique_ptr<Runner> runner;
+
+  // reader/worker split with a bounded queue: the reader always drains
+  // incoming requests so a client that pipelines faster than it reads
+  // cannot deadlock the pair with both TCP buffers full (see serving.py)
+  std::mutex mu;
+  std::condition_variable cv_put, cv_get;
+  std::deque<Request> queue;
+  bool eof = false, worker_dead = false;
+  const size_t kMaxQueued = 128;
+
+  std::thread worker([&]() {
+    // on ANY exit: unblock a reader waiting on a full queue and kick a
+    // reader blocked in recv, otherwise the pair can deadlock after a
+    // send failure
+    struct Guard {
+      std::mutex& mu; std::condition_variable& cv; bool& dead; int fd;
+      ~Guard() {
+        { std::lock_guard<std::mutex> lk(mu); dead = true; }
+        cv.notify_all();
+        ::shutdown(fd, SHUT_RDWR);
+      }
+    } guard{mu, cv_put, worker_dead, fd};
+    while (true) {
+      Request req;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_get.wait(lk, [&] { return eof || !queue.empty(); });
+        if (queue.empty()) return;  // eof and drained
+        req = std::move(queue.front());
+        queue.pop_front();
+      }
+      cv_put.notify_one();
+      try {
+        if (!runner) runner = std::make_unique<Runner>(meta, module);
+        // match feeds to meta.ins BY NAME; every declared input required
+        std::map<std::string, std::pair<const Json*, const std::string*>> by_name;
+        if (!req.header.Has("feeds")) Die("request has no 'feeds'");
+        const auto& feeds = req.header.At("feeds").arr;
+        for (size_t i = 0; i < feeds.size(); ++i)
+          by_name[feeds[i].At("name").str] = {&feeds[i], &req.buffers[i]};
+        std::vector<HostTensor> inputs;
+        for (const auto& spec : meta.ins) {
+          auto it = by_name.find(spec.name);
+          if (it == by_name.end()) Die("missing feed '" + spec.name + "'");
+          const Json& fj = *it->second.first;
+          HostTensor ht;
+          ht.name = spec.name;
+          ht.dtype = fj.At("dtype").str;
+          if (ht.dtype != spec.dtype)
+            Die("feed '" + spec.name + "': dtype " + ht.dtype +
+                " but model expects " + spec.dtype);
+          for (const auto& d : fj.At("shape").arr)
+            ht.shape.push_back(static_cast<int64_t>(d.num));
+          ht.data = *it->second.second;
+          inputs.push_back(std::move(ht));
+        }
+
+        std::vector<HostTensor> results = runner->Run(inputs);
+
+        // optional fetch subset by output name (≙ fetch_names)
+        std::vector<const HostTensor*> selected;
+        if (req.header.Has("fetch")) {
+          for (const auto& want : req.header.At("fetch").arr) {
+            const HostTensor* found = nullptr;
+            for (const auto& r : results)
+              if (r.name == want.str) { found = &r; break; }
+            if (!found) Die("unknown fetch '" + want.str + "'");
+            selected.push_back(found);
+          }
+        } else {
+          for (const auto& r : results) selected.push_back(&r);
+        }
+
+        std::ostringstream hj;
+        hj << "{\"outs\": [";
+        for (size_t i = 0; i < selected.size(); ++i) {
+          const auto& t = *selected[i];
+          if (i) hj << ", ";
+          hj << "{\"name\": " << JsonQuote(t.name)
+             << ", \"dtype\": " << JsonQuote(t.dtype) << ", \"shape\": [";
+          for (size_t d = 0; d < t.shape.size(); ++d) {
+            if (d) hj << ", ";
+            hj << t.shape[d];
+          }
+          hj << "]}";
+        }
+        hj << "]}";
+        if (!SendResponse(fd, hj.str(), selected)) break;
+      } catch (const std::exception& e) {
+        // per-request error: report and keep the connection alive
+        if (!SendError(fd, e.what())) break;
+      }
+    }
+  });
+
+  try {
+    while (true) {
+      Request req;
+      if (!RecvRequest(fd, &req)) break;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_put.wait(lk, [&] {
+          return queue.size() < kMaxQueued || worker_dead;
+        });
+        if (worker_dead) break;
+        queue.push_back(std::move(req));
+      }
+      cv_get.notify_one();
+    }
+  } catch (const std::exception& e) {
+    // framing lost (malformed header): the connection cannot continue
+    std::fprintf(stderr, "ptpu_predict: connection error: %s\n", e.what());
+  }
+  {
+    std::lock_guard<std::mutex> lk(mu);
+    eof = true;
+  }
+  cv_get.notify_all();
+  worker.join();
+  ::close(fd);
+}
+
+int ServeMain(const Meta& meta, const std::string& module, int port) {
+  int srv = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (srv < 0) Die("socket() failed");
+  int one = 1;
+  ::setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+    Die("bind() failed");
+  if (::listen(srv, 64) != 0) Die("listen() failed");
+  socklen_t alen = sizeof(addr);
+  ::getsockname(srv, reinterpret_cast<sockaddr*>(&addr), &alen);
+  // the startup line a supervisor (or the test) parses for the bound port
+  std::printf("LISTENING %d\n", ntohs(addr.sin_port));
+  std::fflush(stdout);
+  while (true) {
+    int fd = ::accept(srv, nullptr, nullptr);
+    if (fd < 0) continue;
+    std::thread(ServeConnection, fd, std::cref(meta), std::cref(module))
+        .detach();
   }
   return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  try {
+    if (argc < 3) {
+      std::fprintf(
+          stderr,
+          "usage: %s <export_dir> <input0.npy> [...] [--out DIR]\n"
+          "       %s <export_dir> --serve [PORT]\n",
+          argv[0], argv[0]);
+      return 2;
+    }
+    std::string dir = argv[1];
+    std::string out_dir = ".";
+    bool serve = false;
+    int port = 0;
+    std::vector<std::string> input_paths;
+    for (int i = 2; i < argc; ++i) {
+      if (std::strcmp(argv[i], "--out") == 0 && i + 1 < argc) {
+        out_dir = argv[++i];
+      } else if (std::strcmp(argv[i], "--serve") == 0) {
+        serve = true;
+        if (i + 1 < argc && std::isdigit((unsigned char)argv[i + 1][0]))
+          port = std::atoi(argv[++i]);
+      } else {
+        input_paths.push_back(argv[i]);
+      }
+    }
+
+    Meta meta = ReadMeta(dir + "/__exported_native__.meta");
+    std::string module = ReadFile(dir + "/__exported_native__.stablehlo");
+
+    if (serve) return ServeMain(meta, module, port);
+
+    if (input_paths.size() != meta.ins.size())
+      Die("expected " + std::to_string(meta.ins.size()) + " inputs, got " +
+          std::to_string(input_paths.size()));
+
+    std::vector<HostTensor> inputs;
+    for (size_t i = 0; i < input_paths.size(); ++i) {
+      Npy npy = ReadNpy(input_paths[i]);
+      DType dt = DTypeByName(meta.ins[i].dtype);
+      if (npy.descr != dt.npy)
+        Die(input_paths[i] + ": dtype " + npy.descr + " but model expects " +
+            meta.ins[i].dtype + " (" + dt.npy + ")");
+      HostTensor ht;
+      ht.name = meta.ins[i].name;
+      ht.dtype = meta.ins[i].dtype;
+      ht.shape = npy.shape;
+      ht.data = std::move(npy.data);
+      inputs.push_back(std::move(ht));
+    }
+
+    Runner runner(meta, module);
+    std::vector<HostTensor> outs = runner.Run(inputs);
+    for (size_t i = 0; i < outs.size(); ++i) {
+      DType dt = DTypeByName(outs[i].dtype);
+      std::string path = out_dir + "/out" + std::to_string(i) + ".npy";
+      WriteNpy(path, dt.npy, outs[i].shape, outs[i].data.data(),
+               outs[i].data.size());
+      std::printf("%s %s -> %s\n", outs[i].name.c_str(),
+                  outs[i].dtype.c_str(), path.c_str());
+    }
+    return 0;
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "ptpu_predict: %s\n", e.what());
+    return 1;
+  }
 }

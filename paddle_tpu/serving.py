@@ -157,6 +157,10 @@ class PredictorServer:
         # itself instead of growing server memory without limit
         requests: "_q.Queue" = _q.Queue(maxsize=128)
         _EOF = object()
+        # set when the worker exits for ANY reason: a reader blocked in
+        # put() on a full queue must not wait forever for a consumer that
+        # is gone (the worker also drains the queue on exit)
+        worker_dead = threading.Event()
 
         def work():
             try:
@@ -192,18 +196,40 @@ class PredictorServer:
             except (ConnectionError, OSError):
                 pass
 
-        worker = threading.Thread(target=work, daemon=True)
+        def work_outer():
+            try:
+                work()
+            finally:
+                worker_dead.set()
+                try:  # unblock a reader stuck in put() on a full queue
+                    while True:
+                        requests.get_nowait()
+                except _q.Empty:
+                    pass
+
+        def put_alive(item) -> bool:
+            """put() that gives up once the worker is gone."""
+            while not worker_dead.is_set():
+                try:
+                    requests.put(item, timeout=0.2)
+                    return True
+                except _q.Full:
+                    continue
+            return False
+
+        worker = threading.Thread(target=work_outer, daemon=True)
         worker.start()
         try:
             while not self._stop.is_set():
                 header, buffers = _recv_msg(conn)
                 if header is None:
-                    return
-                requests.put((header, buffers))
+                    break
+                if not put_alive((header, buffers)):
+                    break
         except (ConnectionError, OSError):
             pass
         finally:
-            requests.put(_EOF)
+            put_alive(_EOF)
             worker.join(timeout=30)
             conn.close()
             with self._lock:

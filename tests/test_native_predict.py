@@ -108,5 +108,80 @@ class TestNativePredict:
         assert "dtype" in r.stderr
 
 
+@pytest.fixture()
+def cpp_server(tmp_path, ptpu_predict_bin):
+    """A ptpu_predict --serve process over a freshly exported model; yields
+    (host, port, reference_predictor_output_fn)."""
+    d, _ = _export_model(tmp_path)
+    proc = subprocess.Popen([ptpu_predict_bin, d, "--serve", "0"],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
+    try:
+        line = proc.stdout.readline()
+        if not line.startswith("LISTENING "):
+            # kill BEFORE reading stderr: .read() on a live process blocks
+            # until EOF and would wedge the whole test session
+            proc.kill()
+            proc.wait(timeout=30)
+            pytest.fail(f"server failed to start: {line!r}\n"
+                        f"{proc.stderr.read()[-800:]}")
+        port = int(line.split()[1])
+        yield d, "127.0.0.1", port
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
+
+
+class TestNativeServe:
+    """Server mode of the C++ entry: the same TCP protocol as
+    paddle_tpu.serving.PredictorServer, served from a pure-C++ process with
+    a private TFE context per connection (≙ reference api_impl.cc:126
+    long-lived NativePaddlePredictor, :170 Clone-per-thread)."""
+
+    def test_served_logits_match_python(self, cpp_server):
+        d, host, port = cpp_server
+        from paddle_tpu.inferencer import Predictor
+        from paddle_tpu.serving import PredictorClient
+
+        x = np.random.RandomState(0).rand(3, 8, 8, 1).astype(np.float32)
+        ref = np.asarray(Predictor.from_exported(d).run({"img": x})[0])
+        with PredictorClient(host, port) as c:
+            got = c.infer({"img": x})[0]
+        np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
+
+    def test_pipelined_and_concurrent_connections(self, cpp_server):
+        d, host, port = cpp_server
+        from paddle_tpu.serving import PredictorClient
+
+        rng = np.random.RandomState(1)
+        xs = [rng.rand(2, 8, 8, 1).astype(np.float32) for _ in range(6)]
+        with PredictorClient(host, port) as c1, \
+                PredictorClient(host, port) as c2:
+            # pipeline 6 requests on c1 before reading any response; c2
+            # interleaves blocking RPCs on its own connection (own context)
+            for x in xs:
+                c1.send({"img": x})
+            other = c2.infer({"img": xs[0]})[0]
+            outs = [c1.recv()[0] for _ in xs]
+        # responses in request order (softmax rows sum to 1, batch matches)
+        for x, o in zip(xs, outs):
+            assert o.shape == (2, 10)
+            np.testing.assert_allclose(o.sum(axis=1), 1.0, rtol=1e-4)
+        np.testing.assert_allclose(other, outs[0], atol=1e-6)
+
+    def test_per_request_error_keeps_connection(self, cpp_server):
+        d, host, port = cpp_server
+        from paddle_tpu.serving import PredictorClient
+
+        x = np.random.RandomState(2).rand(2, 8, 8, 1).astype(np.float32)
+        with PredictorClient(host, port) as c:
+            with pytest.raises(RuntimeError, match="dtype"):
+                c.infer({"img": x.astype(np.float64)})
+            with pytest.raises(RuntimeError, match="missing feed"):
+                c.infer({"wrong_name": x})
+            out = c.infer({"img": x})[0]  # connection survived both errors
+            assert out.shape == (2, 10)
+
+
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-x", "-q"]))

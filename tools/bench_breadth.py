@@ -129,6 +129,18 @@ def build_stacked_lstm(rng):
     return loss, feeds, b * t, opt
 
 
+def _markov_tokens(rng, b, t, vocab):
+    """Sequences where tok[i+1] = (tok[i]*13 + 7 + eps) % vocab, eps∈[0,8):
+    a 1st-order process any of the models here can learn, with a known
+    entropy floor — distinct batches share the map, so descent is signal."""
+    toks = np.empty((b, t), np.int64)
+    toks[:, 0] = rng.randint(0, vocab, (b,))
+    for i in range(1, t):
+        toks[:, i] = (toks[:, i - 1] * 13 + 7
+                      + rng.randint(0, 8, (b,))) % vocab
+    return toks
+
+
 def build_transformer(rng):
     import paddle_tpu as pt
     from paddle_tpu.models import transformer
@@ -136,11 +148,18 @@ def build_transformer(rng):
     loss, _ = transformer.transformer_lm(
         vocab=32000, max_len=t, d_model=512, d_inner=2048, num_heads=8,
         num_layers=6, dropout=0.0)   # dropout 0 -> flash-attention path
-    feed = {"tokens": rng.randint(0, 32000, (b, t)).astype("int64"),
-            "tokens@SEQLEN": np.full((b,), t, "int32"),
-            "targets": rng.randint(0, 32000, (b, t)).astype("int64")}
+    # 4 distinct batches drawn from a learnable process: the next token is a
+    # deterministic map of the current plus 3 bits of noise, so the CE floor
+    # is ln(8)≈2.08 and descent reflects learning the map, not memorizing a
+    # single fixed batch
+    feeds = []
+    for _ in range(4):
+        toks = _markov_tokens(rng, b, t + 1, 32000)
+        feeds.append({"tokens": toks[:, :-1].copy(),
+                      "tokens@SEQLEN": np.full((b,), t, "int32"),
+                      "targets": toks[:, 1:].copy()})
     opt = pt.optimizer.AdamOptimizer(learning_rate=1e-4)
-    return loss, feed, b * t, opt
+    return loss, feeds, b * t, opt
 
 
 def build_transformer_nmt(rng):
@@ -150,13 +169,21 @@ def build_transformer_nmt(rng):
     loss, _ = transformer.transformer(
         src_vocab=16000, tgt_vocab=16000, max_len=t, d_model=512,
         d_inner=2048, num_heads=8, num_layers=4, dropout=0.0)
-    feed = {"src": rng.randint(1, 16000, (b, t)).astype("int64"),
-            "src@SEQLEN": np.full((b,), t, "int32"),
-            "tgt": rng.randint(1, 16000, (b, t)).astype("int64"),
-            "tgt@SEQLEN": np.full((b,), t, "int32"),
-            "lbl": rng.randint(1, 16000, (b, t)).astype("int64")}
+    # 4 distinct batches of a learnable translation task: tgt is a fixed
+    # pointwise map of src ((src+5) mod V), lbl the next-token shift — the
+    # decoder can learn it through cross-attention; no single batch to
+    # memorize
+    feeds = []
+    for _ in range(4):
+        src = _markov_tokens(rng, b, t + 1, 16000)
+        tgt = (src + 5) % 16000
+        feeds.append({"src": src[:, :-1].copy(),
+                      "src@SEQLEN": np.full((b,), t, "int32"),
+                      "tgt": tgt[:, :-1].copy(),
+                      "tgt@SEQLEN": np.full((b,), t, "int32"),
+                      "lbl": tgt[:, 1:].copy()})
     opt = pt.optimizer.AdamOptimizer(learning_rate=1e-4)
-    return loss, feed, b * t, opt
+    return loss, feeds, b * t, opt
 
 
 def build_deepfm(rng):

@@ -8,5 +8,6 @@ and returns the loss (and aux outputs), exactly as the reference model files
 build programs for fluid_benchmark.py.
 """
 
-from . import (deepfm, googlenet, machine_translation,  # noqa: F401
-               mnist, resnet, se_resnext, stacked_lstm, transformer, vgg)
+from . import (alexnet, deepfm, googlenet,  # noqa: F401
+               machine_translation, mnist, resnet, se_resnext, stacked_lstm,
+               transformer, vgg)

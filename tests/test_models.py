@@ -202,3 +202,21 @@ def test_googlenet_trains_tiny(rng):
             "label": rng.randint(0, 10, (2, 1)).astype("int64")}
     l0 = exe.run(feed=feed, fetch_list=[loss, acc])
     assert np.isfinite(l0[0]).all()
+
+
+def test_alexnet_trains_tiny(rng):
+    """AlexNet 5-conv + 3-fc stack (≙ benchmark/paddle/image/alexnet.py);
+    full 224x224 geometry so every stride/pad survives the conv math."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import alexnet
+
+    loss, acc, logits = alexnet.alexnet_imagenet(class_num=10)
+    pt.optimizer.SGDOptimizer(learning_rate=0.01).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    feed = {"img": rng.rand(2, 224, 224, 3).astype("float32"),
+            "label": rng.randint(0, 10, (2, 1)).astype("int64")}
+    l0 = exe.run(feed=feed, fetch_list=[loss, acc])
+    l1 = exe.run(feed=feed, fetch_list=[loss, acc])
+    assert np.isfinite(l0[0]).all() and np.isfinite(l1[0]).all()
+    assert logits.shape[-1] == 10

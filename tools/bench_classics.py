@@ -1,10 +1,11 @@
-"""Classic-CNN training throughput vs the reference's OWN published
-baselines (reference benchmark/IntelOptimizedPaddle.md:29-65 — its best
-in-repo training numbers): VGG-19 30.44 img/s and GoogLeNet 269.50 img/s,
-both bs256 on a 2-socket Xeon 6148.
+"""Classic-CNN train AND infer throughput vs the reference's OWN published
+baselines (reference benchmark/IntelOptimizedPaddle.md — its best in-repo
+numbers, 2-socket Xeon 6148 MKL-DNN): train bs256 VGG-19 30.44 / GoogLeNet
+269.50 / AlexNet 626.53 img/s (:29-65), infer bs16 VGG-19 96.75 /
+GoogLeNet 600.94 / AlexNet 850.51 img/s (:71-107).
 
     env PYTHONPATH=/root/.axon_site:/root/repo \
-        python tools/bench_classics.py | tee BENCH_CLASSICS_r03.json
+        python tools/bench_classics.py | tee BENCH_CLASSICS_r04.json
 
 Same audit fields + sync discipline as bench.py / bench_breadth.py.
 """
@@ -16,7 +17,9 @@ import time
 
 import numpy as np
 
-_REFERENCE_BEST = {"vgg19": 30.44, "googlenet": 269.50}
+_REFERENCE_BEST = {"vgg19": 30.44, "googlenet": 269.50, "alexnet": 626.53}
+_REFERENCE_BEST_INFER = {"vgg19": 96.75, "googlenet": 600.94,
+                         "alexnet": 850.51}
 
 
 def _measure_cnn(name, build_loss, batch, img_shape, iters=15):
@@ -90,12 +93,72 @@ def _measure_cnn(name, build_loss, batch, img_shape, iters=15):
     return rec
 
 
+def _measure_cnn_infer(name, build_logits, batch, img_shape, iters=30):
+    """Inference img/s vs the reference's published bs16 infer table.
+
+    Sync discipline mirrors bench._resnet_infer_throughput: step k's input
+    derives (negligibly but really) from step k-1's output so the final
+    realization bounds every timed dispatch through the tunnel."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+
+    import os as _os
+    import sys as _sys
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+    from bench import _best_of
+
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    with pt.core.unique_name.guard():
+        logits = build_logits()
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(3)
+    img0 = jnp.asarray(rng.rand(*img_shape).astype("float32"))
+    label = jnp.asarray(rng.randint(0, 1000, (batch, 1)).astype("int64"))
+    out = exe.run(feed={"img": img0, "label": label}, fetch_list=[logits],
+                  return_numpy=False)
+    float(out[0][0, 0])
+
+    def window():
+        cur = img0
+        t0 = time.time()
+        out = None
+        for _ in range(iters):
+            out = exe.run(feed={"img": cur, "label": label},
+                          fetch_list=[logits], return_numpy=False)
+            cur = img0 + out[0][0, 0].astype(jnp.float32) * 1e-30
+        float(out[0][0, 0])
+        return batch * iters / (time.time() - t0)
+
+    imgs_s = _best_of(3, window)
+    dev = jax.devices()[0]
+    ref = _REFERENCE_BEST_INFER.get(name)
+    rec = {
+        "model": f"{name}_infer_bs{batch}",
+        "value": round(imgs_s, 2),
+        "unit": "images/sec",
+        "vs_reference_best": round(imgs_s / ref, 2) if ref else None,
+        "evidence": {
+            "device_kind": getattr(dev, "device_kind", str(dev)),
+            "reference_best_images_per_sec": ref,
+            "step_ms": round(batch / imgs_s * 1e3, 2),
+        },
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 def main():
     import jax
     from paddle_tpu import models
     on_accel = jax.devices()[0].platform != "cpu"
     batch = 128 if on_accel else 4
     iters = 15 if on_accel else 2
+    infer_bs = 16 if on_accel else 4
+    infer_iters = 30 if on_accel else 2
 
     def vgg():
         # vgg builds NCHW fp32 (the model's reference-mirroring layout)
@@ -107,12 +170,40 @@ def main():
             is_test=False, data_format="NHWC", use_bf16=True)
         return loss
 
+    def alex():
+        loss, acc, _ = models.alexnet.alexnet_imagenet(
+            is_test=False, data_format="NHWC", use_bf16=True)
+        return loss
+
     recs = [_measure_cnn("vgg19", vgg, batch, (batch, 3, 224, 224), iters),
             _measure_cnn("googlenet", goog, batch, (batch, 224, 224, 3),
+                         iters),
+            _measure_cnn("alexnet", alex, batch, (batch, 224, 224, 3),
                          iters)]
     print(json.dumps({"all_losses_decreased":
                       all(r["evidence"]["loss_decreased"] for r in recs)}),
           flush=True)
+
+    def vgg_i():
+        _, _, logits = models.vgg.vgg(depth=19, is_test=True)
+        return logits
+
+    def goog_i():
+        _, _, logits = models.googlenet.googlenet_imagenet(
+            is_test=True, data_format="NHWC", use_bf16=True)
+        return logits
+
+    def alex_i():
+        _, _, logits = models.alexnet.alexnet_imagenet(
+            is_test=True, data_format="NHWC", use_bf16=True)
+        return logits
+
+    _measure_cnn_infer("vgg19", vgg_i, infer_bs,
+                       (infer_bs, 3, 224, 224), infer_iters)
+    _measure_cnn_infer("googlenet", goog_i, infer_bs,
+                       (infer_bs, 224, 224, 3), infer_iters)
+    _measure_cnn_infer("alexnet", alex_i, infer_bs,
+                       (infer_bs, 224, 224, 3), infer_iters)
 
 
 if __name__ == "__main__":

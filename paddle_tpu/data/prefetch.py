@@ -56,17 +56,32 @@ class DevicePrefetcher:
         q: queue.Queue = queue.Queue(maxsize=self._capacity)
         err = []
         pool = ThreadPoolExecutor(max_workers=self._stage_threads)
+        # set when the consumer abandons the iterator (break / exception
+        # in the training loop): the producer must not stay blocked in
+        # put() forever, pinning its thread, the pool, and up to
+        # `capacity` staged device batches for process lifetime
+        closed = threading.Event()
+
+        def put_open(item) -> bool:
+            while not closed.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def producer():
             try:
                 for b in self._fn():
                     # bounded queue of FUTURES: up to `capacity` batches
                     # are staging/staged ahead, in iterator order
-                    q.put(pool.submit(self._put, b))
+                    if not put_open(pool.submit(self._put, b)):
+                        return
             except Exception as e:  # propagate to consumer
                 err.append(e)
             finally:
-                q.put(self._END)
+                put_open(self._END)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
@@ -79,4 +94,10 @@ class DevicePrefetcher:
                     return
                 yield item.result()
         finally:
+            closed.set()
+            try:  # drop queued futures so staged batches free promptly
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
             pool.shutdown(wait=False)

@@ -1,0 +1,101 @@
+"""Probe: what bounds prefetcher link utilization? (round-4 follow-up)
+
+Measures, all in ONE tunnel session: raw uint8 link at 1/2/3 concurrent
+streams, float->uint8 conversion cost, and drain-only DevicePrefetcher
+rates at several (stage_threads, capacity) settings.
+
+    env PYTHONPATH=/root/.axon_site:/root/repo python tools/probe_prefetch2.py
+"""
+import json
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+
+def main(batch=128):
+    import jax
+
+    from paddle_tpu.data.feeder import staging_specs  # noqa: F401
+    from paddle_tpu.data.prefetch import DevicePrefetcher
+
+    img_u8 = (np.random.RandomState(0).rand(batch, 224, 224, 3) * 255
+              ).astype("uint8")
+    nbytes = img_u8.nbytes
+
+    d = jax.device_put(img_u8)
+    _ = np.asarray(d[0, 0, 0, 0])
+
+    out = {}
+
+    def put_one(x):
+        h = jax.device_put(x)
+        _ = np.asarray(h[0, 0, 0, 0])
+        return h
+
+    for streams in (1, 2, 3):
+        pool = ThreadPoolExecutor(max_workers=streams)
+        reps = 6
+        best = None
+        for _ in range(2):
+            t0 = time.time()
+            futs = [pool.submit(put_one, img_u8) for _ in range(reps)]
+            for f in futs:
+                f.result()
+            dt = time.time() - t0
+            best = dt if best is None else min(best, dt)
+        out[f"link_MBps_{streams}stream"] = round(
+            nbytes * reps / best / 1e6, 2)
+        pool.shutdown()
+
+    # conversion cost on the staging thread (fp32 batch -> uint8 wire)
+    img_f32 = np.random.RandomState(1).rand(batch, 224, 224, 3).astype(
+        "float32")
+    t0 = time.time()
+    for _ in range(5):
+        w = (img_f32 * 255.0).astype("uint8")
+    out["convert_ms_per_batch"] = round((time.time() - t0) / 5 * 1e3, 1)
+
+    # drain-only prefetcher rate (no training step): the pipeline's own
+    # ceiling at each setting
+    import paddle_tpu as pt  # noqa: F401  (registers staging helpers)
+    host_batches = [
+        {"img": np.random.RandomState(i).rand(batch, 224, 224, 3)
+         .astype("float32"),
+         "label": np.random.RandomState(i).randint(0, 1000, (batch, 1))
+         .astype("int64")}
+        for i in range(4)
+    ]
+    specs = {"img": ("uint8", 1.0 / 255.0)}
+
+    def feed_iter():
+        for i in range(12):
+            yield host_batches[i % 4]
+
+    for threads, cap in ((1, 4), (2, 4), (3, 6), (4, 8)):
+        best = None
+        for _ in range(2):
+            pf = iter(DevicePrefetcher(feed_iter, capacity=cap,
+                                       staging=specs,
+                                       stage_threads=threads))
+            first = next(pf)  # warm
+            _ = np.asarray(first["img"][0, 0, 0, 0])
+            t0 = time.time()
+            n = 0
+            last = None
+            for b in pf:
+                last = b
+                n += 1
+            _ = np.asarray(last["img"][0, 0, 0, 0])
+            dt = time.time() - t0
+            rate = n * batch / dt
+            best = rate if best is None else max(best, rate)
+        out[f"drain_imgs_per_s_t{threads}_c{cap}"] = round(best, 2)
+        out[f"drain_wire_MBps_t{threads}_c{cap}"] = round(
+            best * 224 * 224 * 3 / 1e6, 2)
+
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

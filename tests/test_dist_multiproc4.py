@@ -195,6 +195,88 @@ def test_four_process_tp_spanning_parity(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# pipeline ring spanning processes: pp=8 over 4x2-device processes means
+# EVERY ppermute hop crosses a process boundary (the reference never ran a
+# pipeline schedule at all; this witnesses ours at multi-host topology)
+# ---------------------------------------------------------------------------
+
+_PP_MODEL = r"""
+import numpy as np
+
+
+def run_pipeline():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.parallel import DeviceMesh
+    from paddle_tpu.parallel.pipeline import pipeline_apply
+
+    n, d, b, m = 8, 16, 32, 4
+    mesh = DeviceMesh(jax.devices(), axes={"pp": n})
+    rng = np.random.RandomState(11)
+    stacked_w = jnp.asarray(
+        rng.randn(n, d, d).astype("float32") / np.sqrt(d))
+    x = jnp.asarray(rng.randn(b, d).astype("float32"))
+    tgt = jnp.asarray(rng.randn(b, d).astype("float32"))
+
+    def stage_fn(w, h):
+        return jnp.tanh(h @ w)
+
+    def loss_fn(w, x):
+        y = pipeline_apply(mesh, stage_fn, w, x, num_microbatches=m)
+        return jnp.mean((y - tgt) ** 2)
+
+    loss, grad = jax.jit(jax.value_and_grad(loss_fn))(stacked_w, x)
+    y = jax.jit(lambda w, x: pipeline_apply(mesh, stage_fn, w, x,
+                                            num_microbatches=m))(stacked_w, x)
+    return {"loss": float(loss),
+            "grad_norm": float(jnp.linalg.norm(grad)),
+            "y_head": np.asarray(y)[0, :4].tolist()}
+"""
+
+_PP_SINGLE = r"""
+import json
+from pp_model import run_pipeline
+print(json.dumps(run_pipeline()), flush=True)
+"""
+
+_PP_MULTI = _BOOT + r"""
+import json
+import jax
+from paddle_tpu.distributed import init_parallel_env
+from pp_model import run_pipeline
+
+env = init_parallel_env()
+assert jax.process_count() == 4
+out = run_pipeline()
+out["rank"] = env.trainer_id
+print(json.dumps(out), flush=True)
+"""
+
+
+def test_four_process_pipeline_ring_parity(tmp_path):
+    with open(tmp_path / "pp_model.py", "w") as f:
+        f.write(_PP_MODEL)
+
+    boot8 = _BOOT.replace("host_platform_device_count=2",
+                          "host_platform_device_count=8")
+    ref = subprocess.run(
+        [sys.executable, "-c", _script(boot8 + _PP_SINGLE)],
+        capture_output=True, text=True, timeout=420, cwd=str(tmp_path))
+    assert ref.returncode == 0, ref.stderr[-3000:]
+    expect = json.loads(ref.stdout.strip().splitlines()[-1])
+
+    results = _join_world(_spawn_world(tmp_path, _PP_MULTI, 4, _free_port()))
+    assert set(results) == {0, 1, 2, 3}
+    for rank in range(4):
+        got = results[rank]
+        np.testing.assert_allclose(got["loss"], expect["loss"], rtol=2e-5)
+        np.testing.assert_allclose(got["grad_norm"], expect["grad_norm"],
+                                   rtol=2e-4)
+        np.testing.assert_allclose(got["y_head"], expect["y_head"],
+                                   rtol=2e-4, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
 # elastic resize 4 -> 2 via sharded checkpoint re-shard
 # ---------------------------------------------------------------------------
 

@@ -94,11 +94,20 @@ class TestParallelExecutor:
         np.testing.assert_allclose(single, multi, rtol=2e-4)
 
     def test_indivisible_batch_padded_and_runs(self, rng):
-        """Round 4: a non-dp-divisible batch no longer raises — it is padded
-        to the next dp multiple by wrapping real rows (see
-        tests/test_uneven_batch.py for the mask-weighted loss-parity
-        coverage; ≙ reference details/data_balance_op_handle.cc)."""
-        loss = _build_mlp()
+        """Round 4: a non-dp-divisible batch no longer raises when the
+        program declares layers.batch_row_mask() and weights its loss by
+        it — the feed is padded to the next dp multiple by wrapping real
+        rows and the mask zeroes the wrapped ones (full loss-parity
+        coverage in tests/test_uneven_batch.py, including the guard that a
+        plain-mean program still raises; ≙ reference
+        details/data_balance_op_handle.cc)."""
+        img = layers.data(name="img", shape=[16], dtype="float32")
+        label = layers.data(name="label", shape=[1], dtype="int64")
+        h = layers.fc(img, size=32, act="relu")
+        logits = layers.fc(h, size=10)
+        mask = layers.batch_row_mask()
+        per_ex = layers.softmax_with_cross_entropy(logits, label)
+        loss = layers.reduce_sum(per_ex * mask) / layers.reduce_sum(mask)
         pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
         _run_startup()
         pe = ParallelExecutor(loss_name=loss.name)

@@ -162,6 +162,27 @@ def build_transformer(rng):
     return loss, feeds, b * t, opt
 
 
+def build_transformer_big(rng):
+    """d_model=1024, 12 layers: a config whose arithmetic intensity sits
+    ABOVE the v5e balance point — demonstrates the stack's MFU when the
+    model shape permits it (the bs16·d512 line is HBM-intensity-capped at
+    ~0.33 no matter the kernels; see tools/probe_lm.py)."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer
+    b, t = 8, 1024
+    loss, _ = transformer.transformer_lm(
+        vocab=32000, max_len=t, d_model=1024, d_inner=4096, num_heads=16,
+        num_layers=12, dropout=0.0)
+    feeds = []
+    for _ in range(2):
+        toks = _markov_tokens(rng, b, t + 1, 32000)
+        feeds.append({"tokens": toks[:, :-1].copy(),
+                      "tokens@SEQLEN": np.full((b,), t, "int32"),
+                      "targets": toks[:, 1:].copy()})
+    opt = pt.optimizer.AdamOptimizer(learning_rate=1e-4)
+    return loss, feeds, b * t, opt
+
+
 def build_transformer_nmt(rng):
     import paddle_tpu as pt
     from paddle_tpu.models import transformer
@@ -212,10 +233,10 @@ def build_deepfm(rng):
 _RAGGED_T, _RAGGED_VOCAB = 512, 32000
 
 
-def _ragged_corpus(rng):
+def _ragged_corpus(rng, n_seqs=64):
     """Deterministic ragged corpus (~median length 100, up to T) shared by
     the packed and padded variants so the comparison is apples-to-apples."""
-    lengths = np.clip((np.exp(rng.randn(64) * 0.6 + 4.6)).astype(int),
+    lengths = np.clip((np.exp(rng.randn(n_seqs) * 0.6 + 4.6)).astype(int),
                       32, _RAGGED_T)
     seqs = [rng.randint(1, _RAGGED_VOCAB, (L,)).astype(np.int64)
             for L in lengths]
@@ -223,12 +244,12 @@ def _ragged_corpus(rng):
     return seqs, real_tokens
 
 
-def _build_ragged_lm(rng, packed):
+def _build_ragged_lm(rng, packed, n_seqs=64):
     import paddle_tpu as pt
     from paddle_tpu.data.packing import pack_lm_batch
     from paddle_tpu.models import transformer
 
-    seqs, real_tokens = _ragged_corpus(rng)
+    seqs, real_tokens = _ragged_corpus(rng, n_seqs)
     T = _RAGGED_T
     loss, _ = transformer.transformer_lm(
         vocab=_RAGGED_VOCAB, max_len=T, d_model=512, d_inner=2048,
@@ -263,10 +284,21 @@ def measure_packed_vs_padded(iters=10):
     padded = _measure("padded_ragged_lm_6l_512d_T512",
                       lambda rng: _build_ragged_lm(rng, False),
                       "real_tokens/sec", iters)
+    # equal-ROW-COUNT packed run (4x corpus -> ~64 packed rows, the padded
+    # run's row count): packing 64 sequences yields only ~16 rows, and a
+    # 16-row program has lower MFU than a 64-row one on any path — this
+    # line separates the segment-id kernel's true overhead from that
+    # batch-size effect
+    packed_eq = _measure("packed_ragged_lm_6l_512d_T512_eqrows",
+                         lambda rng: _build_ragged_lm(rng, True, 256),
+                         "real_tokens/sec", iters)
     print(json.dumps({
         "packed_over_padded_speedup":
-            round(packed["value"] / padded["value"], 2)}), flush=True)
-    return packed, padded
+            round(packed["value"] / padded["value"], 2),
+        "packed_eqrows_mfu_over_padded_mfu":
+            round(packed_eq["evidence"]["mfu"]
+                  / padded["evidence"]["mfu"], 3)}), flush=True)
+    return packed, padded, packed_eq
 
 
 def main():
@@ -278,6 +310,8 @@ def main():
                  "tokens/sec", iters),
         _measure("transformer_lm_6l_512d_bs16_T512_flash",
                  build_transformer, "tokens/sec", iters),
+        _measure("transformer_lm_12l_1024d_bs8_T1024_flash",
+                 build_transformer_big, "tokens/sec", iters),
         _measure("transformer_nmt_4l_512d_bs16_T256_flash",
                  build_transformer_nmt, "tokens/sec", iters),
         _measure("deepfm_bs4096_vocab1M_sparse", build_deepfm,

@@ -3,10 +3,10 @@
 The framework's long-context story (SURVEY §5 row: LoD -> segment-ids +
 true context parallelism) rests on the O(T)-memory Pallas kernel. This
 prints the scaling curve — per-step time and achieved attention FLOP/s for
-the kernel at T = 2k..32k, with the XLA composite alongside until it OOMs.
+the kernel at T = 2k..64k, with the XLA composite alongside until it OOMs.
 
     env PYTHONPATH=/root/.axon_site:/root/repo \
-        python tools/bench_longctx.py | tee BENCH_LONGCTX_r03.json
+        python tools/bench_longctx.py | tee BENCH_LONGCTX_r04.json
 """
 
 from __future__ import annotations
@@ -101,7 +101,8 @@ def main():
     import jax
     dev = jax.devices()[0]
     on_accel = dev.platform != "cpu"
-    lengths = (2048, 4096, 8192, 16384, 32768) if on_accel else (256,)
+    lengths = ((2048, 4096, 8192, 16384, 32768, 65536) if on_accel
+               else (256,))
     for T in lengths:
         if on_accel:
             rec = {"T": T, **measure_pair(T)}

@@ -220,3 +220,37 @@ def test_alexnet_trains_tiny(rng):
     l1 = exe.run(feed=feed, fetch_list=[loss, acc])
     assert np.isfinite(l0[0]).all() and np.isfinite(l1[0]).all()
     assert logits.shape[-1] == 10
+
+
+def test_ssd_detector_trains_and_decodes(rng):
+    """SSD zoo model: backbone + multi_box_head + ssd_loss trains (loss
+    decreases), and ssd_decode emits [label, score, box] rows under NMS —
+    the reference's SSD stack as one composed model (≙ reference
+    layers/detection.py multi_box_head:211 / ssd_loss:264)."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import ssd
+
+    B, G = 2, 4
+    loss, head = ssd.ssd_detector(num_classes=4, image_shape=(3, 64, 64),
+                                  num_gt=G)
+    pt.optimizer.AdamOptimizer(learning_rate=3e-3).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    gb = np.zeros((B, G, 4), "float32")
+    gl = np.zeros((B, G), "int64")
+    for b in range(B):
+        gb[b, 0] = [0.1, 0.1, 0.45, 0.45]
+        gl[b, 0] = 1
+        gb[b, 1] = [0.5, 0.5, 0.95, 0.95]
+        gl[b, 1] = 2
+    feed = {"img": rng.rand(B, 3, 64, 64).astype("float32"),
+            "gt_box": gb, "gt_label": gl}
+    l0 = float(exe.run(feed=feed, fetch_list=[loss])[0])
+    for _ in range(10):
+        l1 = float(exe.run(feed=feed, fetch_list=[loss])[0])
+    assert np.isfinite(l1) and l1 < l0
+
+    out, num = ssd.ssd_decode(*head, keep_top_k=20)
+    res, cnt = exe.run(feed=feed, fetch_list=[out, num])
+    assert res.shape == (B, 20, 6)
+    assert (cnt >= 0).all() and (cnt <= 20).all()

@@ -9,5 +9,5 @@ build programs for fluid_benchmark.py.
 """
 
 from . import (alexnet, deepfm, googlenet,  # noqa: F401
-               machine_translation, mnist, resnet, se_resnext, ssd,
-               stacked_lstm, transformer, vgg)
+               machine_translation, mnist, ocr_crnn, resnet, se_resnext,
+               ssd, stacked_lstm, transformer, vgg)

@@ -254,3 +254,33 @@ def test_ssd_detector_trains_and_decodes(rng):
     res, cnt = exe.run(feed=feed, fetch_list=[out, num])
     assert res.shape == (B, 20, 6)
     assert (cnt >= 0).all() and (cnt <= 20).all()
+
+
+def test_crnn_ctc_trains_and_decodes(rng):
+    """CRNN-CTC OCR zoo model: conv columns -> BiGRU -> warpctc trains to
+    decreasing loss; greedy CTC decode emits merged label sequences
+    (≙ reference warpctc/ctc_align OCR recipe)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.models import ocr_crnn
+
+    B, L, NC = 2, 4, 10
+    loss, logits, seqlen = ocr_crnn.crnn_ctc(
+        num_classes=NC, image_shape=(1, 32, 64), max_label_len=L, hidden=32)
+    pt.optimizer.AdamOptimizer(learning_rate=3e-3).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    feed = {"img": rng.rand(B, 1, 32, 64).astype("float32"),
+            "label": rng.randint(0, NC, (B, L)).astype("int64")}
+    l0 = float(exe.run(feed=feed, fetch_list=[loss])[0])
+    for _ in range(12):
+        l1 = float(exe.run(feed=feed, fetch_list=[loss])[0])
+    assert np.isfinite(l1) and l1 < l0
+
+    dec, dec_len = layers.sequence.ctc_greedy_decoder(
+        layers.softmax(logits), blank=NC, input_length=seqlen)
+    d, dl = exe.run(feed=feed, fetch_list=[dec, dec_len])
+    assert d.shape[0] == B and (dl >= 0).all()
+    # decoded ids are real classes only (blank removed by the aligner)
+    for b in range(B):
+        assert (d[b, :int(dl[b, 0])] < NC).all()

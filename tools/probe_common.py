@@ -1,0 +1,83 @@
+"""Shared harness for the step-attribution probes (probe_lstm/probe_nmt).
+
+One place for the build → compile → cost_analysis → best-of-N timing
+boilerplate, so fixes to timing or cost-model handling land once.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Tuple
+
+import numpy as np
+
+V5E_PEAK_TFLOPS = 197e12
+V5E_HBM_BPS = 819e9
+
+
+def measure_step(build: Callable[[], Tuple], make_feed: Callable[[], Dict],
+                 iters: int = 15, windows: int = 3, hlo_path: str = None):
+    """build() -> (loss_var, optimizer); make_feed() -> feed dict.
+
+    Returns {step_s, flops, bytes_acc} with flops/bytes from XLA's own
+    cost model for the compiled train step (0.0 when the backend does not
+    report them) and step_s the best-of-`windows` mean over `iters` steps,
+    host-value realization as the only trusted barrier (see bench.py).
+    """
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    with pt.core.unique_name.guard():
+        loss, opt = build()
+        opt.minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    feed = {k: jnp.asarray(v) for k, v in make_feed().items()}
+
+    prog, scope = pt.default_main_program(), pt.global_scope()
+    compiled = exe._lookup_or_compile(prog, feed, [loss.name], scope)
+    feed_vals = tuple(jnp.asarray(feed[n]) for n in compiled.feed_names)
+    ro_vals = tuple(scope.get(n) for n in compiled.ro_names)
+    rw_vals = tuple(scope.get(n) for n in compiled.rw_names)
+    ex = compiled.fn.lower(feed_vals, ro_vals, rw_vals,
+                           np.uint32(0)).compile()
+    if hlo_path:
+        with open(hlo_path, "w") as f:
+            f.write(ex.as_text())
+    ca = ex.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    ca = ca or {}
+    bytes_acc = float(ca.get("bytes accessed", 0.0))
+    flops = float(ca.get("flops", 0.0))
+
+    o = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
+    float(np.asarray(o[0]).ravel()[0])  # compile + drain
+    best = None
+    for _ in range(windows):
+        t0 = time.time()
+        fetched = []
+        for _ in range(iters):
+            o = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
+            fetched.append(o[0])
+        float(np.asarray(fetched[-1]).ravel()[0])
+        dt = (time.time() - t0) / iters
+        best = dt if best is None else min(best, dt)
+    return {"step_s": best, "flops": flops, "bytes_acc": bytes_acc}
+
+
+def roofline_fields(step_s: float, flops: float, bytes_acc: float) -> Dict:
+    """The shared attribution fields; None where the cost model gave 0."""
+    out = {
+        "step_ms": round(step_s * 1e3, 2),
+        "bytes_GB": round(bytes_acc / 1e9, 2) if bytes_acc else None,
+        "flops_G": round(flops / 1e9, 1) if flops else None,
+        "intensity_flops_per_byte":
+            round(flops / bytes_acc, 1) if flops and bytes_acc else None,
+        "ideal_mxu_ms":
+            round(flops / V5E_PEAK_TFLOPS * 1e3, 3) if flops else None,
+        "ideal_hbm_ms":
+            round(bytes_acc / V5E_HBM_BPS * 1e3, 3) if bytes_acc else None,
+        "mfu": round(flops / step_s / V5E_PEAK_TFLOPS, 4) if flops else None,
+    }
+    return out

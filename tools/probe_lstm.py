@@ -11,78 +11,47 @@ wall time vs the per-tick ideal.
     env PYTHONPATH=/root/.axon_site:/root/repo python tools/probe_lstm.py
 """
 import json
+import os
 import sys
-import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from probe_common import measure_step, roofline_fields  # noqa: E402
+
 
 def main(b=64, t=64, emb=256, hid=256):
-    import jax.numpy as jnp
     import paddle_tpu as pt
     from paddle_tpu.models import stacked_lstm
 
-    sys.path.insert(0, "/root/repo")
-
-    pt.reset_default_programs()
-    pt.reset_global_scope()
     rng = np.random.RandomState(0)
-    with pt.core.unique_name.guard():
+
+    def build():
         loss, acc, _ = stacked_lstm.stacked_lstm_net(
             dict_dim=10000, emb_dim=emb, hid_dim=hid, max_len=t)
-        opt = pt.optimizer.AdamOptimizer(learning_rate=5e-4)
-        opt.minimize(loss)
-    exe = pt.Executor()
-    exe.run(pt.default_startup_program())
-    feed = {"words": jnp.asarray(rng.randint(0, 10000, (b, t))
-                                 .astype("int64")),
-            "words@SEQLEN": jnp.asarray(np.full((b,), t, "int32")),
-            "label": jnp.asarray(rng.randint(0, 2, (b, 1)).astype("int64"))}
-    prog, scope = pt.default_main_program(), pt.global_scope()
-    compiled = exe._lookup_or_compile(prog, feed, [loss.name], scope)
-    feed_vals = tuple(jnp.asarray(feed[n]) for n in compiled.feed_names)
-    ro_vals = tuple(scope.get(n) for n in compiled.ro_names)
-    rw_vals = tuple(scope.get(n) for n in compiled.rw_names)
-    ex = compiled.fn.lower(feed_vals, ro_vals, rw_vals,
-                           np.uint32(0)).compile()
-    ca = ex.cost_analysis()
-    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-    bytes_acc = float(ca.get("bytes accessed", 0))
-    flops = float(ca.get("flops", 0))
+        return loss, pt.optimizer.AdamOptimizer(learning_rate=5e-4)
 
-    o = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
-    float(np.asarray(o[0]).ravel()[0])
-    best = None
-    for _ in range(3):
-        t0 = time.time()
-        fetched = []
-        for _ in range(20):
-            o = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
-            fetched.append(o[0])
-        float(np.asarray(fetched[-1]).ravel()[0])
-        dt = (time.time() - t0) / 20
-        best = dt if best is None else min(best, dt)
+    def make_feed():
+        return {"words": rng.randint(0, 10000, (b, t)).astype("int64"),
+                "words@SEQLEN": np.full((b,), t, "int32"),
+                "label": rng.randint(0, 2, (b, 1)).astype("int64")}
+
+    m = measure_step(build, make_feed, iters=20)
+    out = roofline_fields(m["step_s"], m["flops"], m["bytes_acc"])
 
     # 3 stacked LSTMs, each a T-tick scan, fwd + bwd (bwd re-scans) ->
     # sequential tick chain the step time divides over
     ticks = 3 * t * 2
-    per_tick_matmul_flops = 2 * b * hid * (4 * hid)
-    print(json.dumps({
-        "step_ms": round(best * 1e3, 2),
-        "bytes_GB": round(bytes_acc / 1e9, 2),
-        "flops_G": round(flops / 1e9, 1),
-        "intensity_flops_per_byte": round(flops / bytes_acc, 1),
-        "ideal_mxu_ms": round(flops / 197e12 * 1e3, 3),
-        "ideal_hbm_ms": round(bytes_acc / 819e9 * 1e3, 3),
-        "mfu": round(flops / best / 197e12, 4),
+    out.update({
         "sequential_ticks_fwd_bwd": ticks,
-        "wall_us_per_tick": round(best / ticks * 1e6, 1),
+        "wall_us_per_tick": round(m["step_s"] / ticks * 1e6, 1),
         "recurrent_matmul_mflops_per_tick":
-            round(per_tick_matmul_flops / 1e6, 1),
+            round(2 * b * hid * (4 * hid) / 1e6, 1),
         "note": "a ~34-MFLOP matmul per tick cannot fill the MXU; the "
                 "step is bound by the serialized scan ticks, not "
                 "flops or HBM (both ideals are far below measured)",
-    }))
+    })
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -8,73 +8,45 @@ as a bare number.
     env PYTHONPATH=/root/.axon_site:/root/repo python tools/probe_nmt.py
 """
 import json
+import os
 import sys
-import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from probe_common import (V5E_HBM_BPS, V5E_PEAK_TFLOPS,  # noqa: E402
+                          measure_step, roofline_fields)
+
 
 def main(b=16, t=256):
-    import jax.numpy as jnp
     import paddle_tpu as pt
     from paddle_tpu.models import transformer
 
-    sys.path.insert(0, "/root/repo")
-
-    pt.reset_default_programs()
-    pt.reset_global_scope()
     rng = np.random.RandomState(0)
-    with pt.core.unique_name.guard():
+
+    def build():
         loss, _ = transformer.transformer(
             src_vocab=16000, tgt_vocab=16000, max_len=t, d_model=512,
             d_inner=2048, num_heads=8, num_layers=4, dropout=0.0)
-        opt = pt.optimizer.AdamOptimizer(learning_rate=1e-4)
-        opt.minimize(loss)
-    exe = pt.Executor()
-    exe.run(pt.default_startup_program())
-    feed = {"src": jnp.asarray(rng.randint(1, 16000, (b, t)).astype("int64")),
-            "src@SEQLEN": jnp.asarray(np.full((b,), t, "int32")),
-            "tgt": jnp.asarray(rng.randint(1, 16000, (b, t)).astype("int64")),
-            "tgt@SEQLEN": jnp.asarray(np.full((b,), t, "int32")),
-            "lbl": jnp.asarray(rng.randint(1, 16000, (b, t)).astype("int64"))}
-    prog, scope = pt.default_main_program(), pt.global_scope()
-    compiled = exe._lookup_or_compile(prog, feed, [loss.name], scope)
-    feed_vals = tuple(jnp.asarray(feed[n]) for n in compiled.feed_names)
-    ro_vals = tuple(scope.get(n) for n in compiled.ro_names)
-    rw_vals = tuple(scope.get(n) for n in compiled.rw_names)
-    ex = compiled.fn.lower(feed_vals, ro_vals, rw_vals,
-                           np.uint32(0)).compile()
-    with open("/tmp/nmt_train.hlo", "w") as f:
-        f.write(ex.as_text())
-    ca = ex.cost_analysis()
-    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-    bytes_acc = float(ca.get("bytes accessed", 0))
-    flops = float(ca.get("flops", 0))
+        return loss, pt.optimizer.AdamOptimizer(learning_rate=1e-4)
 
-    o = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
-    float(np.asarray(o[0]).ravel()[0])
-    best = None
-    for _ in range(3):
-        t0 = time.time()
-        fetched = []
-        for _ in range(15):
-            o = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
-            fetched.append(o[0])
-        float(np.asarray(fetched[-1]).ravel()[0])
-        dt = (time.time() - t0) / 15
-        best = dt if best is None else min(best, dt)
-    print(json.dumps({
-        "step_ms": round(best * 1e3, 2),
-        "bytes_GB": round(bytes_acc / 1e9, 2),
-        "flops_G": round(flops / 1e9, 1),
-        "intensity_flops_per_byte": round(flops / bytes_acc, 1),
-        "ideal_mxu_ms": round(flops / 197e12 * 1e3, 2),
-        "ideal_hbm_ms": round(bytes_acc / 819e9 * 1e3, 2),
-        "roofline_mfu_cap": round(
-            flops / max(flops / 197e12, bytes_acc / 819e9) / 197e12, 3),
-        "mfu": round(flops / best / 197e12, 4),
-        "tokens_per_s": round(b * t / best),
-    }))
+    def make_feed():
+        return {"src": rng.randint(1, 16000, (b, t)).astype("int64"),
+                "src@SEQLEN": np.full((b,), t, "int32"),
+                "tgt": rng.randint(1, 16000, (b, t)).astype("int64"),
+                "tgt@SEQLEN": np.full((b,), t, "int32"),
+                "lbl": rng.randint(1, 16000, (b, t)).astype("int64")}
+
+    m = measure_step(build, make_feed, iters=15,
+                     hlo_path="/tmp/nmt_train.hlo")
+    out = roofline_fields(m["step_s"], m["flops"], m["bytes_acc"])
+    if m["flops"] and m["bytes_acc"]:
+        out["roofline_mfu_cap"] = round(
+            m["flops"] / max(m["flops"] / V5E_PEAK_TFLOPS,
+                             m["bytes_acc"] / V5E_HBM_BPS)
+            / V5E_PEAK_TFLOPS, 3)
+    out["tokens_per_s"] = round(b * t / m["step_s"])
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

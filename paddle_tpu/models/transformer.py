@@ -102,19 +102,26 @@ def ffn(x, d_model, d_inner, dropout=0.0, is_test=False, name=None):
                      name=name and name + "_fc2")
 
 
-def _add_norm(x, residual, dropout=0.0, is_test=False):
+def _add_norm(x, residual, dropout=0.0, is_test=False, name=None):
+    """name (when given) pins the LayerNorm parameter names so a decode
+    graph built later in the same program shares the trained weights (the
+    generation path rebuilds per-step computation from the same names)."""
     if dropout:
         x = layers.dropout(x, dropout_prob=dropout, is_test=is_test)
+    kw = {}
+    if name:
+        kw = {"param_attr": ParamAttr(name=name + ".scale"),
+              "bias_attr": ParamAttr(name=name + ".bias")}
     return layers.layer_norm(layers.elementwise_add(x, residual),
-                             begin_norm_axis=2)
+                             begin_norm_axis=2, **kw)
 
 
 def encoder_layer(x, d_model, num_heads, d_inner, dropout, is_test, name):
     attn = multi_head_attention(x, x, x, d_model, num_heads, dropout,
                                 is_test, name=name + "_attn")
-    x = _add_norm(attn, x, dropout, is_test)
+    x = _add_norm(attn, x, dropout, is_test, name=name + "_ln1")
     f = ffn(x, d_model, d_inner, dropout, is_test, name=name + "_ffn")
-    return _add_norm(f, x, dropout, is_test)
+    return _add_norm(f, x, dropout, is_test, name=name + "_ln2")
 
 
 def decoder_layer(x, enc_out, d_model, num_heads, d_inner, dropout, is_test,
@@ -122,12 +129,12 @@ def decoder_layer(x, enc_out, d_model, num_heads, d_inner, dropout, is_test,
     self_attn = multi_head_attention(x, x, x, d_model, num_heads, dropout,
                                      is_test, causal=True,
                                      name=name + "_self")
-    x = _add_norm(self_attn, x, dropout, is_test)
+    x = _add_norm(self_attn, x, dropout, is_test, name=name + "_ln1")
     cross = multi_head_attention(x, enc_out, enc_out, d_model, num_heads,
                                  dropout, is_test, name=name + "_cross")
-    x = _add_norm(cross, x, dropout, is_test)
+    x = _add_norm(cross, x, dropout, is_test, name=name + "_ln2")
     f = ffn(x, d_model, d_inner, dropout, is_test, name=name + "_ffn")
-    return _add_norm(f, x, dropout, is_test)
+    return _add_norm(f, x, dropout, is_test, name=name + "_ln3")
 
 
 def _embed(tokens, vocab_size, d_model, max_len, name, positions=None):
@@ -206,6 +213,113 @@ def transformer(src=None, tgt=None, label=None, src_vocab=30000,
     return loss, logits
 
 
+def transformer_lm_generate(prompt=None, vocab=32000, max_gen=32,
+                            d_model=512, d_inner=2048, num_heads=8,
+                            num_layers=6, bos_id=0, eos_id=-1, beam_size=1):
+    """Autoregressive generation with a per-layer KV cache (capability ≙
+    the reference transformer benchmark's fast decoder; the reference
+    decodes by re-running the while_op decoder with LoD beam state).
+
+    TPU-first: one StaticRNN (lax.scan) over max_gen positions; the KV
+    cache lives in the scan carry as [B, K, max_gen, d_model] tensors
+    written by a one-hot outer product (no dynamic-update ops needed,
+    MXU-friendly), each step attends q·K over the masked cache. Weights
+    are shared BY NAME with a transformer_lm(...) built earlier in the
+    same program (l{i}_attn_{q,k,v,o}, l{i}_ln{1,2}, l{i}_ffn_*,
+    tok_emb, lm_head) — train first, then build this decode graph and
+    run it in the same scope. beam_size=1 is greedy; >1 is beam search
+    through the shared BeamSearchDecoder.
+
+    Returns (sequences [B, max_gen, K], scores [B, K])."""
+    from ..contrib.decoder import BeamSearchDecoder
+
+    if prompt is None:
+        prompt = layers.data(name="prompt", shape=[1], dtype="int64")
+    K, T, H = beam_size, max_gen, d_model
+    d_head = d_model // num_heads
+    decoder = BeamSearchDecoder(beam_size=K, bos_id=bos_id, eos_id=eos_id,
+                                max_len=T, name="lm_gen")
+
+    pe_table = positional_encoding_table(T, d_model).astype("float32")
+    arange = np.arange(T, dtype="float32").reshape(1, 1, T)
+
+    def zeros_cache():
+        return layers.fill_constant_batch_size_like(
+            prompt, shape=[-1, K, T, H], dtype="float32", value=0.0)
+
+    init = {"pos": layers.fill_constant_batch_size_like(
+        prompt, shape=[-1, K, 1], dtype="float32", value=0.0)}
+    for i in range(num_layers):
+        init[f"k{i}"] = zeros_cache()
+        init[f"v{i}"] = zeros_cache()
+
+    def step(states, ids_prev):
+        pos = states["pos"]                                      # [B,K,1]
+        onehot_t = layers.one_hot(
+            layers.cast(pos, "int64"), depth=T)                  # [B,K,T]
+        emb = layers.embedding(layers.unsqueeze(ids_prev, axes=[2]),
+                               size=[vocab, d_model],
+                               param_attr=ParamAttr(name="tok_emb"))
+        x = layers.scale(emb, scale=float(d_model) ** 0.5)
+        x = layers.elementwise_add(
+            x, layers.matmul(onehot_t, layers.assign(pe_table)))
+
+        # cache positions > current are masked out of every attention
+        valid = layers.cast(layers.less_than(
+            layers.assign(arange),
+            layers.elementwise_add(
+                pos, layers.fill_constant([1], "float32", 1.0))),
+            "float32")                                           # [B,K,T]
+        bias = layers.unsqueeze(
+            layers.scale(valid, scale=1e9, bias=-1e9), axes=[2, 3])
+
+        new_states = {"pos": layers.elementwise_add(
+            pos, layers.fill_constant([1], "float32", 1.0))}
+        write = layers.unsqueeze(onehot_t, axes=[3])             # [B,K,T,1]
+        for i in range(num_layers):
+            q = layers.fc(x, size=H, num_flatten_dims=2, bias_attr=False,
+                          use_bf16=True, name=f"l{i}_attn_q")
+            kn = layers.fc(x, size=H, num_flatten_dims=2, bias_attr=False,
+                           use_bf16=True, name=f"l{i}_attn_k")
+            vn = layers.fc(x, size=H, num_flatten_dims=2, bias_attr=False,
+                           use_bf16=True, name=f"l{i}_attn_v")
+            kc = layers.elementwise_add(
+                states[f"k{i}"],
+                layers.elementwise_mul(write,
+                                       layers.unsqueeze(kn, axes=[2])))
+            vc = layers.elementwise_add(
+                states[f"v{i}"],
+                layers.elementwise_mul(write,
+                                       layers.unsqueeze(vn, axes=[2])))
+            new_states[f"k{i}"], new_states[f"v{i}"] = kc, vc
+
+            # per-head attention over the cache: [B,K,nh,1,T] scores
+            q5 = layers.reshape(q, shape=[0, K, num_heads, 1, d_head])
+            k5 = layers.transpose(
+                layers.reshape(kc, shape=[0, K, T, num_heads, d_head]),
+                perm=[0, 1, 3, 4, 2])                   # [B,K,nh,dh,T]
+            v5 = layers.transpose(
+                layers.reshape(vc, shape=[0, K, T, num_heads, d_head]),
+                perm=[0, 1, 3, 2, 4])                   # [B,K,nh,T,dh]
+            scores = layers.matmul(q5, k5, alpha=float(d_head) ** -0.5)
+            weights = layers.softmax(
+                layers.elementwise_add(scores, bias))
+            ctx = layers.reshape(layers.matmul(weights, v5),
+                                 shape=[0, K, H])
+            attn = layers.fc(ctx, size=H, num_flatten_dims=2,
+                             bias_attr=False, use_bf16=True,
+                             name=f"l{i}_attn_o")
+            x = _add_norm(attn, x, name=f"l{i}_ln1")
+            f = ffn(x, d_model, d_inner, name=f"l{i}_ffn")
+            x = _add_norm(f, x, name=f"l{i}_ln2")
+
+        logits = layers.fc(x, size=vocab, num_flatten_dims=2, use_bf16=True,
+                           name="lm_head")
+        return new_states, layers.log_softmax(logits)
+
+    return decoder.decode(prompt, init, step)
+
+
 def transformer_lm(tokens=None, label=None, vocab=32000, max_len=128,
                    d_model=512, d_inner=2048, num_heads=8, num_layers=6,
                    dropout=0.0, is_test=False, packed=False):
@@ -241,9 +355,9 @@ def transformer_lm(tokens=None, label=None, vocab=32000, max_len=128,
                                     is_test, causal=True,
                                     segment_ids=segments,
                                     name=f"l{i}_attn")
-        x = _add_norm(attn, x, dropout, is_test)
+        x = _add_norm(attn, x, dropout, is_test, name=f"l{i}_ln1")
         f = ffn(x, d_model, d_inner, dropout, is_test, name=f"l{i}_ffn")
-        x = _add_norm(f, x, dropout, is_test)
+        x = _add_norm(f, x, dropout, is_test, name=f"l{i}_ln2")
     logits = layers.fc(x, size=vocab, num_flatten_dims=2, use_bf16=True,
                        name="lm_head")
     label3 = layers.unsqueeze(label, axes=[2])

@@ -365,7 +365,12 @@ def export_inference_model(dirname: str,
     def fn(*feeds):
         env: Dict[str, object] = dict(state_vals)
         env.update(zip(feed_names, feeds))
-        ctx = LowerCtx(rng_key=jax.random.PRNGKey(0), is_test=True)
+        # extras['program'] lets control-flow ops (static_rnn/while/cond)
+        # resolve their sub-blocks — a beam-search decode graph exports
+        # the same way a feed-forward one does
+        ctx = LowerCtx(rng_key=jax.random.PRNGKey(0), is_test=True,
+                       extras={"program": inference_program,
+                               "fetch_names": tuple(target_names)})
         run_plan(plan, env, block, ctx)
         return tuple(env[n] for n in target_names)
 

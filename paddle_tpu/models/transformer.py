@@ -213,6 +213,140 @@ def transformer(src=None, tgt=None, label=None, src_vocab=30000,
     return loss, logits
 
 
+def transformer_generate(src=None, src_vocab=30000, tgt_vocab=30000,
+                         max_src_len=64, max_gen=32, d_model=512,
+                         d_inner=2048, num_heads=8, num_layers=6,
+                         bos_id=0, eos_id=1, beam_size=4):
+    """Encoder-decoder generation: encode the source once, then decode
+    autoregressively with per-layer SELF-attention KV caches in the scan
+    carry; cross-attention keys/values are projected once outside the
+    scan and broadcast over the beam axis. Weights shared by name with a
+    transformer(...) train graph (enc{i}_*, dec{i}_*, src/tgt_emb, proj)
+    built with the same dims — train, then build this in its own program
+    and run it in the same scope.
+
+    Returns (sequences [B, max_gen, K], scores [B, K])."""
+    from ..contrib.decoder import BeamSearchDecoder
+
+    if src is None:
+        src = layers.data(name="src", shape=[max_src_len], dtype="int64",
+                          lod_level=1)
+    src_len = layers.sequence.get_seqlen(src)
+    K, T, H = beam_size, max_gen, d_model
+    Ts = max_src_len
+    d_head = d_model // num_heads
+
+    enc = _embed(src, src_vocab, d_model, Ts, "src")
+    for i in range(num_layers):
+        enc = encoder_layer(enc, d_model, num_heads, d_inner, 0.0, True,
+                            f"enc{i}")
+
+    # cross K/V once per layer, [B, 1, nh, dh|Ts] views that broadcast
+    # over the beam axis inside the scan
+    cross_k, cross_v = [], []
+    for i in range(num_layers):
+        ck = layers.fc(enc, size=H, num_flatten_dims=2, bias_attr=False,
+                       use_bf16=True, name=f"dec{i}_cross_k")
+        cv = layers.fc(enc, size=H, num_flatten_dims=2, bias_attr=False,
+                       use_bf16=True, name=f"dec{i}_cross_v")
+        ck = layers.transpose(
+            layers.reshape(ck, shape=[0, 1, Ts, num_heads, d_head]),
+            perm=[0, 1, 3, 4, 2])                        # [B,1,nh,dh,Ts]
+        cv = layers.transpose(
+            layers.reshape(cv, shape=[0, 1, Ts, num_heads, d_head]),
+            perm=[0, 1, 3, 2, 4])                        # [B,1,nh,Ts,dh]
+        cross_k.append(ck)
+        cross_v.append(cv)
+    src_mask = layers.sequence_mask(src_len, maxlen=Ts)   # [B,Ts]
+    src_bias = layers.unsqueeze(
+        layers.scale(src_mask, scale=1e9, bias=-1e9), axes=[1, 2, 3])
+
+    decoder = BeamSearchDecoder(beam_size=K, bos_id=bos_id, eos_id=eos_id,
+                                max_len=T, name="nmt_gen")
+    pe_table = positional_encoding_table(T, d_model).astype("float32")
+    arange = np.arange(T, dtype="float32").reshape(1, 1, T)
+
+    init = {"pos": layers.fill_constant_batch_size_like(
+        src, shape=[-1, K, 1], dtype="float32", value=0.0)}
+    for i in range(num_layers):
+        for s in ("k", "v"):
+            init[f"{s}{i}"] = layers.fill_constant_batch_size_like(
+                src, shape=[-1, K, T, H], dtype="float32", value=0.0)
+
+    def step(states, ids_prev):
+        pos = states["pos"]
+        onehot_t = layers.one_hot(layers.cast(pos, "int64"), depth=T)
+        emb = layers.embedding(layers.unsqueeze(ids_prev, axes=[2]),
+                               size=[tgt_vocab, d_model],
+                               param_attr=ParamAttr(name="tgt_emb"))
+        x = layers.scale(emb, scale=float(d_model) ** 0.5)
+        x = layers.elementwise_add(
+            x, layers.matmul(onehot_t, layers.assign(pe_table)))
+
+        valid = layers.cast(layers.less_than(
+            layers.assign(arange),
+            layers.elementwise_add(
+                pos, layers.fill_constant([1], "float32", 1.0))),
+            "float32")
+        self_bias = layers.unsqueeze(
+            layers.scale(valid, scale=1e9, bias=-1e9), axes=[2, 3])
+        new_states = {"pos": layers.elementwise_add(
+            pos, layers.fill_constant([1], "float32", 1.0))}
+        write = layers.unsqueeze(onehot_t, axes=[3])
+
+        def heads_q(q):
+            return layers.reshape(q, shape=[0, K, num_heads, 1, d_head])
+
+        def attend(q5, k5, v5, bias):
+            scores = layers.matmul(q5, k5, alpha=float(d_head) ** -0.5)
+            w = layers.softmax(layers.elementwise_add(scores, bias))
+            return layers.reshape(layers.matmul(w, v5), shape=[0, K, H])
+
+        for i in range(num_layers):
+            # causal self-attention over the KV cache
+            q = layers.fc(x, size=H, num_flatten_dims=2, bias_attr=False,
+                          use_bf16=True, name=f"dec{i}_self_q")
+            kn = layers.fc(x, size=H, num_flatten_dims=2, bias_attr=False,
+                           use_bf16=True, name=f"dec{i}_self_k")
+            vn = layers.fc(x, size=H, num_flatten_dims=2, bias_attr=False,
+                           use_bf16=True, name=f"dec{i}_self_v")
+            kc = layers.elementwise_add(
+                states[f"k{i}"], layers.elementwise_mul(
+                    write, layers.unsqueeze(kn, axes=[2])))
+            vc = layers.elementwise_add(
+                states[f"v{i}"], layers.elementwise_mul(
+                    write, layers.unsqueeze(vn, axes=[2])))
+            new_states[f"k{i}"], new_states[f"v{i}"] = kc, vc
+            k5 = layers.transpose(
+                layers.reshape(kc, shape=[0, K, T, num_heads, d_head]),
+                perm=[0, 1, 3, 4, 2])
+            v5 = layers.transpose(
+                layers.reshape(vc, shape=[0, K, T, num_heads, d_head]),
+                perm=[0, 1, 3, 2, 4])
+            ctx = attend(heads_q(q), k5, v5, self_bias)
+            attn = layers.fc(ctx, size=H, num_flatten_dims=2,
+                             bias_attr=False, use_bf16=True,
+                             name=f"dec{i}_self_o")
+            x = _add_norm(attn, x, name=f"dec{i}_ln1")
+
+            # cross-attention over the pre-projected encoder K/V
+            cq = layers.fc(x, size=H, num_flatten_dims=2, bias_attr=False,
+                           use_bf16=True, name=f"dec{i}_cross_q")
+            cctx = attend(heads_q(cq), cross_k[i], cross_v[i], src_bias)
+            cattn = layers.fc(cctx, size=H, num_flatten_dims=2,
+                              bias_attr=False, use_bf16=True,
+                              name=f"dec{i}_cross_o")
+            x = _add_norm(cattn, x, name=f"dec{i}_ln2")
+            f = ffn(x, d_model, d_inner, name=f"dec{i}_ffn")
+            x = _add_norm(f, x, name=f"dec{i}_ln3")
+
+        logits = layers.fc(x, size=tgt_vocab, num_flatten_dims=2,
+                           use_bf16=True, name="proj")
+        return new_states, layers.log_softmax(logits)
+
+    return decoder.decode(src, init, step)
+
+
 def transformer_lm_generate(prompt=None, vocab=32000, max_gen=32,
                             d_model=512, d_inner=2048, num_heads=8,
                             num_layers=6, bos_id=0, eos_id=-1, beam_size=1):

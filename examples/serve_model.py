@@ -1,0 +1,72 @@
+"""Export a model and serve it three ways: in-process Predictor, the TCP
+PredictorServer (clone-per-connection), and — when the native binary is
+built — the pure-C++ `ptpu_predict --serve` speaking the same protocol.
+
+    python examples/serve_model.py
+    # optional native server: sh paddle_tpu/native/build.sh predict
+"""
+import os
+import subprocess
+import tempfile
+import sys
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import paddle_tpu as pt                                   # noqa: E402
+from paddle_tpu import layers                             # noqa: E402
+from paddle_tpu.inferencer import Predictor               # noqa: E402
+from paddle_tpu.serving import (PredictorClient,          # noqa: E402
+                                PredictorServer)
+
+NATIVE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "paddle_tpu", "native", "ptpu_predict")
+
+
+def main():
+    img = layers.data("img", shape=[8, 8, 1])
+    conv = layers.conv2d(img, num_filters=8, filter_size=3, padding=1,
+                         data_format="NHWC", act="relu")
+    flat = layers.reshape(conv, shape=[-1, 8 * 8 * 8])
+    logits = layers.fc(flat, size=10, act="softmax")
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+
+    d = os.path.join(tempfile.mkdtemp(), "model")
+    pt.io.save_inference_model(d, ["img"], [logits], executor=exe,
+                               export=True, native=True)
+    x = np.random.RandomState(0).rand(2, 8, 8, 1).astype("float32")
+
+    # 1. cold-load the exported StableHLO artifact, no tracer in sight
+    p = Predictor.from_exported(d)
+    print("in-process:", p.run({"img": x})[0][0, :3])
+
+    # 2. TCP server with pipelined requests
+    with PredictorServer(p) as srv, \
+            PredictorClient(*srv.address) as client:
+        for _ in range(4):
+            client.send({"img": x})
+        outs = [client.recv()[0] for _ in range(4)]
+        print("served (4 pipelined):", outs[0][0, :3])
+
+    # 3. the same artifact from a pure-C++ process, same wire protocol
+    if os.path.exists(NATIVE):
+        proc = subprocess.Popen([NATIVE, d, "--serve", "0"],
+                                stdout=subprocess.PIPE, text=True)
+        try:
+            port = int(proc.stdout.readline().split()[1])
+            with PredictorClient("127.0.0.1", port) as client:
+                print("C++ server:", client.infer({"img": x})[0][0, :3])
+        finally:
+            proc.kill()
+    else:
+        print("C++ server: build with `sh paddle_tpu/native/build.sh "
+              "predict` to run this leg")
+
+
+if __name__ == "__main__":
+    main()

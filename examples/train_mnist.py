@@ -1,0 +1,65 @@
+"""Train an MNIST classifier end to end: build -> train -> evaluate ->
+save -> reload -> serve one prediction.
+
+    python examples/train_mnist.py          # CPU or TPU, ~1 min
+
+Uses the real MNIST IDX files when cached under ~/.cache/paddle_tpu
+(data.common.download verifies md5), synthetic digits offline.
+"""
+import os
+import tempfile
+import sys
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import paddle_tpu as pt                                   # noqa: E402
+from paddle_tpu import layers                             # noqa: E402
+from paddle_tpu.data import datasets                      # noqa: E402
+
+
+def main():
+    img = layers.data("img", shape=[784])
+    label = layers.data("label", shape=[1], dtype="int64")
+    h = layers.fc(img, size=200, act="relu")
+    h = layers.fc(h, size=200, act="relu")
+    logits = layers.fc(h, size=10)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(logits, label)
+    pt.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(loss)
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+
+    train = datasets.mnist.train()          # real IDX or synthetic fallback
+    batch, bs = [], 64
+    for epoch in range(1):
+        for i, (x, y) in enumerate(train()):
+            batch.append((x, y))
+            if len(batch) < bs:
+                continue
+            xs = np.stack([b[0] for b in batch]).reshape(bs, 784)
+            ys = np.array([b[1] for b in batch], "int64").reshape(bs, 1)
+            batch = []
+            l, a = exe.run(feed={"img": xs.astype("float32"), "label": ys},
+                           fetch_list=[loss, acc])
+            if i % 6400 < bs:
+                print(f"epoch {epoch} step {i // bs}: "
+                      f"loss {float(l):.3f} acc {float(a):.3f}")
+            if i >= 12800:                  # a quick demo slice
+                break
+
+    d = os.path.join(tempfile.mkdtemp(), "mnist_model")
+    pt.io.save_inference_model(d, ["img"], [logits], executor=exe)
+    pred = pt.Predictor(d)
+    probe = np.random.RandomState(0).rand(1, 784).astype("float32")
+    print("reloaded predictor says:",
+          int(np.argmax(pred.run({"img": probe})[0])))
+
+
+if __name__ == "__main__":
+    main()

@@ -66,13 +66,18 @@ class BeamSearchDecoder:
                              expand_times=[1, self.beam_size, 1])
 
     def decode(self, batch_ref, init_states: Dict[str, object],
-               step_fn: Callable) -> Tuple[object, object]:
+               step_fn: Callable, init_ids=None) -> Tuple[object, object]:
         """batch_ref: any variable whose dim 0 is the batch (shapes for the
         id/score/driver tensors derive from it); init_states: name -> [B, K,
-        ...] beam-expanded variables (see expand_to_beams)."""
+        ...] beam-expanded variables (see expand_to_beams); init_ids
+        (optional [B, 1] int64 var): per-row FIRST token to condition on —
+        every beam starts from it — instead of the constant bos_id."""
         K = self.beam_size
-        ids0 = layers.fill_constant_batch_size_like(
-            batch_ref, shape=[-1, K], dtype="int64", value=self.bos_id)
+        if init_ids is not None:
+            ids0 = layers.expand(init_ids, expand_times=[1, K])
+        else:
+            ids0 = layers.fill_constant_batch_size_like(
+                batch_ref, shape=[-1, K], dtype="int64", value=self.bos_id)
         # beam 0 live, beams 1..K-1 muted so step 1 expands ONE hypothesis
         # instead of K copies of the same bos continuation
         mute = layers.fill_constant_batch_size_like(

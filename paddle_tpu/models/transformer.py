@@ -213,17 +213,98 @@ def transformer(src=None, tgt=None, label=None, src_vocab=30000,
     return loss, logits
 
 
+def _attend_cached(q, k5, v5, bias, K, num_heads, d_head, dropout=0.0):
+    """Per-head attention of a single-position query over a cached K/V:
+    q [B,K,H] against k5 [B,*,nh,dh,T*] / v5 [B,*,nh,T*,dh] (the * dims
+    broadcast over the beam axis), additive bias masking invalid keys.
+    When the train graph had attention-weight dropout, the context is
+    scaled by (1-p) — the same downgrade_in_infer correction the fused
+    multi_head_attention path applies at inference."""
+    H = num_heads * d_head
+    q5 = layers.reshape(q, shape=[0, K, num_heads, 1, d_head])
+    scores = layers.matmul(q5, k5, alpha=float(d_head) ** -0.5)
+    weights = layers.softmax(layers.elementwise_add(scores, bias))
+    ctx = layers.reshape(layers.matmul(weights, v5), shape=[0, K, H])
+    if dropout:
+        ctx = layers.scale(ctx, scale=1.0 - dropout)
+    return ctx
+
+
+def _cached_self_attention(x, states, new_states, cache_id, prefix, K, T,
+                           num_heads, d_head, write, bias, dropout=0.0):
+    """One cached self-attention block inside a decode scan step: project
+    q/k/v from x [B,K,H], write k/v into the [B,K,T,H] caches at the
+    current position (one-hot outer product via `write`), attend over the
+    masked cache, output-project. Shared by the LM and encoder-decoder
+    generators; parameter names come from `prefix` (matching the train
+    graph's multi_head_attention names)."""
+    H = num_heads * d_head
+    q = layers.fc(x, size=H, num_flatten_dims=2, bias_attr=False,
+                  use_bf16=True, name=f"{prefix}_q")
+    kn = layers.fc(x, size=H, num_flatten_dims=2, bias_attr=False,
+                   use_bf16=True, name=f"{prefix}_k")
+    vn = layers.fc(x, size=H, num_flatten_dims=2, bias_attr=False,
+                   use_bf16=True, name=f"{prefix}_v")
+    kc = layers.elementwise_add(
+        states[f"k{cache_id}"],
+        layers.elementwise_mul(write, layers.unsqueeze(kn, axes=[2])))
+    vc = layers.elementwise_add(
+        states[f"v{cache_id}"],
+        layers.elementwise_mul(write, layers.unsqueeze(vn, axes=[2])))
+    new_states[f"k{cache_id}"], new_states[f"v{cache_id}"] = kc, vc
+    k5 = layers.transpose(
+        layers.reshape(kc, shape=[0, K, T, num_heads, d_head]),
+        perm=[0, 1, 3, 4, 2])                            # [B,K,nh,dh,T]
+    v5 = layers.transpose(
+        layers.reshape(vc, shape=[0, K, T, num_heads, d_head]),
+        perm=[0, 1, 3, 2, 4])                            # [B,K,nh,T,dh]
+    ctx = _attend_cached(q, k5, v5, bias, K, num_heads, d_head, dropout)
+    return layers.fc(ctx, size=H, num_flatten_dims=2, bias_attr=False,
+                     use_bf16=True, name=f"{prefix}_o")
+
+
+def _gen_embed_step(ids_prev, pos, emb_name, vocab, d_model, pe_table,
+                    dropout=0.0):
+    """Embed the previous token + positional encoding at `pos` (one-hot
+    row-select from the PE table), with the train graph's post-embedding
+    dropout corrected to its (1-p) inference scaling."""
+    T = pe_table.shape[0]
+    onehot_t = layers.one_hot(layers.cast(pos, "int64"), depth=T)
+    emb = layers.embedding(layers.unsqueeze(ids_prev, axes=[2]),
+                           size=[vocab, d_model],
+                           param_attr=ParamAttr(name=emb_name))
+    x = layers.scale(emb, scale=float(d_model) ** 0.5)
+    x = layers.elementwise_add(
+        x, layers.matmul(onehot_t, layers.assign(pe_table)))
+    if dropout:
+        x = layers.dropout(x, dropout_prob=dropout, is_test=True)
+    return x, onehot_t
+
+
+def _step_mask_bias(pos, arange):
+    """Additive bias hiding cache positions beyond the current one."""
+    valid = layers.cast(layers.less_than(
+        layers.assign(arange),
+        layers.elementwise_add(
+            pos, layers.fill_constant([1], "float32", 1.0))),
+        "float32")
+    return layers.unsqueeze(
+        layers.scale(valid, scale=1e9, bias=-1e9), axes=[2, 3])
+
+
 def transformer_generate(src=None, src_vocab=30000, tgt_vocab=30000,
                          max_src_len=64, max_gen=32, d_model=512,
                          d_inner=2048, num_heads=8, num_layers=6,
-                         bos_id=0, eos_id=1, beam_size=4):
+                         bos_id=0, eos_id=1, beam_size=4, dropout=0.0):
     """Encoder-decoder generation: encode the source once, then decode
     autoregressively with per-layer SELF-attention KV caches in the scan
     carry; cross-attention keys/values are projected once outside the
     scan and broadcast over the beam axis. Weights shared by name with a
     transformer(...) train graph (enc{i}_*, dec{i}_*, src/tgt_emb, proj)
     built with the same dims — train, then build this in its own program
-    and run it in the same scope.
+    and run it in the same scope. Pass the SAME `dropout` the train graph
+    used: every dropout site is corrected to its (1-p) inference scaling
+    (downgrade_in_infer), exactly as is_test=True does on the train graph.
 
     Returns (sequences [B, max_gen, K], scores [B, K])."""
     from ..contrib.decoder import BeamSearchDecoder
@@ -237,9 +318,11 @@ def transformer_generate(src=None, src_vocab=30000, tgt_vocab=30000,
     d_head = d_model // num_heads
 
     enc = _embed(src, src_vocab, d_model, Ts, "src")
+    if dropout:
+        enc = layers.dropout(enc, dropout_prob=dropout, is_test=True)
     for i in range(num_layers):
-        enc = encoder_layer(enc, d_model, num_heads, d_inner, 0.0, True,
-                            f"enc{i}")
+        enc = encoder_layer(enc, d_model, num_heads, d_inner, dropout,
+                            True, f"enc{i}")
 
     # cross K/V once per layer, [B, 1, nh, dh|Ts] views that broadcast
     # over the beam axis inside the scan
@@ -275,70 +358,31 @@ def transformer_generate(src=None, src_vocab=30000, tgt_vocab=30000,
 
     def step(states, ids_prev):
         pos = states["pos"]
-        onehot_t = layers.one_hot(layers.cast(pos, "int64"), depth=T)
-        emb = layers.embedding(layers.unsqueeze(ids_prev, axes=[2]),
-                               size=[tgt_vocab, d_model],
-                               param_attr=ParamAttr(name="tgt_emb"))
-        x = layers.scale(emb, scale=float(d_model) ** 0.5)
-        x = layers.elementwise_add(
-            x, layers.matmul(onehot_t, layers.assign(pe_table)))
-
-        valid = layers.cast(layers.less_than(
-            layers.assign(arange),
-            layers.elementwise_add(
-                pos, layers.fill_constant([1], "float32", 1.0))),
-            "float32")
-        self_bias = layers.unsqueeze(
-            layers.scale(valid, scale=1e9, bias=-1e9), axes=[2, 3])
+        x, onehot_t = _gen_embed_step(ids_prev, pos, "tgt_emb", tgt_vocab,
+                                      d_model, pe_table, dropout)
+        self_bias = _step_mask_bias(pos, arange)
         new_states = {"pos": layers.elementwise_add(
             pos, layers.fill_constant([1], "float32", 1.0))}
         write = layers.unsqueeze(onehot_t, axes=[3])
 
-        def heads_q(q):
-            return layers.reshape(q, shape=[0, K, num_heads, 1, d_head])
-
-        def attend(q5, k5, v5, bias):
-            scores = layers.matmul(q5, k5, alpha=float(d_head) ** -0.5)
-            w = layers.softmax(layers.elementwise_add(scores, bias))
-            return layers.reshape(layers.matmul(w, v5), shape=[0, K, H])
-
         for i in range(num_layers):
             # causal self-attention over the KV cache
-            q = layers.fc(x, size=H, num_flatten_dims=2, bias_attr=False,
-                          use_bf16=True, name=f"dec{i}_self_q")
-            kn = layers.fc(x, size=H, num_flatten_dims=2, bias_attr=False,
-                           use_bf16=True, name=f"dec{i}_self_k")
-            vn = layers.fc(x, size=H, num_flatten_dims=2, bias_attr=False,
-                           use_bf16=True, name=f"dec{i}_self_v")
-            kc = layers.elementwise_add(
-                states[f"k{i}"], layers.elementwise_mul(
-                    write, layers.unsqueeze(kn, axes=[2])))
-            vc = layers.elementwise_add(
-                states[f"v{i}"], layers.elementwise_mul(
-                    write, layers.unsqueeze(vn, axes=[2])))
-            new_states[f"k{i}"], new_states[f"v{i}"] = kc, vc
-            k5 = layers.transpose(
-                layers.reshape(kc, shape=[0, K, T, num_heads, d_head]),
-                perm=[0, 1, 3, 4, 2])
-            v5 = layers.transpose(
-                layers.reshape(vc, shape=[0, K, T, num_heads, d_head]),
-                perm=[0, 1, 3, 2, 4])
-            ctx = attend(heads_q(q), k5, v5, self_bias)
-            attn = layers.fc(ctx, size=H, num_flatten_dims=2,
-                             bias_attr=False, use_bf16=True,
-                             name=f"dec{i}_self_o")
-            x = _add_norm(attn, x, name=f"dec{i}_ln1")
+            attn = _cached_self_attention(
+                x, states, new_states, i, f"dec{i}_self", K, T, num_heads,
+                d_head, write, self_bias, dropout)
+            x = _add_norm(attn, x, dropout, True, name=f"dec{i}_ln1")
 
             # cross-attention over the pre-projected encoder K/V
             cq = layers.fc(x, size=H, num_flatten_dims=2, bias_attr=False,
                            use_bf16=True, name=f"dec{i}_cross_q")
-            cctx = attend(heads_q(cq), cross_k[i], cross_v[i], src_bias)
+            cctx = _attend_cached(cq, cross_k[i], cross_v[i], src_bias,
+                                  K, num_heads, d_head, dropout)
             cattn = layers.fc(cctx, size=H, num_flatten_dims=2,
                               bias_attr=False, use_bf16=True,
                               name=f"dec{i}_cross_o")
-            x = _add_norm(cattn, x, name=f"dec{i}_ln2")
-            f = ffn(x, d_model, d_inner, name=f"dec{i}_ffn")
-            x = _add_norm(f, x, name=f"dec{i}_ln3")
+            x = _add_norm(cattn, x, dropout, True, name=f"dec{i}_ln2")
+            f = ffn(x, d_model, d_inner, dropout, True, name=f"dec{i}_ffn")
+            x = _add_norm(f, x, dropout, True, name=f"dec{i}_ln3")
 
         logits = layers.fc(x, size=tgt_vocab, num_flatten_dims=2,
                            use_bf16=True, name="proj")
@@ -349,7 +393,8 @@ def transformer_generate(src=None, src_vocab=30000, tgt_vocab=30000,
 
 def transformer_lm_generate(prompt=None, vocab=32000, max_gen=32,
                             d_model=512, d_inner=2048, num_heads=8,
-                            num_layers=6, bos_id=0, eos_id=-1, beam_size=1):
+                            num_layers=6, bos_id=0, eos_id=-1, beam_size=1,
+                            dropout=0.0):
     """Autoregressive generation with a per-layer KV cache (capability ≙
     the reference transformer benchmark's fast decoder; the reference
     decodes by re-running the while_op decoder with LoD beam state).
@@ -361,8 +406,12 @@ def transformer_lm_generate(prompt=None, vocab=32000, max_gen=32,
     are shared BY NAME with a transformer_lm(...) built earlier in the
     same program (l{i}_attn_{q,k,v,o}, l{i}_ln{1,2}, l{i}_ffn_*,
     tok_emb, lm_head) — train first, then build this decode graph and
-    run it in the same scope. beam_size=1 is greedy; >1 is beam search
-    through the shared BeamSearchDecoder.
+    run it in the same scope, passing the SAME `dropout` the train graph
+    used (each site is corrected to its (1-p) inference scaling).
+    Generation is conditioned on the fed `prompt` ([B, 1] int64): each
+    row's first token seeds the decode; `bos_id` is the fallback start
+    used only when a caller builds its own decoder. beam_size=1 is
+    greedy; >1 is beam search through the shared BeamSearchDecoder.
 
     Returns (sequences [B, max_gen, K], scores [B, K])."""
     from ..contrib.decoder import BeamSearchDecoder
@@ -389,69 +438,25 @@ def transformer_lm_generate(prompt=None, vocab=32000, max_gen=32,
 
     def step(states, ids_prev):
         pos = states["pos"]                                      # [B,K,1]
-        onehot_t = layers.one_hot(
-            layers.cast(pos, "int64"), depth=T)                  # [B,K,T]
-        emb = layers.embedding(layers.unsqueeze(ids_prev, axes=[2]),
-                               size=[vocab, d_model],
-                               param_attr=ParamAttr(name="tok_emb"))
-        x = layers.scale(emb, scale=float(d_model) ** 0.5)
-        x = layers.elementwise_add(
-            x, layers.matmul(onehot_t, layers.assign(pe_table)))
-
-        # cache positions > current are masked out of every attention
-        valid = layers.cast(layers.less_than(
-            layers.assign(arange),
-            layers.elementwise_add(
-                pos, layers.fill_constant([1], "float32", 1.0))),
-            "float32")                                           # [B,K,T]
-        bias = layers.unsqueeze(
-            layers.scale(valid, scale=1e9, bias=-1e9), axes=[2, 3])
-
+        x, onehot_t = _gen_embed_step(ids_prev, pos, "tok_emb", vocab,
+                                      d_model, pe_table, dropout)
+        bias = _step_mask_bias(pos, arange)
         new_states = {"pos": layers.elementwise_add(
             pos, layers.fill_constant([1], "float32", 1.0))}
         write = layers.unsqueeze(onehot_t, axes=[3])             # [B,K,T,1]
         for i in range(num_layers):
-            q = layers.fc(x, size=H, num_flatten_dims=2, bias_attr=False,
-                          use_bf16=True, name=f"l{i}_attn_q")
-            kn = layers.fc(x, size=H, num_flatten_dims=2, bias_attr=False,
-                           use_bf16=True, name=f"l{i}_attn_k")
-            vn = layers.fc(x, size=H, num_flatten_dims=2, bias_attr=False,
-                           use_bf16=True, name=f"l{i}_attn_v")
-            kc = layers.elementwise_add(
-                states[f"k{i}"],
-                layers.elementwise_mul(write,
-                                       layers.unsqueeze(kn, axes=[2])))
-            vc = layers.elementwise_add(
-                states[f"v{i}"],
-                layers.elementwise_mul(write,
-                                       layers.unsqueeze(vn, axes=[2])))
-            new_states[f"k{i}"], new_states[f"v{i}"] = kc, vc
-
-            # per-head attention over the cache: [B,K,nh,1,T] scores
-            q5 = layers.reshape(q, shape=[0, K, num_heads, 1, d_head])
-            k5 = layers.transpose(
-                layers.reshape(kc, shape=[0, K, T, num_heads, d_head]),
-                perm=[0, 1, 3, 4, 2])                   # [B,K,nh,dh,T]
-            v5 = layers.transpose(
-                layers.reshape(vc, shape=[0, K, T, num_heads, d_head]),
-                perm=[0, 1, 3, 2, 4])                   # [B,K,nh,T,dh]
-            scores = layers.matmul(q5, k5, alpha=float(d_head) ** -0.5)
-            weights = layers.softmax(
-                layers.elementwise_add(scores, bias))
-            ctx = layers.reshape(layers.matmul(weights, v5),
-                                 shape=[0, K, H])
-            attn = layers.fc(ctx, size=H, num_flatten_dims=2,
-                             bias_attr=False, use_bf16=True,
-                             name=f"l{i}_attn_o")
-            x = _add_norm(attn, x, name=f"l{i}_ln1")
-            f = ffn(x, d_model, d_inner, name=f"l{i}_ffn")
-            x = _add_norm(f, x, name=f"l{i}_ln2")
+            attn = _cached_self_attention(
+                x, states, new_states, i, f"l{i}_attn", K, T, num_heads,
+                d_head, write, bias, dropout)
+            x = _add_norm(attn, x, dropout, True, name=f"l{i}_ln1")
+            f = ffn(x, d_model, d_inner, dropout, True, name=f"l{i}_ffn")
+            x = _add_norm(f, x, dropout, True, name=f"l{i}_ln2")
 
         logits = layers.fc(x, size=vocab, num_flatten_dims=2, use_bf16=True,
                            name="lm_head")
         return new_states, layers.log_softmax(logits)
 
-    return decoder.decode(prompt, init, step)
+    return decoder.decode(prompt, init, step, init_ids=prompt)
 
 
 def transformer_lm(tokens=None, label=None, vocab=32000, max_len=128,

@@ -325,7 +325,9 @@ def test_transformer_lm_generate_kv_cache(rng):
     with program_guard(gen_prog, gen_startup), unique_name.guard():
         seqs, scores = transformer.transformer_lm_generate(
             vocab=V, max_gen=G, d_model=D, d_inner=128, num_heads=4,
-            num_layers=2, bos_id=5, beam_size=1)
+            num_layers=2, bos_id=0, beam_size=1)
+    # bos_id deliberately differs from the fed prompt token: the decode
+    # must condition on the PROMPT VALUES, not the constant
     out, sc = exe.run(program=gen_prog,
                       feed={"prompt": np.full((4, 1), 5, "int64")},
                       fetch_list=[seqs, scores])

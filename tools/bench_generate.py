@@ -47,7 +47,9 @@ def measure(batch, gen_len, beam, iters=3):
     rec = {
         "config": f"lm6l_512d_bs{batch}_gen{gen_len}_beam{beam}",
         "tokens_per_sec": round(batch * gen_len / best, 1),
-        "ms_per_token": round(best / gen_len * 1e3, 3),
+        # per decode STEP (scan tick) — batch-independent; divide
+        # 1000/tokens_per_sec for per-token amortized latency
+        "ms_per_step": round(best / gen_len * 1e3, 3),
         "unit": "generated tokens/sec",
         "device_kind": getattr(dev, "device_kind", str(dev)),
     }

@@ -114,3 +114,58 @@ class TestPredictorServer:
             with PredictorClient(host, port) as c:
                 out, = c.infer({"img2": x})
         np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+class TestServerRobustness:
+    def test_worker_death_with_full_queue_recovers(self, tmp_path, rng):
+        """Regression: a client that pipelines far past the 128-request
+        queue bound and dies without reading must not wedge the server —
+        the worker's exit (response send fails) has to unblock a reader
+        stuck in put(), the connection must clean up, and the server must
+        keep serving new connections."""
+        import socket
+        import struct
+        import time
+
+        class Slow:
+            fetch_names = ["out"]
+
+            def run(self, feed, fetch_names=None, return_numpy=True):
+                time.sleep(0.02)  # keep the worker behind the reader
+                return [np.asarray(feed["x"]).sum(keepdims=True)]
+
+            def clone(self):
+                return self
+
+        x = np.ones((4,), "float32")
+        with PredictorServer(Slow()) as srv:
+            host, port = srv.address
+            before = threading.active_count()
+            raw = socket.create_connection((host, port))
+            header = (b'{"feeds": [{"name": "x", "dtype": "float32", '
+                      b'"shape": [4]}]}')
+            msg = struct.pack("<I", len(header)) + header + x.tobytes()
+            sent = 0
+            try:
+                raw.settimeout(10)
+                for _ in range(300):   # > queue bound + worker backlog
+                    raw.sendall(msg)
+                    sent += 1
+            except (OSError, socket.timeout):
+                pass                  # TCP backpressure is fine too
+            raw.close()               # die without reading a single reply
+            assert sent > 150, sent
+
+            # the pair must unwind: reader unblocked, worker drained
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if threading.active_count() <= before:
+                    break
+                time.sleep(0.2)
+            assert threading.active_count() <= before, \
+                "connection threads leaked after client death"
+
+            # and the server still answers a fresh connection
+            with PredictorClient(host, port) as c:
+                out, = c.infer({"x": x})
+                assert float(out[0]) == 4.0

@@ -177,3 +177,29 @@ class TestPrefetcherStaging:
 if __name__ == "__main__":
     import sys
     sys.exit(pytest.main([__file__, "-x", "-q"]))
+
+
+class TestPrefetcherLifecycle:
+    def test_abandoned_iterator_releases_producer(self):
+        """Regression: breaking out of a DevicePrefetcher loop must not
+        leave the producer thread blocked in put() forever (pinning the
+        pool and up to `capacity` staged device batches)."""
+        import threading
+        import time
+
+        from paddle_tpu.data.prefetch import DevicePrefetcher
+
+        batches = [{"x": np.zeros((4, 4), "float32")} for _ in range(50)]
+        before = threading.active_count()
+        it = iter(DevicePrefetcher(lambda: iter(batches), capacity=2,
+                                   stage_threads=2))
+        next(it)
+        next(it)
+        it.close()  # what an early `break` does to the generator
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if threading.active_count() <= before:
+                break
+            time.sleep(0.1)
+        assert threading.active_count() <= before, \
+            "producer/pool threads leaked after abandoning the iterator"

@@ -172,3 +172,67 @@ class TestUnderstandSentiment:
         assert last < first
         out = infer({"words": toks[:4], "words@SEQLEN": lens[:4]})[0]
         assert out.shape == (4, 2)
+
+
+class TestLabelSemanticRoles:
+    def test_bilstm_crf_book_flow(self, rng, tmp_path):
+        """Book chapter 7 (label_semantic_roles) flow: embedding -> BiLSTM
+        -> CRF trained end to end, Viterbi decode against the trained
+        transitions, chunk-level F1 — the last book chapter as one flow
+        (≙ reference book/07.label_semantic_roles built over
+        linear_chain_crf/crf_decoding/chunk_eval)."""
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.layers import sequence as seq
+
+        B, T, V, NT = 16, 10, 60, 5         # NT tag types
+        words = layers.data("words", shape=[T], dtype="int64",
+                            lod_level=1)
+        label = layers.data("label", shape=[T], dtype="int64")
+        length = seq.get_seqlen(words)
+
+        emb = layers.embedding(words, size=[V, 24])
+        emb = seq.tag_sequence(emb, length)
+        fwd_in = seq.tag_sequence(
+            layers.fc(emb, size=32 * 4, num_flatten_dims=2), length)
+        bwd_in = seq.tag_sequence(
+            layers.fc(emb, size=32 * 4, num_flatten_dims=2), length)
+        fwd, _ = seq.dynamic_lstm(fwd_in, size=32 * 4)
+        bwd, _ = seq.dynamic_lstm(bwd_in, size=32 * 4, is_reverse=True)
+        hidden = seq.tag_sequence(layers.concat([fwd, bwd], axis=2),
+                                  length)
+        emission = layers.fc(hidden, size=NT, num_flatten_dims=2)
+
+        crf_cost = layers.linear_chain_crf(
+            emission, label, length,
+            param_attr=pt.ParamAttr(name="srl_crfw"))
+        loss = layers.mean(crf_cost)
+        pt.optimizer.AdamOptimizer(learning_rate=5e-3).minimize(loss)
+
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+
+        def batch():
+            w = rng.randint(0, V, (B, T)).astype("int64")
+            lab = (w % NT).astype("int64")   # learnable tagging rule
+            return {"words": w, "words@SEQLEN": np.full((B,), T, "int32"),
+                    "label": lab}
+
+        feed = batch()
+        first = float(exe.run(feed=feed, fetch_list=[loss])[0])
+        for _ in range(60):
+            feed = batch()
+            last = float(exe.run(feed=feed, fetch_list=[loss])[0])
+        assert last < first * 0.5, (first, last)
+
+        # inference: Viterbi decode with the trained transitions + F1
+        path = layers.sequence.crf_decoding(
+            emission, length, param_attr=pt.ParamAttr(name="srl_crfw"))
+        p, r, f1, *_ = layers.sequence.chunk_eval(
+            path, label, length, chunk_scheme="plain", num_chunk_types=NT)
+        feed = batch()
+        decoded, f1_val = exe.run(feed=feed, fetch_list=[path, f1])
+        expect = feed["words"] % NT
+        acc = float((decoded == expect).mean())
+        assert acc > 0.9, acc
+        assert 0.0 <= float(f1_val) <= 1.0

@@ -281,15 +281,35 @@ def _gen_embed_step(ids_prev, pos, emb_name, vocab, d_model, pe_table,
     return x, onehot_t
 
 
+def _mask_to_bias(mask, axes):
+    """0/1 keep-mask -> additive attention bias (-1e9 on masked keys),
+    unsqueezed to broadcast against [.., nh, 1, T] score tensors."""
+    return layers.unsqueeze(layers.scale(mask, scale=1e9, bias=-1e9),
+                            axes=axes)
+
+
+def _next_pos(pos):
+    return layers.elementwise_add(pos,
+                                  layers.fill_constant([1], "float32", 1.0))
+
+
 def _step_mask_bias(pos, arange):
     """Additive bias hiding cache positions beyond the current one."""
-    valid = layers.cast(layers.less_than(
-        layers.assign(arange),
-        layers.elementwise_add(
-            pos, layers.fill_constant([1], "float32", 1.0))),
-        "float32")
-    return layers.unsqueeze(
-        layers.scale(valid, scale=1e9, bias=-1e9), axes=[2, 3])
+    valid = layers.cast(
+        layers.less_than(layers.assign(arange), _next_pos(pos)), "float32")
+    return _mask_to_bias(valid, axes=[2, 3])
+
+
+def _init_gen_states(batch_ref, K, T, H, num_layers):
+    """The decode scan's initial carry: position counter + zeroed
+    per-layer [B, K, T, H] KV caches."""
+    init = {"pos": layers.fill_constant_batch_size_like(
+        batch_ref, shape=[-1, K, 1], dtype="float32", value=0.0)}
+    for i in range(num_layers):
+        for s in ("k", "v"):
+            init[f"{s}{i}"] = layers.fill_constant_batch_size_like(
+                batch_ref, shape=[-1, K, T, H], dtype="float32", value=0.0)
+    return init
 
 
 def transformer_generate(src=None, src_vocab=30000, tgt_vocab=30000,
@@ -341,28 +361,20 @@ def transformer_generate(src=None, src_vocab=30000, tgt_vocab=30000,
         cross_k.append(ck)
         cross_v.append(cv)
     src_mask = layers.sequence_mask(src_len, maxlen=Ts)   # [B,Ts]
-    src_bias = layers.unsqueeze(
-        layers.scale(src_mask, scale=1e9, bias=-1e9), axes=[1, 2, 3])
+    src_bias = _mask_to_bias(src_mask, axes=[1, 2, 3])
 
     decoder = BeamSearchDecoder(beam_size=K, bos_id=bos_id, eos_id=eos_id,
                                 max_len=T, name="nmt_gen")
     pe_table = positional_encoding_table(T, d_model).astype("float32")
     arange = np.arange(T, dtype="float32").reshape(1, 1, T)
-
-    init = {"pos": layers.fill_constant_batch_size_like(
-        src, shape=[-1, K, 1], dtype="float32", value=0.0)}
-    for i in range(num_layers):
-        for s in ("k", "v"):
-            init[f"{s}{i}"] = layers.fill_constant_batch_size_like(
-                src, shape=[-1, K, T, H], dtype="float32", value=0.0)
+    init = _init_gen_states(src, K, T, H, num_layers)
 
     def step(states, ids_prev):
         pos = states["pos"]
         x, onehot_t = _gen_embed_step(ids_prev, pos, "tgt_emb", tgt_vocab,
                                       d_model, pe_table, dropout)
         self_bias = _step_mask_bias(pos, arange)
-        new_states = {"pos": layers.elementwise_add(
-            pos, layers.fill_constant([1], "float32", 1.0))}
+        new_states = {"pos": _next_pos(pos)}
         write = layers.unsqueeze(onehot_t, axes=[3])
 
         for i in range(num_layers):
@@ -425,24 +437,14 @@ def transformer_lm_generate(prompt=None, vocab=32000, max_gen=32,
 
     pe_table = positional_encoding_table(T, d_model).astype("float32")
     arange = np.arange(T, dtype="float32").reshape(1, 1, T)
-
-    def zeros_cache():
-        return layers.fill_constant_batch_size_like(
-            prompt, shape=[-1, K, T, H], dtype="float32", value=0.0)
-
-    init = {"pos": layers.fill_constant_batch_size_like(
-        prompt, shape=[-1, K, 1], dtype="float32", value=0.0)}
-    for i in range(num_layers):
-        init[f"k{i}"] = zeros_cache()
-        init[f"v{i}"] = zeros_cache()
+    init = _init_gen_states(prompt, K, T, H, num_layers)
 
     def step(states, ids_prev):
         pos = states["pos"]                                      # [B,K,1]
         x, onehot_t = _gen_embed_step(ids_prev, pos, "tok_emb", vocab,
                                       d_model, pe_table, dropout)
         bias = _step_mask_bias(pos, arange)
-        new_states = {"pos": layers.elementwise_add(
-            pos, layers.fill_constant([1], "float32", 1.0))}
+        new_states = {"pos": _next_pos(pos)}
         write = layers.unsqueeze(onehot_t, axes=[3])             # [B,K,T,1]
         for i in range(num_layers):
             attn = _cached_self_attention(

@@ -378,7 +378,10 @@ def export_inference_model(dirname: str,
     args = []
     for i, name in enumerate(feed_names):
         v = block.var(name)
-        dims = [f"d{i}_{j}" if d == -1 else str(d)
+        # every feed's leading -1 is the SAME batch symbol: feeds share
+        # the batch by the feed contract, and computations between them
+        # (e.g. a sequence var and its @SEQLEN lengths) must broadcast
+        dims = [("b" if j == 0 else f"d{i}_{j}") if d == -1 else str(d)
                 for j, d in enumerate(v.shape)]
         shape = jax_export.symbolic_shape(", ".join(dims), scope=sym_scope) \
             if any(d == -1 for d in v.shape) else tuple(v.shape)

@@ -144,6 +144,42 @@ class TestNativeGenerate:
         np.testing.assert_array_equal(got, ref)
 
 
+class TestNativeGenerateNMT:
+    def test_cpp_runs_encoder_decoder_generation(self, tmp_path,
+                                                 ptpu_predict_bin):
+        """The encoder-decoder generator exports with BOTH its feeds —
+        the src tokens and the int32 @SEQLEN companion — and the C++
+        entry reproduces the Python beam decode exactly."""
+        from paddle_tpu.core import unique_name
+        from paddle_tpu.models import transformer
+
+        with unique_name.guard():
+            seqs, scores = transformer.transformer_generate(
+                src_vocab=40, tgt_vocab=40, max_src_len=6, max_gen=5,
+                d_model=32, d_inner=64, num_heads=4, num_layers=2,
+                bos_id=0, eos_id=-1, beam_size=2)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        rng = np.random.RandomState(4)
+        src = rng.randint(1, 40, (2, 6)).astype("int64")
+        lens = np.full((2,), 6, "int32")
+        ref = np.asarray(exe.run(
+            feed={"src": src, "src@SEQLEN": lens}, fetch_list=[seqs])[0])
+
+        d = str(tmp_path / "nmtgen")
+        pt.io.save_inference_model(d, ["src", "src@SEQLEN"], [seqs],
+                                   executor=exe, export=True, native=True)
+        np.save(tmp_path / "src.npy", src.astype(np.int32))
+        np.save(tmp_path / "lens.npy", lens)
+        r = subprocess.run(
+            [ptpu_predict_bin, d, str(tmp_path / "src.npy"),
+             str(tmp_path / "lens.npy"), "--out", str(tmp_path)],
+            capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stderr[-1500:]
+        got = np.load(tmp_path / "out0.npy")
+        np.testing.assert_array_equal(got, ref)
+
+
 @pytest.fixture()
 def cpp_server(tmp_path, ptpu_predict_bin):
     """A ptpu_predict --serve process over a freshly exported model; yields

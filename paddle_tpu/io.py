@@ -339,6 +339,15 @@ def export_inference_model(dirname: str,
     serialized StableHLO, which any PJRT runtime (tpu serving, CPU) can
     execute. Leading -1 dims export as symbolic so one artifact serves any
     batch size.
+
+    Feed contract: every feed's leading -1 dimension is bound to ONE
+    shared batch symbol — all dynamic-leading feeds of one artifact must
+    arrive with equal first dims (a sequence var and its @SEQLEN lengths,
+    an image and its label, ...). A feed whose dynamic leading dim is NOT
+    the batch (e.g. a variable-row auxiliary table) must be exported with
+    that dim concrete, or through a separate artifact; jax.export shape
+    refinement rejects unequal leading dims at call time (the Predictor
+    surfaces this as a shape-refinement error naming the symbol 'b').
     """
     import jax
     import jax.numpy as jnp

@@ -918,10 +918,6 @@ def elementwise_pow(x, y, axis=-1, act=None, name=None):
     return elementwise_op_layer("elementwise_pow", x, y, axis, act, name)
 
 
-def dropout_infer_guard():  # pragma: no cover - convenience stub
-    raise NotImplementedError
-
-
 def lrn(input, n=5, k=2.0, alpha=1e-4, beta=0.75, name=None):
     helper = LayerHelper("lrn", name=name)
     out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),

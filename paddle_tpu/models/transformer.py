@@ -406,7 +406,7 @@ def transformer_generate(src=None, src_vocab=30000, tgt_vocab=30000,
 def transformer_lm_generate(prompt=None, vocab=32000, max_gen=32,
                             d_model=512, d_inner=2048, num_heads=8,
                             num_layers=6, bos_id=0, eos_id=-1, beam_size=1,
-                            dropout=0.0):
+                            dropout=0.0, packed=False):
     """Autoregressive generation with a per-layer KV cache (capability ≙
     the reference transformer benchmark's fast decoder; the reference
     decodes by re-running the while_op decoder with LoD beam state).
@@ -418,8 +418,12 @@ def transformer_lm_generate(prompt=None, vocab=32000, max_gen=32,
     are shared BY NAME with a transformer_lm(...) built earlier in the
     same program (l{i}_attn_{q,k,v,o}, l{i}_ln{1,2}, l{i}_ffn_*,
     tok_emb, lm_head) — train first, then build this decode graph and
-    run it in the same scope, passing the SAME `dropout` the train graph
-    used (each site is corrected to its (1-p) inference scaling).
+    run it in the same scope, passing the SAME `dropout` AND the same
+    `packed` flag the train graph used (each dropout site is corrected
+    to its (1-p) inference scaling, and — mirroring transformer_lm's
+    `0.0 if packed else dropout` attention-weight dropout — packed
+    training applied NO attention dropout, so packed=True here skips
+    the (1-p) attention-context downscale the train graph never had).
     Generation is conditioned on the fed `prompt` ([B, 1] int64): each
     row's first token seeds the decode; `bos_id` is the fallback start
     used only when a caller builds its own decoder. beam_size=1 is
@@ -438,6 +442,7 @@ def transformer_lm_generate(prompt=None, vocab=32000, max_gen=32,
     pe_table = positional_encoding_table(T, d_model).astype("float32")
     arange = np.arange(T, dtype="float32").reshape(1, 1, T)
     init = _init_gen_states(prompt, K, T, H, num_layers)
+    attn_dropout = 0.0 if packed else dropout
 
     def step(states, ids_prev):
         pos = states["pos"]                                      # [B,K,1]
@@ -449,7 +454,7 @@ def transformer_lm_generate(prompt=None, vocab=32000, max_gen=32,
         for i in range(num_layers):
             attn = _cached_self_attention(
                 x, states, new_states, i, f"l{i}_attn", K, T, num_heads,
-                d_head, write, bias, dropout)
+                d_head, write, bias, attn_dropout)
             x = _add_norm(attn, x, dropout, True, name=f"l{i}_ln1")
             f = ffn(x, d_model, d_inner, dropout, True, name=f"l{i}_ffn")
             x = _add_norm(f, x, dropout, True, name=f"l{i}_ln2")

@@ -32,6 +32,7 @@
 // kernel.
 
 #include <arpa/inet.h>
+#include <endian.h>
 #include <netinet/in.h>
 #include <sys/socket.h>
 #include <unistd.h>
@@ -481,6 +482,7 @@ class Runner {
 // -- server mode -----------------------------------------------------------
 
 ssize_t RecvExact(int fd, char* buf, size_t n) {
+  if (n == 0) return 1;  // nothing to read is success, not peer-close
   size_t got = 0;
   while (got < n) {
     ssize_t r = ::recv(fd, buf + got, n - got, 0);
@@ -512,7 +514,14 @@ bool RecvRequest(int fd, Request* out) {
   ssize_t r = RecvExact(fd, lenbuf, 4);
   if (r <= 0) return false;
   uint32_t hlen;
-  std::memcpy(&hlen, lenbuf, 4);  // little-endian hosts only (x86/arm)
+  std::memcpy(&hlen, lenbuf, 4);
+  // wire format is little-endian. NOTE: only the length fields are
+  // byte-order-converted; raw tensor payloads are memcpy'd in native
+  // order, so server and client must both be little-endian hosts (the
+  // only kind this is built for) — a BE build would corrupt payloads
+  // silently rather than fail fast here.
+  hlen = le32toh(hlen);
+  if (hlen == 0) Die("malformed request: zero-length header");
   if (hlen > (64u << 20)) Die("unreasonable header length");
   std::string hraw(hlen, '\0');
   if (RecvExact(fd, hraw.data(), hlen) <= 0) return false;
@@ -544,7 +553,7 @@ bool RecvRequest(int fd, Request* out) {
 
 bool SendResponse(int fd, const std::string& header_json,
                   const std::vector<const HostTensor*>& outs) {
-  uint32_t hlen = static_cast<uint32_t>(header_json.size());
+  uint32_t hlen = htole32(static_cast<uint32_t>(header_json.size()));
   char lenbuf[4];
   std::memcpy(lenbuf, &hlen, 4);
   if (!SendAll(fd, lenbuf, 4)) return false;

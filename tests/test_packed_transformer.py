@@ -117,6 +117,49 @@ class TestPackedTransformerLM:
                 segment_ids=pt.layers.data(name="s", shape=[8],
                                            dtype="int32"))
 
+    def test_packed_generate_skips_attention_downscale(self, rng):
+        """A packed-trained LM applied NO attention-weight dropout
+        (`0.0 if packed else dropout`), so its decode graph must not apply
+        the (1-p) attention-context inference downscale either: generate
+        with packed=True mirrors the train graph; packed=False (which
+        downscales) must produce different scores on the same weights."""
+        from paddle_tpu.core import unique_name
+        from paddle_tpu.models import transformer
+
+        V, D, T = 50, 16, 16
+        loss, _ = transformer.transformer_lm(
+            vocab=V, max_len=T, d_model=D, d_inner=32, num_heads=2,
+            num_layers=1, dropout=0.3, packed=True)
+        pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        seqs = [rng.randint(1, V, (L,)).astype(np.int64)
+                for L in (10, 7, 12, 5)]
+        feed = pack_lm_batch(seqs, T)
+        for _ in range(3):
+            exe.run(feed=feed, fetch_list=[loss])
+
+        def decode(packed):
+            prog = pt.Program()
+            with pt.framework.program.program_guard(prog, pt.Program()), \
+                    unique_name.guard():
+                s, sc = transformer.transformer_lm_generate(
+                    vocab=V, max_gen=6, d_model=D, d_inner=32,
+                    num_heads=2, num_layers=1, dropout=0.3,
+                    packed=packed)
+                return exe.run(
+                    program=prog,
+                    feed={"prompt": np.array([[3], [9]], "int64")},
+                    fetch_list=[s, sc])
+
+        seq_p, score_p = decode(packed=True)
+        seq_u, score_u = decode(packed=False)
+        assert seq_p.shape == (2, 6, 1)
+        assert np.isfinite(score_p).all() and np.isfinite(score_u).all()
+        # the downscale shifts every attention context by (1-0.3); on the
+        # same weights the two decode graphs cannot emit equal log-probs
+        assert not np.allclose(score_p, score_u), (score_p, score_u)
+
 
 if __name__ == "__main__":
     import sys

@@ -209,6 +209,18 @@ def _flash_kernel(q_ref, k_ref, v_ref, qseg_ref, kvseg_ref, o_ref, lse_ref,
 
 
 
+def _out_struct(shape, dtype, *refs):
+    """ShapeDtypeStruct for a pallas_call output, carrying the union of the
+    inputs' device-varying axes — required when the kernel runs inside
+    shard_map (ring attention) where check_vma demands explicit vma."""
+    vma = set()
+    for r in refs:
+        vma |= set(getattr(getattr(r, "aval", None), "vma", ()) or ())
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _clamp_block(block, t):
     """Block size actually used for length t: the requested block, clamped
     to t rounded UP to a 128 multiple. Keeps every block shape
@@ -279,12 +291,12 @@ def _flash_attention_pallas(q, k, v, scale, causal, block_q, block_k,
         _flash_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
         num_k_blocks=nk, causal_offset=Tk - T, true_tk=Tk)
     out_specs = [pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))]
-    out_shape = [jax.ShapeDtypeStruct((B * H, Tp, D), q.dtype)]
+    out_shape = [_out_struct((B * H, Tp, D), q.dtype, q, k, v)]
     if with_lse:
         out_specs.append(
             pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)))
         out_shape.append(
-            jax.ShapeDtypeStruct((B * H, Tp, 128), jnp.float32))
+            _out_struct((B * H, Tp, 128), jnp.float32, q, k, v))
     # adapt the kernel's (fixed) signature to the optional refs actually
     # staged: segment refs when packed, lse only on the training path.
     # pallas passes refs positionally (inputs, outputs, scratch), so one
@@ -431,7 +443,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_attention_bwd_pallas(q, k, v, o, lse, do, scale, causal,
                                 block_q, block_k, interpret,
-                                segment_ids=None):
+                                segment_ids=None, delta=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -444,8 +456,11 @@ def _flash_attention_bwd_pallas(q, k, v, o, lse, do, scale, causal,
     Tkp = -(-Tk // bk) * bk
     nq, nk = Tp // bq, Tkp // bk
 
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)                       # [B, H, T]
+    if delta is None:
+        # delta_i = sum_d do*o — recomputed here on the single-device path;
+        # ring attention passes the global delta in (o may then be None)
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1)                   # [B, H, T]
     qf = _pad_to(q.reshape(B * H, T, D), 1, Tp)
     kf = _pad_to(k.reshape(B * H, Tk, D), 1, Tkp)
     vf = _pad_to(v.reshape(B * H, Tk, D), 1, Tkp)
@@ -491,7 +506,7 @@ def _flash_attention_bwd_pallas(q, k, v, o, lse, do, scale, causal,
         grid=(B * H, nq, nk),
         in_specs=dq_specs,
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((B * H, Tp, D), q.dtype),
+        out_shape=_out_struct((B * H, Tp, D), q.dtype, q, k, v, do),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
     )(*dq_inputs)
@@ -518,8 +533,8 @@ def _flash_attention_bwd_pallas(q, k, v, o, lse, do, scale, causal,
         grid=(B * H, nk, nq),
         in_specs=dkv_specs,
         out_specs=[kj_spec, kj_spec],
-        out_shape=[jax.ShapeDtypeStruct((B * H, Tkp, D), k.dtype),
-                   jax.ShapeDtypeStruct((B * H, Tkp, D), v.dtype)],
+        out_shape=[_out_struct((B * H, Tkp, D), k.dtype, q, k, v, do),
+                   _out_struct((B * H, Tkp, D), v.dtype, q, k, v, do)],
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32)],
         interpret=interpret,

@@ -1,0 +1,181 @@
+"""VERDICT r4 #1: falsify-or-confirm the conv-backward irreducibility claim.
+
+PROF_r04 §3 attributed +9.7 GB/step of flagship HBM traffic to XLA's conv
+dgrad scheduling and declared it not program-reducible. This probe tests
+that assertion on the worst-excess stage shapes from tools/attribute_bytes
+(the [256,56,56,*] bottleneck convs; the single worst instruction is the
+1x1 256<->64 dgrad fusion at 2.26 GB):
+
+  A. 1x1 conv dgrad — XLA's conv emitter (what jax.vjp of
+     conv_general_dilated lowers to) vs the SAME math as one dot_general
+     ([B*H*W, Co] x [Co, Ci]): a 1x1 conv IS a matmul, so any emitter gap
+     is pure scheduling waste.
+  B. 3x3 conv dgrad — conv emitter vs an im2col formulation
+     (conv_general_dilated_patches + dot), the verdict's suggested probe.
+  C. the same A/B for the full fwd+bwd vjp of each conv (what the train
+     step actually runs), since dgrad never runs un-fused in the step.
+
+Each variant reports best-of-5 wall time and XLA cost-model bytes; the
+verdict's decision rule: a >=10% win on the step-relevant variant ->
+adopt + re-baseline the flagship; otherwise the MFU-0.29 roofline claim
+stands TESTED.
+
+    env PYTHONPATH=/root/.axon_site:/root/repo python tools/probe_dgrad.py
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DN = ("NHWC", "HWIO", "NHWC")
+
+
+def _time(fn, args, iters=30, windows=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    best = None
+    for _ in range(windows):
+        t0 = time.time()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        dt = (time.time() - t0) / iters
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def _cost(fn, args):
+    ex = jax.jit(fn).lower(*args).compile()
+    ca = ex.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
+    return (float(ca.get("bytes accessed", 0.0)),
+            float(ca.get("flops", 0.0)))
+
+
+def _report(name, fn, args):
+    jfn = jax.jit(fn)
+    t = _time(jfn, args)
+    b, f = _cost(fn, args)
+    row = {"variant": name, "ms": round(t * 1e3, 3),
+           "bytes_MB": round(b / 1e6, 1), "flops_G": round(f / 1e9, 2),
+           "achieved_GBps": round(b / t / 1e9, 1) if b else None,
+           "achieved_TFLOPs": round(f / t / 1e12, 2) if f else None}
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def conv_fwd(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=DN)
+
+
+def main():
+    rng = np.random.RandomState(0)
+    results = {}
+
+    # ---- A: 1x1 dgrad, the worst-excess instruction family --------------
+    # forward: x [256,56,56,256] (*) w [1,1,256,64] -> y [256,56,56,64]
+    # dgrad:   dy [256,56,56,64] -> dx [256,56,56,256]
+    B, HW, Ci, Co = 256, 56, 256, 64
+    dy = jnp.asarray(rng.rand(B, HW, HW, Co).astype("float32"),
+                     jnp.bfloat16)
+    w = jnp.asarray(rng.rand(1, 1, Ci, Co).astype("float32"), jnp.bfloat16)
+    x = jnp.asarray(rng.rand(B, HW, HW, Ci).astype("float32"),
+                    jnp.bfloat16)
+
+    def dgrad_conv_1x1(dy, w):
+        # exactly what jax emits for the vjp of a SAME 1x1 conv
+        _, vjp = jax.vjp(lambda x_: conv_fwd(x_, w), x)
+        return vjp(dy)[0]
+
+    def dgrad_dot_1x1(dy, w):
+        dy2 = dy.reshape(-1, Co)                     # [B*H*W, Co]
+        w2 = w.reshape(Ci, Co)                       # [Ci, Co]
+        dx = jax.lax.dot_general(dy2, w2, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        return dx.astype(dy.dtype).reshape(B, HW, HW, Ci)
+
+    print("== A: 1x1 dgrad [256,56,56,64] -> [256,56,56,256]", flush=True)
+    a_conv = _report("dgrad_1x1_conv_emitter", dgrad_conv_1x1, (dy, w))
+    a_dot = _report("dgrad_1x1_dot_general", dgrad_dot_1x1, (dy, w))
+    np.testing.assert_allclose(
+        np.asarray(dgrad_conv_1x1(dy, w), np.float32),
+        np.asarray(dgrad_dot_1x1(dy, w), np.float32), rtol=2e-2, atol=1e-2)
+    results["dgrad_1x1_speedup_dot_over_conv"] = round(
+        a_conv["ms"] / a_dot["ms"], 3)
+
+    # ---- A': full vjp of the 1x1 conv (fwd + dgrad + wgrad) -------------
+    def vjp_conv_1x1(x, w, dy):
+        y, vjp = jax.vjp(lambda x_, w_: conv_fwd(x_, w_), x, w)
+        return (y,) + vjp(dy)
+
+    def vjp_dot_1x1(x, w, dy):
+        x2 = x.reshape(-1, Ci)
+        w2 = w.reshape(Ci, Co)
+        dy2 = dy.reshape(-1, Co)
+
+        def f(x2_, w2_):
+            return jax.lax.dot_general(
+                x2_, w2_, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(x2_.dtype)
+        y2, vjp = jax.vjp(f, x2, w2)
+        dx2, dw2 = vjp(dy2)
+        return (y2.reshape(B, HW, HW, Co), dx2.reshape(B, HW, HW, Ci),
+                dw2.reshape(1, 1, Ci, Co))
+
+    print("== A': 1x1 fwd+bwd vjp", flush=True)
+    av_conv = _report("vjp_1x1_conv_emitter", vjp_conv_1x1, (x, w, dy))
+    av_dot = _report("vjp_1x1_dot_general", vjp_dot_1x1, (x, w, dy))
+    results["vjp_1x1_speedup_dot_over_conv"] = round(
+        av_conv["ms"] / av_dot["ms"], 3)
+
+    # ---- B: 3x3 dgrad at 56x56, 64->64 ----------------------------------
+    C3 = 64
+    x3 = jnp.asarray(rng.rand(B, HW, HW, C3).astype("float32"),
+                     jnp.bfloat16)
+    w3 = jnp.asarray(rng.rand(3, 3, C3, C3).astype("float32"),
+                     jnp.bfloat16)
+    dy3 = jnp.asarray(rng.rand(B, HW, HW, C3).astype("float32"),
+                      jnp.bfloat16)
+
+    def dgrad_conv_3x3(dy, w):
+        _, vjp = jax.vjp(lambda x_: conv_fwd(x_, w), x3)
+        return vjp(dy)[0]
+
+    def dgrad_im2col_3x3(dy, w):
+        # dx = full-correlation of dy with the spatially-flipped filter:
+        # extract 3x3 patches of dy -> [B,H,W,9*C] then one dot with the
+        # flipped filter reshaped [9*C, C]. Same math, matmul emitter.
+        patches = jax.lax.conv_general_dilated_patches(
+            dy, (3, 3), (1, 1), "SAME", dimension_numbers=DN)
+        wf = jnp.flip(w, (0, 1))                    # [3,3,Ci,Co]
+        # dx[ci] = sum_{dh,dw,co} dy[h+dh,w+dw,co] * wf[dh,dw,ci,co]
+        # patches channel layout from lax: [Cin_of_input=Co, 3, 3]
+        wr = jnp.transpose(wf, (3, 0, 1, 2)).reshape(9 * C3, C3)
+        dx = jax.lax.dot_general(
+            patches.reshape(-1, 9 * C3), wr, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dx.astype(dy.dtype).reshape(B, HW, HW, C3)
+
+    print("== B: 3x3 dgrad 64ch @56x56", flush=True)
+    b_conv = _report("dgrad_3x3_conv_emitter", dgrad_conv_3x3, (dy3, w3))
+    b_im2col = _report("dgrad_3x3_im2col_dot", dgrad_im2col_3x3, (dy3, w3))
+    np.testing.assert_allclose(
+        np.asarray(dgrad_conv_3x3(dy3, w3), np.float32),
+        np.asarray(dgrad_im2col_3x3(dy3, w3), np.float32),
+        rtol=3e-2, atol=3e-1)
+    results["dgrad_3x3_speedup_im2col_over_conv"] = round(
+        b_conv["ms"] / b_im2col["ms"], 3)
+
+    print(json.dumps({"exp": "dgrad_probe_summary", **results}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

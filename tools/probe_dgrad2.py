@@ -1,0 +1,165 @@
+"""probe_dgrad with hardened timing: cycles 4 DISTINCT input variants per
+iteration (the same degenerate-benchmark rule the breadth suite applies)
+and cross-checks wall time of the whole window. Supersedes the first
+probe_dgrad run whose variant-A numbers (1667 TFLOP/s on a 197-peak chip)
+were an identical-call artifact.
+
+    env PYTHONPATH=/root/.axon_site:/root/repo python tools/probe_dgrad2.py
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DN = ("NHWC", "HWIO", "NHWC")
+NVAR = 4
+
+
+def _sync(out):
+    """Host-value realization is the ONLY trusted barrier through the
+    axon tunnel (probe_common.py / bench.py methodology):
+    block_until_ready returns early there. Fetch one scalar element of
+    the final output — 4 bytes over the link, ordered after the whole
+    queue."""
+    x = out[0] if isinstance(out, (tuple, list)) else out
+    return float(np.asarray(x[(0,) * x.ndim]))
+
+
+def _time(fn, variants, iters=24, windows=4):
+    """variants: list of arg-tuples cycled across iterations."""
+    for v in variants:
+        _sync(fn(*v))
+    best = None
+    for _ in range(windows):
+        t0 = time.time()
+        out = None
+        for i in range(iters):
+            out = fn(*variants[i % len(variants)])
+        _sync(out)
+        dt = (time.time() - t0) / iters
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def _cost(fn, args):
+    ex = jax.jit(fn).lower(*args).compile()
+    ca = ex.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
+    return (float(ca.get("bytes accessed", 0.0)),
+            float(ca.get("flops", 0.0)))
+
+
+def _report(name, fn, variants):
+    jfn = jax.jit(fn)
+    t = _time(jfn, variants)
+    b, f = _cost(fn, variants[0])
+    row = {"variant": name, "ms": round(t * 1e3, 3),
+           "bytes_MB": round(b / 1e6, 1), "flops_G": round(f / 1e9, 2),
+           "achieved_GBps": round(b / t / 1e9, 1) if b else None,
+           "achieved_TFLOPs": round(f / t / 1e12, 2) if f else None,
+           "n_distinct_inputs": len(variants)}
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def conv_fwd(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=DN)
+
+
+def main():
+    rng = np.random.RandomState(0)
+    results = {}
+
+    B, HW, Ci, Co = 256, 56, 256, 64
+
+    def mk(shape):
+        return [jnp.asarray(rng.rand(*shape).astype("float32"),
+                            jnp.bfloat16) for _ in range(NVAR)]
+
+    dys = mk((B, HW, HW, Co))
+    ws = mk((1, 1, Ci, Co))
+    xs = mk((B, HW, HW, Ci))
+
+    def dgrad_conv_1x1(dy, w, x):
+        _, vjp = jax.vjp(lambda x_: conv_fwd(x_, w), x)
+        return vjp(dy)[0]
+
+    def dgrad_dot_1x1(dy, w, x):
+        dy2 = dy.reshape(-1, Co)
+        w2 = w.reshape(Ci, Co)
+        dx = jax.lax.dot_general(dy2, w2, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        return dx.astype(dy.dtype).reshape(B, HW, HW, Ci)
+
+    print("== A: 1x1 dgrad [256,56,56,64] -> [256,56,56,256]", flush=True)
+    var3 = list(zip(dys, ws, xs))
+    a_conv = _report("dgrad_1x1_conv_emitter", dgrad_conv_1x1, var3)
+    a_dot = _report("dgrad_1x1_dot_general", dgrad_dot_1x1, var3)
+    results["dgrad_1x1_speedup_dot_over_conv"] = round(
+        a_conv["ms"] / a_dot["ms"], 3)
+
+    def vjp_conv_1x1(x, w, dy):
+        y, vjp = jax.vjp(lambda x_, w_: conv_fwd(x_, w_), x, w)
+        return (y,) + vjp(dy)
+
+    def vjp_dot_1x1(x, w, dy):
+        x2 = x.reshape(-1, Ci)
+        w2 = w.reshape(Ci, Co)
+        dy2 = dy.reshape(-1, Co)
+
+        def f(x2_, w2_):
+            return jax.lax.dot_general(
+                x2_, w2_, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(x2_.dtype)
+        y2, vjp = jax.vjp(f, x2, w2)
+        dx2, dw2 = vjp(dy2)
+        return (y2.reshape(B, HW, HW, Co), dx2.reshape(B, HW, HW, Ci),
+                dw2.reshape(1, 1, Ci, Co))
+
+    print("== A': 1x1 fwd+bwd vjp", flush=True)
+    var_xwd = list(zip(xs, ws, dys))
+    av_conv = _report("vjp_1x1_conv_emitter", vjp_conv_1x1, var_xwd)
+    av_dot = _report("vjp_1x1_dot_general", vjp_dot_1x1, var_xwd)
+    results["vjp_1x1_speedup_dot_over_conv"] = round(
+        av_conv["ms"] / av_dot["ms"], 3)
+
+    # ---- B: 3x3 dgrad at 56x56, 64->64 ----------------------------------
+    C3 = 64
+    xs3 = mk((B, HW, HW, C3))
+    ws3 = mk((3, 3, C3, C3))
+    dys3 = mk((B, HW, HW, C3))
+
+    def dgrad_conv_3x3(dy, w, x):
+        _, vjp = jax.vjp(lambda x_: conv_fwd(x_, w), x)
+        return vjp(dy)[0]
+
+    def dgrad_im2col_3x3(dy, w, x):
+        patches = jax.lax.conv_general_dilated_patches(
+            dy, (3, 3), (1, 1), "SAME", dimension_numbers=DN)
+        wf = jnp.flip(w, (0, 1))
+        wr = jnp.transpose(wf, (3, 0, 1, 2)).reshape(9 * C3, C3)
+        dx = jax.lax.dot_general(
+            patches.reshape(-1, 9 * C3), wr, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dx.astype(dy.dtype).reshape(B, HW, HW, C3)
+
+    print("== B: 3x3 dgrad 64ch @56x56", flush=True)
+    var3b = list(zip(dys3, ws3, xs3))
+    b_conv = _report("dgrad_3x3_conv_emitter", dgrad_conv_3x3, var3b)
+    b_im2col = _report("dgrad_3x3_im2col_dot", dgrad_im2col_3x3, var3b)
+    results["dgrad_3x3_speedup_im2col_over_conv"] = round(
+        b_conv["ms"] / b_im2col["ms"], 3)
+
+    print(json.dumps({"exp": "dgrad_probe2_summary", **results}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

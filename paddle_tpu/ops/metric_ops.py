@@ -94,5 +94,9 @@ def _mean_iou(ctx, ins, attrs):
     valid = union > 0
     iou = jnp.where(valid, inter / jnp.maximum(union, 1e-12), 0.0)
     mean_iou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1)
-    return {"OutMeanIou": [mean_iou], "OutWrong": [jnp.sum(cm, 0) - inter],
+    # OutWrong counts each mismatch against BOTH its predicted and its
+    # label class (FP + FN, ≙ mean_iou_op.h:95-97); OutWrong + OutCorrect
+    # is then exactly the per-class union the IoU divides by
+    wrong = jnp.sum(cm, 0) + jnp.sum(cm, 1) - 2 * inter
+    return {"OutMeanIou": [mean_iou], "OutWrong": [wrong],
             "OutCorrect": [inter]}

@@ -387,6 +387,12 @@ def ring_attention_sharded(mesh: DeviceMesh, q, k, v, *, causal=False,
             f"plain attention")
     in_spec = mesh.pspec(DATA_AXIS, SEQUENCE_AXIS, None, None)
     seg_spec = mesh.pspec(DATA_AXIS, SEQUENCE_AXIS)
+    backend = _resolve_backend(backend)
+    # the pallas INTERPRETER's discharge path trips a jax vma bug inside
+    # checked shard_map (dynamic_slice "varying manual axes" mismatch);
+    # disable the check only for that test backend — the production
+    # pallas/xla paths keep shard_map's varying-axes validation
+    check_vma = backend != "pallas_interpret"
 
     if segment_ids is None:
         def body(q, k, v):
@@ -395,19 +401,16 @@ def ring_attention_sharded(mesh: DeviceMesh, q, k, v, *, causal=False,
                                   block_k=block_k)
         f = shard_map(body, mesh=mesh.jax_mesh,
                       in_specs=(in_spec, in_spec, in_spec),
-                      out_specs=in_spec, check_vma=False)
+                      out_specs=in_spec, check_vma=check_vma)
         return f(q, k, v)
 
     def body(q, k, v, seg):
         return ring_attention(q, k, v, causal=causal, scale=scale,
                               segment_ids=seg, backend=backend,
                               block_q=block_q, block_k=block_k)
-    # check_vma=False: the pallas interpreter's discharge path trips a
-    # jax vma bug inside checked shard_map (dynamic_slice "varying manual
-    # axes" mismatch); correctness is pinned by the parity tests instead
     f = shard_map(body, mesh=mesh.jax_mesh,
                   in_specs=(in_spec, in_spec, in_spec, seg_spec),
-                  out_specs=in_spec, check_vma=False)
+                  out_specs=in_spec, check_vma=check_vma)
     return f(q, k, v, segment_ids)
 
 
@@ -424,6 +427,7 @@ def ring_attention_live_blocks(mesh: DeviceMesh, q, k, v, *, causal=False,
     if segment_ids is not None:
         specs.append(seg_spec)
         args.append(segment_ids)
+    backend = _resolve_backend(backend)
 
     def body(*xs):
         seg = xs[3] if len(xs) > 3 else None
@@ -437,6 +441,7 @@ def ring_attention_live_blocks(mesh: DeviceMesh, q, k, v, *, causal=False,
         return out, jax.lax.psum(live, tuple(mesh.axes.keys()))
 
     f = shard_map(body, mesh=mesh.jax_mesh, in_specs=tuple(specs),
-                  out_specs=(in_spec, mesh.pspec()), check_vma=False)
+                  out_specs=(in_spec, mesh.pspec()),
+                  check_vma=backend != "pallas_interpret")
     out, live = f(*args)
     return out, int(jnp.max(live))

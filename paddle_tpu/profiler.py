@@ -225,6 +225,46 @@ def export_chrome_tracing(path: str, device_trace_dir: Optional[str] = None):
     return path
 
 
+def merge_process_traces(trace_paths, path: str, labels=None):
+    """Merge per-process Chrome traces — each produced by
+    `export_chrome_tracing` inside one trainer process — into ONE timeline
+    with per-process lanes (≙ the reference's tools/timeline.py:24-33,
+    whose --profile_path takes a list of per-trainer profile files and
+    emits a single catapult view).
+
+    Each input trace's pids are shifted into a disjoint range and labeled
+    `rank{r}/host` / `rank{r}/device{k}`, so an N-process world reads as N
+    stacked lanes in chrome://tracing / Perfetto."""
+    merged = {"traceEvents": [], "displayTimeUnit": "ms"}
+    for r, p in enumerate(trace_paths):
+        with open(p) as f:
+            t = json.load(f)
+        label = labels[r] if labels else f"rank{r}"
+        base = r * 100
+        seen = set()
+        for ev in t.get("traceEvents", []):
+            if not isinstance(ev, dict):
+                continue
+            ev = dict(ev)
+            pid = int(ev.get("pid", 0))
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                # rewritten below with the rank prefix
+                continue
+            ev["pid"] = base + pid
+            seen.add(pid)
+            merged["traceEvents"].append(ev)
+        for pid in sorted(seen):
+            merged["traceEvents"].append({
+                "ph": "M", "name": "process_name", "pid": base + pid,
+                "args": {"name": label + ("/host" if pid == 0
+                                          else f"/device{pid - 1}")}})
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(merged, f)
+    return path
+
+
 @contextmanager
 def profiler(state: str = "All", sorted_key: str = "default",
              profile_path: Optional[str] = None,

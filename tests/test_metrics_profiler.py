@@ -98,6 +98,34 @@ class TestProfiler:
         names = {e["name"] for e in data["traceEvents"]}
         assert {"outer", "inner"} <= names
 
+    def test_merge_process_traces(self, tmp_path):
+        """Per-process traces merge into one timeline with disjoint,
+        labeled per-rank lanes (≙ reference tools/timeline.py multi-
+        profile_path mode)."""
+        paths = []
+        for r in range(3):
+            p = str(tmp_path / f"trace_rank{r}.json")
+            with open(p, "w") as f:
+                json.dump({"traceEvents": [
+                    {"name": f"step_{r}", "cat": "host", "ph": "X",
+                     "ts": 10.0 * r, "dur": 5.0, "pid": 0, "tid": 1},
+                    {"name": "dev", "cat": "device", "ph": "X",
+                     "ts": 11.0 * r, "dur": 2.0, "pid": 1, "tid": 0},
+                ]}, f)
+            paths.append(p)
+        out = profiler.merge_process_traces(
+            paths, str(tmp_path / "merged.json"))
+        with open(out) as f:
+            merged = json.load(f)
+        evs = merged["traceEvents"]
+        pids = {e["pid"] for e in evs if e.get("ph") != "M"}
+        assert pids == {0, 1, 100, 101, 200, 201}, pids
+        labels = {e["args"]["name"] for e in evs if e.get("ph") == "M"}
+        assert "rank0/host" in labels and "rank2/device0" in labels, labels
+        # every rank's host events survive with their names
+        names = {e["name"] for e in evs}
+        assert {"step_0", "step_1", "step_2"} <= names
+
     def test_executor_events_recorded(self, capsys):
         x = pt.layers.data("x", shape=[4], dtype="float32")
         y = pt.layers.fc(x, size=2)

@@ -376,6 +376,11 @@ SPECS.update({
     "split_ids": dict(
         ins=lambda r: {"Ids": np.array([0, 3, 5, 6, 9], dtype="int64")},
         attrs={"num_shards": 2},
+        # modulo routing, order-preserving, -1 padded (≙ split_ids_op.h)
+        ref=lambda i, a: {
+            "Out": [np.array([0, 6, -1, -1, -1], "int32"),
+                    np.array([3, 5, 9, -1, -1], "int32")],
+            "Count": np.array([2, 3], "int32")},
         grad=[]),
     "merge_ids": dict(
         ins=lambda r: {"Ids": [np.array([0, 2], dtype="int64"),
@@ -686,7 +691,12 @@ SPECS.update({
         ins=lambda r: {"X": _away(r, (3, 5, 4)),
                        "Offset": np.array([[1], [0], [2]], "int64"),
                        "Length": np.array([[2], [2], [2]], "int64")},
-        attrs={"length": 2}, grad=[]),
+        attrs={"length": 2},
+        ref=lambda i, a: {"Out": np.stack([
+            i["X"][0][b, int(i["Offset"][0][b, 0]):
+                      int(i["Offset"][0][b, 0]) + 2]
+            for b in range(3)])},
+        grad=[]),
     "sequence_mask": dict(
         ins=lambda r: {"X": np.array([3, 1, 4], "int64")},
         attrs={"maxlen": 5},
@@ -698,7 +708,13 @@ SPECS.update({
     "sequence_erase": dict(
         ins=lambda r: {"X": _ints(r, (2, 6), 5),
                        "SeqLen": np.array([6, 4], "int32")},
-        attrs={"tokens": [0]}, grad=[]),
+        # a NONZERO erase token: erased positions become 0 != 2, so the
+        # Out check distinguishes erase-to-zero from identity
+        attrs={"tokens": [2]},
+        ref=lambda i, a: {
+            "Out": np.where(i["X"][0] == 2, 0, i["X"][0]),
+            "Mask": (i["X"][0] != 2).astype("int32")},
+        grad=[]),
     "lstm_unit": dict(
         ins=lambda r: {"X": _away(r, (3, 16)), "C_prev": _away(r, (3, 4))},
         grad=["X", "C_prev"], out_slot="H"),
@@ -728,9 +744,104 @@ SPECS.update({
 # -- optimizers --------------------------------------------------------------
 
 
+def _mean_iou_ref(pred, label, n):
+    cm = np.zeros((n, n))
+    for pv, lv in zip(pred, label):
+        cm[lv, pv] += 1
+    inter = np.diag(cm)
+    union = cm.sum(0) + cm.sum(1) - inter
+    valid = union > 0
+    iou = np.where(valid, inter / np.maximum(union, 1e-12), 0.0)
+    # mismatches count against both the predicted and the label class
+    # (mean_iou_op.h:95-97): OutWrong + OutCorrect == per-class union
+    return {"OutMeanIou": np.float32(iou.sum() / max(valid.sum(), 1)),
+            "OutWrong": (cm.sum(0) + cm.sum(1) - 2 * inter
+                         ).astype("float32"),
+            "OutCorrect": inter.astype("float32")}
+
+
 def _opt_base(r, shape=(4, 3)):
     return {"Param": _away(r, shape), "Grad": _away(r, shape) * 0.1,
             "LearningRate": np.array([0.1], "float32")}
+
+
+# numpy transcriptions of the reference's optimizer-op semantics
+# (adam_op.h, adamax_op.h, adadelta_op.h, ftrl_op.h, proximal_adagrad_op.h,
+# LAMB paper eq. as in the lowering's docstring) — independent of the jnp
+# lowerings they check.
+
+def _adam_ref(i, a):
+    p, g = i["Param"][0], i["Grad"][0]
+    m, v = i["Moment1"][0], i["Moment2"][0]
+    b1p, b2p = i["Beta1Pow"][0], i["Beta2Pow"][0]
+    b1, b2, eps = a["beta1"], a["beta2"], a["epsilon"]
+    m2 = b1 * m + (1 - b1) * g
+    v2 = b2 * v + (1 - b2) * g ** 2
+    lr_t = 0.1 * np.sqrt(1 - b2p) / (1 - b1p)
+    return {"ParamOut": p - lr_t * m2 / (np.sqrt(v2) + eps),
+            "Moment1Out": m2, "Moment2Out": v2,
+            "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
+
+
+def _adamax_ref(i, a):
+    p, g = i["Param"][0], i["Grad"][0]
+    m, inf = i["Moment"][0], i["InfNorm"][0]
+    b1p = i["Beta1Pow"][0]
+    b1, b2, eps = a["beta1"], a["beta2"], a["epsilon"]
+    m2 = b1 * m + (1 - b1) * g
+    inf2 = np.maximum(b2 * inf, np.abs(g))
+    return {"ParamOut": p - (0.1 / (1 - b1p)) * (m2 / (inf2 + eps)),
+            "MomentOut": m2, "InfNormOut": inf2, "Beta1PowOut": b1p * b1}
+
+
+def _adadelta_ref(i, a):
+    p, g = i["Param"][0], i["Grad"][0]
+    asg, asu = i["AvgSquaredGrad"][0], i["AvgSquaredUpdate"][0]
+    rho, eps = a["rho"], a["epsilon"]
+    g2 = rho * asg + (1 - rho) * g ** 2
+    upd = -np.sqrt((asu + eps) / (g2 + eps)) * g
+    return {"ParamOut": p + upd, "AvgSquaredGradOut": g2,
+            "AvgSquaredUpdateOut": rho * asu + (1 - rho) * upd ** 2}
+
+
+def _ftrl_ref(i, a):
+    p, g = i["Param"][0], i["Grad"][0]
+    sq, lin = i["SquaredAccumulator"][0], i["LinearAccumulator"][0]
+    lr, l1, l2 = 0.1, a["l1"], a["l2"]
+    new_sq = sq + g ** 2
+    sigma = (np.sqrt(new_sq) - np.sqrt(sq)) / lr
+    lin2 = lin + g - sigma * p
+    denom = np.sqrt(new_sq) / lr + 2 * l2
+    return {"ParamOut": (np.clip(lin2, -l1, l1) - lin2) / denom,
+            "SquaredAccumOut": new_sq, "LinearAccumOut": lin2}
+
+
+def _proximal_adagrad_ref(i, a):
+    p, g, mom = i["Param"][0], i["Grad"][0], i["Moment"][0]
+    lr, l1, l2 = 0.1, a["l1"], a["l2"]
+    mom2 = mom + g ** 2
+    alr = lr / np.sqrt(mom2)
+    prox = p - alr * g
+    return {"MomentOut": mom2,
+            "ParamOut": np.sign(prox) * np.maximum(np.abs(prox) - alr * l1,
+                                                   0.0) / (1.0 + alr * l2)}
+
+
+def _lamb_ref(i, a):
+    p, g = i["Param"][0], i["Grad"][0]
+    m, v = i["Moment1"][0], i["Moment2"][0]
+    b1p, b2p = i["Beta1Pow"][0], i["Beta2Pow"][0]
+    b1, b2, eps = a["beta1"], a["beta2"], a["epsilon"]
+    wd = a["weight_decay"]
+    m2 = b1 * m + (1 - b1) * g
+    v2 = b2 * v + (1 - b2) * g ** 2
+    upd = (m2 / (1 - b1p)) / (np.sqrt(v2 / (1 - b2p)) + eps) + wd * p
+    pn = np.sqrt(np.sum(p ** 2))
+    un = np.sqrt(np.sum(upd ** 2))
+    trust = pn / un if (pn > 0 and un > 0) else 1.0
+    return {"ParamOut": p - 0.1 * trust * upd, "Moment1Out": m2,
+            "Moment2Out": v2, "Beta1PowOut": b1p * b1,
+            "Beta2PowOut": b2p * b2}
 
 
 SPECS.update({
@@ -752,6 +863,7 @@ SPECS.update({
                        "Beta1Pow": np.array([0.9], "float32"),
                        "Beta2Pow": np.array([0.999], "float32")},
         attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+        ref=lambda i, a: _adam_ref(i, a),
         grad=[]),
     "adamax": dict(
         ins=lambda r: {**_opt_base(r),
@@ -759,6 +871,7 @@ SPECS.update({
                        "InfNorm": _pos(r, (4, 3)) * 0.1,
                        "Beta1Pow": np.array([0.9], "float32")},
         attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+        ref=lambda i, a: _adamax_ref(i, a),
         grad=[]),
     "adagrad": dict(
         ins=lambda r: {**_opt_base(r), "Moment": _pos(r, (4, 3)) * 0.01},
@@ -770,6 +883,11 @@ SPECS.update({
     "decayed_adagrad": dict(
         ins=lambda r: {**_opt_base(r), "Moment": _pos(r, (4, 3)) * 0.01},
         attrs={"decay": 0.95, "epsilon": 1e-6},
+        ref=lambda i, a: (lambda m2: {
+            "MomentOut": m2,
+            "ParamOut": i["Param"][0] - 0.1 * i["Grad"][0]
+            / (np.sqrt(m2) + 1e-6)})(
+                0.95 * i["Moment"][0] + 0.05 * i["Grad"][0] ** 2),
         grad=[]),
     "adadelta": dict(
         ins=lambda r: {"Param": _away(r, (4, 3)),
@@ -777,26 +895,40 @@ SPECS.update({
                        "AvgSquaredGrad": _pos(r, (4, 3)) * 0.01,
                        "AvgSquaredUpdate": _pos(r, (4, 3)) * 0.01},
         attrs={"rho": 0.95, "epsilon": 1e-6},
+        ref=lambda i, a: _adadelta_ref(i, a),
         grad=[]),
     "rmsprop": dict(
         ins=lambda r: {**_opt_base(r),
                        "MeanSquare": _pos(r, (4, 3)) * 0.01,
                        "Moment": _away(r, (4, 3)) * 0.01},
         attrs={"decay": 0.95, "epsilon": 1e-6, "momentum": 0.9},
+        ref=lambda i, a: (lambda ms: (lambda mom: {
+            "MeanSquareOut": ms, "MomentOut": mom,
+            "ParamOut": i["Param"][0] - mom})(
+                0.9 * i["Moment"][0]
+                + 0.1 * i["Grad"][0] / np.sqrt(ms + 1e-6)))(
+                    0.95 * i["MeanSquare"][0] + 0.05 * i["Grad"][0] ** 2),
         grad=[]),
     "ftrl": dict(
         ins=lambda r: {**_opt_base(r),
                        "SquaredAccumulator": _pos(r, (4, 3)) * 0.01,
                        "LinearAccumulator": _away(r, (4, 3)) * 0.01},
         attrs={"l1": 0.01, "l2": 0.01, "lr_power": -0.5},
+        ref=lambda i, a: _ftrl_ref(i, a),
         grad=[]),
     "proximal_gd": dict(
         ins=lambda r: _opt_base(r),
         attrs={"l1": 0.01, "l2": 0.01},
+        ref=lambda i, a: (lambda prox: {
+            "ParamOut": np.sign(prox)
+            * np.maximum(np.abs(prox) - 0.1 * 0.01, 0.0)
+            / (1.0 + 0.1 * 0.01)})(
+                i["Param"][0] - 0.1 * i["Grad"][0]),
         grad=[]),
     "proximal_adagrad": dict(
         ins=lambda r: {**_opt_base(r), "Moment": _pos(r, (4, 3)) * 0.01},
         attrs={"l1": 0.01, "l2": 0.01},
+        ref=lambda i, a: _proximal_adagrad_ref(i, a),
         grad=[]),
     "lamb": dict(
         ins=lambda r: {**_opt_base(r),
@@ -806,6 +938,7 @@ SPECS.update({
                        "Beta2Pow": np.array([0.999], "float32")},
         attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-6,
                "weight_decay": 0.01},
+        ref=lambda i, a: _lamb_ref(i, a),
         grad=[]),
 })
 
@@ -916,6 +1049,8 @@ SPECS.update({
         ins=lambda r: {"Predictions": _ints(r, (10,), 3),
                        "Labels": _ints(r, (10,), 3)},
         attrs={"num_classes": 3},
+        ref=lambda i, a: _mean_iou_ref(i["Predictions"][0].reshape(-1),
+                                       i["Labels"][0].reshape(-1), 3),
         grad=[]),
     "chunk_eval": dict(
         ins=lambda r: {"Inference": _ints(r, (2, 6), 5),
@@ -1209,8 +1344,10 @@ def test_op(op):
 
 def test_registry_fully_accounted():
     """Every registered op is directly checked here, checked by a named
-    dedicated test, or excluded with a reason — and the directly-checked
-    count beats the VERDICT target of 150."""
+    dedicated test, or excluded with a reason — the directly-checked count
+    beats the VERDICT r4 target of 190, and so does the stricter count of
+    specs carrying a VALUE assertion (numpy ref, numeric-grad check, or
+    property check), not just a finite-smoke run."""
     ops = set(_registered())
     spec_ops = set(SPECS)
     unknown_specs = spec_ops - ops
@@ -1219,8 +1356,14 @@ def test_registry_fully_accounted():
     assert not unaccounted, (
         f"{len(unaccounted)} registered ops have no direct check, no "
         f"dedicated test, and no exclusion reason: {sorted(unaccounted)}")
+    strong = {op for op in spec_ops & ops
+              if SPECS[op].get("ref") is not None
+              or SPECS[op].get("grad")
+              or SPECS[op].get("check") is not None}
     print(f"\nop coverage: {len(spec_ops & ops)} direct "
+          f"({len(strong)} value-asserted) "
           f"+ {len(set(COVERED_ELSEWHERE) & ops)} dedicated "
           f"+ {len(set(EXCLUDED) & ops)} excluded "
           f"of {len(ops)} registered")
-    assert len(spec_ops & ops) >= 150
+    assert len(spec_ops & ops) >= 190
+    assert len(strong) >= 190, len(strong)

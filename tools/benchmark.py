@@ -6,12 +6,17 @@ stacked_dynamic_lstm / machine_translation with --update_method
 {local,pserver,nccl2}, printing images/sec). TPU translation: the pserver and
 nccl2 modes collapse into `--update_method collective` (ParallelExecutor over
 the device mesh — compiled XLA collectives); `local` is the single-device
-Executor. Synthetic data keeps the harness runnable anywhere
-(≙ --use_fake_data).
+Executor; `multiproc` launches a REAL N-process jax.distributed world
+(≙ the nccl2 multi-trainer path, fluid_benchmark.py:30-61) on this host's
+virtual CPU mesh and reports per-process step time vs the single-process
+collective baseline (the process-boundary overhead). Synthetic data keeps
+the harness runnable anywhere (≙ --use_fake_data).
 
 Examples:
     python tools/benchmark.py --model resnet --batch_size 64 --iters 20
     python tools/benchmark.py --model transformer --update_method collective
+    python tools/benchmark.py --model mnist --update_method multiproc \
+        --nproc 4 --local_devices 2 --iters 10
 """
 
 from __future__ import annotations
@@ -19,11 +24,22 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
+import socket
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+if os.environ.get("PTPU_BENCH_CPU_BOOT"):
+    # worker/baseline child of the multiproc driver: force the virtual CPU
+    # platform BEFORE jax initializes (the axon TPU plugin would otherwise
+    # pin jax_platforms to the tunnel)
+    import jax._src.xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
 
 
 def _mnist(args, rng):
@@ -152,6 +168,110 @@ MODELS = {
 }
 
 
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_child(args, extra_env, extra_args=()):
+    """Re-exec this CLI as a child process on the virtual CPU platform."""
+    env = dict(os.environ)
+    env["PTPU_BENCH_CPU_BOOT"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra_env)
+    argv = [sys.executable, os.path.abspath(__file__),
+            "--model", args.model, "--batch_size", str(args.batch_size),
+            "--iters", str(args.iters), "--warmup", str(args.warmup),
+            "--seq_len", str(args.seq_len), "--depth", str(args.depth),
+            "--learning_rate", str(args.learning_rate),
+            "--optimizer", args.optimizer] + list(extra_args)
+    if args.no_bf16:
+        argv.append("--no_bf16")
+    return subprocess.Popen(argv, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, env=env)
+
+
+def _drive_multiproc(args):
+    """Parent of the N-process world: spawn N trainer children + a
+    1-process collective baseline on the same total device count, report
+    the process-boundary overhead (≙ fluid_benchmark.py nccl2 launcher)."""
+    total_dev = args.nproc * args.local_devices
+    port = _free_port()
+    trace_dir = args.trace_dir
+    if trace_dir:
+        os.makedirs(trace_dir, exist_ok=True)
+    procs = []
+    for rank in range(args.nproc):
+        extra = {
+            "PADDLE_TRAINING_ROLE": "TRAINER",
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(args.nproc),
+            "PADDLE_COORDINATOR_ENDPOINT": f"127.0.0.1:{port}",
+            "XLA_FLAGS":
+                f"--xla_force_host_platform_device_count="
+                f"{args.local_devices}",
+        }
+        worker_args = ["--update_method", "collective"]
+        if trace_dir:
+            worker_args += ["--trace_dir", trace_dir]
+        procs.append(_spawn_child(args, extra, worker_args))
+    ranks = {}
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=900)
+            if p.returncode != 0:
+                raise RuntimeError(f"worker failed:\n{err[-3000:]}")
+            rec = json.loads(out.strip().splitlines()[-1])
+            ranks[rec.get("rank", 0)] = rec
+    finally:
+        # one failed/hung rank must not orphan siblings blocked in a
+        # collective that will never complete
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    base = _spawn_child(args, {
+        "XLA_FLAGS":
+            f"--xla_force_host_platform_device_count={total_dev}",
+    }, ["--update_method", "collective"])
+    out, err = base.communicate(timeout=900)
+    if base.returncode != 0:
+        raise RuntimeError(f"baseline failed:\n{err[-3000:]}")
+    baseline = json.loads(out.strip().splitlines()[-1])
+
+    worst = max(r["latency_ms"] for r in ranks.values())
+    overhead = (worst - baseline["latency_ms"]) / baseline["latency_ms"]
+    merged_trace = None
+    if trace_dir:
+        import glob
+
+        from paddle_tpu import profiler as prof
+        paths = sorted(glob.glob(os.path.join(trace_dir,
+                                              "trace_rank*.json")))
+        if paths:
+            merged_trace = prof.merge_process_traces(
+                paths, os.path.join(trace_dir, "merged_trace.json"))
+    print(json.dumps({
+        "model": args.model,
+        "update_method": "multiproc",
+        "nproc": args.nproc,
+        "local_devices_per_proc": args.local_devices,
+        "total_devices": total_dev,
+        "batch_size": args.batch_size,
+        "per_process_latency_ms": {str(k): v["latency_ms"]
+                                   for k, v in sorted(ranks.items())},
+        "worst_rank_latency_ms": worst,
+        "single_process_latency_ms": baseline["latency_ms"],
+        "multiproc_overhead_pct": round(overhead * 100, 1),
+        "throughput": min(r["throughput"] for r in ranks.values()),
+        "unit": baseline["unit"],
+        "merged_trace": merged_trace,
+    }))
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--model", choices=sorted(MODELS), default="resnet")
@@ -161,23 +281,40 @@ def main():
     p.add_argument("--seq_len", type=int, default=64)
     p.add_argument("--depth", type=int, default=50)
     p.add_argument("--learning_rate", type=float, default=0.01)
-    p.add_argument("--update_method", choices=["local", "collective"],
+    p.add_argument("--update_method",
+                   choices=["local", "collective", "multiproc"],
                    default="local",
                    help="local = single device; collective = "
-                        "ParallelExecutor over the mesh (≙ nccl2/pserver)")
+                        "ParallelExecutor over the mesh (≙ nccl2/pserver); "
+                        "multiproc = N-process jax.distributed world on the "
+                        "virtual CPU mesh (≙ nccl2 multi-trainer)")
+    p.add_argument("--nproc", type=int, default=4,
+                   help="multiproc: number of trainer processes")
+    p.add_argument("--local_devices", type=int, default=2,
+                   help="multiproc: virtual devices per process")
     p.add_argument("--optimizer", default="momentum",
                    choices=["sgd", "momentum", "adam"])
     p.add_argument("--no_bf16", action="store_true")
     p.add_argument("--profile", action="store_true")
+    p.add_argument("--trace_dir", default=None,
+                   help="write a per-rank Chrome trace here (multiproc "
+                        "parent merges them into merged_trace.json)")
     args = p.parse_args()
     if args.iters < 1:
         p.error("--iters must be >= 1")
     if args.warmup < 0:
         p.error("--warmup must be >= 0")
 
+    if args.update_method == "multiproc":
+        _drive_multiproc(args)
+        return
+
     import numpy as np
     import jax
     import paddle_tpu as pt
+
+    from paddle_tpu.distributed import init_parallel_env
+    denv = init_parallel_env()  # no-op without PADDLE_COORDINATOR_ENDPOINT
 
     rng = np.random.RandomState(0)
     loss, feed, units_per_step = MODELS[args.model](args, rng)
@@ -205,19 +342,30 @@ def main():
     if out is not None:
         jax.block_until_ready(out)
 
+    trace_events = args.trace_dir is not None
+    if trace_events:
+        pt.profiler.reset_profiler()
+        pt.profiler.start_profiler("All")
     t0 = time.time()
-    for _ in range(args.iters):
-        out = runner.run(feed=feed, fetch_list=[loss], return_numpy=False)
+    for i in range(args.iters):
+        with pt.profiler.RecordEvent(f"step_{i}"):
+            out = runner.run(feed=feed, fetch_list=[loss],
+                             return_numpy=False)
     jax.block_until_ready(out)
     dt = time.time() - t0
     if args.profile:
         pt.profiler.stop_profiler(sorted_key="total")
+    if trace_events:
+        pt.profiler.export_chrome_tracing(os.path.join(
+            args.trace_dir, f"trace_rank{denv.trainer_id}.json"))
 
     unit = ("tokens/sec" if args.model in
             ("transformer", "machine_translation") else "examples/sec")
     print(json.dumps({
         "model": args.model,
         "update_method": args.update_method,
+        "rank": denv.trainer_id,
+        "nproc": denv.num_trainers,
         "batch_size": args.batch_size,
         "iters": args.iters,
         "latency_ms": round(dt / args.iters * 1000, 3),

@@ -1,16 +1,16 @@
-"""probe_dgrad, final methodology: repetitions run INSIDE one jit via
-lax.scan over stacked distinct inputs, so per-dispatch tunnel overhead
-(which dominated probe_dgrad2's 5-15 ms kernels at ~200 GB/s apparent
-bandwidth) is amortized over 32 on-device executions per call. The scan
-carry threads a scalar through every iteration, ordering the executions
-and defeating CSE; host-value realization is the barrier.
+"""probe_dgrad, final methodology: repetitions run INSIDE one jit via a
+ROLLED lax.scan, so per-dispatch tunnel overhead (which dominated
+probe_dgrad2's 5-15 ms kernels at ~200 GB/s apparent bandwidth) is
+amortized over 32 on-device executions per call. A rolled loop body
+executes every iteration (no cross-iteration CSE), and folding the carry
+into the first operand (+ carry*0, unfoldable for floats) blocks
+loop-invariant hoisting. Host-value realization is the barrier.
 
     env PYTHONPATH=/root/.axon_site:/root/repo python tools/probe_dgrad3.py
 """
 
 from __future__ import annotations
 
-import functools
 import json
 import time
 
@@ -19,26 +19,21 @@ import jax.numpy as jnp
 import numpy as np
 
 DN = ("NHWC", "HWIO", "NHWC")
-NVAR = 4
 REPS = 32          # scan length inside one dispatch
 
 
-def _scan_bench(op, variants):
-    """op(*args) -> array. Builds jit(f) running REPS sequential
-    executions cycling NVAR distinct input sets inside ONE dispatch.
-    Each scan iteration lax.switches to one closed-over variant (no
-    stack/copy of the inputs); the carry accumulates one output element,
-    ordering the iterations."""
-    idxs = jnp.asarray([i % len(variants) for i in range(REPS)],
-                       jnp.int32)
+def _scan_bench(op, args):
+    """op(*args) -> array. Builds jit(f) running REPS executions inside a
+    ROLLED lax.scan in ONE dispatch. Identical overhead lands on both
+    sides of every A/B."""
 
     @jax.jit
     def f():
-        def body(carry, i):
-            out = jax.lax.switch(
-                i, [functools.partial(op, *v) for v in variants])
+        def body(carry, _):
+            a0 = args[0] + carry.astype(args[0].dtype) * 0
+            out = op(a0, *args[1:])
             return carry + out.reshape(-1)[0].astype(jnp.float32), None
-        carry, _ = jax.lax.scan(body, jnp.float32(0), idxs)
+        carry, _ = jax.lax.scan(body, jnp.float32(0), None, length=REPS)
         return carry
     return f, ()
 
@@ -63,15 +58,15 @@ def _cost_single(op, args1):
             float(ca.get("flops", 0.0)))
 
 
-def _report(name, op, variants, args1):
-    f, fargs = _scan_bench(op, variants)
+def _report(name, op, args1):
+    f, fargs = _scan_bench(op, args1)
     t = _time_scan(f, fargs)
     b, fl = _cost_single(op, args1)
     row = {"variant": name, "ms": round(t * 1e3, 3),
            "bytes_MB": round(b / 1e6, 1), "flops_G": round(fl / 1e9, 2),
            "achieved_GBps": round(b / t / 1e9, 1) if b else None,
            "achieved_TFLOPs": round(fl / t / 1e12, 2) if fl else None,
-           "reps_per_dispatch": REPS, "n_distinct_inputs": NVAR}
+           "reps_per_dispatch": REPS}
     print(json.dumps(row), flush=True)
     return row
 
@@ -88,13 +83,13 @@ def main():
 
     B, HW, Ci, Co = 256, 56, 256, 64
 
-    def mkstack(shape):
-        return jnp.asarray(rng.rand(NVAR, *shape).astype("float32"),
+    def mk(shape):
+        return jnp.asarray(rng.rand(*shape).astype("float32"),
                            jnp.bfloat16)
 
-    dys = mkstack((B, HW, HW, Co))
-    ws = mkstack((1, 1, Ci, Co))
-    xs = mkstack((B, HW, HW, Ci))
+    dys = mk((B, HW, HW, Co))
+    ws = mk((1, 1, Ci, Co))
+    xs = mk((B, HW, HW, Ci))
 
     def dgrad_conv_1x1(dy, w):
         _, vjp = jax.vjp(
@@ -110,11 +105,8 @@ def main():
         return dx.astype(dy.dtype).reshape(B, HW, HW, Ci)
 
     print("== A: 1x1 dgrad [256,56,56,64] -> [256,56,56,256]", flush=True)
-    var_dw = [(dys[i], ws[i]) for i in range(NVAR)]
-    a_conv = _report("dgrad_1x1_conv_emitter", dgrad_conv_1x1,
-                     var_dw, (dys[0], ws[0]))
-    a_dot = _report("dgrad_1x1_dot_general", dgrad_dot_1x1,
-                    var_dw, (dys[0], ws[0]))
+    a_conv = _report("dgrad_1x1_conv_emitter", dgrad_conv_1x1, (dys, ws))
+    a_dot = _report("dgrad_1x1_dot_general", dgrad_dot_1x1, (dys, ws))
     results["dgrad_1x1_speedup_dot_over_conv"] = round(
         a_conv["ms"] / a_dot["ms"], 3)
 
@@ -137,19 +129,17 @@ def main():
         return (dx2.reshape(B, HW, HW, Ci) + y2.sum() * 0 + dw2.sum() * 0)
 
     print("== A': 1x1 fwd+bwd vjp", flush=True)
-    var_xwd = [(xs[i], ws[i], dys[i]) for i in range(NVAR)]
     av_conv = _report("vjp_1x1_conv_emitter", vjp_conv_1x1,
-                      var_xwd, (xs[0], ws[0], dys[0]))
-    av_dot = _report("vjp_1x1_dot_general", vjp_dot_1x1,
-                     var_xwd, (xs[0], ws[0], dys[0]))
+                      (xs, ws, dys))
+    av_dot = _report("vjp_1x1_dot_general", vjp_dot_1x1, (xs, ws, dys))
     results["vjp_1x1_speedup_dot_over_conv"] = round(
         av_conv["ms"] / av_dot["ms"], 3)
 
     # ---- B: 3x3 dgrad at 56x56, 64->64 ----------------------------------
     C3 = 64
-    xs3 = mkstack((B, HW, HW, C3))
-    ws3 = mkstack((3, 3, C3, C3))
-    dys3 = mkstack((B, HW, HW, C3))
+    xs3 = mk((B, HW, HW, C3))
+    ws3 = mk((3, 3, C3, C3))
+    dys3 = mk((B, HW, HW, C3))
 
     def dgrad_conv_3x3(dy, w):
         _, vjp = jax.vjp(
@@ -168,11 +158,10 @@ def main():
         return dx.astype(dy.dtype).reshape(B, HW, HW, C3)
 
     print("== B: 3x3 dgrad 64ch @56x56", flush=True)
-    var_dw3 = [(dys3[i], ws3[i]) for i in range(NVAR)]
     b_conv = _report("dgrad_3x3_conv_emitter", dgrad_conv_3x3,
-                     var_dw3, (dys3[0], ws3[0]))
+                     (dys3, ws3))
     b_im2col = _report("dgrad_3x3_im2col_dot", dgrad_im2col_3x3,
-                       var_dw3, (dys3[0], ws3[0]))
+                       (dys3, ws3))
     results["dgrad_3x3_speedup_im2col_over_conv"] = round(
         b_conv["ms"] / b_im2col["ms"], 3)
 
@@ -183,9 +172,7 @@ def main():
         return dx + y.sum() * 0 + dw.sum() * 0
 
     print("== C: 3x3 fwd+bwd vjp (reference point)", flush=True)
-    _report("vjp_3x3_conv_emitter", vjp_conv_3x3,
-            [(xs3[i], ws3[i], dys3[i]) for i in range(NVAR)],
-            (xs3[0], ws3[0], dys3[0]))
+    _report("vjp_3x3_conv_emitter", vjp_conv_3x3, (xs3, ws3, dys3))
 
     print(json.dumps({"exp": "dgrad_probe3_summary", **results}),
           flush=True)

@@ -109,7 +109,12 @@ class BeamSearchDecoder:
             sel_ids, sel_scores, parent = layers.beam_search(
                 ids_prev, sc_prev, logp, beam_size=K, end_id=self.eos_id)
             for n, v in new_states.items():
-                rnn.update_memory(mem[n], gather_beams(v, parent))
+                # greedy (K=1) has exactly one hypothesis: parent is
+                # identically 0 and the beam gather is an identity that
+                # would still read+rewrite every state (the KV caches!)
+                # once per step — skip it
+                rnn.update_memory(mem[n],
+                                  v if K == 1 else gather_beams(v, parent))
             rnn.update_memory(ids_prev, sel_ids)
             rnn.update_memory(sc_prev, sel_scores)
             rnn.step_output(sel_ids)

@@ -918,6 +918,21 @@ def elementwise_pow(x, y, axis=-1, act=None, name=None):
     return elementwise_op_layer("elementwise_pow", x, y, axis, act, name)
 
 
+def cache_write(cache, new, pos, axis, name=None):
+    """Write `new` (size-1 along `axis`) into `cache` at scalar position
+    `pos` (any tensor; its first element is the position) — the KV-cache
+    decode primitive (lowers to an in-place dynamic_update_slice inside
+    scan carries)."""
+    helper = LayerHelper("cache_write", name=name)
+    out = helper.create_tmp_variable(dtype=dtype_name(cache.dtype),
+                                     shape=cache.shape, stop_gradient=True)
+    helper.append_op(type="cache_write",
+                     inputs={"Cache": [cache], "New": [new], "Pos": [pos]},
+                     outputs={"Out": [out]},
+                     attrs={"axis": axis})
+    return out
+
+
 def lrn(input, n=5, k=2.0, alpha=1e-4, beta=0.75, name=None):
     helper = LayerHelper("lrn", name=name)
     out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),

@@ -215,14 +215,18 @@ def transformer(src=None, tgt=None, label=None, src_vocab=30000,
 
 def _attend_cached(q, k5, v5, bias, K, num_heads, d_head, dropout=0.0):
     """Per-head attention of a single-position query over a cached K/V:
-    q [B,K,H] against k5 [B,*,nh,dh,T*] / v5 [B,*,nh,T*,dh] (the * dims
-    broadcast over the beam axis), additive bias masking invalid keys.
-    When the train graph had attention-weight dropout, the context is
-    scaled by (1-p) — the same downgrade_in_infer correction the fused
-    multi_head_attention path applies at inference."""
+    q [B,K,H] against k5 / v5 both laid out [B,*,nh,T*,dh] (the * dims
+    broadcast over the beam axis; scores read k via transpose_y — free on
+    the MXU — so ONE cache layout serves both matmuls and the per-step
+    cache write lands on the sublane T axis, not the lane axis), additive
+    bias masking invalid keys. When the train graph had attention-weight
+    dropout, the context is scaled by (1-p) — the same downgrade_in_infer
+    correction the fused multi_head_attention path applies at
+    inference."""
     H = num_heads * d_head
     q5 = layers.reshape(q, shape=[0, K, num_heads, 1, d_head])
-    scores = layers.matmul(q5, k5, alpha=float(d_head) ** -0.5)
+    scores = layers.matmul(q5, k5, transpose_y=True,
+                           alpha=float(d_head) ** -0.5)
     weights = layers.softmax(layers.elementwise_add(scores, bias))
     ctx = layers.reshape(layers.matmul(weights, v5), shape=[0, K, H])
     if dropout:
@@ -231,13 +235,19 @@ def _attend_cached(q, k5, v5, bias, K, num_heads, d_head, dropout=0.0):
 
 
 def _cached_self_attention(x, states, new_states, cache_id, prefix, K, T,
-                           num_heads, d_head, write, bias, dropout=0.0):
+                           num_heads, d_head, pos, bias, dropout=0.0):
     """One cached self-attention block inside a decode scan step: project
-    q/k/v from x [B,K,H], write k/v into the [B,K,T,H] caches at the
-    current position (one-hot outer product via `write`), attend over the
-    masked cache, output-project. Shared by the LM and encoder-decoder
-    generators; parameter names come from `prefix` (matching the train
-    graph's multi_head_attention names)."""
+    q/k/v from x [B,K,H], write k/v into the PRE-TRANSPOSED caches
+    (k and v both [B,K,nh,T,dh]; scores read k via transpose_y) at scalar
+    position `pos` via
+    `cache_write` (an in-place dynamic_update_slice inside the scan
+    carry), attend over the masked cache, output-project. The head-major
+    cache layout makes the attention read direct — no per-step transpose
+    or one-hot full-cache rewrite, so the per-step HBM cost is one row
+    write + one cache read (the decode roofline's structural floor).
+    Shared by the LM and encoder-decoder generators; parameter names come
+    from `prefix` (matching the train graph's multi_head_attention
+    names)."""
     H = num_heads * d_head
     q = layers.fc(x, size=H, num_flatten_dims=2, bias_attr=False,
                   use_bf16=True, name=f"{prefix}_q")
@@ -245,20 +255,14 @@ def _cached_self_attention(x, states, new_states, cache_id, prefix, K, T,
                    use_bf16=True, name=f"{prefix}_k")
     vn = layers.fc(x, size=H, num_flatten_dims=2, bias_attr=False,
                    use_bf16=True, name=f"{prefix}_v")
-    kc = layers.elementwise_add(
+    kc = layers.cache_write(
         states[f"k{cache_id}"],
-        layers.elementwise_mul(write, layers.unsqueeze(kn, axes=[2])))
-    vc = layers.elementwise_add(
+        layers.reshape(kn, shape=[0, K, num_heads, 1, d_head]), pos, axis=3)
+    vc = layers.cache_write(
         states[f"v{cache_id}"],
-        layers.elementwise_mul(write, layers.unsqueeze(vn, axes=[2])))
+        layers.reshape(vn, shape=[0, K, num_heads, 1, d_head]), pos, axis=3)
     new_states[f"k{cache_id}"], new_states[f"v{cache_id}"] = kc, vc
-    k5 = layers.transpose(
-        layers.reshape(kc, shape=[0, K, T, num_heads, d_head]),
-        perm=[0, 1, 3, 4, 2])                            # [B,K,nh,dh,T]
-    v5 = layers.transpose(
-        layers.reshape(vc, shape=[0, K, T, num_heads, d_head]),
-        perm=[0, 1, 3, 2, 4])                            # [B,K,nh,T,dh]
-    ctx = _attend_cached(q, k5, v5, bias, K, num_heads, d_head, dropout)
+    ctx = _attend_cached(q, kc, vc, bias, K, num_heads, d_head, dropout)
     return layers.fc(ctx, size=H, num_flatten_dims=2, bias_attr=False,
                      use_bf16=True, name=f"{prefix}_o")
 
@@ -278,7 +282,7 @@ def _gen_embed_step(ids_prev, pos, emb_name, vocab, d_model, pe_table,
         x, layers.matmul(onehot_t, layers.assign(pe_table)))
     if dropout:
         x = layers.dropout(x, dropout_prob=dropout, is_test=True)
-    return x, onehot_t
+    return x
 
 
 def _mask_to_bias(mask, axes):
@@ -300,15 +304,21 @@ def _step_mask_bias(pos, arange):
     return _mask_to_bias(valid, axes=[2, 3])
 
 
-def _init_gen_states(batch_ref, K, T, H, num_layers):
+def _init_gen_states(batch_ref, K, T, H, num_layers, num_heads):
     """The decode scan's initial carry: position counter + zeroed
-    per-layer [B, K, T, H] KV caches."""
+    per-layer PRE-TRANSPOSED head-major KV caches, BOTH [B,K,nh,T,dh]:
+    one layout serves the score matmul (via transpose_y) and the context
+    matmul, and the per-step `cache_write` updates a [.., 1, dh] slice on
+    the SUBLANE T axis (a lane-axis dynamic update would be the slowest
+    store path on TPU)."""
+    d_head = H // num_heads
     init = {"pos": layers.fill_constant_batch_size_like(
         batch_ref, shape=[-1, K, 1], dtype="float32", value=0.0)}
     for i in range(num_layers):
-        for s in ("k", "v"):
-            init[f"{s}{i}"] = layers.fill_constant_batch_size_like(
-                batch_ref, shape=[-1, K, T, H], dtype="float32", value=0.0)
+        for sname in ("k", "v"):
+            init[f"{sname}{i}"] = layers.fill_constant_batch_size_like(
+                batch_ref, shape=[-1, K, num_heads, T, d_head],
+                dtype="float32", value=0.0)
     return init
 
 
@@ -354,7 +364,7 @@ def transformer_generate(src=None, src_vocab=30000, tgt_vocab=30000,
                        use_bf16=True, name=f"dec{i}_cross_v")
         ck = layers.transpose(
             layers.reshape(ck, shape=[0, 1, Ts, num_heads, d_head]),
-            perm=[0, 1, 3, 4, 2])                        # [B,1,nh,dh,Ts]
+            perm=[0, 1, 3, 2, 4])                        # [B,1,nh,Ts,dh]
         cv = layers.transpose(
             layers.reshape(cv, shape=[0, 1, Ts, num_heads, d_head]),
             perm=[0, 1, 3, 2, 4])                        # [B,1,nh,Ts,dh]
@@ -367,21 +377,20 @@ def transformer_generate(src=None, src_vocab=30000, tgt_vocab=30000,
                                 max_len=T, name="nmt_gen")
     pe_table = positional_encoding_table(T, d_model).astype("float32")
     arange = np.arange(T, dtype="float32").reshape(1, 1, T)
-    init = _init_gen_states(src, K, T, H, num_layers)
+    init = _init_gen_states(src, K, T, H, num_layers, num_heads)
 
     def step(states, ids_prev):
         pos = states["pos"]
-        x, onehot_t = _gen_embed_step(ids_prev, pos, "tgt_emb", tgt_vocab,
-                                      d_model, pe_table, dropout)
+        x = _gen_embed_step(ids_prev, pos, "tgt_emb", tgt_vocab,
+                            d_model, pe_table, dropout)
         self_bias = _step_mask_bias(pos, arange)
         new_states = {"pos": _next_pos(pos)}
-        write = layers.unsqueeze(onehot_t, axes=[3])
 
         for i in range(num_layers):
             # causal self-attention over the KV cache
             attn = _cached_self_attention(
                 x, states, new_states, i, f"dec{i}_self", K, T, num_heads,
-                d_head, write, self_bias, dropout)
+                d_head, pos, self_bias, dropout)
             x = _add_norm(attn, x, dropout, True, name=f"dec{i}_ln1")
 
             # cross-attention over the pre-projected encoder K/V
@@ -412,9 +421,11 @@ def transformer_lm_generate(prompt=None, vocab=32000, max_gen=32,
     decodes by re-running the while_op decoder with LoD beam state).
 
     TPU-first: one StaticRNN (lax.scan) over max_gen positions; the KV
-    cache lives in the scan carry as [B, K, max_gen, d_model] tensors
-    written by a one-hot outer product (no dynamic-update ops needed,
-    MXU-friendly), each step attends q·K over the masked cache. Weights
+    cache lives in the scan carry PRE-TRANSPOSED head-major
+    (k and v both [B,K,nh,T,dh]) and each step writes one row via
+    `cache_write` (an in-place dynamic_update_slice in the carry) then
+    attends q·K over the masked cache directly — per-step cache cost is
+    one row write + one read, the decode roofline's floor. Weights
     are shared BY NAME with a transformer_lm(...) built earlier in the
     same program (l{i}_attn_{q,k,v,o}, l{i}_ln{1,2}, l{i}_ffn_*,
     tok_emb, lm_head) — train first, then build this decode graph and
@@ -441,20 +452,19 @@ def transformer_lm_generate(prompt=None, vocab=32000, max_gen=32,
 
     pe_table = positional_encoding_table(T, d_model).astype("float32")
     arange = np.arange(T, dtype="float32").reshape(1, 1, T)
-    init = _init_gen_states(prompt, K, T, H, num_layers)
+    init = _init_gen_states(prompt, K, T, H, num_layers, num_heads)
     attn_dropout = 0.0 if packed else dropout
 
     def step(states, ids_prev):
         pos = states["pos"]                                      # [B,K,1]
-        x, onehot_t = _gen_embed_step(ids_prev, pos, "tok_emb", vocab,
-                                      d_model, pe_table, dropout)
+        x = _gen_embed_step(ids_prev, pos, "tok_emb", vocab,
+                            d_model, pe_table, dropout)
         bias = _step_mask_bias(pos, arange)
         new_states = {"pos": _next_pos(pos)}
-        write = layers.unsqueeze(onehot_t, axes=[3])             # [B,K,T,1]
         for i in range(num_layers):
             attn = _cached_self_attention(
                 x, states, new_states, i, f"l{i}_attn", K, T, num_heads,
-                d_head, write, bias, attn_dropout)
+                d_head, pos, bias, attn_dropout)
             x = _add_norm(attn, x, dropout, True, name=f"l{i}_ln1")
             f = ffn(x, d_model, d_inner, dropout, True, name=f"l{i}_ffn")
             x = _add_norm(f, x, dropout, True, name=f"l{i}_ln2")

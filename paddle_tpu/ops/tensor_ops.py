@@ -134,6 +134,25 @@ def _pad_constant_like(ctx, ins, attrs):
     return {"Out": [jnp.pad(y, pads, constant_values=attrs.get("pad_value", 0.0))]}
 
 
+@register_op("cache_write", stop_gradient=True)
+def _cache_write(ctx, ins, attrs):
+    """Write `New` (size-1 on `axis`) into `Cache` at scalar position
+    `Pos` along `axis` via dynamic_update_slice — the KV-cache decode
+    idiom. Inside a scan carry XLA performs the update in place, so the
+    per-step cache cost is one row write + the attention read, not a full
+    read+rewrite of the cache (the one-hot outer-product formulation's
+    cost). No reference analogue: the reference's while_op decoder
+    re-runs attention over growing LoD tensors instead of caching."""
+    cache = ins["Cache"][0]
+    new = ins["New"][0].astype(cache.dtype)
+    pos = ins["Pos"][0].reshape(-1)[0].astype(jnp.int32)
+    axis = attrs["axis"] % cache.ndim
+    starts = [jnp.int32(0)] * cache.ndim
+    starts[axis] = pos
+    return {"Out": [jax.lax.dynamic_update_slice(cache, new,
+                                                 tuple(starts))]}
+
+
 @register_op("one_hot", stop_gradient=True)
 def _one_hot(ctx, ins, attrs):
     x = ins["X"][0]

@@ -235,12 +235,23 @@ def merge_process_traces(trace_paths, path: str, labels=None):
     Each input trace's pids are shifted into a disjoint range and labeled
     `rank{r}/host` / `rank{r}/device{k}`, so an N-process world reads as N
     stacked lanes in chrome://tracing / Perfetto."""
-    merged = {"traceEvents": [], "displayTimeUnit": "ms"}
-    for r, p in enumerate(trace_paths):
+    traces = []
+    for p in trace_paths:
         with open(p) as f:
-            t = json.load(f)
-        label = labels[r] if labels else f"rank{r}"
-        base = r * 100
+            traces.append(json.load(f))
+    # pid stride: one disjoint block per rank, wide enough for the
+    # largest pid any input trace carries (device-trace planes can be
+    # numerous)
+    max_pid = 0
+    for t in traces:
+        for ev in t.get("traceEvents", []):
+            if isinstance(ev, dict):
+                max_pid = max(max_pid, int(ev.get("pid", 0)))
+    stride = max(100, max_pid + 1)
+    merged = {"traceEvents": [], "displayTimeUnit": "ms"}
+    for r, t in enumerate(traces):
+        label = (labels[r] if labels and r < len(labels) else f"rank{r}")
+        base = r * stride
         seen = set()
         for ev in t.get("traceEvents", []):
             if not isinstance(ev, dict):

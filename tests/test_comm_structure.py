@@ -19,7 +19,9 @@ All on the 8-virtual-CPU-device mesh; byte counts parsed from the
 partitioned, optimized HLO.
 """
 
+import os
 import re
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -30,8 +32,9 @@ import paddle_tpu as pt
 from paddle_tpu import layers
 from paddle_tpu.parallel.mesh import DeviceMesh
 
-_IT = {"bf16": 2, "f16": 2, "f32": 4, "s32": 4, "u32": 4, "s8": 1,
-       "u8": 1, "pred": 1, "s64": 8, "u64": 8}
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+from probe_common import hlo_shape_bytes as _shape_bytes  # noqa: E402
 
 
 @pytest.fixture
@@ -44,18 +47,6 @@ def _fresh():
     pt.reset_default_programs()
     pt.reset_global_scope()
     yield
-
-
-def _shape_bytes(sh: str) -> int:
-    total = 0
-    for m in re.finditer(r"(bf16|f32|f16|s32|u32|s8|u8|pred|s64|u64)"
-                         r"\[([0-9,]*)\]", sh):
-        n = 1
-        for d in m.group(2).split(","):
-            if d:
-                n *= int(d)
-        total += n * _IT[m.group(1)]
-    return total
 
 
 def collective_census(hlo: str):

@@ -373,6 +373,14 @@ SPECS.update({
         ins=lambda r: {"W": _away(r, (8, 4)),
                        "Ids": np.array([1, 3, 7], dtype="int64")},
         grad=[]),
+    "cache_write": dict(
+        ins=lambda r: {"Cache": _away(r, (2, 3, 6, 4)),
+                       "New": _away(r, (2, 3, 1, 4)),
+                       "Pos": np.array([[2.0]], "float32")},
+        attrs={"axis": 2},
+        ref=lambda i, a: {"Out": _cache_write_ref(
+            i["Cache"][0], i["New"][0], 2, 2)},
+        grad=[]),
     "split_ids": dict(
         ins=lambda r: {"Ids": np.array([0, 3, 5, 6, 9], dtype="int64")},
         attrs={"num_shards": 2},
@@ -742,6 +750,14 @@ SPECS.update({
 })
 
 # -- optimizers --------------------------------------------------------------
+
+
+def _cache_write_ref(cache, new, pos, axis):
+    out = cache.copy()
+    sl = [slice(None)] * cache.ndim
+    sl[axis] = slice(pos, pos + 1)
+    out[tuple(sl)] = new
+    return out
 
 
 def _mean_iou_ref(pred, label, n):

@@ -177,8 +177,13 @@ def _free_port():
 
 
 def _spawn_child(args, extra_env, extra_args=()):
-    """Re-exec this CLI as a child process on the virtual CPU platform."""
-    env = dict(os.environ)
+    """Re-exec this CLI as a child process on the virtual CPU platform.
+    Output goes to temp FILES, not pipes: the parent polls without
+    draining, and a pipe-buffered child (~64 KB of XLA/absl log spew)
+    would deadlock in write() and read as a hang."""
+    import tempfile
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PADDLE_")}   # no stale world config leaks
     env["PTPU_BENCH_CPU_BOOT"] = "1"
     env["JAX_PLATFORMS"] = "cpu"
     env.update(extra_env)
@@ -190,8 +195,27 @@ def _spawn_child(args, extra_env, extra_args=()):
             "--optimizer", args.optimizer] + list(extra_args)
     if args.no_bf16:
         argv.append("--no_bf16")
-    return subprocess.Popen(argv, stdout=subprocess.PIPE,
-                            stderr=subprocess.PIPE, text=True, env=env)
+    out_f = tempfile.TemporaryFile(mode="w+", prefix="ptpu_bench_out_")
+    err_f = tempfile.TemporaryFile(mode="w+", prefix="ptpu_bench_err_")
+    p = subprocess.Popen(argv, stdout=out_f, stderr=err_f, text=True,
+                         env=env)
+    p._ptpu_out, p._ptpu_err = out_f, err_f
+    return p
+
+
+def _child_output(p):
+    out = err = ""
+    for attr, var in (("_ptpu_out", "out"), ("_ptpu_err", "err")):
+        f = getattr(p, attr, None)
+        if f is not None:
+            f.seek(0)
+            text = f.read()
+            f.close()
+            if var == "out":
+                out = text
+            else:
+                err = text
+    return out, err
 
 
 def _drive_multiproc(args):
@@ -220,12 +244,27 @@ def _drive_multiproc(args):
         procs.append(_spawn_child(args, extra, worker_args))
     ranks = {}
     try:
-        for p in procs:
-            out, err = p.communicate(timeout=900)
-            if p.returncode != 0:
-                raise RuntimeError(f"worker failed:\n{err[-3000:]}")
-            rec = json.loads(out.strip().splitlines()[-1])
-            ranks[rec.get("rank", 0)] = rec
+        # poll ALL ranks: a crashed rank must surface ITS stderr
+        # immediately, not after a sibling's 900 s collective hang
+        deadline = time.time() + 900
+        pending = list(procs)
+        while pending:
+            for p in list(pending):
+                if p.poll() is not None:
+                    out, err = _child_output(p)
+                    if p.returncode != 0:
+                        raise RuntimeError(
+                            f"worker failed (rc={p.returncode}):\n"
+                            f"{err[-3000:]}")
+                    rec = json.loads(out.strip().splitlines()[-1])
+                    ranks[rec.get("rank", 0)] = rec
+                    pending.remove(p)
+            if pending:
+                if time.time() > deadline:
+                    raise RuntimeError(
+                        f"{len(pending)} worker(s) still running at the "
+                        f"900 s deadline")
+                time.sleep(0.5)
     finally:
         # one failed/hung rank must not orphan siblings blocked in a
         # collective that will never complete
@@ -237,7 +276,8 @@ def _drive_multiproc(args):
         "XLA_FLAGS":
             f"--xla_force_host_platform_device_count={total_dev}",
     }, ["--update_method", "collective"])
-    out, err = base.communicate(timeout=900)
+    base.wait(timeout=900)
+    out, err = _child_output(base)
     if base.returncode != 0:
         raise RuntimeError(f"baseline failed:\n{err[-3000:]}")
     baseline = json.loads(out.strip().splitlines()[-1])

@@ -37,24 +37,10 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from probe_common import (V5E_HBM_BPS, V5E_PEAK_TFLOPS,  # noqa: E402
-                          measure_step)
+                          hlo_shape_bytes as _shape_bytes, measure_step)
 
-_IT = {"bf16": 2, "f16": 2, "f32": 4, "s32": 4, "u32": 4, "s8": 1,
-       "u8": 1, "pred": 1, "s64": 8, "u64": 8}
 _SKIP = {"get-tuple-element", "bitcast", "parameter", "tuple", "constant",
          "after-all", "copy-start", "async-start"}
-
-
-def _shape_bytes(sh):
-    total = 0
-    for m in re.finditer(r"(bf16|f32|f16|s32|u32|s8|u8|pred|s64|u64)"
-                         r"\[([0-9,]*)\]", sh):
-        n = 1
-        for d in m.group(2).split(","):
-            if d:
-                n *= int(d)
-        total += n * _IT[m.group(1)]
-    return total
 
 
 def entry_census(hlo: str):

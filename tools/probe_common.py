@@ -13,6 +13,25 @@ import numpy as np
 V5E_PEAK_TFLOPS = 197e12
 V5E_HBM_BPS = 819e9
 
+# dtype byte widths for parsing XLA shape strings — the ONE copy shared by
+# the probes (probe_caps) and the comm-structure tests
+HLO_ITEM_BYTES = {"bf16": 2, "f16": 2, "f32": 4, "s32": 4, "u32": 4,
+                  "s8": 1, "u8": 1, "pred": 1, "s64": 8, "u64": 8}
+
+
+def hlo_shape_bytes(sh: str) -> int:
+    """Total bytes of every typed array in one HLO shape string."""
+    import re
+    total = 0
+    for m in re.finditer(r"(bf16|f32|f16|s32|u32|s8|u8|pred|s64|u64)"
+                         r"\[([0-9,]*)\]", sh):
+        n = 1
+        for d in m.group(2).split(","):
+            if d:
+                n *= int(d)
+        total += n * HLO_ITEM_BYTES[m.group(1)]
+    return total
+
 
 def measure_step(build: Callable[[], Tuple], make_feed: Callable[[], Dict],
                  iters: int = 15, windows: int = 3, hlo_path: str = None):

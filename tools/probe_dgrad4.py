@@ -1,0 +1,117 @@
+"""Mixed-emitter 1x1 conv backward: the decisive dgrad experiment.
+
+probe_dgrad2 (interleaved per-dispatch A/B — absolute times carry tunnel
+dispatch overhead but it lands symmetrically on both sides) showed:
+  - ISOLATED 1x1 dgrad: the dot_general formulation beats the conv
+    emitter 1.33x and reads fewer cost-model bytes (1189 vs 1541 MB —
+    the conv emitter pads 64 channels to 128 lanes);
+  - the full vjp (fwd+dgrad+wgrad): all-conv beats all-dot 1.24x, because
+    the wgrad-as-matmul is a [Ci, B*H*W] x [B*H*W, Co] huge-K skinny
+    GEMM the matmul emitter handles worse than the conv emitter.
+
+So the open question is the MIXED split: conv fwd + dot dgrad + conv
+wgrad via custom_vjp — each half routed to the emitter that won its
+isolated probe. This file measures exactly that pair, interleaved.
+
+    env PYTHONPATH=/root/.axon_site:/root/repo python tools/probe_dgrad4.py
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DN = ("NHWC", "HWIO", "NHWC")
+B, HW, Ci, Co = 256, 56, 256, 64
+
+
+def conv_fwd(x, w):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding="SAME", dimension_numbers=DN)
+
+
+@jax.custom_vjp
+def conv1x1_mixed(x, w):
+    return conv_fwd(x, w)
+
+
+def _mixed_fwd(x, w):
+    return conv_fwd(x, w), (x, w)
+
+
+def _mixed_bwd(res, dy):
+    x, w = res
+    # dgrad as one dot_general (a 1x1 conv IS a matmul)
+    dy2 = dy.reshape(-1, Co)
+    dx = jax.lax.dot_general(
+        dy2, w.reshape(Ci, Co), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dy.dtype)
+    dx = dx.reshape(B, HW, HW, Ci)
+    # wgrad through the conv emitter (its win in probe_dgrad2)
+    _, vjp = jax.vjp(lambda w_: conv_fwd(x, w_), w)
+    dw = vjp(dy)[0]
+    return dx, dw
+
+
+conv1x1_mixed.defvjp(_mixed_fwd, _mixed_bwd)
+
+
+def _make_runner(fn, x, w, dy, reps=20):
+    def loss(x, w):
+        return jnp.sum(fn(x, w).astype(jnp.float32) * dy)
+
+    g = jax.jit(jax.value_and_grad(loss, argnums=(0, 1)))
+    out = g(x, w)
+    float(np.asarray(out[1][0][(0,) * 4]))   # compile + drain
+
+    def run():
+        t0 = time.time()
+        o = None
+        for _ in range(reps):
+            o = g(x, w)
+        float(np.asarray(o[1][0][(0,) * 4]))  # trusted barrier
+        return (time.time() - t0) / reps
+    return run
+
+
+def main():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(B, HW, HW, Ci).astype("float32"), jnp.bfloat16)
+    w = jnp.asarray(rng.rand(1, 1, Ci, Co).astype("float32"), jnp.bfloat16)
+    dy = jnp.asarray(rng.rand(B, HW, HW, Co).astype("float32"),
+                     jnp.float32)
+
+    run_conv = _make_runner(lambda x, w: conv_fwd(x, w), x, w, dy)
+    run_mixed = _make_runner(conv1x1_mixed, x, w, dy)
+
+    # parity first
+    g1 = jax.grad(lambda x_: jnp.sum(conv_fwd(x_, w).astype(jnp.float32)
+                                     * dy))(x)
+    g2 = jax.grad(lambda x_: jnp.sum(conv1x1_mixed(x_, w)
+                                     .astype(jnp.float32) * dy))(x)
+    np.testing.assert_allclose(np.asarray(g1, np.float32),
+                               np.asarray(g2, np.float32),
+                               rtol=2e-2, atol=2e-1)
+
+    best = {"vjp_conv": None, "vjp_mixed": None}
+    for _ in range(4):
+        for name, run in (("vjp_conv", run_conv), ("vjp_mixed", run_mixed)):
+            dt = run()
+            best[name] = dt if best[name] is None else min(best[name], dt)
+    ratio = best["vjp_conv"] / best["vjp_mixed"]
+    print(json.dumps({
+        "exp": "mixed_emitter_1x1_vjp",
+        "vjp_conv_ms": round(best["vjp_conv"] * 1e3, 3),
+        "vjp_mixed_ms": round(best["vjp_mixed"] * 1e3, 3),
+        "mixed_speedup_over_conv": round(ratio, 3),
+        "note": "interleaved per-dispatch best-of-4; dispatch overhead "
+                "symmetric on both sides",
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

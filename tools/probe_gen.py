@@ -30,7 +30,7 @@ V5E_HBM_BPS = 819e9
 VOCAB, D, DI, NH, NL = 32000, 512, 2048, 8, 6
 
 
-def _param_bytes(beam_cache_dtype=2):
+def _param_bytes():
     """bf16 bytes of every weight the decode step streams: 6 layers of
     (qkvo projs + 2 ffn mats + 2 LN) + tok_emb row gather + lm_head."""
     per_layer = 4 * D * D + D * DI + DI * D + 4 * D
@@ -69,7 +69,6 @@ def measure(batch, gen_len, beam, iters=3):
 
     ca = exe.cost_analysis(feed=feed, fetch_list=[seqs]) or {}
     total_bytes = float(ca.get("bytes accessed", 0.0))
-    total_flops = float(ca.get("flops", 0.0))
 
     best = None
     for _ in range(3):
@@ -86,30 +85,29 @@ def measure(batch, gen_len, beam, iters=3):
     read_attn, write_onehot, cache1 = _cache_traffic_per_step(
         batch, beam, gen_len)
     structural = p_bytes + read_attn + 2 * NL * cache1 / gen_len  # DUS write
-    current_form = p_bytes + read_attn + write_onehot
 
-    bound_xla = batch / (xla_step_bytes / V5E_HBM_BPS)
     bound_structural = batch / (structural / V5E_HBM_BPS)
     rec = {
         "config": f"lm6l_512d_bs{batch}_gen{gen_len}_beam{beam}",
         "tokens_per_sec": round(tokens_per_sec, 1),
         "ms_per_step": round(best / gen_len * 1e3, 3),
-        "xla_bytes_per_step_MB": round(xla_step_bytes / 1e6, 1),
+        # diagnostic only: XLA's cost model underreports while-loop bodies
+        # (~1/loop-count of the real traffic), so no bound is derived
+        # from it
+        "xla_bytes_per_step_MB_diagnostic": round(xla_step_bytes / 1e6, 1),
         "model_bytes_per_step_MB": {
             "params_bf16": round(p_bytes / 1e6, 1),
             "kv_attention_read": round(read_attn / 1e6, 1),
-            "kv_onehot_write_readwrite": round(write_onehot / 1e6, 1),
+            "kv_onehot_write_readwrite_legacy": round(write_onehot / 1e6,
+                                                      1),
             "structural_floor_dus_write": round(structural / 1e6, 1),
-            "current_formulation": round(current_form / 1e6, 1),
         },
-        "decode_bound_tokens_per_sec_xla_bytes": round(bound_xla, 1),
-        "fraction_of_decode_bound": round(tokens_per_sec / bound_xla, 3),
-        "decode_bound_tokens_per_sec_structural": round(bound_structural,
-                                                        1),
-        "fraction_of_structural_bound": round(
+        # THE committed metric: achieved fraction of the HBM-bandwidth
+        # decode bound at the structural byte model (params + one cache
+        # read + one row write per step)
+        "decode_bound_tokens_per_sec": round(bound_structural, 1),
+        "fraction_of_decode_bound": round(
             tokens_per_sec / bound_structural, 3),
-        "flops_per_token_G": round(
-            total_flops / (batch * gen_len) / 1e9, 2) if total_flops else 0,
     }
     print(json.dumps(rec), flush=True)
     return rec

@@ -109,7 +109,7 @@ define_bool("conv1x1_mixed_vjp", False,
             "Lower the backward of 1x1 stride-1 NHWC convs with a "
             "mixed-emitter custom_vjp (dgrad as one dot_general, wgrad "
             "on the conv emitter). Wins 1.52x on the ISOLATED fwd+bwd "
-            "unit but LOSES 1.46x inside the full flagship step (+30 GB "
+            "unit but LOSES 1.43x inside the full flagship step (+30 GB "
             "traffic: the [BHW,C] reshapes force layout copies of every "
             "1x1 activation and break BN-backward fusion) - default OFF; "
             "kept as the committed falsification probe "

@@ -98,7 +98,7 @@ def _conv1x1_mixed(x, w, dn):
     the conv emitter 1.33x on it and skips its 64->128 lane padding),
     wgrad stays on the conv emitter (which wins the huge-K skinny GEMM).
     Measured 1.52x on the ISOLATED fwd+bwd unit of the flagship's
-    worst-traffic conv shape — but 1.46x SLOWER inside the full train
+    worst-traffic conv shape — but 1.43x SLOWER inside the full train
     step (+30 GB cost-model traffic): the [BHW,C] reshapes materialize
     layout copies of every 1x1 activation and the custom_vjp boundary
     breaks the BN-backward fusions the conv path enjoys. Default OFF

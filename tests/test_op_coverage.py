@@ -371,7 +371,11 @@ SPECS.update({
         grad=["W"]),
     "lookup_sparse_table": dict(
         ins=lambda r: {"W": _away(r, (8, 4)),
-                       "Ids": np.array([1, 3, 7], dtype="int64")},
+                       "Ids": np.array([1, 3, -1, 7], dtype="int64")},
+        # padded (-1) ids yield zero rows (≙ the auto-grown init value)
+        ref=lambda i, a: {"Out": np.concatenate([
+            i["W"][0][[1, 3]], np.zeros((1, 4), "float32"),
+            i["W"][0][[7]]])},
         grad=[]),
     "cache_write": dict(
         ins=lambda r: {"Cache": _away(r, (2, 3, 6, 4)),
@@ -528,6 +532,11 @@ SPECS.update({
         ins=lambda r: {"X": _away(r, (2, 3, 6, 6))},
         attrs={"kernels": [2, 2], "strides": [2, 2],
                "paddings": [0, 0, 0, 0]},
+        # each output row = one 2x2 patch, channel-major, in row-major
+        # patch order (≙ im2sequence_op.h Im2ColFunctor layout)
+        ref=lambda i, a: {"Out": np.stack([
+            i["X"][0][b, :, 2*ph:2*ph+2, 2*pw:2*pw+2].reshape(-1)
+            for b in range(2) for ph in range(3) for pw in range(3)])},
         grad=[]),
     "spp": dict(
         ins=lambda r: {"X": _away(r, (2, 3, 4, 4))},
@@ -750,6 +759,50 @@ SPECS.update({
 })
 
 # -- optimizers --------------------------------------------------------------
+
+
+def _gather_tree_ref(ids, parents):
+    B, T, K = ids.shape
+    out = np.zeros_like(ids)
+    for b in range(B):
+        for k in range(K):
+            beam = k
+            for t in range(T - 1, -1, -1):
+                out[b, t, k] = ids[b, t, beam]
+                beam = parents[b, t, beam]
+    return out
+
+
+def _box_encode_ref(prior, target):
+    def cs(b):
+        w = b[:, 2] - b[:, 0]
+        h = b[:, 3] - b[:, 1]
+        return b[:, 0] + w / 2, b[:, 1] + h / 2, w, h
+    pcx, pcy, pw, ph = cs(prior)
+    tcx, tcy, tw, th = cs(target)
+    dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+    dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+    dw = np.log(np.maximum(tw[:, None] / pw[None, :], 1e-10))
+    dh = np.log(np.maximum(th[:, None] / ph[None, :], 1e-10))
+    return np.stack([dx, dy, dw, dh], -1).astype("float32")
+
+
+def _precision_recall_ref(indices, labels, n):
+    tp = np.zeros(n); fp = np.zeros(n); fn = np.zeros(n)
+    for i, l in zip(indices, labels):
+        if i == l:
+            tp[l] += 1
+        else:
+            fp[i] += 1
+            fn[l] += 1
+    prec = tp / np.maximum(tp + fp, 1e-12)
+    rec = tp / np.maximum(tp + fn, 1e-12)
+    f1 = 2 * prec * rec / np.maximum(prec + rec, 1e-12)
+    mp = tp.sum() / max((tp + fp).sum(), 1e-12)
+    mr = tp.sum() / max((tp + fn).sum(), 1e-12)
+    mf = 2 * mp * mr / max(mp + mr, 1e-12)
+    return np.array([prec.mean(), rec.mean(), f1.mean(), mp, mr, mf],
+                    "float32")
 
 
 def _cache_write_ref(cache, new, pos, axis):
@@ -1013,6 +1066,12 @@ SPECS.update({
     "fake_quantize_abs_max": dict(
         ins=lambda r: {"X": _away(r, (4, 6))},
         attrs={"bit_length": 8},
+        # quantize-dequantize to the int8 grid at the abs-max scale
+        ref=lambda i, a: (lambda s: {
+            "Out": (np.round(i["X"][0] * (127 / s)) / (127 / s)
+                    ).astype("float32"),
+            "OutScale": np.float32(s)})(np.abs(i["X"][0]).max()),
+        atol=1e-6, rtol=1e-5,
         grad=[]),
     "fake_dequantize_max_abs": dict(
         ins=lambda r: {"X": _ints(r, (4, 6), 127).astype("float32"),
@@ -1060,6 +1119,9 @@ SPECS.update({
                        "Indices": _ints(r, (6, 1), 3),
                        "Labels": _ints(r, (6, 1), 3)},
         attrs={"class_number": 3},
+        ref=lambda i, a: {"BatchMetrics": _precision_recall_ref(
+            i["Indices"][0].reshape(-1), i["Labels"][0].reshape(-1), 3)},
+        atol=1e-5, rtol=1e-4,
         grad=[]),
     "mean_iou": dict(
         ins=lambda r: {"Predictions": _ints(r, (10,), 3),
@@ -1110,6 +1172,8 @@ SPECS.update({
     "gather_tree": dict(
         ins=lambda r: {"Ids": _ints(r, (3, 2, 4), 5),
                        "Parents": _ints(r, (3, 2, 4), 4)},
+        ref=lambda i, a: {"Out": _gather_tree_ref(i["Ids"][0],
+                                                  i["Parents"][0])},
         grad=[]),
     "beam_search": dict(
         ins=lambda r: {"PreIds": _ints(r, (2, 2), 5),
@@ -1152,6 +1216,9 @@ SPECS.update({
         ins=lambda r: {"PriorBox": _boxes(r, 4),
                        "TargetBox": _boxes(r, 4)},
         attrs={"code_type": "encode_center_size"},
+        ref=lambda i, a: {"OutputBox": _box_encode_ref(
+            i["PriorBox"][0], i["TargetBox"][0])},
+        atol=1e-4, rtol=1e-4,
         grad=[], out_slot="OutputBox"),
     "prior_box": dict(
         ins=lambda r: {"Input": _away(r, (1, 3, 4, 4)),
@@ -1361,9 +1428,9 @@ def test_op(op):
 def test_registry_fully_accounted():
     """Every registered op is directly checked here, checked by a named
     dedicated test, or excluded with a reason — the directly-checked count
-    beats the VERDICT r4 target of 190, and so does the stricter count of
-    specs carrying a VALUE assertion (numpy ref, numeric-grad check, or
-    property check), not just a finite-smoke run."""
+    beats the VERDICT r4 target of 190, and the stricter count of specs
+    carrying a VALUE assertion (numpy ref, numeric-grad check, or
+    property check — not just a finite-smoke run) beats 195."""
     ops = set(_registered())
     spec_ops = set(SPECS)
     unknown_specs = spec_ops - ops
@@ -1382,4 +1449,4 @@ def test_registry_fully_accounted():
           f"+ {len(set(EXCLUDED) & ops)} excluded "
           f"of {len(ops)} registered")
     assert len(spec_ops & ops) >= 190
-    assert len(strong) >= 190, len(strong)
+    assert len(strong) >= 195, len(strong)

@@ -395,11 +395,22 @@ SPECS.update({
             "Count": np.array([2, 3], "int32")},
         grad=[]),
     "merge_ids": dict(
-        ins=lambda r: {"Ids": [np.array([0, 2], dtype="int64"),
-                               np.array([1, 3], dtype="int64")],
-                       "Rows": [np.array([0, 2], dtype="int64"),
-                                np.array([1, 3], dtype="int64")],
-                       "X": [_away(r, (2, 3)), _away(r, (2, 3))]},
+        # inverse of split_ids: Ids = the ORIGINAL query, X = per-shard
+        # padded id tensors, Rows = per-shard looked-up row values; Out
+        # restores original order (≙ merge_ids_op.h)
+        ins=lambda r: {"Ids": np.array([0, 3, 5, 6, 9], "int64"),
+                       "X": [np.array([0, 6, -1, -1, -1], "int64"),
+                             np.array([3, 5, 9, -1, -1], "int64")],
+                       "Rows": [np.arange(15, dtype="float32"
+                                          ).reshape(5, 3),
+                                np.arange(100, 115, dtype="float32"
+                                          ).reshape(5, 3)]},
+        ref=lambda i, a: {"Out": np.stack([
+            i["Rows"][0][0],       # id 0 -> shard0 row 0
+            i["Rows"][1][0],       # id 3 -> shard1 row 0
+            i["Rows"][1][1],       # id 5 -> shard1 row 1
+            i["Rows"][0][1],       # id 6 -> shard0 row 1
+            i["Rows"][1][2]])},    # id 9 -> shard1 row 2
         grad=[]),
 })
 
@@ -1220,6 +1231,17 @@ SPECS.update({
             i["PriorBox"][0], i["TargetBox"][0])},
         atol=1e-4, rtol=1e-4,
         grad=[], out_slot="OutputBox"),
+    "anchor_generator": dict(
+        ins=lambda r: {"Input": _away(r, (1, 3, 2, 2))},
+        attrs={"anchor_sizes": [64.0], "aspect_ratios": [1.0],
+               "stride": [16.0, 16.0], "offset": 0.5},
+        # one size x one ratio at stride 16: base 16x16 anchor scaled by
+        # 64/16 -> 64x64 box centered at ((i+.5)*16, (j+.5)*16)
+        ref=lambda i, a: {"Anchors": np.stack([np.stack([np.array(
+            [(fx + 0.5) * 16 - 32, (fy + 0.5) * 16 - 32,
+             (fx + 0.5) * 16 + 32, (fy + 0.5) * 16 + 32], "float32")
+            for fx in range(2)]) for fy in range(2)])[:, :, None, :]},
+        grad=[]),
     "prior_box": dict(
         ins=lambda r: {"Input": _away(r, (1, 3, 4, 4)),
                        "Image": _away(r, (1, 3, 32, 32))},
@@ -1234,11 +1256,6 @@ SPECS.update({
                        "Image": _away(r, (1, 3, 32, 32))},
         attrs={"fixed_sizes": [4.0], "fixed_ratios": [1.0],
                "densities": [2]},
-        grad=[]),
-    "anchor_generator": dict(
-        ins=lambda r: {"Input": _away(r, (1, 3, 4, 4))},
-        attrs={"anchor_sizes": [32.0], "aspect_ratios": [1.0],
-               "stride": [8.0, 8.0]},
         grad=[]),
     "bipartite_match": dict(
         ins=lambda r: {"DistMat": r.rand(4, 3).astype("float32")},

@@ -772,6 +772,68 @@ SPECS.update({
 # -- optimizers --------------------------------------------------------------
 
 
+def _roi_pool_ref(x, rois, ph, pw, scale):
+    """Quantized-bin ROI max pool (roi_pool_op.cc)."""
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    out = np.zeros((R, C, ph, pw), x.dtype)
+    for r in range(R):
+        b = int(rois[r, 0])
+        x1, y1, x2, y2 = [np.round(v * scale) for v in rois[r, 1:]]
+        rw = max(x2 - x1 + 1, 1.0)
+        rh = max(y2 - y1 + 1, 1.0)
+        for i in range(ph):
+            hs = int(np.clip(np.floor(i * rh / ph) + y1, 0, H))
+            he = int(np.clip(np.ceil((i + 1) * rh / ph) + y1, 0, H))
+            for j in range(pw):
+                ws = int(np.clip(np.floor(j * rw / pw) + x1, 0, W))
+                we = int(np.clip(np.ceil((j + 1) * rw / pw) + x1, 0, W))
+                if he > hs and we > ws:
+                    out[r, :, i, j] = x[b, :, hs:he, ws:we].max((1, 2))
+    return out
+
+
+def _viterbi_ref(emission, transition, lengths):
+    """Plain-numpy Viterbi per row (reference crf_decoding_op.h semantics:
+    transition row 0 = start, row 1 = end, rows 2.. = [D, D])."""
+    B, T, D = emission.shape
+    start_w, end_w, trans = transition[0], transition[1], transition[2:]
+    out = np.zeros((B, T), "int64")
+    for b in range(B):
+        L = int(lengths[b])
+        v = start_w + emission[b, 0]
+        bps = []
+        for t in range(1, L):
+            scores = v[:, None] + trans
+            bps.append(scores.argmax(0))
+            v = scores.max(0) + emission[b, t]
+        tag = int((v + end_w).argmax())
+        path = [tag]
+        for bp in reversed(bps):
+            tag = int(bp[tag])
+            path.append(tag)
+        out[b, :L] = path[::-1]
+    return out
+
+
+def _bipartite_ref(dist):
+    """Greedy global bipartite matching (bipartite_match_op.cc): pick the
+    best unused (row, col) pair repeatedly while positive."""
+    N, M = dist.shape
+    d = dist.copy()
+    midx = np.full(M, -1, "int32")
+    mdist = np.zeros(M, "float32")
+    for _ in range(min(N, M)):
+        r, c = np.unravel_index(d.argmax(), d.shape)
+        if d[r, c] <= 0:
+            break
+        midx[c] = r
+        mdist[c] = d[r, c]
+        d[r, :] = -1e30
+        d[:, c] = -1e30
+    return midx, mdist
+
+
 def _gather_tree_ref(ids, parents):
     B, T, K = ids.shape
     out = np.zeros_like(ids)
@@ -1172,7 +1234,9 @@ SPECS.update({
         ins=lambda r: {"Emission": _away(r, (2, 4, 3)) * 0.3,
                        "Transition": _away(r, (5, 3)) * 0.3,
                        "Length": np.array([4, 3], "int64")},
-        grad=[]),
+        ref=lambda i, a: {"ViterbiPath": _viterbi_ref(
+            i["Emission"][0], i["Transition"][0], i["Length"][0])},
+        grad=[], out_slot="ViterbiPath"),
     "warpctc": dict(
         ins=lambda r: {"Logits": _away(r, (2, 5, 4)) * 0.3,
                        "Label": _ints(r, (2, 2), 3) + 1,
@@ -1259,6 +1323,9 @@ SPECS.update({
         grad=[]),
     "bipartite_match": dict(
         ins=lambda r: {"DistMat": r.rand(4, 3).astype("float32")},
+        ref=lambda i, a: dict(zip(
+            ("ColToRowMatchIndices", "ColToRowMatchDist"),
+            _bipartite_ref(i["DistMat"][0]))),
         grad=[]),
     "target_assign": dict(
         ins=lambda r: {"X": _away(r, (1, 4, 3)),
@@ -1278,6 +1345,8 @@ SPECS.update({
                                          [0, 2, 2, 6, 6]], "float32")},
         attrs={"pooled_height": 2, "pooled_width": 2,
                "spatial_scale": 1.0},
+        ref=lambda i, a: {"Out": _roi_pool_ref(
+            i["X"][0], i["ROIs"][0], 2, 2, 1.0)},
         grad=[]),
     "ssd_loss": dict(
         ins=lambda r: {"Location": _away(r, (1, 4, 4)) * 0.2,

@@ -772,6 +772,27 @@ SPECS.update({
 # -- optimizers --------------------------------------------------------------
 
 
+def _density_prior_ref(fh, fw, ih, iw, size, dens):
+    """Grid of size x size priors, dens^2 per cell, normalized + clipped
+    (density_prior_box_op.cc, single size / ratio 1)."""
+    step_w, step_h = iw / fw, ih / fh
+    offs = [((d + 0.5) / dens - 0.5) for d in range(dens)]
+    boxes = np.zeros((fh, fw, dens * dens, 4), "float32")
+    for y in range(fh):
+        for x in range(fw):
+            p = 0
+            for dy in offs:
+                for dx in offs:
+                    cx = (x + 0.5) * step_w + dx * step_w
+                    cy = (y + 0.5) * step_h + dy * step_h
+                    boxes[y, x, p] = [(cx - size / 2) / iw,
+                                      (cy - size / 2) / ih,
+                                      (cx + size / 2) / iw,
+                                      (cy + size / 2) / ih]
+                    p += 1
+    return np.clip(boxes, 0.0, 1.0)
+
+
 def _roi_pool_ref(x, rois, ph, pw, scale):
     """Quantized-bin ROI max pool (roi_pool_op.cc)."""
     N, C, H, W = x.shape
@@ -1158,6 +1179,13 @@ SPECS.update({
                        "InAccum": np.array([1.0], "float32"),
                        "InState": np.array([1.0], "float32")},
         attrs={"bit_length": 8, "moving_rate": 0.9},
+        # scale = EMA(abs-max); quantize-dequantize at the EMA scale
+        ref=lambda i, a: (lambda sc: {
+            "OutScale": np.float32(sc),
+            "Out": (np.round(i["X"][0] * (127 / sc)) / (127 / sc)
+                    ).astype("float32")})(
+            0.9 * 1.5 + 0.1 * np.abs(i["X"][0]).max()),
+        atol=1e-6, rtol=1e-5,
         grad=[]),
     "piecewise_decay": dict(
         ins=lambda r: {"Step": np.array([150], "int64")},
@@ -1320,7 +1348,10 @@ SPECS.update({
                        "Image": _away(r, (1, 3, 32, 32))},
         attrs={"fixed_sizes": [4.0], "fixed_ratios": [1.0],
                "densities": [2]},
-        grad=[]),
+        ref=lambda i, a: {"Boxes": _density_prior_ref(4, 4, 32, 32, 4.0,
+                                                      2)},
+        atol=1e-5, rtol=1e-4,
+        grad=[], out_slot="Boxes"),
     "bipartite_match": dict(
         ins=lambda r: {"DistMat": r.rand(4, 3).astype("float32")},
         ref=lambda i, a: dict(zip(

@@ -117,6 +117,18 @@ define_bool("conv1x1_mixed_vjp", False,
 define_bool("disable_pallas", False,
             "Force XLA-composite lowerings for ops that default to Pallas "
             "kernels on TPU (escape hatch: PTPU_DISABLE_PALLAS=1).")
+define_bool("fuse_recurrent_cells", True,
+            "Executor-compile-time fuse_recurrent_cell_pass: rewrite "
+            "dynamic_lstm/dynamic_gru to the fused whole-sequence cell "
+            "kernels (paddle_tpu/fusion/recurrent.py — one Pallas kernel "
+            "for the entire recurrence on TPU). Numerically equivalent "
+            "fwd+grad; kill switch PTPU_FUSE_RECURRENT_CELLS=0.")
+define_bool("fuse_decode_attention", True,
+            "Executor-compile-time fuse_decode_attention_pass: rewrite the "
+            "cached-decode QK^T->+bias->softmax->V op chain into one "
+            "fused_decode_attention kernel per tick "
+            "(paddle_tpu/fusion/decode_attention.py). Kill switch "
+            "PTPU_FUSE_DECODE_ATTENTION=0.")
 # (num_iteration_per_drop_scope lives on ExecutionStrategy for API parity;
 # the functional executor has no per-iteration kid scopes to drop)
 define_int("sparse_dense_apply_max_bytes", 1 << 30,

@@ -32,6 +32,15 @@ from .program import (BATCH_ROW_MASK_NAME, Program, Variable,
 from .scope import Scope, global_scope
 
 
+def _fusion_flags_key():
+    """The fuse_* flags are inputs to compilation (apply_fusion_passes reads
+    them in _build_step_fn): they must be part of the compile-cache key or
+    toggling a kill switch at runtime would silently keep serving the
+    previously compiled variant."""
+    return (flags.get_flag("fuse_recurrent_cells"),
+            flags.get_flag("fuse_decode_attention"))
+
+
 def _feed_signature(feed: Dict[str, Any]):
     return tuple(sorted((k, tuple(np.shape(v)), str(np.asarray(v).dtype) if not
                          hasattr(v, "dtype") else str(v.dtype))
@@ -113,6 +122,14 @@ class Executor:
                        ro, rw, state_out_names):
         """The pure per-step function both the single-step compile and the
         scan-fused run_steps build on."""
+        # operator fusion (fused recurrent cells / decode attention): a
+        # compile-time rewrite of a CLONE of the program, gated by the
+        # default-on fuse_* flags (kill switch PTPU_FUSE_*=0). The caller's
+        # program and the compile-cache key (original program version) are
+        # untouched — the rewrite is deterministic per version.
+        from .passes import apply_fusion_passes
+        program = apply_fusion_passes(
+            program, protected=set(fetch_names) | set(state_out_names))
         block = program.global_block()
         plan = build_plan(block)
         fetch_names = list(fetch_names)
@@ -260,7 +277,8 @@ class Executor:
         self._validate_fetches(program, feed, fetch_names)
         avail_key = self._scope_avail_key(program, scope)
         key = (id(program), program._version, _feed_signature(feed),
-               tuple(fetch_names), id(scope), avail_key)
+               tuple(fetch_names), id(scope), avail_key,
+               _fusion_flags_key())
         compiled = self._cache.get(key)
         if compiled is None:
             from .. import profiler as _prof
@@ -363,7 +381,8 @@ class Executor:
         self._validate_fetches(program, feed_list[0], fetch_names)
         avail_key = self._scope_avail_key(program, scope)
         key = ("scan", k, id(program), program._version, sig0,
-               tuple(fetch_names), id(scope), avail_key)
+               tuple(fetch_names), id(scope), avail_key,
+               _fusion_flags_key())
         compiled = self._cache.get(key)
         if compiled is None:
             ro, rw, out_only = self._analyze_state(

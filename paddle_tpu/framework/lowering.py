@@ -245,6 +245,11 @@ def run_vjp_region(region_op: Operator, seg_indices: Sequence[int],
                   if policy_name else None)
         fwd = jax.checkpoint(fwd, policy=policy)
 
+    missing = [n for n in dense_names if n not in env]
+    if missing:
+        raise NotFoundError(
+            f"vjp_region differentiates wrt {missing} which are not "
+            f"initialized — run the startup program or feed them")
     dense_vals = tuple(env[n] for n in dense_names)
     loss_val, vjp_fn, aux = jax.vjp(fwd, dense_vals, tuple(perturbs),
                                     has_aux=True)

@@ -139,6 +139,229 @@ class GraphVizPass(Pass):
         return program
 
 
+# ---------------------------------------------------------------------------
+# fusion passes (≙ the reference's fuse passes: framework/ir
+# attention_lstm_fuse_pass.cc, operators/fusion_lstm_op.cc). These rewrite
+# matched op-DAG subgraphs to the fused ops in paddle_tpu/fusion/ — users
+# keep building dynamic_lstm / cached decode attention; the executor applies
+# the passes at compile time behind the default-on fuse_* flags.
+# ---------------------------------------------------------------------------
+
+
+@register_pass("fuse_recurrent_cell_pass")
+class FuseRecurrentCellPass(Pass):
+    """Rewrite `dynamic_lstm` / `dynamic_gru` ops to their fused-cell
+    equivalents (`fused_lstm` / `fused_gru`, paddle_tpu/fusion/recurrent.py)
+    — the whole recurrence becomes ONE Pallas kernel on TPU instead of a
+    per-tick dispatched scan body. Only default-activation instances are
+    fusable; others are left untouched. The rewrite is 1:1 in the op list,
+    so op indices (vjp_region fwd_ops segments) stay valid."""
+
+    allowed_attrs = ()
+
+    _REWRITES = {"dynamic_lstm": "fused_lstm", "dynamic_gru": "fused_gru"}
+
+    def apply(self, program, scope=None):
+        from ..fusion.recurrent import (gru_attrs_fusable,
+                                        lstm_attrs_fusable)
+        fusable = {"dynamic_lstm": lstm_attrs_fusable,
+                   "dynamic_gru": gru_attrs_fusable}
+        n = 0
+        for block in program.blocks:
+            for op in block.ops:
+                target = self._REWRITES.get(op.type)
+                if target is None or not fusable[op.type](op.attrs):
+                    continue
+                op.attrs["fused_from"] = op.type
+                op.type = target
+                n += 1
+        if n:
+            program._bump()
+        return program
+
+
+@register_pass("fuse_decode_attention_pass")
+class FuseDecodeAttentionPass(Pass):
+    """Fuse the cached-decode attention chain
+    matmul(q, K^T, alpha) -> elementwise_add(bias) -> softmax -> matmul(V)
+    (a SINGLE-position query over a KV cache, the `_attend_cached` idiom)
+    into one `fused_decode_attention` op. attrs: protected=[var names that
+    must survive — fetch targets]. Blocks containing a vjp_region are
+    skipped: the region's fwd_ops segments index into the op list, which a
+    multi-op splice would invalidate (decode graphs are inference-only)."""
+
+    allowed_attrs = ("protected",)
+
+    def apply(self, program, scope=None):
+        protected = set(self.attrs.get("protected", ()))
+        # a fused intermediate may not be read anywhere else in the program
+        reads = {}
+        for blk in program.blocks:
+            for op in blk.ops:
+                for name in op.input_names():
+                    reads[name] = reads.get(name, 0) + 1
+        n = 0
+        for block in program.blocks:
+            if any(op.type == "vjp_region" for op in block.ops):
+                continue
+            n += self._rewrite_block(block, reads, protected)
+        if n:
+            program._bump()
+        return program
+
+    @staticmethod
+    def _shape(block, name):
+        try:
+            return block.var(name).shape
+        except NotFoundError:
+            return None
+
+    def _match(self, block, ops, si, producer, reads, protected):
+        """Try to match the 4-op chain whose softmax is ops[si]; returns
+        (match dict) or None."""
+        sm = ops[si]
+        if sm.attrs.get("axis", -1) != -1:
+            return None
+        add = producer.get(sm.inputs.get("X", [None])[0])
+        if add is None or add.type != "elementwise_add" or \
+                add.attrs.get("axis", -1) != -1:
+            return None
+        m1 = producer.get(add.inputs["X"][0])
+        if m1 is None or m1.type != "matmul" or \
+                not m1.attrs.get("transpose_Y") or \
+                m1.attrs.get("transpose_X") or m1.attrs.get("use_bf16"):
+            return None
+        # the single consumer of the softmax must be the context matmul
+        sm_out = sm.outputs["Out"][0]
+        m2 = None
+        for op in ops:
+            if sm_out in op.input_names():
+                if m2 is not None:
+                    return None
+                m2 = op
+        if m2 is None or m2.type != "matmul" or \
+                m2.inputs["X"][0] != sm_out or \
+                m2.attrs.get("transpose_X") or m2.attrs.get("transpose_Y") \
+                or m2.attrs.get("alpha", 1.0) != 1.0 \
+                or m2.attrs.get("use_bf16"):
+            return None
+        q, k = m1.inputs["X"][0], m1.inputs["Y"][0]
+        v = m2.inputs["Y"][0]
+        bias = add.inputs["Y"][0]
+        qs, ks = self._shape(block, q), self._shape(block, k)
+        vs, bs = self._shape(block, v), self._shape(block, bias)
+        if qs is None or ks is None or vs is None or bs is None:
+            return None
+        # single-position query over an equal-layout cache (no beam
+        # broadcast on K/V — that pattern reads better through XLA's own
+        # batched matmul). Rank 3 ([B, 1, H] state over [B, T, H] encoder
+        # outputs — the GRU-attention NMT idiom) fuses too: the batch rows
+        # simply ride the fused kernel's head axis.
+        if len(qs) < 3 or qs[-2] != 1 or len(ks) != len(qs) or \
+                tuple(ks[:-2]) != tuple(qs[:-2]) or tuple(vs) != tuple(ks):
+            return None
+        tgt = tuple(qs[:-2]) + (1, ks[-2])
+        if len(bs) != len(tgt) or any(
+                bd != 1 and bd != td for bd, td in zip(bs, tgt)):
+            return None
+        # intermediates must be pure glue: consumed exactly once, by the
+        # next op in the chain, and not fetched/protected
+        for name, n_reads in ((m1.outputs["Out"][0], 1),
+                              (add.outputs["Out"][0], 1), (sm_out, 1)):
+            if reads.get(name, 0) != n_reads or name in protected:
+                return None
+            var = block.vars.get(name)
+            if var is not None and (var.persistable or var.is_data):
+                return None
+        return {"m1": m1, "add": add, "sm": sm, "m2": m2,
+                "q": q, "k": k, "v": v, "bias": bias,
+                "scale": float(m1.attrs.get("alpha", 1.0))}
+
+    def _rewrite_block(self, block, reads, protected):
+        from .program import Operator
+        ops = block.ops
+        producer = {}
+        for op in ops:
+            for name in op.output_names():
+                producer[name] = op
+        matches = []
+        claimed = set()
+        for si, op in enumerate(ops):
+            if op.type != "softmax":
+                continue
+            m = self._match(block, ops, si, producer, reads, protected)
+            if m is None:
+                continue
+            group = {id(m["m1"]), id(m["add"]), id(m["sm"]), id(m["m2"])}
+            if group & claimed:
+                continue
+            claimed |= group
+            matches.append(m)
+        if not matches:
+            return 0
+        # splice at the LAST op of the chain (m2): every fused input
+        # (q/k/v/bias) is produced before it by construction — the bias
+        # may legitimately be built between the score matmul and the add
+        # (the NMT attention builds it mid-chain)
+        by_anchor = {id(m["m2"]): m for m in matches}
+        drop = set()
+        for m in matches:
+            drop |= {id(m["m1"]), id(m["add"]), id(m["sm"])}
+        new_ops = []
+        for op in ops:
+            m = by_anchor.get(id(op))
+            if m is not None:
+                fused = Operator(
+                    block, "fused_decode_attention",
+                    inputs={"Q": [m["q"]], "K": [m["k"]], "V": [m["v"]],
+                            "Bias": [m["bias"]]},
+                    outputs={"Out": [m["m2"].outputs["Out"][0]]},
+                    attrs={"scale": m["scale"]})
+                new_ops.append(fused)
+                out_name = m["m2"].outputs["Out"][0]
+                if out_name in block.vars:
+                    block.vars[out_name].op = fused
+                for name in (m["m1"].outputs["Out"][0],
+                             m["add"].outputs["Out"][0],
+                             m["sm"].outputs["Out"][0]):
+                    block.vars.pop(name, None)
+                continue
+            if id(op) in drop:
+                continue
+            new_ops.append(op)
+        block.ops = new_ops
+        return len(matches)
+
+
+def apply_fusion_passes(program: Program, protected=()) -> Program:
+    """Executor-compile-time entry: apply the flag-enabled fusion passes to
+    a CLONE of `program` (the caller's program is never mutated). Returns
+    the original program untouched when the flags are off or nothing can
+    match — the common case costs one cheap op-type scan."""
+    from ..core import flags
+    do_rnn = flags.get_flag("fuse_recurrent_cells")
+    do_dec = flags.get_flag("fuse_decode_attention")
+    if not (do_rnn or do_dec):
+        return program
+    has_rnn = has_dec = False
+    for blk in program.blocks:
+        has_vjp = any(op.type == "vjp_region" for op in blk.ops)
+        for op in blk.ops:
+            if op.type in ("dynamic_lstm", "dynamic_gru"):
+                has_rnn = True
+            elif op.type == "softmax" and not has_vjp:
+                has_dec = True
+    if not ((do_rnn and has_rnn) or (do_dec and has_dec)):
+        return program
+    rewritten = program.clone()
+    if do_rnn and has_rnn:
+        get_pass("fuse_recurrent_cell_pass")(rewritten)
+    if do_dec and has_dec:
+        get_pass("fuse_decode_attention_pass",
+                 protected=sorted(protected))(rewritten)
+    return rewritten
+
+
 class Analyzer:
     """Ordered pass manager preparing a trained program for serving
     (≙ inference/analysis/analyzer.h:53 running its pass pipeline over the

@@ -1,0 +1,189 @@
+"""Fused decode-attention step: one KV-cache tick's QK^T·softmax·V in one
+kernel.
+
+≙ reference attention_lstm_fuse_pass.cc's fused attention step — the
+reference fuses the decoder's per-step attention chain into one op; here
+the chain is the cached-decode hot path (`models/transformer.py
+_attend_cached`): matmul(q, K^T, alpha=scale) → +bias → softmax →
+matmul(·, V), four kernels per tick per layer with the [.., 1, T]
+score/weight tensors round-tripping HBM between them. The fused kernel
+reads the cache ONCE and keeps scores/weights in VMEM. The cache WRITE
+side stays on the existing `cache_write` dynamic-update-slice op — this
+kernel only fuses the read side.
+
+The query has exactly one position (the decode tick), so the score matrix
+is [heads, T]: heads ride the sublane axis, cache positions the lane axis,
+and the whole per-(batch·beam) computation is VPU element-wise + lane
+reductions — decode attention is memory-bound, so the win is the single
+pass over the cache, not MXU utilization.
+
+Gradients (decode graphs are inference-only, but the op is registered
+without `stop_gradient` for completeness): `jax.custom_vjp` whose backward
+differentiates the identical XLA composite — exact.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+_NEG_INF = -1e30
+
+
+def _auto_backend():
+    from ..ops.pallas_kernels import _auto_backend as _ab
+    return _ab()
+
+
+def _round_up(n, m):
+    return -(-n // m) * m
+
+
+def _decode_xla(q4, k4, v4, bias3, scale):
+    """Normalized-shape composite: q4 [R, nh, 1, dh], k4/v4 [R, nh, T, dh],
+    bias3 [R, nh, T]. Replicates the unfused op chain's math exactly
+    (matmul in f32 preferred type, alpha after, softmax last-axis)."""
+    s = jnp.matmul(q4, jnp.swapaxes(k4, -1, -2),
+                   preferred_element_type=jnp.float32).astype(q4.dtype)
+    s = s * scale + bias3[:, :, None, :]
+    w = jax.nn.softmax(s, axis=-1)
+    out = jnp.matmul(w, v4, preferred_element_type=jnp.float32)
+    return out.astype(q4.dtype)
+
+
+def _decode_step_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, *, scale):
+    q = q_ref[0].astype(jnp.float32)                 # [nh, 1, dh] -> [nh, dh]
+    q = q[:, 0, :]
+    k = k_ref[0].astype(jnp.float32)                 # [nh, T, dh]
+    v = v_ref[0].astype(jnp.float32)
+    bias = b_ref[0]                                  # [nh, T]
+    s = jnp.sum(q[:, None, :] * k, axis=-1) * scale + bias       # [nh, T]
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    w = p / l
+    o_ref[0] = jnp.sum(w[:, :, None] * v, axis=1)[:, None, :].astype(
+        o_ref.dtype)
+
+
+def _decode_pallas(q4, k4, v4, bias3, scale, interpret):
+    from jax.experimental import pallas as pl
+
+    r, nh, _, dh = q4.shape
+    t = k4.shape[2]
+    nhp = _round_up(nh, 8)
+    tp = _round_up(t, 128)
+
+    def pad(a, axis, target, value=0.0):
+        if a.shape[axis] == target:
+            return a
+        spec = [(0, 0)] * a.ndim
+        spec[axis] = (0, target - a.shape[axis])
+        return jnp.pad(a, spec, constant_values=value)
+
+    qf = pad(q4, 1, nhp)
+    kf = pad(pad(k4, 1, nhp), 2, tp)
+    vf = pad(pad(v4, 1, nhp), 2, tp)
+    # padded cache columns must be dead under softmax
+    bf = pad(pad(bias3, 1, nhp), 2, tp, value=_NEG_INF)
+
+    out = pl.pallas_call(
+        functools.partial(_decode_step_kernel, scale=scale),
+        grid=(r,),
+        in_specs=[
+            pl.BlockSpec((1, nhp, 1, dh), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, nhp, tp, dh), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, nhp, tp, dh), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, nhp, tp), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, nhp, 1, dh), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((r, nhp, 1, dh), q4.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, bf)
+    return out[:, :nh]
+
+
+# per-grid-step VMEM budget for the kernel's K/V/bias blocks: stay well
+# under the ~16 MB/core VMEM so the compiler has room for double buffering
+_VMEM_BUDGET_BYTES = 6 << 20
+
+
+def _pallas_fits(nh, t, dh):
+    """Mosaic-path gate: the K/V/bias blocks must fit the VMEM budget and
+    dh (the lane axis of every block) must be sublane-packable — dh % 8,
+    matching the flash kernels' proven D=64 tiling. Anything else takes
+    the identical XLA composite (same policy as recurrent._pallas_ok)."""
+    nhp = _round_up(nh, 8)
+    tp = _round_up(t, 128)
+    return (dh % 8 == 0
+            and nhp * tp * (2 * dh + 1) * 4 <= _VMEM_BUDGET_BYTES)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _decode_attention(q4, k4, v4, bias3, scale, backend):
+    if backend != "xla" and not _pallas_fits(q4.shape[1], k4.shape[2],
+                                             q4.shape[3]):
+        backend = "xla"   # cache block would blow the VMEM budget
+    if backend == "xla":
+        return _decode_xla(q4, k4, v4, bias3, scale)
+    return _decode_pallas(q4, k4, v4, bias3, scale,
+                          interpret=(backend == "pallas_interpret"))
+
+
+def _decode_attention_fwd(q4, k4, v4, bias3, scale, backend):
+    return (_decode_attention(q4, k4, v4, bias3, scale, backend),
+            (q4, k4, v4, bias3))
+
+
+def _decode_attention_bwd(scale, backend, res, g):
+    q4, k4, v4, bias3 = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_, b_: _decode_xla(q_, k_, v_, b_, scale),
+        q4, k4, v4, bias3)
+    return vjp(g)
+
+
+_decode_attention.defvjp(_decode_attention_fwd, _decode_attention_bwd)
+
+
+def fused_decode_attention(q, k, v, bias, scale=1.0, backend=None):
+    """One decode tick of cached attention in one kernel.
+
+    q [..., nh, 1, dh] (single query position), k/v [..., nh, T, dh]
+    (the KV cache), bias broadcastable to [..., nh, 1, T] (additive mask
+    hiding cache positions beyond the current tick). Returns
+    [..., nh, 1, dh]. Equals matmul(q, k^T)*scale + bias → softmax →
+    matmul(·, v) exactly.
+    """
+    backend = backend or _auto_backend()
+    lead = q.shape[:-3]
+    nh, dh = q.shape[-3], q.shape[-1]
+    t = k.shape[-2]
+    r = 1
+    for d in lead:
+        r *= d
+    q4 = q.reshape((r, nh, 1, dh))
+    k4 = jnp.broadcast_to(k, lead + k.shape[-3:]).reshape((r, nh, t, dh))
+    v4 = jnp.broadcast_to(v, lead + v.shape[-3:]).reshape((r, nh, t, dh))
+    bias3 = jnp.broadcast_to(
+        bias, lead + (nh, 1, t)).reshape((r, nh, t)).astype(jnp.float32)
+    out = _decode_attention(q4, k4, v4, bias3, float(scale), backend)
+    return out.reshape(lead + (nh, 1, dh))
+
+
+@register_op("fused_decode_attention")
+def _fused_decode_attention_op(ctx, ins, attrs):
+    """Fused Q·K^T+bias→softmax→·V over a KV cache for a single-position
+    query (emitted by `fuse_decode_attention_pass` from the 4-op decode
+    chain)."""
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    bias = ins["Bias"][0]
+    backend = attrs.get("backend") or _auto_backend()
+    out = fused_decode_attention(q, k, v, bias,
+                                 scale=attrs.get("scale", 1.0),
+                                 backend=backend)
+    return {"Out": [out]}

@@ -134,6 +134,31 @@ def _pad_constant_like(ctx, ins, attrs):
     return {"Out": [jnp.pad(y, pads, constant_values=attrs.get("pad_value", 0.0))]}
 
 
+def _uniform_pos_guard(pos_flat):
+    """cache_write's contract: ONE scalar position for the whole batch
+    (`Pos.reshape(-1)[0]` is what gets used). A caller feeding per-row
+    positions (ragged prompt lengths) would silently have every row
+    written at row 0's position — enforce instead (ADVICE r5 #3). Host
+    callbacks are a CPU-debug facility (see _nan_guard): the check is
+    active on CPU — where the whole test tier runs — and a no-op on the
+    tunneled TPU backend."""
+    if pos_flat.shape[0] <= 1 or jax.default_backend() != "cpu":
+        return
+    lo = jnp.min(pos_flat)
+    hi = jnp.max(pos_flat)
+
+    def _report(lo_v, hi_v):
+        if int(lo_v) != int(hi_v):
+            raise ValueError(
+                f"cache_write requires a uniform position across rows "
+                f"(contract: Pos is one scalar broadcast to the batch), "
+                f"got per-row positions spanning [{int(lo_v)}, "
+                f"{int(hi_v)}]; write ragged rows via separate "
+                f"cache_write calls or a vmapped update")
+
+    jax.debug.callback(_report, lo, hi)
+
+
 @register_op("cache_write", stop_gradient=True)
 def _cache_write(ctx, ins, attrs):
     """Write `New` (size-1 on `axis`) into `Cache` at scalar position
@@ -142,10 +167,16 @@ def _cache_write(ctx, ins, attrs):
     per-step cache cost is one row write + the attention read, not a full
     read+rewrite of the cache (the one-hot outer-product formulation's
     cost). No reference analogue: the reference's while_op decoder
-    re-runs attention over growing LoD tensors instead of caching."""
+    re-runs attention over growing LoD tensors instead of caching.
+
+    `Pos` must be UNIFORM: a single position (any tensor; every element
+    equal). Non-uniform per-row positions raise on CPU (enforced via host
+    callback — inactive on TPU, where host send/recv is unavailable)."""
     cache = ins["Cache"][0]
     new = ins["New"][0].astype(cache.dtype)
-    pos = ins["Pos"][0].reshape(-1)[0].astype(jnp.int32)
+    pos_flat = ins["Pos"][0].reshape(-1)
+    _uniform_pos_guard(pos_flat)
+    pos = pos_flat[0].astype(jnp.int32)
     axis = attrs["axis"] % cache.ndim
     starts = [jnp.int32(0)] * cache.ndim
     starts[axis] = pos

@@ -14,10 +14,9 @@ import functools
 from typing import Callable, Sequence
 
 import jax
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from .mesh import DeviceMesh
+from .mesh import DeviceMesh, shard_map
 
 
 def all_reduce(x, axis_name: str):

@@ -16,12 +16,11 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 from jax.tree_util import tree_map
 
 from .collective import ring_perm
-from .mesh import PIPELINE_AXIS, DeviceMesh
+from .mesh import PIPELINE_AXIS, DeviceMesh, shard_map
 
 
 def _pipeline_body(stage_fn: Callable, axis_name: str):
@@ -41,9 +40,11 @@ def _pipeline_body(stage_fn: Callable, axis_name: str):
         y = jnp.zeros(x.shape, x.dtype)               # outputs (last stage)
         # the scan carry is device-varying (each stage holds different
         # activations) — mark the initial zeros as such for shard_map's
-        # varying-axis type system
-        state = jax.lax.pvary(state, (axis_name,))
-        y = jax.lax.pvary(y, (axis_name,))
+        # varying-axis type system (jax < 0.6 has no pvary and no vma
+        # tracking either, so nothing needs marking there)
+        if hasattr(jax.lax, "pvary"):
+            state = jax.lax.pvary(state, (axis_name,))
+            y = jax.lax.pvary(y, (axis_name,))
 
         def tick(carry, t):
             state, y = carry

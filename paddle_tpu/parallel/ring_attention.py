@@ -33,10 +33,10 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from .mesh import DATA_AXIS, SEQUENCE_AXIS, DeviceMesh
+from .mesh import (DATA_AXIS, SEQUENCE_AXIS, DeviceMesh,  # noqa: F401
+                   shard_map)
 
 _NEG_INF = -1e30
 
@@ -147,7 +147,9 @@ def _as_varying_as(x, *refs):
     axes = set()
     for r in refs:
         axes |= set(getattr(r.aval, "vma", ()) or ())
-    if not axes:
+    if not axes or not hasattr(jax.lax, "pcast"):
+        # jax < 0.7 has no vma tracking (avals carry no .vma, so `axes` is
+        # empty there anyway) — nothing to mark
         return x
     return jax.lax.pcast(x, tuple(sorted(axes)), to="varying")
 
@@ -429,16 +431,23 @@ def ring_attention_live_blocks(mesh: DeviceMesh, q, k, v, *, causal=False,
         args.append(segment_ids)
     backend = _resolve_backend(backend)
 
+    # sum over the axes the computation is actually SHARDED on (batch over
+    # DATA, sequence over SEQUENCE): with a dp-sharded batch and
+    # heterogeneous packing, different data shards skip different numbers
+    # of steps — a SEQUENCE_AXIS-only psum would report one data shard's
+    # count as the mesh total. But axes the body is REPLICATED over (e.g.
+    # a tensor-parallel axis absent from in_specs) must NOT be summed:
+    # each replica holds the identical count, and summing replicas would
+    # inflate the diagnostic by the replication factor (ADVICE r5 #1).
+    shard_axes = tuple(a for a in (DATA_AXIS, SEQUENCE_AXIS)
+                       if a in mesh.axes)
+
     def body(*xs):
         seg = xs[3] if len(xs) > 3 else None
         out, live = ring_attention(
             xs[0], xs[1], xs[2], causal=causal, scale=scale,
             segment_ids=seg, backend=backend, with_stats=True)
-        # sum over EVERY mesh axis: with a dp-sharded batch and
-        # heterogeneous packing, different data shards skip different
-        # numbers of steps — a SEQUENCE_AXIS-only psum would report one
-        # data shard's count as the mesh total
-        return out, jax.lax.psum(live, tuple(mesh.axes.keys()))
+        return out, jax.lax.psum(live, shard_axes)
 
     f = shard_map(body, mesh=mesh.jax_mesh, in_specs=tuple(specs),
                   out_specs=(in_spec, mesh.pspec()),

@@ -17,10 +17,9 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from .mesh import MODEL_AXIS, DeviceMesh
+from .mesh import MODEL_AXIS, DeviceMesh, shard_map
 
 
 def sharded_embedding_lookup(mesh: DeviceMesh, table, ids,

@@ -152,6 +152,19 @@ class TestRingFlashParity:
                                              backend="xla")
         assert live == 16 + 4, live
 
+    def test_live_blocks_not_inflated_by_replicated_axes(self, rng):
+        """Regression (ADVICE r5 #1): on a dp×tp×sp mesh the live-block
+        psum must run only over the axes the body is sharded on (dp, sp);
+        summing over the replicated tp axis would double the count."""
+        mesh = make_mesh({"dp": 2, "tp": 2, "sp": 2})
+        n = 2
+        q = jnp.asarray(rng.randn(2, 8 * n, 1, 8).astype("float32"))
+        _, live = ring_attention_live_blocks(mesh, q, q, q, causal=True,
+                                             backend="xla")
+        # per data shard a causal sp=2 ring executes n(n+1)/2 = 3 of 4
+        # steps; dp=2 shards -> 6. The tp=2 replicas must NOT double it.
+        assert live == 2 * (n * (n + 1) // 2), live
+
     def test_ring_packed_segments_pallas(self, rng):
         """Packed segment ids through the flash blocks on the ring."""
         mesh = make_mesh({"sp": 2})

@@ -276,7 +276,19 @@ def _drive_multiproc(args):
         "XLA_FLAGS":
             f"--xla_force_host_platform_device_count={total_dev}",
     }, ["--update_method", "collective"])
-    base.wait(timeout=900)
+    try:
+        base.wait(timeout=900)
+    finally:
+        # mirror the worker cleanup: a hung baseline must not stay
+        # orphaned past the deadline, and its temp output files must be
+        # closed (TemporaryFile unlinks on close) even on the raise path
+        if base.poll() is None:
+            base.kill()
+            base.wait()
+            _child_output(base)  # drain + close -> files reclaimed
+            raise RuntimeError(
+                "single-process baseline still running at the 900 s "
+                "deadline; killed")
     out, err = _child_output(base)
     if base.returncode != 0:
         raise RuntimeError(f"baseline failed:\n{err[-3000:]}")

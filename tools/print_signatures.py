@@ -46,6 +46,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.distributed",
     "paddle_tpu.parallel",
     "paddle_tpu.data",
+    "paddle_tpu.fusion",
 ]
 
 

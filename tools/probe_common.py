@@ -14,22 +14,50 @@ V5E_PEAK_TFLOPS = 197e12
 V5E_HBM_BPS = 819e9
 
 # dtype byte widths for parsing XLA shape strings — the ONE copy shared by
-# the probes (probe_caps) and the comm-structure tests
-HLO_ITEM_BYTES = {"bf16": 2, "f16": 2, "f32": 4, "s32": 4, "u32": 4,
-                  "s8": 1, "u8": 1, "pred": 1, "s64": 8, "u64": 8}
+# the probes (probe_caps) and the comm-structure tests. Covers every XLA
+# scalar type that can appear in a typed shape (ADVICE r5 #4); an
+# unrecognized typed-shape token RAISES instead of silently counting 0
+# bytes (which would let byte-balance assertions pass/fail misleadingly
+# if dtypes drift).
+HLO_ITEM_BYTES = {"pred": 1,
+                  "s2": 1, "u2": 1, "s4": 1, "u4": 1,     # sub-byte types
+                  "s8": 1, "u8": 1, "s16": 2, "u16": 2,   # pack >= 1 byte
+                  "s32": 4, "u32": 4, "s64": 8, "u64": 8,
+                  "f8e4m3": 1, "f8e4m3fn": 1, "f8e4m3b11fnuz": 1,
+                  "f8e4m3fnuz": 1, "f8e5m2": 1, "f8e5m2fnuz": 1,
+                  "f8e3m4": 1, "f8e8m0fnu": 1,
+                  "bf16": 2, "f16": 2, "f32": 4, "f64": 8,
+                  "c64": 8, "c128": 16}
+
+# typed-shape tokens that are legitimately byte-free
+_HLO_ZERO_BYTE_TYPES = frozenset({"token", "opaque"})
 
 
 def hlo_shape_bytes(sh: str) -> int:
-    """Total bytes of every typed array in one HLO shape string."""
+    """Total bytes of every typed array in one HLO shape string (tuple
+    shapes sum their elements). Raises on a typed-shape token whose
+    element type is not in HLO_ITEM_BYTES."""
     import re
     total = 0
-    for m in re.finditer(r"(bf16|f32|f16|s32|u32|s8|u8|pred|s64|u64)"
-                         r"\[([0-9,]*)\]", sh):
+    matched_any = False
+    for m in re.finditer(r"([a-zA-Z][a-zA-Z0-9]*)\[([0-9,]*)\]", sh):
+        matched_any = True
+        dtype = m.group(1)
+        if dtype in _HLO_ZERO_BYTE_TYPES:
+            continue
+        if dtype not in HLO_ITEM_BYTES:
+            raise ValueError(
+                f"hlo_shape_bytes: unrecognized element type {dtype!r} in "
+                f"shape string {sh!r}; add it to HLO_ITEM_BYTES")
         n = 1
         for d in m.group(2).split(","):
             if d:
                 n *= int(d)
-        total += n * HLO_ITEM_BYTES[m.group(1)]
+        total += n * HLO_ITEM_BYTES[dtype]
+    if not matched_any and "[" in sh:
+        raise ValueError(
+            f"hlo_shape_bytes: no typed shape recognized in {sh!r} "
+            f"(dynamic dims or unexpected syntax?)")
     return total
 
 

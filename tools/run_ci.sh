@@ -22,12 +22,16 @@ diff /tmp/api_golden.txt /tmp/api_current.txt || {
 
 if [ "$TIER" = "quick" ]; then
     echo "== quick test tier (~5 min) =="
+    # the fusion numeric-parity tests (tests/test_fusion.py) ride this
+    # tier via their `quick` marks — the fuse passes are default-on, so
+    # every smoke must see them verified
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
         python -m pytest tests/ -q -x -m quick
 else
     echo "== full test pyramid (~29 min on 2 cores with -n 2; measured) =="
+    # tier-1 selection: everything but the slow-marked A/B bench smokes
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
-        python -m pytest tests/ -q -n 2 --dist load
+        python -m pytest tests/ -q -n 2 --dist load -m 'not slow'
 fi
 
 echo "== benchmark smoke =="

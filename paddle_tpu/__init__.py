@@ -29,6 +29,7 @@ from . import io  # noqa: F401,E402
 from . import sharded_checkpoint  # noqa: F401,E402
 from .inferencer import Inferencer, Predictor  # noqa: F401,E402
 from . import serving  # noqa: F401,E402
+from . import serving_engine  # noqa: F401,E402
 from . import metrics  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import debugger  # noqa: F401,E402

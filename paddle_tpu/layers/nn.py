@@ -918,18 +918,31 @@ def elementwise_pow(x, y, axis=-1, act=None, name=None):
     return elementwise_op_layer("elementwise_pow", x, y, axis, act, name)
 
 
-def cache_write(cache, new, pos, axis, name=None):
-    """Write `new` (size-1 along `axis`) into `cache` at scalar position
-    `pos` (any tensor; its first element is the position) — the KV-cache
-    decode primitive (lowers to an in-place dynamic_update_slice inside
-    scan carries)."""
+def cache_write(cache, new, pos, axis, batch_axis=None, out=None, name=None):
+    """Write `new` (size-1 along `axis`) into `cache` at position `pos` —
+    the KV-cache decode primitive (lowers to an in-place
+    dynamic_update_slice inside scan carries).
+
+    Default mode: `pos` is one scalar position for the whole batch (any
+    tensor; its first element is the position — the contract is enforced).
+    With `batch_axis` set, `pos` holds one position PER ROW of `cache`
+    along that axis and each row is written at its own position — the
+    slot-indexed cache the continuous-batching serving engine runs on.
+    `out` (optional Variable) receives the result in place of a fresh
+    temporary — pass the cache variable itself to round-trip a persistable
+    serving cache through the executor's donated state path."""
     helper = LayerHelper("cache_write", name=name)
-    out = helper.create_tmp_variable(dtype=dtype_name(cache.dtype),
-                                     shape=cache.shape, stop_gradient=True)
+    if out is None:
+        out = helper.create_tmp_variable(dtype=dtype_name(cache.dtype),
+                                         shape=cache.shape,
+                                         stop_gradient=True)
+    attrs = {"axis": axis}
+    if batch_axis is not None:
+        attrs["batch_axis"] = batch_axis
     helper.append_op(type="cache_write",
                      inputs={"Cache": [cache], "New": [new], "Pos": [pos]},
                      outputs={"Out": [out]},
-                     attrs={"axis": axis})
+                     attrs=attrs)
     return out
 
 

@@ -235,7 +235,8 @@ def _attend_cached(q, k5, v5, bias, K, num_heads, d_head, dropout=0.0):
 
 
 def _cached_self_attention(x, states, new_states, cache_id, prefix, K, T,
-                           num_heads, d_head, pos, bias, dropout=0.0):
+                           num_heads, d_head, pos, bias, dropout=0.0,
+                           slot_axis=None):
     """One cached self-attention block inside a decode scan step: project
     q/k/v from x [B,K,H], write k/v into the PRE-TRANSPOSED caches
     (k and v both [B,K,nh,T,dh]; scores read k via transpose_y) at scalar
@@ -247,7 +248,13 @@ def _cached_self_attention(x, states, new_states, cache_id, prefix, K, T,
     write + one cache read (the decode roofline's structural floor).
     Shared by the LM and encoder-decoder generators; parameter names come
     from `prefix` (matching the train graph's multi_head_attention
-    names)."""
+    names).
+
+    slot_axis (serving-engine mode): cache rows along this axis belong to
+    INDEPENDENT requests at independent positions — `pos` is per-slot and
+    the cache_write output is the persistable cache variable itself, so
+    the executor round-trips it through donated state instead of a scan
+    carry."""
     H = num_heads * d_head
     q = layers.fc(x, size=H, num_flatten_dims=2, bias_attr=False,
                   use_bf16=True, name=f"{prefix}_q")
@@ -255,12 +262,19 @@ def _cached_self_attention(x, states, new_states, cache_id, prefix, K, T,
                    use_bf16=True, name=f"{prefix}_k")
     vn = layers.fc(x, size=H, num_flatten_dims=2, bias_attr=False,
                    use_bf16=True, name=f"{prefix}_v")
+    slot_kw = {}
+    if slot_axis is not None:
+        slot_kw = {"batch_axis": slot_axis}
     kc = layers.cache_write(
         states[f"k{cache_id}"],
-        layers.reshape(kn, shape=[0, K, num_heads, 1, d_head]), pos, axis=3)
+        layers.reshape(kn, shape=[0, K, num_heads, 1, d_head]), pos, axis=3,
+        out=states[f"k{cache_id}"] if slot_axis is not None else None,
+        **slot_kw)
     vc = layers.cache_write(
         states[f"v{cache_id}"],
-        layers.reshape(vn, shape=[0, K, num_heads, 1, d_head]), pos, axis=3)
+        layers.reshape(vn, shape=[0, K, num_heads, 1, d_head]), pos, axis=3,
+        out=states[f"v{cache_id}"] if slot_axis is not None else None,
+        **slot_kw)
     new_states[f"k{cache_id}"], new_states[f"v{cache_id}"] = kc, vc
     ctx = _attend_cached(q, kc, vc, bias, K, num_heads, d_head, dropout)
     return layers.fc(ctx, size=H, num_flatten_dims=2, bias_attr=False,
@@ -474,6 +488,96 @@ def transformer_lm_generate(prompt=None, vocab=32000, max_gen=32,
         return new_states, layers.log_softmax(logits)
 
     return decoder.decode(prompt, init, step, init_ids=prompt)
+
+
+def _slot_cache_var(name, shape, dtype="float32"):
+    """Persistable zero-initialized cache variable (main + startup blocks,
+    the optimizer-accumulator idiom): the serving engine's KV caches live
+    in the Scope across ticks and ride the executor's donated read-write
+    state path — updated in place on device, never re-staged."""
+    from ..framework.program import (default_main_program,
+                                     default_startup_program)
+    mb = default_main_program().global_block()
+    if name in mb.vars:
+        return mb.vars[name]
+    var = mb.create_var(name=name, shape=list(shape), dtype=dtype,
+                        persistable=True)
+    var.stop_gradient = True
+    sb = default_startup_program().global_block()
+    sv = sb.create_var(name=name, shape=list(shape), dtype=dtype,
+                       persistable=True)
+    sb.append_op("fill_constant", outputs={"Out": [sv.name]},
+                 attrs={"shape": list(shape), "value": 0.0, "dtype": dtype})
+    return var
+
+
+def transformer_lm_decode_tick(n_slots, vocab=32000, max_len=64,
+                               d_model=512, d_inner=2048, num_heads=8,
+                               num_layers=6, dropout=0.0, packed=False,
+                               cache_prefix="srv"):
+    """ONE decode tick over a slot-indexed KV cache — the continuous-
+    batching serving engine's compiled step (paddle_tpu/serving_engine.py).
+
+    Where transformer_lm_generate scans max_gen positions with the cache
+    in the scan carry (every sequence at the SAME position), this builds a
+    single-step program whose state is per-slot: caches are persistable
+    [S,1,nh,T,dh] variables written back through the executor's donated
+    read-write state, `tick_pos` is PER-SLOT (each slot at its own
+    position — one mid-prompt, one 30 tokens into generation), and
+    `cache_write(batch_axis=0)` writes each slot's row at its own
+    position. One compiled program serves every mixture of request
+    phases, which is what lets the scheduler admit a new request into the
+    in-flight batch without recompiling or padding to a static batch.
+
+    Inputs (all fed per tick): `tick_tok` [S,1] int64 (the token each
+    slot consumes: next prompt token while prefilling, else the slot's
+    previously sampled token), `tick_pos` [S,1,1] float32 (the position
+    being written). Weights are shared BY NAME with transformer_lm
+    (tok_emb, l{i}_attn_*, l{i}_ln*, l{i}_ffn_*, lm_head) — train first
+    (or load), then build this in its own program and run it in the same
+    scope; pass the SAME dropout/packed the train graph used (inference
+    (1-p) corrections applied, as in transformer_lm_generate).
+
+    Returns (next_ids [S,1] int64, cache_names list): argmax of the tick
+    logits per slot, and the persistable cache variable names (the engine
+    resets nothing on slot reuse — positions > a slot's own pos are
+    masked, and prefill overwrites rows 0..P-1 before exposing them).
+    """
+    S, T, H = n_slots, max_len, d_model
+    d_head = d_model // num_heads
+    # STATIC slot dim (no -1 batch): the slot count is the program's shape,
+    # and the static form is what lets fuse_decode_attention_pass match the
+    # per-tick attention chain against the fixed-shape slot caches
+    tok = layers.data(name="tick_tok", shape=[S, 1], dtype="int64",
+                      append_batch_size=False)
+    pos = layers.data(name="tick_pos", shape=[S, 1, 1], dtype="float32",
+                      append_batch_size=False)
+    attn_dropout = 0.0 if packed else dropout
+
+    states = {}
+    for i in range(num_layers):
+        for s in ("k", "v"):
+            states[f"{s}{i}"] = _slot_cache_var(
+                f"{cache_prefix}_{s}{i}", [S, 1, num_heads, T, d_head])
+
+    pe_table = positional_encoding_table(T, d_model).astype("float32")
+    arange = np.arange(T, dtype="float32").reshape(1, 1, T)
+    x = _gen_embed_step(tok, pos, "tok_emb", vocab, d_model, pe_table,
+                        dropout)
+    bias = _step_mask_bias(pos, arange)       # per-slot: pos broadcasts
+    new_states = {}
+    for i in range(num_layers):
+        attn = _cached_self_attention(
+            x, states, new_states, i, f"l{i}_attn", 1, T, num_heads,
+            d_head, pos, bias, attn_dropout, slot_axis=0)
+        x = _add_norm(attn, x, dropout, True, name=f"l{i}_ln1")
+        f = ffn(x, d_model, d_inner, dropout, True, name=f"l{i}_ffn")
+        x = _add_norm(f, x, dropout, True, name=f"l{i}_ln2")
+    logits = layers.fc(x, size=vocab, num_flatten_dims=2, use_bf16=True,
+                       name="lm_head")
+    next_ids = layers.argmax(logits, axis=2)            # [S,1] int64
+    cache_names = [v.name for v in states.values()]
+    return next_ids, cache_names
 
 
 def transformer_lm(tokens=None, label=None, vocab=32000, max_len=128,

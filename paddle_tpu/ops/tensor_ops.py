@@ -161,27 +161,56 @@ def _uniform_pos_guard(pos_flat):
 
 @register_op("cache_write", stop_gradient=True)
 def _cache_write(ctx, ins, attrs):
-    """Write `New` (size-1 on `axis`) into `Cache` at scalar position
-    `Pos` along `axis` via dynamic_update_slice — the KV-cache decode
-    idiom. Inside a scan carry XLA performs the update in place, so the
-    per-step cache cost is one row write + the attention read, not a full
-    read+rewrite of the cache (the one-hot outer-product formulation's
-    cost). No reference analogue: the reference's while_op decoder
-    re-runs attention over growing LoD tensors instead of caching.
+    """Write `New` (size-1 on `axis`) into `Cache` at position `Pos` along
+    `axis` via dynamic_update_slice — the KV-cache decode idiom. Inside a
+    scan carry XLA performs the update in place, so the per-step cache
+    cost is one row write + the attention read, not a full read+rewrite of
+    the cache (the one-hot outer-product formulation's cost). No reference
+    analogue: the reference's while_op decoder re-runs attention over
+    growing LoD tensors instead of caching.
 
-    `Pos` must be UNIFORM: a single position (any tensor; every element
-    equal). Non-uniform per-row positions raise on CPU (enforced via host
-    callback — inactive on TPU, where host send/recv is unavailable)."""
+    Two position modes, selected by the `batch_axis` attr:
+
+    - batch_axis None (default): `Pos` must be UNIFORM — a single
+      position (any tensor; every element equal). Non-uniform per-row
+      positions raise on CPU (enforced via host callback — inactive on
+      TPU, where host send/recv is unavailable).
+    - batch_axis set: `Pos` holds ONE position PER ROW of `Cache` along
+      `batch_axis` (`Pos.reshape(-1)` length == that dim) and each row is
+      written at its own position — the slot-indexed KV cache the
+      continuous-batching serving engine needs (a slot mid-prompt and a
+      slot mid-generation share one compiled tick). Lowers to a vmapped
+      dynamic_update_slice over the batch axis."""
     cache = ins["Cache"][0]
     new = ins["New"][0].astype(cache.dtype)
     pos_flat = ins["Pos"][0].reshape(-1)
-    _uniform_pos_guard(pos_flat)
-    pos = pos_flat[0].astype(jnp.int32)
     axis = attrs["axis"] % cache.ndim
-    starts = [jnp.int32(0)] * cache.ndim
-    starts[axis] = pos
-    return {"Out": [jax.lax.dynamic_update_slice(cache, new,
-                                                 tuple(starts))]}
+    batch_axis = attrs.get("batch_axis", None)
+    if batch_axis is None:
+        _uniform_pos_guard(pos_flat)
+        pos = pos_flat[0].astype(jnp.int32)
+        starts = [jnp.int32(0)] * cache.ndim
+        starts[axis] = pos
+        return {"Out": [jax.lax.dynamic_update_slice(cache, new,
+                                                     tuple(starts))]}
+    ba = batch_axis % cache.ndim
+    if ba == axis:
+        raise ValueError("cache_write: batch_axis must differ from axis")
+    if pos_flat.shape[0] != cache.shape[ba]:
+        raise ValueError(
+            f"cache_write: per-slot Pos has {pos_flat.shape[0]} entries "
+            f"but Cache dim {ba} is {cache.shape[ba]}")
+    pos = pos_flat.astype(jnp.int32)
+    row_axis = axis - (1 if axis > ba else 0)
+
+    def _write_row(c, n, p):
+        starts = [jnp.int32(0)] * c.ndim
+        starts[row_axis] = p
+        return jax.lax.dynamic_update_slice(c, n, tuple(starts))
+
+    out = jax.vmap(_write_row, in_axes=(ba, ba, 0),
+                   out_axes=ba)(cache, new, pos)
+    return {"Out": [out]}
 
 
 @register_op("one_hot", stop_gradient=True)

@@ -14,6 +14,23 @@ shared-weights predictor per serving thread). The TPU translation:
   run concurrently — XLA executions release the GIL, so concurrent
   requests genuinely overlap on device.
 
+Transport (v2 — the round-5 serving link sat at 0.54–0.71 of what the
+prefetcher sustained on the same link; the per-request turnaround below is
+what closed it, BENCH_SERVE_r07.json):
+
+- ZERO-COPY VECTORED FRAMING: a frame (length prefix + header + tensor
+  payloads) goes out as ONE sendmsg syscall over memoryviews of the numpy
+  buffers — no tobytes() copy, no per-part sendall round trip.
+- BATCHED RESPONSE WRITES: each connection has a writer thread that drains
+  every response ready at that moment and emits them as one vectored
+  send, so a pipelined client's K responses pay one syscall, not K.
+- DOUBLE-BUFFERED RECV: request payloads land in two pooled per-connection
+  buffers via recv_into — the reader fills one while the worker still
+  parses/stages the other; numpy views are taken zero-copy over the pool
+  buffer and the buffer is recycled once the run consumed them.
+- The decode/compute tick and socket I/O run on separate threads (reader,
+  worker, writer), so neither blocks the other.
+
 Protocol, per request:
     u32  header length
     JSON {"feeds": [{"name", "dtype", "shape"}...], "fetch": [...]? }
@@ -27,6 +44,7 @@ Response:
 from __future__ import annotations
 
 import json
+import queue as _queue
 import socket
 import struct
 import threading
@@ -34,13 +52,51 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
+# sendmsg takes at most IOV_MAX (commonly 1024) iovecs; stay well under
+_IOV_CHUNK = 512
+
+
+def _byte_views(parts):
+    """Flat byte views (memoryview cast to 'B') over heterogeneous parts
+    (bytes, bytearray, contiguous numpy arrays) — the zero-copy scatter
+    list sendmsg consumes."""
+    views = []
+    for p in parts:
+        mv = memoryview(p)
+        if mv.ndim != 1 or mv.itemsize != 1:
+            mv = mv.cast("B")
+        if len(mv):
+            views.append(mv)
+    return views
+
+
+def _sendall_vec(sock: socket.socket, parts):
+    """Vectored sendall: the whole frame list in as few sendmsg syscalls
+    as the kernel allows, advancing through partial sends."""
+    views = _byte_views(parts)
+    while views:
+        try:
+            sent = sock.sendmsg(views[:_IOV_CHUNK])
+        except InterruptedError:
+            continue
+        while sent:
+            if sent >= len(views[0]):
+                sent -= len(views[0])
+                views.pop(0)
+            else:
+                views[0] = views[0][sent:]
+                sent = 0
+
+
+def _encode_msg(header: dict, buffers=()):
+    """Frame parts for one message (length prefix + JSON + payloads);
+    payloads stay by-reference (zero-copy through sendmsg)."""
+    raw = json.dumps(header).encode()
+    return [struct.pack("<I", len(raw)), raw, *buffers]
+
 
 def _send_msg(sock: socket.socket, header: dict, buffers=()):
-    raw = json.dumps(header).encode()
-    sock.sendall(struct.pack("<I", len(raw)))
-    sock.sendall(raw)
-    for b in buffers:
-        sock.sendall(b)
+    _sendall_vec(sock, _encode_msg(header, buffers))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -54,17 +110,163 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def _recv_msg(sock: socket.socket):
+def _recv_exact_into(sock: socket.socket, mv: memoryview):
+    """recv_into the whole view (no intermediate bytes objects)."""
+    while len(mv):
+        n = sock.recv_into(mv, len(mv))
+        if not n:
+            raise ConnectionError("peer closed")
+        mv = mv[n:]
+
+
+class _RecvBufferPool:
+    """N (default 2 — double buffering) reusable payload buffers: the
+    reader fills one while the worker still parses/stages another;
+    acquire blocks when all are in flight, which bounds per-connection
+    buffer memory no matter how hard a client pipelines. Buffers grow to
+    the largest payload seen and are reused at that size."""
+
+    def __init__(self, n: int = 2):
+        self._free: "_queue.Queue" = _queue.Queue()
+        for _ in range(n):
+            self._free.put(bytearray(0))
+
+    def acquire(self, size: int, timeout=None) -> Optional[bytearray]:
+        try:
+            buf = self._free.get(timeout=timeout)
+        except _queue.Empty:
+            return None
+        if len(buf) < size:
+            buf = bytearray(size)
+        return buf
+
+    def release(self, buf: bytearray):
+        self._free.put(buf)
+
+
+_WRITER_EOF = object()
+
+
+class _BatchingWriter:
+    """Per-connection response writer thread: a BOUNDED queue drained so
+    that every frame ready at wake-up leaves in ONE vectored send
+    (batched response writes). Shared by PredictorServer and
+    serving_engine.EngineServer — the drain/EOF/dead-flag subtleties
+    live once.
+
+    `respond` blocks under backpressure and gives up once the writer is
+    gone (the PredictorServer worker's contract). `offer` never blocks:
+    on a full queue it kills the connection (slow-consumer eviction —
+    the engine's tick thread serves EVERY connection and must not stall
+    on one that stopped reading)."""
+
+    def __init__(self, conn, maxsize: int = 64):
+        self._conn = conn
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=maxsize)
+        self.dead = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        try:
+            while True:
+                item = self._q.get()
+                if item is _WRITER_EOF:
+                    return
+                parts = list(item)
+                try:
+                    while True:   # batch whatever else is ready NOW
+                        nxt = self._q.get_nowait()
+                        if nxt is _WRITER_EOF:
+                            _sendall_vec(self._conn, parts)
+                            return
+                        parts.extend(nxt)
+                except _queue.Empty:
+                    pass
+                _sendall_vec(self._conn, parts)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self.dead.set()
+            try:   # unblock producers stuck in put()
+                while True:
+                    self._q.get_nowait()
+            except _queue.Empty:
+                pass
+
+    def respond(self, parts) -> bool:
+        """Blocking enqueue with backpressure; False once the writer is
+        gone."""
+        while not self.dead.is_set():
+            try:
+                self._q.put(parts, timeout=0.2)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def offer(self, parts) -> bool:
+        """Non-blocking enqueue. A full queue means the peer stopped
+        reading ~maxsize frames ago: the connection is killed (the peer
+        sees a disconnect, never a silent gap) and False returned."""
+        if self.dead.is_set():
+            return False
+        try:
+            self._q.put_nowait(parts)
+            return True
+        except _queue.Full:
+            self.dead.set()
+            # shutdown BEFORE close: the writer thread may be blocked in
+            # sendmsg on this socket, and closing the fd does not wake a
+            # blocked send on Linux — shutdown does
+            for fn in (lambda: self._conn.shutdown(socket.SHUT_RDWR),
+                       self._conn.close):
+                try:
+                    fn()
+                except OSError:
+                    pass
+            return False
+
+    def close(self, join_timeout: float = 10.0):
+        while not self.dead.is_set():
+            try:
+                self._q.put(_WRITER_EOF, timeout=0.2)
+                break
+            except _queue.Full:
+                continue
+        self._thread.join(timeout=join_timeout)
+
+
+def _recv_msg(sock: socket.socket, pool: Optional[_RecvBufferPool] = None,
+              dead=None):
+    """Read one message. Without a pool, payloads are fresh bytes (the
+    client path). With a pool (server reader), payloads are zero-copy
+    memoryviews into a pooled buffer returned as the third element — the
+    consumer must pool.release() it once the views are dead. `dead` (a
+    callable) lets the pooled acquire give up when the consumer that
+    would recycle buffers is gone."""
     try:
         hlen, = struct.unpack("<I", _recv_exact(sock, 4))
     except ConnectionError:
-        return None, None
+        return (None, None) if pool is None else (None, None, None)
     header = json.loads(_recv_exact(sock, hlen))
-    buffers = []
-    for spec in header.get("feeds", header.get("outs", [])):
-        n = int(np.prod(spec["shape"])) * np.dtype(spec["dtype"]).itemsize
-        buffers.append(_recv_exact(sock, n))
-    return header, buffers
+    specs = header.get("feeds", header.get("outs", []))
+    sizes = [int(np.prod(spec["shape"])) * np.dtype(spec["dtype"]).itemsize
+             for spec in specs]
+    if pool is None:
+        return header, [_recv_exact(sock, n) for n in sizes]
+    buf = None
+    while buf is None:
+        buf = pool.acquire(sum(sizes), timeout=0.5)
+        if buf is None and dead is not None and dead():
+            raise ConnectionError("recv-buffer consumer gone")
+    mv = memoryview(buf)
+    buffers, off = [], 0
+    for n in sizes:
+        _recv_exact_into(sock, mv[off:off + n])
+        buffers.append(mv[off:off + n])
+        off += n
+    return header, buffers, buf
 
 
 class PredictorServer:
@@ -131,6 +333,10 @@ class PredictorServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return  # socket closed by shutdown
+            # a response frame is often tiny (header + small logits);
+            # Nagle would hold it hostage to the previous frame's ACK and
+            # a pipelined client sees 40 ms delayed-ACK stalls
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             t = threading.Thread(target=self._serve_conn, args=(conn,),
                                  daemon=True)
             with self._lock:
@@ -140,38 +346,50 @@ class PredictorServer:
             t.start()
 
     def _serve_conn(self, conn: socket.socket):
-        """Reader thread + worker thread per connection. The reader ALWAYS
-        drains incoming requests into a queue and the worker executes +
-        responds in order: with both roles on one thread, a client that
+        """Reader + worker + writer threads per connection. The reader
+        ALWAYS drains incoming requests into a queue and the worker
+        executes in order: with both roles on one thread, a client that
         pipelines faster than it reads would fill both TCP buffers and
         deadlock the pair in sendall (server not reading because it is
-        writing). The queue is the explicit in-flight buffer instead."""
-        import queue as _q
-
+        writing). The queue is the explicit in-flight buffer. The writer
+        decouples compute from socket writes the same way — the worker
+        never blocks in send, and responses that pile up while one write
+        is in flight go out together as a single vectored sendmsg
+        (batched response writes). Request payloads land in a 2-buffer
+        recv pool (double buffering): zero-copy numpy views feed the
+        predictor and the buffer recycles when the run is done."""
         # per-thread context reuse: ONE clone for the connection's lifetime,
         # its executor caches warm across requests
         predictor = (self._base.clone() if hasattr(self._base, "clone")
                      else self._base)
-        # bounded: past 128 queued requests the reader stops reading and
+        # bounded: past 32 queued requests the reader stops reading and
         # TCP backpressure reaches the client — a runaway pipeliner stalls
-        # itself instead of growing server memory without limit
-        requests: "_q.Queue" = _q.Queue(maxsize=128)
+        # itself instead of growing server memory without limit. (The recv
+        # pool bounds PAYLOAD memory at 2 buffers already; this bounds the
+        # header/bookkeeping queue.)
+        requests: "_queue.Queue" = _queue.Queue(maxsize=32)
+        pool = _RecvBufferPool(2)
         _EOF = object()
         # set when the worker exits for ANY reason: a reader blocked in
-        # put() on a full queue must not wait forever for a consumer that
-        # is gone (the worker also drains the queue on exit)
+        # put() or pool.acquire() must not wait forever for a consumer
+        # that is gone (the worker also drains the queue on exit)
         worker_dead = threading.Event()
+        writer = _BatchingWriter(conn)
+        respond = writer.respond
 
         def work():
-            try:
-                while True:
-                    item = requests.get()
-                    if item is _EOF:
-                        return
-                    header, buffers = item
+            while True:
+                item = requests.get()
+                if item is _EOF:
+                    return
+                header, buffers, buf = item
+                try:
                     try:
                         feed = {}
                         for spec, raw in zip(header["feeds"], buffers):
+                            # zero-copy view over the pooled recv buffer;
+                            # predictor.run stages it to device (copies),
+                            # after which the buffer can recycle
                             feed[spec["name"]] = np.frombuffer(
                                 raw, dtype=np.dtype(spec["dtype"])).reshape(
                                     spec["shape"])
@@ -186,25 +404,33 @@ class PredictorServer:
                             {"name": n, "dtype": str(o.dtype),
                              "shape": list(o.shape)}
                             for n, o in zip(names, outs)]}
-                        _send_msg(conn, resp, [o.tobytes() for o in outs])
-                    except Exception as e:  # per-request error, keep going
-                        try:
-                            _send_msg(conn,
-                                      {"error": f"{type(e).__name__}: {e}"})
-                        except OSError:
+                        # outs ride the frame by reference — the writer's
+                        # sendmsg reads the numpy memory directly
+                        if not respond(_encode_msg(resp, outs)):
                             return
-            except (ConnectionError, OSError):
-                pass
+                    except Exception as e:  # per-request error, keep going
+                        if not respond(_encode_msg(
+                                {"error": f"{type(e).__name__}: {e}"})):
+                            return
+                finally:
+                    if buf is not None:
+                        pool.release(buf)
 
         def work_outer():
             try:
                 work()
+            except (ConnectionError, OSError):
+                pass
             finally:
                 worker_dead.set()
-                try:  # unblock a reader stuck in put() on a full queue
+                try:  # unblock a reader stuck in put() on a full queue;
+                    # release any pooled buffers still queued so the
+                    # reader's pool.acquire can't deadlock either
                     while True:
-                        requests.get_nowait()
-                except _q.Empty:
+                        item = requests.get_nowait()
+                        if item is not _EOF and item[2] is not None:
+                            pool.release(item[2])
+                except _queue.Empty:
                     pass
 
         def put_alive(item) -> bool:
@@ -213,7 +439,7 @@ class PredictorServer:
                 try:
                     requests.put(item, timeout=0.2)
                     return True
-                except _q.Full:
+                except _queue.Full:
                     continue
             return False
 
@@ -221,16 +447,22 @@ class PredictorServer:
         worker.start()
         try:
             while not self._stop.is_set():
-                header, buffers = _recv_msg(conn)
+                header, buffers, buf = _recv_msg(
+                    conn, pool,
+                    dead=lambda: (worker_dead.is_set()
+                                  or self._stop.is_set()))
                 if header is None:
                     break
-                if not put_alive((header, buffers)):
+                if not put_alive((header, buffers, buf)):
+                    if buf is not None:
+                        pool.release(buf)
                     break
         except (ConnectionError, OSError):
             pass
         finally:
             put_alive(_EOF)
             worker.join(timeout=30)
+            writer.close(join_timeout=30)
             conn.close()
             with self._lock:
                 if conn in self._conns:
@@ -247,6 +479,7 @@ class PredictorClient:
 
     def __init__(self, host: str, port: int):
         self._sock = socket.create_connection((host, port))
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()  # serializes concurrent send()s
 
     def send(self, feed: Dict[str, Any],
@@ -258,8 +491,8 @@ class PredictorClient:
         if fetch is not None:
             header["fetch"] = list(fetch)
         with self._lock:
-            _send_msg(self._sock, header,
-                      [a.tobytes() for a in arrays.values()])
+            # arrays ride by reference: one vectored sendmsg, no tobytes()
+            _send_msg(self._sock, header, list(arrays.values()))
 
     def recv(self) -> List[np.ndarray]:
         header, buffers = _recv_msg(self._sock)

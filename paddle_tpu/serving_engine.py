@@ -1,0 +1,530 @@
+"""Continuous-batching serving engine over the fused decode path.
+
+≙ reference inference/api/api_impl.cc:126 — the serving hot loop as a
+first-class perf surface — extended with the scheduling idea the reference
+era didn't have: requests of different lengths share ONE compiled decode
+program through a slot-indexed KV cache, so a new request joins the
+in-flight batch the tick a slot frees instead of waiting for a static
+batch to drain.
+
+The pieces:
+
+- `transformer_lm_decode_tick` (models/transformer.py) — one decode tick
+  over persistable [S,1,nh,T,dh] slot caches with PER-SLOT positions
+  (`cache_write(batch_axis=0)`, closing the uniform-`Pos` limitation for
+  real), compiled once; fuse_decode_attention_pass rewrites its attention
+  chains into the r06 fused decode kernel.
+- `SlotAllocator` — free-list over the S cache rows; alloc on admission,
+  free on completion. A reused slot needs NO cache reset: the per-slot
+  mask exposes only positions <= the slot's own pos, and prefill rewrites
+  rows 0..P-1 before they are ever exposed (asserted in
+  tests/test_serving_engine.py).
+- `ContinuousBatchingEngine` — request queue + scheduler + tick loop.
+  Prefill is teacher-forced through the same tick program (the fed token
+  is the next prompt token until the prompt is consumed, then the slot's
+  previously sampled token), so one executable serves every mixture of
+  request phases. Dispatch rides `Executor.prepare` — the per-call
+  validation/signature-hash overhead is off the tick path.
+- `EngineServer`/`EngineClient` — generation RPC over the serving.py v2
+  transport (vectored frames, batched writes): the engine thread ticks
+  while reader/writer threads move bytes, so decode and socket I/O
+  overlap; completions landing on the same tick go out as one vectored
+  send.
+
+Scheduling policies (the A/B in tools/bench_serve.py):
+
+- "continuous": admit whenever a slot is free — the engine's point.
+- "static": admit only when ALL slots are free (form a batch, run it to
+  full completion, drain, repeat) — the padded static-batch baseline.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .core.enforce import InvalidArgumentError, enforce
+
+# atomic in CPython: concurrent engine construction must not mint the
+# same cache namespace (aliased slot caches in a shared scope)
+_ENGINE_SEQ = __import__("itertools").count(1)
+
+
+class SlotAllocator:
+    """Free-list allocator over the decode batch's S cache rows."""
+
+    def __init__(self, n_slots: int):
+        enforce(n_slots >= 1, "need at least one slot",
+                exc=InvalidArgumentError)
+        self.n_slots = n_slots
+        self._free = list(range(n_slots - 1, -1, -1))
+        self._used = set()
+
+    def alloc(self) -> Optional[int]:
+        if not self._free:
+            return None
+        s = self._free.pop()
+        self._used.add(s)
+        return s
+
+    def free(self, slot: int):
+        enforce(slot in self._used, f"slot {slot} not allocated",
+                exc=InvalidArgumentError)
+        self._used.remove(slot)
+        self._free.append(slot)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_used(self) -> int:
+        return len(self._used)
+
+
+class GenRequest:
+    """One generation request moving through the engine."""
+
+    __slots__ = ("rid", "prompt", "max_new", "eos_id", "tokens", "slot",
+                 "fed", "next_tok", "submitted_at", "first_token_at",
+                 "done_at", "on_done", "_event")
+
+    def __init__(self, rid, prompt, max_new, eos_id=None, on_done=None):
+        self.rid = rid
+        self.prompt = [int(t) for t in prompt]
+        self.max_new = int(max_new)
+        self.eos_id = eos_id
+        self.tokens: List[int] = []
+        self.slot: Optional[int] = None
+        self.fed = 0                       # positions consumed so far
+        self.next_tok = self.prompt[0]     # token the next tick feeds
+        self.submitted_at = time.time()
+        self.first_token_at: Optional[float] = None
+        self.done_at: Optional[float] = None
+        self.on_done = on_done
+        self._event = threading.Event()
+
+    @property
+    def done(self) -> bool:
+        return self.done_at is not None
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        return (self.done_at - self.submitted_at) if self.done else None
+
+    def wait(self, timeout: Optional[float] = None) -> List[int]:
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"request {self.rid} not done in {timeout}s")
+        return self.tokens
+
+    def _complete(self):
+        self.done_at = time.time()
+        if self.on_done is not None:
+            self.on_done(self)
+        self._event.set()
+
+
+class ContinuousBatchingEngine:
+    """Slot-scheduled decode loop: one compiled tick, S independent
+    sequences in flight, admission the tick a slot frees.
+
+    Weights are shared BY NAME with a `transformer_lm` train graph (train
+    or load into `scope` first, then hand the same scope here); absent
+    parameters are initialized by this engine's own startup program, so a
+    fresh engine also runs standalone (random weights — tests, benches).
+    """
+
+    def __init__(self, n_slots: int = 8, vocab: int = 32000,
+                 max_len: int = 64, d_model: int = 512, d_inner: int = 2048,
+                 num_heads: int = 8, num_layers: int = 6,
+                 dropout: float = 0.0, packed: bool = False,
+                 eos_id: Optional[int] = None, scope=None,
+                 policy: str = "continuous",
+                 cache_prefix: Optional[str] = None):
+        from .core import unique_name
+        from .framework.executor import Executor
+        from .framework.program import Program, program_guard
+        from .framework.scope import Scope, global_scope
+
+        enforce(policy in ("continuous", "static"),
+                f"unknown scheduling policy {policy!r}",
+                exc=InvalidArgumentError)
+        if cache_prefix is None:
+            # per-engine cache namespace: two engines sharing one scope
+            # (e.g. both over the same trained weights) must not alias
+            # each other's slot caches — shapes differ with n_slots
+            cache_prefix = f"srv{next(_ENGINE_SEQ)}"
+        self.policy = policy
+        self.n_slots = n_slots
+        self.max_len = max_len
+        self.eos_id = eos_id
+        self._slots = SlotAllocator(n_slots)
+        self._active: Dict[int, GenRequest] = {}      # slot -> request
+        self._pending: "deque[GenRequest]" = deque()
+        self._lock = threading.Lock()
+        self._rid = 0
+
+        self._program, self._startup = Program(), Program()
+        with program_guard(self._program, self._startup), \
+                unique_name.guard():
+            self._next_ids, self.cache_names = \
+                _decode_tick_builder(n_slots, vocab, max_len, d_model,
+                                     d_inner, num_heads, num_layers,
+                                     dropout, packed, cache_prefix)
+        self.scope = scope or global_scope()
+        self._exe = Executor()
+        self._init_missing_vars(Scope)
+        self._tok = np.zeros((n_slots, 1), np.int64)
+        self._pos = np.zeros((n_slots, 1, 1), np.float32)
+        self._step = self._exe.prepare(
+            self._program, {"tick_tok": self._tok, "tick_pos": self._pos},
+            [self._next_ids], self.scope)
+        # census counters (tools/bench_serve.py occupancy evidence)
+        self.n_ticks = 0
+        self.busy_slot_ticks = 0
+        self.total_slot_ticks = 0
+        self.tokens_out = 0
+
+    def _init_missing_vars(self, Scope):
+        """Run the startup program into a throwaway scope and copy ONLY
+        the vars the serving scope lacks: trained weights already present
+        (shared by name) must not be re-randomized; caches and any
+        untrained parameters get their init."""
+        tmp = Scope()
+        self._exe.run(self._startup, scope=tmp)
+        for name in tmp.local_var_names():
+            if not self.scope.has_var(name):
+                self.scope.set_var(name, tmp.get(name))
+
+    # -- request intake ---------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new: int,
+               eos_id: Optional[int] = "engine",
+               on_done: Optional[Callable] = None) -> GenRequest:
+        """Queue a generation request; returns the GenRequest handle
+        (wait() for completion, or pass on_done — called on the ENGINE
+        thread, keep it cheap)."""
+        enforce(len(prompt) >= 1, "prompt must not be empty",
+                exc=InvalidArgumentError)
+        enforce(len(prompt) + int(max_new) <= self.max_len,
+                f"prompt({len(prompt)}) + max_new({max_new}) exceeds the "
+                f"engine's max_len {self.max_len}",
+                exc=InvalidArgumentError)
+        with self._lock:
+            self._rid += 1
+            req = GenRequest(self._rid, prompt, max_new,
+                             self.eos_id if eos_id == "engine" else eos_id,
+                             on_done)
+            self._pending.append(req)
+        return req
+
+    # -- scheduler --------------------------------------------------------
+    def _admit(self):
+        with self._lock:
+            if self.policy == "static" and (self._active
+                                            or not self._pending):
+                return
+            while self._pending:
+                if self.policy == "static" and \
+                        self._slots.n_free == 0:
+                    break
+                if self.policy == "continuous" and \
+                        self._slots.n_free == 0:
+                    break
+                slot = self._slots.alloc()
+                req = self._pending.popleft()
+                req.slot = slot
+                self._active[slot] = req
+
+    @property
+    def n_active(self) -> int:
+        with self._lock:
+            return len(self._active)
+
+    @property
+    def n_pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def step(self) -> List[GenRequest]:
+        """One decode tick: admit, run, collect. Returns the requests that
+        COMPLETED on this tick. A no-op (returns []) when nothing is
+        active or pending."""
+        self._admit()
+        with self._lock:
+            active = dict(self._active)
+        if not active:
+            return []
+        tok, pos = self._tok, self._pos
+        tok[:] = 0
+        pos[:] = 0.0
+        for slot, req in active.items():
+            tok[slot, 0] = req.next_tok
+            pos[slot, 0, 0] = float(req.fed)
+        ids = self._step.run({"tick_tok": tok, "tick_pos": pos})[0]
+        ids = np.asarray(ids)              # realization barrier: the next
+        #                                    tick's feed depends on it
+        self.n_ticks += 1
+        self.busy_slot_ticks += len(active)
+        self.total_slot_ticks += self.n_slots
+        finished = []
+        for slot, req in active.items():
+            k = req.fed                    # the position just consumed
+            req.fed += 1
+            if k < len(req.prompt) - 1:
+                req.next_tok = req.prompt[k + 1]     # still prefilling
+                continue
+            t = int(ids[slot, 0])                    # sampled next token
+            if req.first_token_at is None:
+                req.first_token_at = time.time()
+            req.tokens.append(t)
+            self.tokens_out += 1
+            req.next_tok = t
+            hit_eos = (req.eos_id is not None and t == req.eos_id)
+            out_of_room = req.fed >= self.max_len
+            if len(req.tokens) >= req.max_new or hit_eos or out_of_room:
+                finished.append(req)
+        if finished:
+            with self._lock:
+                for req in finished:
+                    del self._active[req.slot]
+                    self._slots.free(req.slot)
+            for req in finished:
+                req._complete()
+        return finished
+
+    def run_until_idle(self, max_ticks: Optional[int] = None
+                       ) -> List[GenRequest]:
+        """Tick until every pending/active request completed (or
+        max_ticks); returns all completions in completion order."""
+        done: List[GenRequest] = []
+        ticks = 0
+        while True:
+            with self._lock:
+                idle = not self._active and not self._pending
+            if idle:
+                return done
+            done.extend(self.step())
+            ticks += 1
+            if max_ticks is not None and ticks >= max_ticks:
+                return done
+
+    def occupancy(self) -> float:
+        """Fraction of slot-ticks that carried an active request —
+        continuous batching's object of optimization."""
+        return (self.busy_slot_ticks / self.total_slot_ticks
+                if self.total_slot_ticks else 0.0)
+
+
+def _decode_tick_builder(n_slots, vocab, max_len, d_model, d_inner,
+                         num_heads, num_layers, dropout, packed,
+                         cache_prefix):
+    from .models import transformer
+    return transformer.transformer_lm_decode_tick(
+        n_slots=n_slots, vocab=vocab, max_len=max_len, d_model=d_model,
+        d_inner=d_inner, num_heads=num_heads, num_layers=num_layers,
+        dropout=dropout, packed=packed, cache_prefix=cache_prefix)
+
+
+# ---------------------------------------------------------------------------
+# generation RPC over the serving.py v2 transport
+# ---------------------------------------------------------------------------
+
+
+class EngineServer:
+    """Serve a ContinuousBatchingEngine over TCP.
+
+    Wire format is the serving.py framing with JSON-only frames:
+      request   {"gen": {"prompt": [ids...], "max_new": n, "tag": any}}
+      response  {"done": {"tag": any, "tokens": [ids...],
+                          "latency_ms": float}}
+    Responses are keyed by the client's `tag` (completion order is the
+    ENGINE's order, not request order — short requests overtake long
+    ones; that reordering is continuous batching working as designed).
+
+    Threads: one engine thread ticks the decode loop; per connection, a
+    reader admits requests and a writer flushes completions — completions
+    landing on the same tick leave in one vectored send (serving.py
+    `_sendall_vec`), so socket I/O and the decode tick overlap."""
+
+    def __init__(self, engine: ContinuousBatchingEngine,
+                 host: str = "127.0.0.1", port: int = 0):
+        import socket as _socket
+
+        self.engine = engine
+        self._sock = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        self._sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.address = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._wake = threading.Event()     # submissions kick the engine
+        self._threads: List[threading.Thread] = []
+        self._conns: List = []
+        self._lock = threading.Lock()
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "EngineServer":
+        t = threading.Thread(target=self._engine_loop, daemon=True)
+        a = threading.Thread(target=self._accept_loop, daemon=True)
+        self._threads += [t, a]
+        t.start()
+        a.start()
+        return self
+
+    def shutdown(self):
+        self._stop.set()
+        self._wake.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        import socket as _socket
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            # shutdown BEFORE close: reader threads parked in recv are
+            # not woken by closing the fd on Linux; shutdown makes recv
+            # return 0 immediately (same drill as PredictorServer)
+            try:
+                c.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=10)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *a):
+        self.shutdown()
+
+    # -- engine thread ----------------------------------------------------
+    def _engine_loop(self):
+        while not self._stop.is_set():
+            if self.engine.n_active or self.engine.n_pending:
+                self.engine.step()
+            else:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+
+    # -- I/O threads ------------------------------------------------------
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            import socket as _socket
+            conn.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            with self._lock:
+                self._conns.append(conn)
+                self._threads.append(t)
+            t.start()
+
+    def _serve_conn(self, conn):
+        from .serving import _BatchingWriter, _encode_msg, _recv_msg
+
+        # shared with PredictorServer: bounded queue + vectored batch
+        # drain. Completions use the NON-blocking offer(): the engine
+        # thread ticks for every connection and must never stall on one
+        # that stopped reading — a client ~64 unread frames behind is
+        # evicted (connection closed), frames for a dead connection are
+        # dropped.
+        writer = _BatchingWriter(conn)
+
+        def on_done(req, tag):
+            writer.offer(_encode_msg({"done": {
+                "tag": tag, "tokens": req.tokens,
+                "latency_ms": round(req.latency_s * 1e3, 3)}}))
+
+        try:
+            while not self._stop.is_set():
+                header, _ = _recv_msg(conn)
+                if header is None or "gen" not in header:
+                    break
+                g = header["gen"]
+                tag = g.get("tag")
+                try:
+                    self.engine.submit(
+                        g["prompt"], g.get("max_new", 16),
+                        on_done=(lambda req, tag=tag: on_done(req, tag)))
+                    self._wake.set()
+                except Exception as e:
+                    writer.respond(_encode_msg(
+                        {"error": f"{type(e).__name__}: {e}",
+                         "tag": tag}))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            writer.close()
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+
+
+class EngineClient:
+    """Client for EngineServer; supports pipelined generation requests."""
+
+    def __init__(self, host: str, port: int):
+        import socket as _socket
+
+        self._sock = _socket.create_connection((host, port))
+        self._sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+        self._tag = 0
+
+    def send_gen(self, prompt: Sequence[int], max_new: int = 16,
+                 tag=None):
+        from .serving import _send_msg
+        with self._lock:
+            self._tag += 1
+            tag = self._tag if tag is None else tag
+            _send_msg(self._sock, {"gen": {
+                "prompt": [int(t) for t in prompt],
+                "max_new": int(max_new), "tag": tag}})
+        return tag
+
+    def recv_done(self):
+        """Next completion: (tag, tokens, latency_ms). Completion order is
+        the engine's, not send order."""
+        from .serving import _recv_msg
+        header, _ = _recv_msg(self._sock)
+        if header is None:
+            raise ConnectionError("server closed the connection")
+        if "error" in header:
+            raise RuntimeError(f"server error: {header['error']}")
+        d = header["done"]
+        return d["tag"], d["tokens"], d["latency_ms"]
+
+    def generate(self, prompt: Sequence[int], max_new: int = 16
+                 ) -> List[int]:
+        tag = self.send_gen(prompt, max_new)
+        got_tag, tokens, _ = self.recv_done()
+        if got_tag != tag:
+            raise RuntimeError(
+                f"unexpected completion tag {got_tag} (want {tag}); use "
+                f"send_gen/recv_done for pipelined requests")
+        return tokens
+
+    def close(self):
+        self._sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()

@@ -19,16 +19,57 @@ import time
 
 import numpy as np
 
-_CHIP_SPECS = (("v5 lite", 197.0), ("v5e", 197.0), ("v5p", 459.0),
-               ("v6", 918.0), ("v4", 275.0))
+_CHIP_SPECS = (("v5 lite", 197.0, 819.0), ("v5e", 197.0, 819.0),
+               ("v5p", 459.0, 2765.0), ("v6", 918.0, 1640.0),
+               ("v4", 275.0, 1228.0))
 
 
 def _peak(dev):
     kind = (getattr(dev, "device_kind", "") or "").lower()
-    for sub, p in _CHIP_SPECS:
+    for sub, p, _ in _CHIP_SPECS:
         if sub in kind:
             return p
     return None
+
+
+def _hbm(dev):
+    kind = (getattr(dev, "device_kind", "") or "").lower()
+    for sub, _, h in _CHIP_SPECS:
+        if sub in kind:
+            return h
+    return None
+
+
+# every row names its binding bound so the artifact is self-interpreting
+# (VERDICT r5 #4): mxu | hbm = roofline sides from XLA's own cost model;
+# gather-bw = the scattered-row bandwidth bound (deepfm — its traffic IS
+# the bound, the MXU is ~idle by design); tick-latency = the serialized
+# per-tick kernel-latency floor (stacked_lstm — fraction_of_bound shows
+# how far BELOW its roofline the latency floor pins it, the ROUND4
+# attribution pulled into the artifact).
+_BOUND_KIND = {
+    "stacked_lstm": "tick-latency",
+    "deepfm": "gather-bw",
+}
+
+
+def _bound_fields(name, step_ms, flops, bytes_acc, peak, hbm_gbps):
+    if not (flops and peak):
+        return {}
+    ideal_mxu = flops / (peak * 1e12) * 1e3
+    ideal_hbm = (bytes_acc / (hbm_gbps * 1e9) * 1e3
+                 if bytes_acc and hbm_gbps else None)
+    kind = next((v for k, v in _BOUND_KIND.items() if k in name), None)
+    if kind is None:
+        kind = ("hbm" if ideal_hbm and ideal_hbm > ideal_mxu else "mxu")
+    binding = max(ideal_mxu, ideal_hbm or 0.0)
+    return {
+        "bound_kind": kind,
+        "ideal_mxu_ms": round(ideal_mxu, 3),
+        "ideal_hbm_ms_xla_bytes": (round(ideal_hbm, 3)
+                                   if ideal_hbm else None),
+        "fraction_of_bound": round(binding / step_ms, 3),
+    }
 
 
 def _measure(name, build, unit, iters=20):
@@ -83,6 +124,7 @@ def _measure(name, build, unit, iters=20):
 
     ca = exe.cost_analysis(feed=feeds[0], fetch_list=[loss])
     flops = float(ca.get("flops", 0.0)) if ca else 0.0
+    bytes_acc = float(ca.get("bytes accessed", 0.0)) if ca else 0.0
     dev = jax.devices()[0]
     peak = _peak(dev)
     implied = flops * iters / dt / 1e12 if flops else None
@@ -96,6 +138,8 @@ def _measure(name, build, unit, iters=20):
             "flops_per_step_xla": flops,
             "implied_tflops": round(implied, 2) if implied else None,
             "mfu": (round(implied / peak, 4) if implied and peak else None),
+            **_bound_fields(name, dt / iters * 1e3, flops, bytes_acc,
+                            peak, _hbm(dev)),
             # first/last = mean over one full feed cycle, so the comparison
             # is over the same batches and batch-to-batch jitter cancels
             "loss_first": round(float(np.mean(losses[:k])), 4),

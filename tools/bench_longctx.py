@@ -144,15 +144,97 @@ def measure_pair(T, b=1, h=8, d=128, with_ring=False):
     return out
 
 
+# v5e inter-chip interconnect: 1600 Gbit/s aggregate per chip (public
+# spec sheet); a 1-D ring drives ONE neighbor link pair per rotation
+# direction — assume 4 link pairs per chip, i.e. 400 Gbit/s = 50 GB/s
+# effective per direction. The assumption is committed with the formula
+# so hardware can falsify it.
+_V5E_ICI_GBPS_PER_DIR = 50.0
+
+
+def ring_predicted(flash_ms_by_T, sp_list=(2, 4, 8), b=1, h=8, d=128,
+                   formulation_overhead_pct=3.3):
+    """Analytic CP scaling line from MEASURED flash-block times (VERDICT
+    r5 #8 — the honest extrapolation a single-chip environment supports).
+
+    Formula (per ring step, sp shards, fwd+bwd totals):
+      t_block(T, sp)  = t_flash(T) / sp^2          [score work is
+            quadratic in the tile extents; causal skipping scales both
+            sides of the ratio identically]
+      bytes_rot(T,sp) = 6 * b*h*(T/sp)*d * 2B      [fwd rotates k+v (2
+            tensors), bwd rotates k+v and the dk+dv partials (4), bf16]
+      t_comm          = bytes_rot / ICI_BW_per_dir
+      comm_over_compute = t_comm / t_block
+      predicted_overhead_pct = max(0, comm_over_compute - 1) * 100
+                               + measured ring-of-1 formulation overhead
+            [rotation overlaps the NEXT block's compute — comm costs
+            wall time only past ratio 1]
+    """
+    rows = []
+    for T, flash_ms in sorted(flash_ms_by_T.items()):
+        for sp in sp_list:
+            t_block = flash_ms / (sp * sp)
+            bytes_rot = 6 * b * h * (T // sp) * d * 2
+            t_comm = bytes_rot / (_V5E_ICI_GBPS_PER_DIR * 1e9) * 1e3
+            ratio = t_comm / t_block
+            rows.append({
+                "T": T, "sp": sp,
+                "t_block_ms": round(t_block, 3),
+                "rotated_MB_per_step": round(bytes_rot / 1e6, 2),
+                "t_comm_ms": round(t_comm, 3),
+                "comm_over_compute": round(ratio, 3),
+                "predicted_overhead_pct": round(
+                    max(0.0, ratio - 1.0) * 100
+                    + formulation_overhead_pct, 1),
+            })
+    return {
+        "ring_predicted": rows,
+        "assumptions": {
+            "ici_GBps_per_direction": _V5E_ICI_GBPS_PER_DIR,
+            "measured_flash_fwd_bwd_ms": {str(t): v for t, v in
+                                          sorted(flash_ms_by_T.items())},
+            "formulation_overhead_pct_measured_ring_of_1":
+                formulation_overhead_pct,
+            "formula": "t_block=t_flash/sp^2; bytes=6*b*h*(T/sp)*d*2; "
+                       "overhead=max(0, t_comm/t_block - 1) + measured "
+                       "formulation overhead (comm overlaps compute)",
+        },
+    }
+
+
 def main():
+    import argparse
     import jax
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--predict_from", default=None,
+                    help="path to a prior BENCH_LONGCTX artifact: emit "
+                         "the analytic ring_predicted block from its "
+                         "measured flash lanes (no hardware needed) and "
+                         "exit")
+    args = ap.parse_args()
+    if args.predict_from:
+        flash = {}
+        with open(args.predict_from) as f:
+            for line in f:
+                rec = json.loads(line)
+                if (isinstance(rec.get("flash"), dict)
+                        and rec["flash"].get("status") == "ok"):
+                    flash[int(rec["T"])] = rec["flash"]["ms"]
+        sel = {t: flash[t] for t in (16384, 65536) if t in flash}
+        print(json.dumps(ring_predicted(sel)), flush=True)
+        return
+
     dev = jax.devices()[0]
     on_accel = dev.platform != "cpu"
     lengths = ((2048, 4096, 8192, 16384, 32768, 65536) if on_accel
                else (256,))
+    flash_ms_by_T = {}
     for T in lengths:
         if on_accel:
             rec = {"T": T, **measure_pair(T, with_ring=T in (8192, 16384))}
+            if rec.get("flash", {}).get("status") == "ok":
+                flash_ms_by_T[T] = rec["flash"]["ms"]
         else:
             # CPU smoke: only the XLA composite runs (the Mosaic kernel
             # needs a TPU); label it as what it is
@@ -162,6 +244,10 @@ def main():
             if run:
                 run()
         print(json.dumps(rec), flush=True)
+    sel = {t: flash_ms_by_T[t] for t in (16384, 65536)
+           if t in flash_ms_by_T}
+    if sel:
+        print(json.dumps(ring_predicted(sel)), flush=True)
     print(json.dumps({
         "device_kind": getattr(dev, "device_kind", str(dev)),
         "note": "causal fwd+bwd, B=1 H=8 D=128 bf16; composite "

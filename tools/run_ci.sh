@@ -38,4 +38,25 @@ echo "== benchmark smoke =="
 JAX_PLATFORMS=cpu python tools/benchmark.py --model mnist --batch_size 8 \
     --iters 3 --warmup 1
 
+echo "== serving-engine smoke =="
+# continuous-batching engine end to end: submit through the RPC server,
+# decode over the slot cache, check a mid-batch join completes (fast:
+# tiny LM, ~15 s including compile)
+JAX_PLATFORMS=cpu python - <<'PY'
+from paddle_tpu.serving_engine import (ContinuousBatchingEngine,
+                                       EngineClient, EngineServer)
+eng = ContinuousBatchingEngine(n_slots=2, vocab=100, max_len=16,
+                               d_model=32, d_inner=64, num_heads=4,
+                               num_layers=2)
+with EngineServer(eng) as srv:
+    host, port = srv.address
+    with EngineClient(host, port) as c:
+        long_tag = c.send_gen([3], max_new=8)
+        short_tag = c.send_gen([5], max_new=2)      # joins mid-batch
+        done = dict((t, toks) for t, toks, _ in
+                    (c.recv_done(), c.recv_done()))
+        assert len(done[long_tag]) == 8 and len(done[short_tag]) == 2
+print("serving-engine smoke OK")
+PY
+
 echo "CI OK"

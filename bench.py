@@ -474,6 +474,24 @@ def _flash_attention_speedup(seq_len: int = 8192, heads: int = 8,
             "composite_ms": round(t_ref * 1e3, 2)}
 
 
+def _dp_comm_wire_evidence(dp: int = 8) -> dict:
+    """Per-device gradient bytes-on-wire per step for the current default
+    main program (the last-built ResNet train step) under the three
+    reduce modes — ring accounting, parallel/grad_comm.py's model."""
+    import paddle_tpu as pt
+    from paddle_tpu.parallel.collective import compressed_size_ratio
+    from paddle_tpu.parallel.grad_comm import spmd_allreduce_wire_bytes
+
+    ar = spmd_allreduce_wire_bytes(pt.default_main_program(), dp)
+    g = ar["grad_wire_bytes"]
+    return {
+        "allreduce": g,
+        "reduce_scatter": g // 2,           # the AG half becomes params
+        "quantized_int8_block256": int(g // 2
+                                       * compressed_size_ratio("int8", 256)),
+    }
+
+
 def main():
     import jax
 
@@ -573,6 +591,13 @@ def main():
             INFER_BASELINE_IMGS_PER_SEC,
         "h2d_staging_MBps": round(h2d_mbps, 1),
         "flash_attention_fwd_bwd_speedup_vs_xla_T8192": flash_speedup,
+        # data-parallel scale-out wire cost of THIS flagship step (ISSUE
+        # r8): analytic ring model over the program's trainable params.
+        # ResNet's batch_norm keeps it on the SPMD allreduce path (the
+        # explicit pipeline rejects batch-global ops), so reduce_scatter/
+        # quantized rows are the analytic what-if for this param set; the
+        # measured A/B lives in BENCH_DP_r08.json on the BN-free configs.
+        "dp8_grad_wire_bytes_per_step": _dp_comm_wire_evidence(),
     }
     print(json.dumps({
         "metric": f"resnet50_train_images_per_sec_bs{main_bs}_{platform}",

@@ -129,6 +129,13 @@ define_bool("fuse_decode_attention", True,
             "fused_decode_attention kernel per tick "
             "(paddle_tpu/fusion/decode_attention.py). Kill switch "
             "PTPU_FUSE_DECODE_ATTENTION=0.")
+define_bool("quant_comm", True,
+            "Allow quantized gradient collectives when the BuildStrategy "
+            "requests them (quant_comm='int8'/'bf16'). Kill switch: "
+            "PTPU_QUANT_COMM=0 forces fp32 gradient transfers everywhere "
+            "while keeping the explicit reduce-scatter pipeline — the "
+            "escape hatch if quantization ever hurts a model's "
+            "convergence in production (parallel/grad_comm.py).")
 # (num_iteration_per_drop_scope lives on ExecutionStrategy for API parity;
 # the functional executor has no per-iteration kid scopes to drop)
 define_int("sparse_dense_apply_max_bytes", 1 << 30,

@@ -33,12 +33,13 @@ from .scope import Scope, global_scope
 
 
 def _fusion_flags_key():
-    """The fuse_* flags are inputs to compilation (apply_fusion_passes reads
-    them in _build_step_fn): they must be part of the compile-cache key or
-    toggling a kill switch at runtime would silently keep serving the
-    previously compiled variant."""
+    """Flags that are inputs to compilation (apply_fusion_passes and the
+    grad-comm rewrite read them at compile time): they must be part of the
+    compile-cache key or toggling a kill switch at runtime would silently
+    keep serving the previously compiled variant."""
     return (flags.get_flag("fuse_recurrent_cells"),
-            flags.get_flag("fuse_decode_attention"))
+            flags.get_flag("fuse_decode_attention"),
+            flags.get_flag("quant_comm"))
 
 
 def _feed_signature(feed: Dict[str, Any]):
@@ -229,8 +230,16 @@ class Executor:
 
         return step
 
+    def _prepare_program(self, program: Program, scope: Scope) -> Program:
+        """Hook: executor-level program rewrite before state analysis and
+        tracing. ParallelExecutor applies the explicit gradient-comm rewrite
+        here (parallel/grad_comm.py); the base executor is a no-op. MUST be
+        idempotent — both _compile and run_steps call it."""
+        return program
+
     def _compile(self, program: Program, scope: Scope, feed_names, fetch_names,
                  in_shardings=None, out_shardings=None, analysis=None):
+        program = self._prepare_program(program, scope)
         ro, rw, out_only = analysis or self._analyze_state(
             program, scope, feed_names, fetch_names)
         state_out_names = sorted(set(rw) | set(out_only))
@@ -432,6 +441,7 @@ class Executor:
                        for f in (fetch_list or [])]
 
         k = len(feed_list)
+        program = self._prepare_program(program, scope)
         self._validate_fetches(program, feed_list[0], fetch_names)
         avail_key = self._scope_avail_key(program, scope)
         key = ("scan", k, id(program), program._version, sig0,

@@ -310,7 +310,8 @@ class Program:
                                   stop_gradient=v.stop_gradient,
                                   lod_level=v.lod_level, is_data=v.is_data)
                 for extra in ("sharding_spec", "is_optimizer_state",
-                              "optimize_attr", "staging"):
+                              "optimize_attr", "staging", "accumulator_of",
+                              "dp_shard_update", "dp_replica_state"):
                     if hasattr(v, extra):
                         setattr(nv, extra, getattr(v, extra))
                 nb.vars[name] = nv

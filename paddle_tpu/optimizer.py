@@ -67,9 +67,14 @@ class Optimizer:
         var = main_block.create_var(name=var_name, shape=shape, dtype=dtype,
                                     persistable=True)
         var.stop_gradient = True
-        # marker consumed by ParallelExecutor's Reduce (ZeRO-1) strategy:
-        # optimizer state may be sharded across the data axis.
+        # markers consumed by ParallelExecutor's Reduce (ZeRO-1) strategy
+        # and the explicit gradient pipeline (parallel/grad_comm.py):
+        # optimizer state may be sharded across the data axis, and the
+        # backref says WHOSE state this is — the comm pass shards a
+        # same-shaped accumulator with its parameter's update slice
+        # without guessing from shape coincidences.
         var.is_optimizer_state = True
+        var.accumulator_of = param.name
         # same-shaped accumulators of a TP/EP-sharded parameter live with
         # the same layout as the parameter.
         pspec = getattr(param, "sharding_spec", None)

@@ -16,6 +16,7 @@ from .mesh import (DeviceMesh, get_default_mesh, set_default_mesh,  # noqa: F401
 from .strategy import BuildStrategy, ExecutionStrategy, ReduceStrategy  # noqa: F401
 from .parallel_executor import ParallelExecutor  # noqa: F401
 from . import collective  # noqa: F401
+from . import grad_comm  # noqa: F401
 from . import tensor_parallel  # noqa: F401
 from . import pipeline  # noqa: F401
 from . import ring_attention  # noqa: F401

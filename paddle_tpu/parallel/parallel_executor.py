@@ -34,7 +34,9 @@ from ..core.enforce import InvalidArgumentError, enforce
 from ..framework.executor import Executor
 from ..framework.program import Program, Variable, default_main_program
 from ..framework.scope import Scope, global_scope
-from .mesh import DATA_AXIS, SEQUENCE_AXIS, DeviceMesh, get_default_mesh
+from . import grad_comm as _grad_comm
+from .mesh import (DATA_AXIS, SEQUENCE_AXIS, DeviceMesh, get_default_mesh,
+                   shard_map as _shard_map)
 from .strategy import (BuildStrategy, ExecutionStrategy,
                        GradientScaleStrategy, ReduceStrategy)
 
@@ -64,6 +66,13 @@ class ParallelExecutor(Executor):
             self.scope = share_vars_from.scope
         self._dp = self.mesh.axis_size(DATA_AXIS)
         self._feed_shapes: Dict[str, tuple] = {}
+        self._comm_cache: Dict[Any, Program] = {}
+        if (_grad_comm.explicit_comm_config(self.build_strategy) is not None):
+            enforce(DATA_AXIS in self.mesh.axes,
+                    f"the explicit gradient pipeline (ReduceScatter / "
+                    f"quant_comm) needs a {DATA_AXIS!r} axis in the mesh, "
+                    f"got axes {self.mesh.axis_names}",
+                    exc=InvalidArgumentError)
         if (self.build_strategy.gradient_scale_strategy
                 == GradientScaleStrategy.CoeffNumDevice):
             raise NotImplementedError(
@@ -87,6 +96,18 @@ class ParallelExecutor(Executor):
             # parallel.auto_shard annotation; mesh.sharding drops axis names
             # not present in this mesh (replicated there).
             return self.mesh.sharding(*spec)
+        if getattr(program, "_dp_comm_applied", False):
+            # explicit pipeline: placement follows the comm pass's markers —
+            # sharded-update accumulators and per-replica error-feedback
+            # state live split on dim 0 over dp; everything else replicated
+            # (the Reduce heuristic below must NOT apply: an accumulator the
+            # pass left on the full-update path is consumed whole per shard)
+            if v is not None and v.shape and (
+                    getattr(v, "dp_shard_update", False)
+                    or getattr(v, "dp_replica_state", False)):
+                return self.mesh.sharding(DATA_AXIS,
+                                          *([None] * (len(v.shape) - 1)))
+            return self.mesh.replicated()
         if (self.build_strategy.reduce_strategy == ReduceStrategy.Reduce
                 and v is not None
                 and getattr(v, "is_optimizer_state", False)
@@ -97,9 +118,24 @@ class ParallelExecutor(Executor):
                                       *([None] * (len(v.shape) - 1)))
         return self.mesh.replicated()
 
+    def _batch_led_feed(self, program: Program, name: str) -> bool:
+        """A feed DECLARED batch-led ([-1, ...]) — or undeclared (sidecars
+        like @SEQLEN, batch-led by construction). Shared rule with
+        _pad_for_dp."""
+        v = self._find_var(program, name)
+        shape = getattr(v, "shape", None) if v is not None else None
+        return shape is None or (bool(shape) and shape[0] == -1)
+
     def _feed_sharding(self, program: Program, name: str,
                        shape) -> NamedSharding:
         if not shape:  # scalar feed
+            return self.mesh.replicated()
+        if (getattr(program, "_dp_comm_applied", False)
+                and not self._batch_led_feed(program, name)):
+            # explicit pipeline: the per-shard step consumes a fixed-shape
+            # auxiliary feed WHOLE — splitting it would hand each shard a
+            # fragment (the SPMD partitioner can split it safely; manual
+            # per-shard code cannot)
             return self.mesh.replicated()
         if (self.build_strategy.enable_sequence_parallel and len(shape) >= 2):
             v = self._find_var(program, name)
@@ -132,6 +168,7 @@ class ParallelExecutor(Executor):
 
     def _compile(self, program: Program, scope: Scope, feed_names, fetch_names,
                  in_shardings=None, out_shardings=None, analysis=None):
+        program = self._prepare_program(program, scope)
         analysis = analysis or self._analyze_state(program, scope, feed_names,
                                                    fetch_names)
         ro, rw, out_only = analysis
@@ -142,6 +179,190 @@ class ParallelExecutor(Executor):
         return super()._compile(
             program, scope, feed_names, fetch_names,
             in_shardings=in_sh, out_shardings=out_sh, analysis=analysis)
+
+    # -- explicit gradient-comm pipeline (parallel/grad_comm.py) ----------
+    def _prepare_program(self, program: Program, scope: Scope) -> Program:
+        """BuildStrategy-driven program rewrite: when the strategy asks for
+        the explicit pipeline (ReduceScatter reduce mode and/or quantized
+        collectives), apply comm_optimize_pass to a clone — cached per
+        (program, version, resolved config) — and zero-init any per-replica
+        error-feedback state the pass declared. Idempotent (the base
+        Executor calls it again inside _compile)."""
+        if getattr(program, "_dp_comm_applied", False):
+            return program
+        cfg = _grad_comm.explicit_comm_config(self.build_strategy)
+        if cfg is None:
+            # still reconcile: a PREVIOUS explicit-mode config may have
+            # left sharded state behind (kill-switch flip back to SPMD)
+            self._reconcile_state_placement(program, scope, None)
+            return program
+        enforce(not self.build_strategy.enable_sequence_parallel,
+                "the explicit gradient pipeline is a pure data-parallel "
+                "path: it runs the step manually over the WHOLE mesh, so "
+                "sequence-parallel feed splitting (enable_sequence_parallel) "
+                "cannot compose with it — use the SPMD AllReduce/Reduce "
+                "strategies for sp programs",
+                exc=InvalidArgumentError)
+        for b in program.blocks:
+            for v in b.vars.values():
+                spec = getattr(v, "sharding_spec", None)
+                # only a spec that still names a LIVE axis on this mesh is
+                # truly sharded — an annotation resolving to all-None
+                # (general-mesh annotation run on a dp-only mesh) is
+                # replicated and composes fine
+                if (v.persistable and spec is not None
+                        and any(s is not None
+                                for s in self.mesh.pspec(*spec))):
+                    raise InvalidArgumentError(
+                        f"parameter {v.name!r} is sharded over mesh axes "
+                        f"{spec} — the explicit gradient pipeline "
+                        f"(ReduceScatter / quant_comm) runs the step "
+                        f"manually over the whole mesh and would compute "
+                        f"partial tensor-parallel products without their "
+                        f"collectives. Use the SPMD AllReduce/Reduce "
+                        f"strategies for TP/EP-sharded programs")
+        key = (id(program), program._version, tuple(sorted(cfg.items())))
+        rewritten = self._comm_cache.get(key)
+        if rewritten is None:
+            rewritten = _grad_comm.comm_optimize_pass(program, self._dp, cfg)
+            self._comm_cache[key] = rewritten
+        for v in rewritten.global_block().vars.values():
+            if getattr(v, "dp_replica_state", False) \
+                    and not scope.has_var(v.name):
+                scope.set_var(v.name, jax.device_put(
+                    np.zeros(v.shape, np.float32),
+                    self._state_sharding(rewritten, v.name)))
+        self._reconcile_state_placement(
+            rewritten, scope, tuple(sorted(cfg.items())))
+        return rewritten
+
+    def _reconcile_state_placement(self, program: Program, scope: Scope,
+                                   cfg_key):
+        """Live state placed under a DIFFERENT comm config (the
+        PTPU_QUANT_COMM kill switch flipped, or the strategy's pipeline
+        toggled between executors sharing a scope) may sit sharded where
+        the new compile expects replicated or vice versa — jit would then
+        reject the arg/sharding mismatch. On config change, re-place every
+        fully-addressable persistable to the placement this program
+        expects. Cross-process arrays are left alone (resharding them is a
+        collective; flip the switch before process start in that world)."""
+        marks = getattr(self, "_scope_cfg", None)
+        if marks is None:
+            marks = self._scope_cfg = {}
+        if marks.get(id(scope), "<unset>") == cfg_key:
+            return
+        for b in program.blocks:
+            for v in b.vars.values():
+                if not v.persistable or not scope.has_var(v.name):
+                    continue
+                val = scope.get(v.name)
+                sh = getattr(val, "sharding", None)
+                if sh is None or not getattr(val, "is_fully_addressable",
+                                             True):
+                    continue
+                want = self._state_sharding(program, v.name)
+                if not sh.is_equivalent_to(want, getattr(val, "ndim", 0)):
+                    scope.set_var(v.name, jax.device_put(val, want))
+        marks[id(scope)] = cfg_key
+
+    def _build_step_fn(self, program, feed_names, fetch_names, ro, rw,
+                       state_out_names):
+        """Explicit mode: run the whole step as per-shard SPMD code —
+        shard_map manual over the data axis (other mesh axes stay with the
+        partitioner), so the dp_grad_comm / dp_shard_* ops the comm pass
+        spliced in can issue their own collectives. Feeds arrive as the
+        local batch slice; gradients leave the vjp as LOCAL partials and
+        cross the wire only through dp_grad_comm."""
+        step = super()._build_step_fn(program, feed_names, fetch_names,
+                                      ro, rw, state_out_names)
+        if not getattr(program, "_dp_comm_applied", False):
+            return step
+
+        def dp_only(ns: NamedSharding) -> PartitionSpec:
+            # manual specs may only name manual axes: keep the dp
+            # component, everything else (tp/sp placements ride the
+            # partitioner via the jit shardings) becomes None
+            cleaned = []
+            for s in ns.spec:
+                if s == DATA_AXIS or (isinstance(s, (tuple, list))
+                                      and DATA_AXIS in s):
+                    cleaned.append(DATA_AXIS)
+                else:
+                    cleaned.append(None)
+            return PartitionSpec(*cleaned)
+
+        feed_specs = tuple(dp_only(self._feed_sharding(
+            program, n, self._feed_shapes.get(n))) for n in feed_names)
+        ro_specs = tuple(dp_only(self._state_sharding(program, n))
+                         for n in ro)
+        rw_specs = tuple(dp_only(self._state_sharding(program, n))
+                         for n in rw)
+        state_specs = tuple(dp_only(self._state_sharding(program, n))
+                            for n in state_out_names)
+        batch_led = self._batch_led_fetches(program, fetch_names)
+        fetch_specs = tuple(PartitionSpec(DATA_AXIS) if led
+                            else PartitionSpec() for led in batch_led)
+        # fetch contract: non-batch-led fetches come back pmean'd — exact
+        # for batch-mean statistics (loss, accuracy), WRONG by 1/dp for a
+        # batch sum. Reject the directly-detectable sum fetches instead of
+        # silently rescaling them (docs/data_parallel.md).
+        producers = {n: op.type for blk in program.blocks
+                     for op in blk.ops for n in op.output_names()}
+        for name, led in zip(fetch_names, batch_led):
+            if led:
+                continue
+            enforce(producers.get(name) not in ("reduce_sum", "sum"),
+                    f"fetch {name!r} is a sum reduction: the explicit "
+                    f"gradient pipeline returns non-batch-led fetches as "
+                    f"the MEAN over data shards, which would silently "
+                    f"divide a batch sum by {self._dp}. Fetch a mean-form "
+                    f"statistic (or the per-row tensor) instead, or use "
+                    f"the SPMD AllReduce/Reduce strategies",
+                    exc=InvalidArgumentError)
+
+        def shard_step(dp_idx, feed_vals, ro_vals, rw_vals, seed):
+            # dp_idx: local slice of a dp-sharded arange — the shard's data
+            # index without a PartitionId instruction (lax.axis_index is
+            # rejected by the partitioner inside partial-manual regions)
+            idx = dp_idx[0]
+            # decorrelate per-shard randomness (dropout masks must differ
+            # across batch shards like they do across rows in SPMD mode)
+            seed = seed + idx.astype(jnp.uint32) * np.uint32(2654435761)
+            with _grad_comm.dp_index_scope(idx):
+                fetches, new_state = step(feed_vals, ro_vals, rw_vals, seed)
+            merged = []
+            for f, led in zip(fetches, batch_led):
+                if led:
+                    merged.append(f)   # local rows; out_spec dp reassembles
+                elif (hasattr(f, "dtype")
+                        and jnp.issubdtype(f.dtype, jnp.inexact)):
+                    # scalar/statistic fetches are batch means (loss,
+                    # accuracy): mean of equal-size shard means == the
+                    # global-batch mean. Replicated values pass through
+                    # unchanged (pmean of identical copies).
+                    merged.append(jax.lax.pmean(f, DATA_AXIS))
+                else:
+                    merged.append(f)
+            return tuple(merged), new_state
+
+        # FULL-manual over every mesh axis (dp-only specs replicate values
+        # across tp/sp, matching what SPMD mode computes for a pure-DP
+        # program on the same mesh). Partial-manual (auto=tp/sp) would be
+        # the composable form, but this jax/XLA rejects PartitionId and
+        # trips manual-subgroup checks inside partial-manual regions — the
+        # TP gate in _prepare_program keeps the contract honest instead.
+        mapped = _shard_map(shard_step, mesh=self.mesh.jax_mesh,
+                            in_specs=(PartitionSpec(DATA_AXIS), feed_specs,
+                                      ro_specs, rw_specs, PartitionSpec()),
+                            out_specs=(fetch_specs, state_specs),
+                            check_vma=False)
+        dp = self._dp
+
+        def wrapped(feed_vals, ro_vals, rw_vals, seed):
+            return mapped(jnp.arange(dp, dtype=jnp.int32), feed_vals,
+                          ro_vals, rw_vals, seed)
+
+        return wrapped
 
     def _pad_for_dp(self, program, feed):
         """Make a partial batch runnable: pad every batch-dim feed up to the
@@ -159,9 +380,7 @@ class ParallelExecutor(Executor):
             # not be wrapped (mirrors _batch_led_fetches on the fetch
             # side). Undeclared feeds (sidecars like @SEQLEN) are batch-led
             # by construction.
-            v = self._find_var(program, name)
-            shape = getattr(v, "shape", None) if v is not None else None
-            return shape is None or (bool(shape) and shape[0] == -1)
+            return self._batch_led_feed(program, name)
 
         sizes = {np.shape(v)[0] for n, v in feed.items()
                  if np.ndim(v) >= 1 and _batch_led(n)}
@@ -174,6 +393,13 @@ class ParallelExecutor(Executor):
         b = sizes.pop()
         if b % self._dp == 0:
             return feed, b, b
+        enforce(_grad_comm.explicit_comm_config(self.build_strategy) is None,
+                f"feed batch size {b} is not divisible by data-parallel "
+                f"degree {self._dp}: the explicit gradient pipeline "
+                f"(ReduceScatter / quant_comm) derives the global-mean "
+                f"gradient from EQUAL per-shard batches, so wrap-padding "
+                f"would bias it. Feed dp-divisible batches in this mode",
+                exc=InvalidArgumentError)
         enforce(BATCH_ROW_MASK_NAME in program.global_block().vars,
                 f"feed batch size {b} is not divisible by data-parallel "
                 f"degree {self._dp}, and the program does not declare "
@@ -256,6 +482,12 @@ class ParallelExecutor(Executor):
         addressable shards."""
         program = program or self.main_program or default_main_program()
         scope = scope or self.scope
+        # rewrite for the explicit gradient pipeline BEFORE any placement
+        # decision: _globalize_state/_place_feed_stack consult the
+        # rewritten program's markers (sharded accumulators, error state,
+        # replicated aux feeds), and the base run_steps would rewrite
+        # anyway — doing it here keeps both views identical
+        program = self._prepare_program(program, scope)
         enforce(len(feed_list) >= 1, "run_steps needs at least one feed",
                 exc=InvalidArgumentError)
         padded_list = []
@@ -341,6 +573,8 @@ class ParallelExecutor(Executor):
         Argument order follows the reference (fetch_list first)."""
         program = program or self.main_program or default_main_program()
         scope = scope or self.scope
+        # see run_steps: placement below must read the REWRITTEN program
+        program = self._prepare_program(program, scope)
         feed, real_b, padded_b = self._pad_for_dp(program, dict(feed or {}))
         # synthesize the batch-row mask BEFORE multi-process placement: the
         # base Executor would otherwise inject a host numpy array after the

@@ -24,9 +24,18 @@ class ReduceStrategy(enum.Enum):
     XLA lowers the parameter update to reduce-scatter(grad) + sharded update +
     all-gather(param) (the TPU-native form of the reference's reduce-to-owner
     + broadcast, multi_devices_graph_pass.cc:412-418,445-453).
+    ReduceScatter: the explicit comm-optimized pipeline ("Automatic
+    Cross-Replica Sharding of Weight Update in Data-Parallel Training",
+    PAPERS.md): the step runs as per-shard SPMD code over the data axis,
+    every gradient is psum_scatter'd so it is NEVER materialized unsharded,
+    optimizer math runs on the local shard only, and the updated shards are
+    all-gathered. Structurally asserted: no all-reduce carries gradient
+    bytes (tests/test_comm_structure.py). Composes with
+    BuildStrategy.quant_comm for quantized transfers.
     """
     AllReduce = 0
     Reduce = 1
+    ReduceScatter = 2
 
 
 class GradientScaleStrategy(enum.Enum):
@@ -50,6 +59,24 @@ class BuildStrategy:
     debug_graphviz_path: str = ""
     memory_optimize: bool = False
     enable_sequence_parallel: bool = False
+    # --- communication-optimized gradient pipeline (parallel/grad_comm.py) --
+    # Wire dtype for gradient collectives: "" = fp32 (off), "int8" =
+    # block-scaled symmetric quantization (≙ EQuARX, PAPERS.md), "bf16" =
+    # half-width cast. Setting this switches the executor to the explicit
+    # per-shard gradient pipeline (like ReduceScatter). Runtime kill switch:
+    # PTPU_QUANT_COMM=0 forces fp32 wire regardless of this field.
+    quant_comm: str = ""
+    # One f32 scale per this many gradient values on the int8 wire.
+    quant_comm_block: int = 256
+    # Per-replica error feedback: each shard accumulates its quantization
+    # residual and adds it to the next step's contribution (state rides the
+    # executor's donated carry; see docs/data_parallel.md).
+    comm_error_feedback: bool = False
+    # Coalesce small gradients into flat transfer buckets of at most this
+    # many bytes before the collective (≙ the reference's fuse_all_reduce
+    # capability, build_strategy.h fuse_all_reduce_ops_). 0 disables
+    # bucketing (one collective per gradient — the probe_overlap A/B side).
+    comm_bucket_bytes: int = 4 << 20
 
 
 @dataclass

@@ -34,7 +34,9 @@ from paddle_tpu.parallel.mesh import DeviceMesh
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "tools"))
-from probe_common import hlo_shape_bytes as _shape_bytes  # noqa: E402
+# census shared with the benchmark's grad_bytes_on_wire reporting and the
+# explicit-pipeline suite (tests/test_zero_comm.py) — one byte model
+from probe_common import collective_census  # noqa: E402
 
 
 @pytest.fixture
@@ -47,24 +49,6 @@ def _fresh():
     pt.reset_default_programs()
     pt.reset_global_scope()
     yield
-
-
-def collective_census(hlo: str):
-    """{kind: [(output_bytes, line)]} for every collective instruction in
-    the compiled module (async pairs counted once, at the -start)."""
-    out = {}
-    for line in hlo.splitlines():
-        m = re.match(
-            r"\s*(?:ROOT )?%?[\w.\-]+ = (\([^=]*?\)|\S+)\s+"
-            r"(all-reduce|reduce-scatter|all-gather|collective-permute|"
-            r"all-to-all)(-start|-done)?\(", line)
-        if not m:
-            continue
-        if m.group(3) == "-done":
-            continue
-        kind = m.group(2)
-        out.setdefault(kind, []).append((_shape_bytes(m.group(1)), line))
-    return out
 
 
 def _compiled_step_hlo(exe, feed, loss, scope=None):

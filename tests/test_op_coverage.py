@@ -1639,6 +1639,13 @@ COVERED_ELSEWHERE = {
     "fused_lstm": "tests/test_fusion.py",
     "fused_gru": "tests/test_fusion.py",
     "fused_decode_attention": "tests/test_fusion.py",
+    # explicit gradient pipeline (registered when paddle_tpu.parallel is
+    # imported): these lower collectives over the dp axis, so the harness
+    # here (single-device, no shard_map context) cannot drive them —
+    # parity + census + state tests live in the dedicated suites
+    "dp_grad_comm": "tests/test_zero_comm.py",
+    "dp_shard_slice": "tests/test_zero_comm.py",
+    "dp_shard_all_gather": "tests/test_zero_comm.py",
 }
 
 

@@ -192,9 +192,16 @@ def _spawn_child(args, extra_env, extra_args=()):
             "--iters", str(args.iters), "--warmup", str(args.warmup),
             "--seq_len", str(args.seq_len), "--depth", str(args.depth),
             "--learning_rate", str(args.learning_rate),
-            "--optimizer", args.optimizer] + list(extra_args)
+            "--optimizer", args.optimizer,
+            "--reduce_mode", args.reduce_mode,
+            "--comm_bucket_bytes", str(args.comm_bucket_bytes)] \
+        + list(extra_args)
     if args.no_bf16:
         argv.append("--no_bf16")
+    if args.comm_error_feedback:
+        argv.append("--comm_error_feedback")
+    if args.no_census:
+        argv.append("--no_census")
     out_f = tempfile.TemporaryFile(mode="w+", prefix="ptpu_bench_out_")
     err_f = tempfile.TemporaryFile(mode="w+", prefix="ptpu_bench_err_")
     p = subprocess.Popen(argv, stdout=out_f, stderr=err_f, text=True,
@@ -315,6 +322,15 @@ def _drive_multiproc(args):
         if paths:
             merged_trace = prof.merge_process_traces(
                 paths, os.path.join(trace_dir, "merged_trace.json"))
+    # the per-rank comm fields are identical across ranks (same compiled
+    # step); lift rank 0's into the aggregate row so multiproc rows stay
+    # self-interpreting like the collective ones
+    rank0 = ranks.get(0, {})
+    comm_fields = {k: rank0[k] for k in
+                   ("reduce_mode", "grad_bytes_on_wire",
+                    "param_allgather_bytes_on_wire", "wire_bytes_per_step",
+                    "wire_bytes_census", "census_collectives")
+                   if k in rank0}
     print(json.dumps({
         "model": args.model,
         "update_method": "multiproc",
@@ -322,6 +338,7 @@ def _drive_multiproc(args):
         "local_devices_per_proc": args.local_devices,
         "total_devices": total_dev,
         "batch_size": args.batch_size,
+        **comm_fields,
         "per_process_latency_ms": {str(k): v["latency_ms"]
                                    for k, v in sorted(ranks.items())},
         "worst_rank_latency_ms": worst,
@@ -364,6 +381,23 @@ def main():
                    help="multiproc: virtual devices per process")
     p.add_argument("--optimizer", default="momentum",
                    choices=["sgd", "momentum", "adam"])
+    p.add_argument("--reduce_mode", default="allreduce",
+                   choices=["allreduce", "reduce_scatter", "quantized"],
+                   help="gradient path for collective/multiproc runs: "
+                        "allreduce = SPMD default; reduce_scatter = "
+                        "explicit psum_scatter + sharded update + "
+                        "all-gather; quantized = reduce_scatter with "
+                        "int8 block-scaled transfers "
+                        "(parallel/grad_comm.py)")
+    p.add_argument("--comm_error_feedback", action="store_true",
+                   help="per-replica error feedback for quantized mode")
+    p.add_argument("--comm_bucket_bytes", type=int, default=-1,
+                   help="gradient transfer bucket cap; -1 = strategy "
+                        "default (4 MiB), 0 = one collective per gradient "
+                        "(the probe_overlap A/B side)")
+    p.add_argument("--no_census", action="store_true",
+                   help="skip the HLO comm census fields (saves one AOT "
+                        "compile on big models)")
     p.add_argument("--no_bf16", action="store_true")
     p.add_argument("--profile", action="store_true")
     p.add_argument("--trace_dir", default=None,
@@ -381,6 +415,7 @@ def main():
 
     import numpy as np
     import jax
+    import jax.numpy as jnp
     import paddle_tpu as pt
 
     from paddle_tpu.distributed import init_parallel_env
@@ -400,7 +435,20 @@ def main():
     exe.run(pt.default_startup_program())
     if args.update_method == "collective":
         from paddle_tpu.parallel import ParallelExecutor
-        runner = ParallelExecutor(loss_name=loss.name)
+        from paddle_tpu.parallel.strategy import (BuildStrategy,
+                                                  ReduceStrategy)
+        bst = BuildStrategy()
+        bst.reduce_strategy = {
+            "allreduce": ReduceStrategy.AllReduce,
+            "reduce_scatter": ReduceStrategy.ReduceScatter,
+            "quantized": ReduceStrategy.ReduceScatter,
+        }[args.reduce_mode]
+        if args.reduce_mode == "quantized":
+            bst.quant_comm = "int8"
+        bst.comm_error_feedback = args.comm_error_feedback
+        if args.comm_bucket_bytes >= 0:
+            bst.comm_bucket_bytes = args.comm_bucket_bytes
+        runner = ParallelExecutor(loss_name=loss.name, build_strategy=bst)
     else:
         runner = exe
 
@@ -429,6 +477,43 @@ def main():
         pt.profiler.export_chrome_tracing(os.path.join(
             args.trace_dir, f"trace_rank{denv.trainer_id}.json"))
 
+    comm_fields = {}
+    if args.update_method == "collective":
+        # self-interpreting comm fields (≙ the r07 breadth rows carrying
+        # bound_kind): which gradient path ran and what it puts on the
+        # wire per device per step — analytic from the rewritten program's
+        # comm plan, cross-checked by the HLO census when affordable
+        # (the census == analytic balance is asserted exactly in
+        # tests/test_zero_comm.py)
+        from paddle_tpu.parallel import grad_comm as _gc
+        prog, scope = pt.default_main_program(), pt.global_scope()
+        dp = runner._dp
+        rewritten = runner._prepare_program(prog, scope)
+        analytic = (_gc.analytic_wire_bytes(rewritten, dp)
+                    or _gc.spmd_allreduce_wire_bytes(prog, dp))
+        comm_fields = {
+            "reduce_mode": args.reduce_mode,
+            "total_devices": dp,
+            "grad_bytes_on_wire": analytic["grad_wire_bytes"],
+            "param_allgather_bytes_on_wire":
+                analytic["param_allgather_wire_bytes"],
+            "wire_bytes_per_step": analytic["wire_bytes"],
+        }
+        if not args.no_census:
+            from probe_common import census_wire_bytes, collective_census
+            cs = list(runner._cache.values())[-1]
+            feed_vals = tuple(jnp.asarray(feed[n]) if n in feed else
+                              scope.get(n) for n in cs.feed_names)
+            ro = tuple(scope.get(n) for n in cs.ro_names)
+            rw = tuple(scope.get(n) for n in cs.rw_names)
+            hlo = cs.fn.lower(feed_vals, ro, rw,
+                              np.uint32(0)).compile().as_text()
+            census = collective_census(hlo)
+            comm_fields["wire_bytes_census"] = int(census_wire_bytes(
+                census, dp, min_bytes=8))
+            comm_fields["census_collectives"] = {
+                k: len(v) for k, v in census.items()}
+
     unit = ("tokens/sec" if args.model in
             ("transformer", "machine_translation") else "examples/sec")
     print(json.dumps({
@@ -442,6 +527,7 @@ def main():
         "throughput": round(units_per_step * args.iters / dt, 2),
         "unit": unit,
         "device": jax.devices()[0].platform,
+        **comm_fields,
     }))
 
 

@@ -46,6 +46,8 @@ PUBLIC_MODULES = [
     "paddle_tpu.transpiler",
     "paddle_tpu.distributed",
     "paddle_tpu.parallel",
+    "paddle_tpu.parallel.collective",
+    "paddle_tpu.parallel.grad_comm",
     "paddle_tpu.data",
     "paddle_tpu.fusion",
 ]

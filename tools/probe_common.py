@@ -61,6 +61,64 @@ def hlo_shape_bytes(sh: str) -> int:
     return total
 
 
+def collective_census(hlo: str) -> Dict[str, list]:
+    """{kind: [(output_bytes, line)]} for every collective instruction in a
+    compiled (per-device) HLO module. Async pairs are counted once, at the
+    -start; tuple-shaped outputs (all-to-all emits one operand per peer,
+    with /*index=N*/ comments past 5 elements) sum their elements."""
+    import re
+    out: Dict[str, list] = {}
+    for line in hlo.splitlines():
+        # tuple shapes may nest one paren level INSIDE the tuple: TPU
+        # layouts print as {1,0:T(8,128)} — [^()] alone would stop there
+        # and silently drop the instruction from the census
+        m = re.match(
+            r"\s*(?:ROOT )?%?[\w.\-]+ = "
+            r"(\((?:[^()]|\([^()]*\))*\)|\S+)\s+"
+            r"(all-reduce|reduce-scatter|all-gather|collective-permute|"
+            r"all-to-all)(-start|-done)?\(", line)
+        if not m:
+            continue
+        if m.group(3) == "-done":
+            continue
+        kind = m.group(2)
+        out.setdefault(kind, []).append((hlo_shape_bytes(m.group(1)), line))
+    return out
+
+
+# Per-device bytes each collective puts on the interconnect, as a function
+# of its (per-device) OUTPUT bytes in the partitioned HLO — the standard
+# ring-algorithm accounting, shared by the comm-structure tests and the
+# benchmark's grad_bytes_on_wire field so both quote the same model:
+#   all-reduce out=n:        ring RS+AG, sends 2n(N-1)/N
+#   reduce-scatter out=c:    input N*c, sends c(N-1)
+#   all-gather out=n:        contributes n/N, sends n(N-1)/N
+#   all-to-all out total=t:  keeps its own chunk, sends t(N-1)/N
+#   collective-permute out=n: sends n
+def collective_wire_bytes(kind: str, out_bytes: int, n_devices: int) -> float:
+    n = n_devices
+    return {
+        "all-reduce": 2.0 * out_bytes * (n - 1) / n,
+        "reduce-scatter": float(out_bytes) * (n - 1),
+        "all-gather": float(out_bytes) * (n - 1) / n,
+        "all-to-all": float(out_bytes) * (n - 1) / n,
+        "collective-permute": float(out_bytes),
+    }[kind]
+
+
+def census_wire_bytes(census: Dict[str, list], n_devices: int,
+                      min_bytes: int = 0) -> float:
+    """Total per-device interconnect bytes for one step, from a
+    collective_census; instructions with output below `min_bytes` can be
+    excluded (scalar loss/metric reductions)."""
+    total = 0.0
+    for kind, items in census.items():
+        for b, _ in items:
+            if b >= min_bytes:
+                total += collective_wire_bytes(kind, b, n_devices)
+    return total
+
+
 def measure_step(build: Callable[[], Tuple], make_feed: Callable[[], Dict],
                  iters: int = 15, windows: int = 3, hlo_path: str = None):
     """build() -> (loss_var, optimizer); make_feed() -> feed dict.

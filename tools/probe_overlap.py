@@ -1,0 +1,268 @@
+#!/usr/bin/env python
+"""Comm/compute overlap census for the data-parallel gradient pipeline
+(ISSUE r8): decompose profiler trace spans into comm-exposed vs
+comm-overlapped time per step, and A/B gradient bucketing (the reference's
+fuse_all_reduce capability) against one-collective-per-gradient.
+
+Method. The XLA:CPU thunk executor emits one device-category trace span
+per HLO instruction (reduce-scatter.N / all-gather.N / all-to-all.N /
+fusions / dots ...). For a traced window of steps we merge, across the
+whole process timeline:
+
+  comm      = union of collective spans
+  compute   = union of every other device-category span
+  exposed   = |comm \\ compute|   (collective time nothing computes under)
+  overlapped= |comm ∩ compute|   (collective time hidden under compute)
+
+per step = totals / traced iters. Two configs:
+
+  wide_mlp    784->2048->2048->10 (23 MB of gradients, comm-heavy): the
+              allreduce / reduce_scatter / quantized mode comparison.
+  deep_narrow 20 layers of fc(63->63) (40+ tiny gradients, none
+              dp-divisible): the bucketed vs unbucketed A/B — bucketing
+              coalesces the whole tail into ONE transfer per phase.
+
+Caveat (stated in the artifact): the "devices" are 8 XLA host-platform
+threads sharing this box's cores, so overlap reflects the host threadpool
+schedule, not an ICI/DMA engine; byte/structure claims are exact, the
+ms decomposition is a CPU-mesh census to be re-run on TPU hardware.
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python tools/probe_overlap.py | tee PROBE_OVERLAP_r08.json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+if "XLA_FLAGS" not in os.environ:
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+
+from probe_common import census_wire_bytes, collective_census  # noqa: E402
+
+_COMM_PREFIXES = ("all-reduce", "all-gather", "reduce-scatter",
+                  "all-to-all", "collective-permute")
+ITERS = 12
+WINDOWS = 3
+
+
+def _merge(intervals):
+    out = []
+    for s, e in sorted(intervals):
+        if out and s <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([s, e])
+    return out
+
+
+def _measure(mlen):
+    return sum(e - s for s, e in mlen)
+
+
+def _intersect_len(a, b):
+    i = j = 0
+    total = 0.0
+    while i < len(a) and j < len(b):
+        s = max(a[i][0], b[j][0])
+        e = min(a[i][1], b[j][1])
+        if e > s:
+            total += e - s
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def overlap_census(trace_dir, iters):
+    """exposed / overlapped comm ms per step from the span timeline."""
+    from paddle_tpu.profiler import _collect_device_trace_events
+    comm, compute = [], []
+    comm_by_kind = {}
+    for ev in _collect_device_trace_events(trace_dir):
+        if ev.get("cat") != "device" or ev.get("dur", 0) <= 0:
+            continue
+        if not isinstance(ev.get("args"), dict) or \
+                "hlo_op" not in ev["args"]:
+            continue
+        name = str(ev.get("name", ""))
+        span = (ev["ts"], ev["ts"] + ev["dur"])
+        kind = next((p for p in _COMM_PREFIXES if name.startswith(p)), None)
+        if kind:
+            comm.append(span)
+            comm_by_kind[kind] = comm_by_kind.get(kind, 0.0) + ev["dur"]
+        else:
+            compute.append(span)
+    mcomm, mcompute = _merge(comm), _merge(compute)
+    comm_len = _measure(mcomm)
+    overlapped = _intersect_len(mcomm, mcompute)
+    exposed = comm_len - overlapped
+    return {
+        "n_comm_spans": len(comm),
+        "comm_span_ms_per_step": round(sum(comm_by_kind.values())
+                                       / 1e3 / iters, 3),
+        "comm_span_ms_by_kind": {k: round(v / 1e3 / iters, 3)
+                                 for k, v in sorted(comm_by_kind.items())},
+        "comm_exposed_ms_per_step": round(exposed / 1e3 / iters, 3),
+        "comm_overlapped_ms_per_step": round(overlapped / 1e3 / iters, 3),
+        "overlapped_fraction": round(overlapped / comm_len, 3)
+        if comm_len else None,
+    }
+
+
+def _build(config):
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    with pt.core.unique_name.guard():
+        if config == "wide_mlp":
+            x = layers.data("x", shape=[784])
+            h = layers.fc(x, size=2048, act="relu")
+            h = layers.fc(h, size=2048, act="relu")
+            logits = layers.fc(h, size=10)
+        else:                                   # deep_narrow
+            x = layers.data("x", shape=[63])
+            h = x
+            for _ in range(20):
+                h = layers.fc(h, size=63, act="relu")
+            logits = layers.fc(h, size=10)
+        label = layers.data("label", shape=[1], dtype="int64")
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        pt.optimizer.MomentumOptimizer(0.05, momentum=0.9).minimize(loss)
+    return loss
+
+
+def _feed(config, rng):
+    d = 784 if config == "wide_mlp" else 63
+    return {"x": rng.rand(64, d).astype("float32"),
+            "label": rng.randint(0, 10, (64, 1)).astype("int64")}
+
+
+def run_variant(config, mode, bucket_bytes=None):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.parallel import ParallelExecutor, grad_comm
+    from paddle_tpu.parallel.strategy import BuildStrategy, ReduceStrategy
+
+    loss = _build(config)
+    bst = BuildStrategy()
+    bst.reduce_strategy = {"allreduce": ReduceStrategy.AllReduce,
+                           "reduce_scatter": ReduceStrategy.ReduceScatter,
+                           "quantized": ReduceStrategy.ReduceScatter,
+                           }[mode]
+    if mode == "quantized":
+        bst.quant_comm = "int8"
+    if bucket_bytes is not None:
+        bst.comm_bucket_bytes = bucket_bytes
+    exe = ParallelExecutor(loss_name=loss.name, build_strategy=bst)
+    pt.Executor().run(pt.default_startup_program())
+    feed = _feed(config, np.random.RandomState(0))
+
+    def step():
+        return exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
+
+    float(np.asarray(step()[0]))            # compile + drain
+    best = None
+    for _ in range(WINDOWS):
+        t0 = time.time()
+        outs = [step() for _ in range(ITERS)]
+        float(np.asarray(outs[-1][0]))
+        dt = (time.time() - t0) / ITERS * 1e3
+        best = dt if best is None else min(best, dt)
+    spreads = []
+    for _ in range(WINDOWS):
+        t0 = time.time()
+        outs = [step() for _ in range(ITERS)]
+        float(np.asarray(outs[-1][0]))
+        spreads.append(round((time.time() - t0) / ITERS * 1e3, 3))
+
+    trace_dir = tempfile.mkdtemp(prefix=f"ptpu_ov_{config}_{mode}_")
+    jax.profiler.start_trace(trace_dir)
+    outs = [step() for _ in range(ITERS)]
+    float(np.asarray(outs[-1][0]))
+    jax.profiler.stop_trace()
+    ov = overlap_census(trace_dir, ITERS)
+    shutil.rmtree(trace_dir, ignore_errors=True)
+
+    # structural side: the compiled collectives + wire bytes
+    scope = pt.global_scope()
+    cs = list(exe._cache.values())[-1]
+    feed_vals = tuple(jnp.asarray(feed[n]) for n in cs.feed_names)
+    ro = tuple(scope.get(n) for n in cs.ro_names)
+    rw = tuple(scope.get(n) for n in cs.rw_names)
+    hlo = cs.fn.lower(feed_vals, ro, rw, np.uint32(0)).compile().as_text()
+    census = collective_census(hlo)
+    n_grad_ar = sum(1 for b, _ in census.get("all-reduce", []) if b > 64)
+    rec = {
+        "config": config,
+        "mode": mode,
+        **({"bucket_bytes": bucket_bytes} if bucket_bytes is not None
+           else {}),
+        "step_ms": round(min(best, min(spreads)), 3),
+        "step_ms_spread": [min(spreads), max(spreads)],
+        "n_collectives": {k: len(v) for k, v in census.items()},
+        "gradient_allreduce_instructions": n_grad_ar,
+        "wire_bytes_per_step": int(census_wire_bytes(census, 8,
+                                                     min_bytes=8)),
+        **ov,
+    }
+    return rec
+
+
+def main():
+    rows = []
+    for mode in ("allreduce", "reduce_scatter", "quantized"):
+        rows.append(run_variant("wide_mlp", mode))
+    ab = []
+    for mode in ("reduce_scatter", "quantized"):
+        for bucket in (4 << 20, 0):
+            ab.append(run_variant("deep_narrow", mode, bucket_bytes=bucket))
+    # the structural assertion the artifact carries: reduce-scatter mode
+    # leaves NO gradient-sized all-reduce in the program
+    assert all(r["gradient_allreduce_instructions"] == 0
+               for r in rows if r["mode"] != "allreduce"), rows
+    assert all(r["gradient_allreduce_instructions"] == 0 for r in ab), ab
+    print(json.dumps({
+        "probe": "comm/compute overlap census (ISSUE r8)",
+        "mesh": "8 virtual CPU devices, single process",
+        "iters_per_window": ITERS, "windows": WINDOWS,
+        "method": "device-category trace spans; exposed = |comm-span "
+                  "union minus compute-span union|, overlapped = "
+                  "|intersection|, per step = /iters. Wire bytes from the "
+                  "partitioned-HLO census under the ring model "
+                  "(probe_common.collective_wire_bytes).",
+        "mode_comparison_wide_mlp": rows,
+        "bucketing_ab_deep_narrow": ab,
+        "structural_assert":
+            "no gradient all-reduce instruction in any "
+            "reduce_scatter/quantized compiled step (checked above); "
+            "the same contract is test-pinned in tests/test_zero_comm.py",
+        "caveats": [
+            "CPU-mesh: the 8 'devices' are host threads sharing this "
+            "box's cores — collectives are memcpy+rendezvous, so the "
+            "exposed/overlapped split reflects the host threadpool "
+            "schedule, not an ICI/DMA engine; re-run on TPU hardware "
+            "for the latency-hiding headline",
+            "byte and instruction-count fields are exact properties of "
+            "the compiled HLO and transfer to TPU unchanged",
+        ],
+    }, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,60 @@ echo "== benchmark smoke =="
 JAX_PLATFORMS=cpu python tools/benchmark.py --model mnist --batch_size 8 \
     --iters 3 --warmup 1
 
+echo "== dp-comm smoke (reduce-scatter + quantized collectives) =="
+# the explicit gradient pipeline end to end on the 8-virtual-device mesh:
+# reduce-scatter mode must leave no gradient all-reduce in the compiled
+# step, quantized mode must put int8 on the wire, and both must train.
+# (A REAL 2-process world needs jaxlib >= 0.5 — the CPU backend below
+# that cannot run multi-process collectives; tests/test_dist_multiproc.py
+# carries the same skip. This smoke pins the structure, which is
+# process-count-invariant.)
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+python - <<'PY'
+import numpy as np, jax
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.parallel import ParallelExecutor
+from paddle_tpu.parallel.strategy import BuildStrategy, ReduceStrategy
+import sys, os
+sys.path.insert(0, "tools")
+from probe_common import collective_census
+
+for quant in ("", "int8"):
+    pt.reset_default_programs(); pt.reset_global_scope()
+    with pt.core.unique_name.guard():
+        x = layers.data("x", shape=[64])
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(x, size=128, act="relu")
+        loss = layers.mean(layers.softmax_with_cross_entropy(
+            layers.fc(h, size=10), label))
+        pt.optimizer.MomentumOptimizer(0.1, momentum=0.9).minimize(loss)
+    bst = BuildStrategy(); bst.reduce_strategy = ReduceStrategy.ReduceScatter
+    bst.quant_comm = quant; bst.comm_error_feedback = bool(quant)
+    exe = ParallelExecutor(loss_name=loss.name, build_strategy=bst)
+    pt.Executor().run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(32, 64).astype("float32"),
+            "label": rng.randint(0, 10, (32, 1)).astype("int64")}
+    l0 = float(exe.run(feed=feed, fetch_list=[loss])[0])
+    l1 = float(exe.run(feed=feed, fetch_list=[loss])[0])
+    assert l1 < l0, (quant, l0, l1)          # it actually trains
+    import jax.numpy as jnp
+    cs = list(exe._cache.values())[-1]
+    scope = pt.global_scope()
+    hlo = cs.fn.lower(tuple(jnp.asarray(feed[n]) for n in cs.feed_names),
+                      tuple(scope.get(n) for n in cs.ro_names),
+                      tuple(scope.get(n) for n in cs.rw_names),
+                      np.uint32(0)).compile().as_text()
+    census = collective_census(hlo)
+    assert all(b <= 64 for b, _ in census.get("all-reduce", [])), \
+        "gradient all-reduce leaked into reduce-scatter mode"
+    if quant:
+        assert any("s8[" in l for v in census.values() for _, l in v), \
+            "quantized mode has no int8 on the wire"
+print("dp-comm smoke OK")
+PY
+
 echo "== serving-engine smoke =="
 # continuous-batching engine end to end: submit through the RPC server,
 # decode over the slot cache, check a mid-batch join completes (fast:

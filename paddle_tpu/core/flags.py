@@ -129,6 +129,15 @@ define_bool("fuse_decode_attention", True,
             "fused_decode_attention kernel per tick "
             "(paddle_tpu/fusion/decode_attention.py). Kill switch "
             "PTPU_FUSE_DECODE_ATTENTION=0.")
+define_bool("pipeline", True,
+            "Allow the program-level pipeline-parallel executor mode when "
+            "the BuildStrategy requests it (pipeline_stages >= 2). Kill "
+            "switch: PTPU_PIPELINE=0 runs the program unpartitioned (plain "
+            "SPMD, replicated over the pp axis) — the escape hatch if "
+            "partitioning ever misbehaves in production. Part of the "
+            "executor's compile cache key (framework/executor.py "
+            "_fusion_flags_key; resolved by parallel/pipeline.py "
+            "pipeline_config).")
 define_bool("quant_comm", True,
             "Allow quantized gradient collectives when the BuildStrategy "
             "requests them (quant_comm='int8'/'bf16'). Kill switch: "

@@ -39,7 +39,8 @@ def _fusion_flags_key():
     keep serving the previously compiled variant."""
     return (flags.get_flag("fuse_recurrent_cells"),
             flags.get_flag("fuse_decode_attention"),
-            flags.get_flag("quant_comm"))
+            flags.get_flag("quant_comm"),
+            flags.get_flag("pipeline"))
 
 
 def _feed_signature(feed: Dict[str, Any]):

@@ -24,6 +24,13 @@ from .registry import LowerCtx, lookup_op
 SEQLEN_SUFFIX = "@SEQLEN"
 GRAD_SUFFIX = "@GRAD"
 
+# Region op type -> runner(region_op, seg_indices, env, block, ctx). A
+# region op consumes a recorded segment of forward ops (attrs["fwd_ops"])
+# and executes it specially: vjp_region under jax.vjp (below);
+# pp_pipeline_region under the pipeline schedule engine (registered by
+# parallel/pipeline.py at import).
+REGION_RUNNERS: Dict[str, Any] = {}
+
 
 def grad_var_name(name: str) -> str:
     return name + GRAD_SUFFIX
@@ -123,7 +130,7 @@ def build_plan(block: Block):
     consumed: Set[int] = set()
     region_ops: Set[int] = set()
     for i, op in enumerate(block.ops):
-        if op.type == "vjp_region":
+        if op.type in REGION_RUNNERS:
             seg = op.attrs["fwd_ops"]
             if not seg:
                 continue
@@ -275,6 +282,9 @@ def run_vjp_region(region_op: Operator, seg_indices: Sequence[int],
                 rows, vals, env[w].shape[0])
 
 
+REGION_RUNNERS["vjp_region"] = run_vjp_region
+
+
 from .registry import register_op  # noqa: E402
 
 
@@ -291,5 +301,5 @@ def run_plan(plan, env: Dict[str, Any], block: Block, ctx: LowerCtx):
             run_op(step[1], env, block, ctx)
         else:
             _, region_op, seg = step
-            run_vjp_region(region_op, seg, env, block, ctx)
+            REGION_RUNNERS[region_op.type](region_op, seg, env, block, ctx)
     return env

@@ -12,9 +12,11 @@ batch norms, freezing quantization, pruning, rematerialization policy).
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional
+import os
+from typing import Callable, Dict, List, Optional, Tuple
 
-from ..core.enforce import AlreadyExistsError, NotFoundError, enforce
+from ..core.enforce import (AlreadyExistsError, InvalidArgumentError,
+                            NotFoundError, enforce)
 from .program import Program
 from .scope import Scope, global_scope
 
@@ -186,9 +188,10 @@ class FuseDecodeAttentionPass(Pass):
     matmul(q, K^T, alpha) -> elementwise_add(bias) -> softmax -> matmul(V)
     (a SINGLE-position query over a KV cache, the `_attend_cached` idiom)
     into one `fused_decode_attention` op. attrs: protected=[var names that
-    must survive — fetch targets]. Blocks containing a vjp_region are
-    skipped: the region's fwd_ops segments index into the op list, which a
-    multi-op splice would invalidate (decode graphs are inference-only)."""
+    must survive — fetch targets]. Blocks containing a vjp_region (or a
+    pp_pipeline_region) are skipped: those regions' fwd_ops/stages segments
+    index into the op list, which a multi-op splice would invalidate
+    (decode graphs are inference-only)."""
 
     allowed_attrs = ("protected",)
 
@@ -202,7 +205,8 @@ class FuseDecodeAttentionPass(Pass):
                     reads[name] = reads.get(name, 0) + 1
         n = 0
         for block in program.blocks:
-            if any(op.type == "vjp_region" for op in block.ops):
+            if any(op.type in ("vjp_region", "pp_pipeline_region")
+                   for op in block.ops):
                 continue
             n += self._rewrite_block(block, reads, protected)
         if n:
@@ -333,6 +337,327 @@ class FuseDecodeAttentionPass(Pass):
         return len(matches)
 
 
+# ---------------------------------------------------------------------------
+# pipeline partitioning (≙ the reference's pipeline_trainer program-section
+# splitting: the transpiler that cuts a program into per-device sections and
+# runs them as a microbatched pipeline). The pass cuts the single
+# vjp_region's forward segment into K contiguous stages balanced by the
+# analytic flop/byte cost model (tools/probe_common.op_cost_flops_bytes),
+# validates every boundary is a narrow activation cut, splices explicit
+# `pp_send`/`pp_recv` ops at the cuts (the census-able collectives — same
+# discipline as dp_grad_comm), and replaces the vjp_region with a
+# `pp_pipeline_region` executed by the GPipe/1F1B schedule engine
+# (parallel/pipeline.py run_pp_region).
+# ---------------------------------------------------------------------------
+
+
+def _pipeline_cost_fns():
+    """(op_cost_flops_bytes, op_time_cost) from tools/probe_common — ONE
+    analytic cost model shared with the probes; numel fallback when the
+    tools tree is not importable (installed package without the repo)."""
+    try:
+        from tools.probe_common import op_cost_flops_bytes, op_time_cost
+        return op_cost_flops_bytes, op_time_cost
+    except ImportError:
+        # source checkout without the repo root on sys.path: load the
+        # module explicitly from its known location (no sys.path mutation
+        # — a library pass must not change process-wide import behavior)
+        path = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "tools", "probe_common.py")
+        if os.path.exists(path):
+            import importlib.util
+            spec = importlib.util.spec_from_file_location(
+                "_ptpu_probe_common", path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            return mod.op_cost_flops_bytes, mod.op_time_cost
+
+    def _fallback_cost(op, block, nominal_batch=8):
+        n = 0
+        for name in op.input_names() + op.output_names():
+            try:
+                v = block.var(name)
+            except NotFoundError:
+                continue
+            m = 1
+            for d in (v.shape or ()):
+                m *= (nominal_batch if d == -1 else int(d))
+            n += m
+        return float(n), 4.0 * n
+
+    return _fallback_cost, lambda f, b: max(f / 197e12, b / 819e9)
+
+
+def _balanced_partition(costs: List[float], k: int) -> List[Tuple[int, int]]:
+    """Split `costs` into k contiguous NON-EMPTY segments minimizing the
+    max segment sum (classic linear-partition DP, the 1-D special case of
+    GDP's cost-modeled graph placement). Returns [start, end) pairs."""
+    n = len(costs)
+    prefix = [0.0]
+    for c in costs:
+        prefix.append(prefix[-1] + c)
+    inf = float("inf")
+    dp = [[inf] * (n + 1) for _ in range(k + 1)]
+    cut = [[0] * (n + 1) for _ in range(k + 1)]
+    dp[0][0] = 0.0
+    for j in range(1, k + 1):
+        for i in range(j, n - (k - j) + 1):
+            best, where = inf, j - 1
+            for c in range(j - 1, i):
+                if dp[j - 1][c] == inf:
+                    continue
+                v = max(dp[j - 1][c], prefix[i] - prefix[c])
+                if v < best:
+                    best, where = v, c
+            dp[j][i] = best
+            cut[j][i] = where
+    bounds = []
+    i = n
+    for j in range(k, 0, -1):
+        c = cut[j][i]
+        bounds.append((c, i))
+        i = c
+    bounds.reverse()
+    return bounds
+
+
+@register_pass("pipeline_partition_pass")
+class PipelinePartitionPass(Pass):
+    """Program-level pipeline partitioning. attrs:
+      num_stages (K >= 2), num_microbatches, schedule ('gpipe'|'1f1b'),
+      dp_axis ('' when the mesh has no data axis), reduce_dp (pmean grads
+      over dp inside the region — False when the r08 dp_grad_comm pipeline
+      owns the dp reduction), max_boundary_vars (narrow-cut gate),
+      nominal_batch (cost-model batch stand-in for -1 dims).
+
+    Gates (rejected, not mis-trained): multiple backward regions;
+    batch-global ops (batch_norm folds statistics over the whole batch —
+    per-microbatch execution would silently change them); non-MEAN losses
+    (per-microbatch means average to the global mean only for equal
+    microbatches of a mean-reduced loss); wide/non-float boundary cuts;
+    load-bearing downstream consumers of forward activations (pipeline
+    publishes only the loss + parameter gradients; pure metric-head sinks
+    are pruned instead, and fetching them raises the clear error)."""
+
+    allowed_attrs = ("num_stages", "num_microbatches", "schedule",
+                     "dp_axis", "reduce_dp", "max_boundary_vars",
+                     "nominal_batch")
+
+    @staticmethod
+    def _batch_led(block, name):
+        try:
+            v = block.var(name)
+        except NotFoundError:
+            return True     # undeclared sidecars (@SEQLEN) are batch-led
+        shape = getattr(v, "shape", None)
+        return shape is None or (bool(shape) and shape[0] == -1)
+
+    def apply(self, program, scope=None):
+        import numpy as np
+        from ..parallel.grad_comm import _BATCH_GLOBAL_OPS, _MEAN_LOSS_OPS
+        from ..parallel.mesh import PIPELINE_AXIS
+        from ..parallel.pipeline import PP_REGION_TYPE  # registers pp ops
+        from .program import Operator
+
+        if getattr(program, "_pp_applied", False):
+            return program
+        K = int(self.attrs["num_stages"])
+        M = int(self.attrs.get("num_microbatches", 1))
+        schedule = self.attrs.get("schedule", "1f1b")
+        max_bvars = int(self.attrs.get("max_boundary_vars", 8))
+        enforce(K >= 2, f"pipeline_partition_pass needs num_stages >= 2, "
+                f"got {K}", exc=InvalidArgumentError)
+
+        out = program.clone()
+        out._dp_comm_applied = getattr(program, "_dp_comm_applied", False)
+        block = out.global_block()
+        regions = [i for i, op in enumerate(block.ops)
+                   if op.type == "vjp_region"]
+        enforce(len(regions) == 1,
+                f"pipeline partitioning supports exactly one backward "
+                f"region (vjp_region), found {len(regions)}: multi-loss "
+                f"programs cannot be cut into one faithful stage chain. "
+                f"Run without pipeline_stages",
+                exc=InvalidArgumentError)
+        rop = block.ops[regions[0]]
+        seg = list(rop.attrs["fwd_ops"])
+        loss_name = rop.attrs["loss"]
+        targets = list(rop.attrs["targets"])
+        enforce(len(seg) >= K,
+                f"cannot cut {len(seg)} forward ops into {K} non-empty "
+                f"pipeline stages", exc=InvalidArgumentError)
+        seg_ops = [block.ops[i] for i in seg]
+
+        bad = sorted({op.type for op in seg_ops
+                      if op.type in _BATCH_GLOBAL_OPS})
+        enforce(not bad,
+                f"pipeline execution runs the forward per-microbatch, but "
+                f"ops {bad} fold statistics over the WHOLE batch and would "
+                f"silently compute per-microbatch statistics instead. Run "
+                f"this program without pipeline_stages",
+                exc=InvalidArgumentError)
+        producer = next((o for o in reversed(seg_ops)
+                         if loss_name in o.output_names()), None)
+        enforce(producer is not None and producer.type in _MEAN_LOSS_OPS,
+                f"pipeline execution requires a MEAN-reduced loss (got "
+                f"{loss_name!r} produced by "
+                f"{producer.type if producer else '<nothing>'!r}): "
+                f"per-microbatch mean losses average to the global-batch "
+                f"mean only for equal microbatches of a mean reduction. "
+                f"Reduce the loss with layers.mean / reduce_mean",
+                exc=InvalidArgumentError)
+
+        # --- cost-balanced contiguous partition -------------------------
+        cost_fn, combine = _pipeline_cost_fns()
+        nb = int(self.attrs.get("nominal_batch", 8))
+        costs = [combine(*cost_fn(op, block, nb)) for op in seg_ops]
+        bounds = _balanced_partition(costs, K)
+        stage_pos = [seg[a:b] for a, b in bounds]
+
+        # --- boundary (cut) computation + narrow-cut validation ----------
+        produced, prod_pos = {}, {}
+        for k, idxs in enumerate(stage_pos):
+            for i in idxs:
+                for n in block.ops[i].output_names():
+                    if n not in produced:
+                        produced[n] = k
+                        prod_pos[n] = i
+        reads_by_stage = [set() for _ in range(K)]
+        for k, idxs in enumerate(stage_pos):
+            for i in idxs:
+                reads_by_stage[k] |= set(block.ops[i].input_names())
+        seg_produced = set(produced)
+        ext_reads = set().union(*reads_by_stage) - seg_produced
+        enforce(produced.get(loss_name) == K - 1,
+                f"loss {loss_name!r} is not produced by the last stage — "
+                f"partitioner bug", exc=InvalidArgumentError)
+
+        crossings = []
+        for c in range(K - 1):
+            later_reads = set().union(*reads_by_stage[c + 1:])
+            names = sorted((n for n, pk in produced.items()
+                            if pk <= c and n in later_reads),
+                           key=lambda n: prod_pos[n])
+            enforce(names, f"stage cut {c} carries no activation — the "
+                    f"loss would not depend on stages <= {c} "
+                    f"(partitioner bug)", exc=InvalidArgumentError)
+            enforce(len(names) <= max_bvars,
+                    f"stage boundary {c} is not a narrow activation cut: "
+                    f"{len(names)} variables would cross it "
+                    f"({names[:6]}{'...' if len(names) > 6 else ''}). "
+                    f"Pick a different num_stages or restructure the "
+                    f"model so stage boundaries carry one activation",
+                    exc=InvalidArgumentError)
+            for n in names:
+                v = block.var(n)
+                enforce(not v.persistable,
+                        f"boundary var {n!r} at cut {c} is persistable — "
+                        f"state cannot cross a pipeline cut",
+                        exc=InvalidArgumentError)
+                enforce(np.issubdtype(np.dtype(v.dtype), np.floating),
+                        f"boundary var {n!r} at cut {c} has non-float "
+                        f"dtype {v.dtype}; only floating activations may "
+                        f"cross a stage cut (ids/labels are feeds — they "
+                        f"reach every stage directly)",
+                        exc=InvalidArgumentError)
+            crossings.append(names)
+
+        # --- downstream consumers of forward activations -----------------
+        # Forward values only ever exist per-microbatch on their stage's
+        # device, so ops outside the region cannot read them. Pure sink
+        # chains (metric heads: accuracy/top_k over the logits) are PRUNED
+        # transitively — fetching their outputs raises the clear pipeline
+        # error at compile (_pp_hidden). Anything load-bearing (an
+        # optimize/backward-role op) reading a hidden activation cannot be
+        # pruned and is rejected instead.
+        hidden = set(seg_produced) - {loss_name}
+        seg_set = set(seg)
+        dropped_ops = set()
+        for i, op in enumerate(block.ops):
+            if i in seg_set or op is rop:
+                continue
+            bad_reads = sorted(set(op.input_names()) & hidden)
+            if not bad_reads:
+                continue
+            enforce(op.attrs.get("op_role") not in ("optimize", "backward"),
+                    f"op {op.type!r} (role "
+                    f"{op.attrs.get('op_role')!r}) reads forward "
+                    f"activation(s) {bad_reads} computed inside the "
+                    f"pipeline region and cannot be pruned: pipeline mode "
+                    f"publishes only the loss and parameter gradients. "
+                    f"Run this program without pipeline_stages",
+                    exc=InvalidArgumentError)
+            dropped_ops.add(id(op))
+            hidden |= set(op.output_names())
+
+        # --- splice pp_send/pp_recv at every cut -------------------------
+        sends, recvs = [], []
+        for c in range(K - 1):
+            buf = block.create_var(name=f"pp_cut{c}@PP", shape=None,
+                                   dtype="float32", stop_gradient=True)
+            sends.append(Operator(
+                block, "pp_send", inputs={"X": list(crossings[c])},
+                outputs={"Out": [buf.name]},
+                attrs={"cut": c, "op_role": "forward"}))
+            recvs.append(Operator(
+                block, "pp_recv", inputs={"X": [buf.name]},
+                outputs={"Out": list(crossings[c])},
+                attrs={"cut": c, "op_role": "forward"}))
+        ins_by_pos: Dict[int, list] = {}
+        for c in range(K - 1):
+            ins_by_pos.setdefault(stage_pos[c][-1] + 1, []).append(sends[c])
+            ins_by_pos.setdefault(stage_pos[c + 1][0], []).append(recvs[c])
+        new_ops = []
+        for i, op in enumerate(block.ops):
+            # a send (insert AFTER op i-1) sorts before a recv (insert
+            # BEFORE op i) at the same position: sends were appended first
+            for nop in ins_by_pos.get(i, []):
+                new_ops.append(nop)
+            if id(op) not in dropped_ops:
+                new_ops.append(op)
+
+        stage_objs = []
+        for k in range(K):
+            objs = ([recvs[k - 1]] if k > 0 else []) \
+                + [block.ops[i] for i in stage_pos[k]] \
+                + ([sends[k]] if k < K - 1 else [])
+            stage_objs.append(objs)
+        newidx = {id(op): i for i, op in enumerate(new_ops)}
+        stage_idx_lists = [[newidx[id(o)] for o in objs]
+                           for objs in stage_objs]
+
+        # --- replace the vjp_region with the pipeline region -------------
+        x_names = sorted(ext_reads | set(targets))
+        batch_led = [n for n in x_names
+                     if n not in set(targets) and self._batch_led(block, n)]
+        region = Operator(
+            block, PP_REGION_TYPE,
+            inputs={"X": x_names},
+            outputs={"Grads": list(rop.outputs["Grads"]),
+                     "LossGrad": list(rop.outputs["LossGrad"])},
+            attrs={"fwd_ops": sorted(i for lst in stage_idx_lists
+                                     for i in lst),
+                   "stages": stage_idx_lists,
+                   "num_stages": K, "num_microbatches": M,
+                   "schedule": schedule, "axis": PIPELINE_AXIS,
+                   "dp_axis": self.attrs.get("dp_axis", ""),
+                   "reduce_dp": bool(self.attrs.get("reduce_dp", False)),
+                   "targets": targets, "loss": loss_name,
+                   "x_names": x_names, "batch_led": batch_led,
+                   "stage_costs": [float(sum(costs[a:b]))
+                                   for a, b in bounds],
+                   "op_role": "backward"})
+        new_ops[newidx[id(rop)]] = region
+        block.ops = new_ops
+
+        out._bump()
+        out._pp_applied = True
+        out._pp_hidden = frozenset(hidden)
+        out._pp_microbatches = M
+        out._pp_stages = K
+        return out
+
+
 def apply_fusion_passes(program: Program, protected=()) -> Program:
     """Executor-compile-time entry: apply the flag-enabled fusion passes to
     a CLONE of `program` (the caller's program is never mutated). Returns
@@ -345,7 +670,8 @@ def apply_fusion_passes(program: Program, protected=()) -> Program:
         return program
     has_rnn = has_dec = False
     for blk in program.blocks:
-        has_vjp = any(op.type == "vjp_region" for op in blk.ops)
+        has_vjp = any(op.type in ("vjp_region", "pp_pipeline_region")
+                      for op in blk.ops)
         for op in blk.ops:
             if op.type in ("dynamic_lstm", "dynamic_gru"):
                 has_rnn = True

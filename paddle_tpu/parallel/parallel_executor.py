@@ -35,8 +35,9 @@ from ..framework.executor import Executor
 from ..framework.program import Program, Variable, default_main_program
 from ..framework.scope import Scope, global_scope
 from . import grad_comm as _grad_comm
-from .mesh import (DATA_AXIS, SEQUENCE_AXIS, DeviceMesh, get_default_mesh,
-                   shard_map as _shard_map)
+from . import pipeline as _pipeline
+from .mesh import (DATA_AXIS, PIPELINE_AXIS, SEQUENCE_AXIS, DeviceMesh,
+                   get_default_mesh, shard_map as _shard_map)
 from .strategy import (BuildStrategy, ExecutionStrategy,
                        GradientScaleStrategy, ReduceStrategy)
 
@@ -67,6 +68,7 @@ class ParallelExecutor(Executor):
         self._dp = self.mesh.axis_size(DATA_AXIS)
         self._feed_shapes: Dict[str, tuple] = {}
         self._comm_cache: Dict[Any, Program] = {}
+        self._pp_cache: Dict[Any, Program] = {}
         if (_grad_comm.explicit_comm_config(self.build_strategy) is not None):
             enforce(DATA_AXIS in self.mesh.axes,
                     f"the explicit gradient pipeline (ReduceScatter / "
@@ -96,12 +98,14 @@ class ParallelExecutor(Executor):
             # parallel.auto_shard annotation; mesh.sharding drops axis names
             # not present in this mesh (replicated there).
             return self.mesh.sharding(*spec)
-        if getattr(program, "_dp_comm_applied", False):
-            # explicit pipeline: placement follows the comm pass's markers —
-            # sharded-update accumulators and per-replica error-feedback
-            # state live split on dim 0 over dp; everything else replicated
-            # (the Reduce heuristic below must NOT apply: an accumulator the
-            # pass left on the full-update path is consumed whole per shard)
+        if (getattr(program, "_dp_comm_applied", False)
+                or getattr(program, "_pp_applied", False)):
+            # manual (explicit-comm and/or pipeline) modes: placement
+            # follows the rewrite passes' markers — sharded-update
+            # accumulators and per-replica error-feedback state live split
+            # on dim 0 over dp; everything else replicated (the Reduce
+            # heuristic below must NOT apply: an accumulator left on the
+            # full-update path is consumed whole per shard)
             if v is not None and v.shape and (
                     getattr(v, "dp_shard_update", False)
                     or getattr(v, "dp_replica_state", False)):
@@ -130,9 +134,10 @@ class ParallelExecutor(Executor):
                        shape) -> NamedSharding:
         if not shape:  # scalar feed
             return self.mesh.replicated()
-        if (getattr(program, "_dp_comm_applied", False)
+        if ((getattr(program, "_dp_comm_applied", False)
+             or getattr(program, "_pp_applied", False))
                 and not self._batch_led_feed(program, name)):
-            # explicit pipeline: the per-shard step consumes a fixed-shape
+            # manual modes: the per-shard step consumes a fixed-shape
             # auxiliary feed WHOLE — splitting it would hand each shard a
             # fragment (the SPMD partitioner can split it safely; manual
             # per-shard code cannot)
@@ -181,27 +186,15 @@ class ParallelExecutor(Executor):
             in_shardings=in_sh, out_shardings=out_sh, analysis=analysis)
 
     # -- explicit gradient-comm pipeline (parallel/grad_comm.py) ----------
-    def _prepare_program(self, program: Program, scope: Scope) -> Program:
-        """BuildStrategy-driven program rewrite: when the strategy asks for
-        the explicit pipeline (ReduceScatter reduce mode and/or quantized
-        collectives), apply comm_optimize_pass to a clone — cached per
-        (program, version, resolved config) — and zero-init any per-replica
-        error-feedback state the pass declared. Idempotent (the base
-        Executor calls it again inside _compile)."""
-        if getattr(program, "_dp_comm_applied", False):
-            return program
-        cfg = _grad_comm.explicit_comm_config(self.build_strategy)
-        if cfg is None:
-            # still reconcile: a PREVIOUS explicit-mode config may have
-            # left sharded state behind (kill-switch flip back to SPMD)
-            self._reconcile_state_placement(program, scope, None)
-            return program
+    def _gate_manual_mode(self, program: Program, what: str):
+        """Shared gates for the full-manual execution modes (explicit dp
+        comm, pipeline): they run the step manually over the WHOLE mesh,
+        so sp feed splitting and TP/EP-sharded parameters cannot compose."""
         enforce(not self.build_strategy.enable_sequence_parallel,
-                "the explicit gradient pipeline is a pure data-parallel "
-                "path: it runs the step manually over the WHOLE mesh, so "
-                "sequence-parallel feed splitting (enable_sequence_parallel) "
-                "cannot compose with it — use the SPMD AllReduce/Reduce "
-                "strategies for sp programs",
+                f"{what} runs the step manually over the WHOLE mesh, so "
+                f"sequence-parallel feed splitting "
+                f"(enable_sequence_parallel) cannot compose with it — use "
+                f"the SPMD AllReduce/Reduce strategies for sp programs",
                 exc=InvalidArgumentError)
         for b in program.blocks:
             for v in b.vars.values():
@@ -215,25 +208,87 @@ class ParallelExecutor(Executor):
                                 for s in self.mesh.pspec(*spec))):
                     raise InvalidArgumentError(
                         f"parameter {v.name!r} is sharded over mesh axes "
-                        f"{spec} — the explicit gradient pipeline "
-                        f"(ReduceScatter / quant_comm) runs the step "
-                        f"manually over the whole mesh and would compute "
-                        f"partial tensor-parallel products without their "
+                        f"{spec} — {what} runs the step manually over the "
+                        f"whole mesh and would compute partial "
+                        f"tensor-parallel products without their "
                         f"collectives. Use the SPMD AllReduce/Reduce "
                         f"strategies for TP/EP-sharded programs")
-        key = (id(program), program._version, tuple(sorted(cfg.items())))
-        rewritten = self._comm_cache.get(key)
-        if rewritten is None:
-            rewritten = _grad_comm.comm_optimize_pass(program, self._dp, cfg)
-            self._comm_cache[key] = rewritten
-        for v in rewritten.global_block().vars.values():
-            if getattr(v, "dp_replica_state", False) \
-                    and not scope.has_var(v.name):
-                scope.set_var(v.name, jax.device_put(
-                    np.zeros(v.shape, np.float32),
-                    self._state_sharding(rewritten, v.name)))
+
+    def _prepare_program(self, program: Program, scope: Scope) -> Program:
+        """BuildStrategy-driven program rewrite, two ordered passes, each
+        cached per (program, version, resolved config) and idempotent (the
+        base Executor calls this again inside _compile):
+
+        1. explicit gradient comm (ReduceScatter / quant_comm):
+           grad_comm.comm_optimize_pass + zero-init of per-replica
+           error-feedback state;
+        2. pipeline partitioning (pipeline_stages >= 2, PTPU_PIPELINE=1):
+           passes.pipeline_partition_pass on the (possibly comm-rewritten)
+           program — the pp_pipeline_region leaves gradients as LOCAL dp
+           partials when dp_grad_comm owns the dp reduction, and pmeans
+           them itself otherwise."""
+        if getattr(program, "_pp_applied", False):
+            return program
+        cfg = _grad_comm.explicit_comm_config(self.build_strategy)
+        pcfg = _pipeline.pipeline_config(self.build_strategy)
+        if not getattr(program, "_dp_comm_applied", False):
+            if cfg is None and pcfg is None:
+                # still reconcile: a PREVIOUS explicit-mode config may have
+                # left sharded state behind (kill-switch flip back to SPMD)
+                self._reconcile_state_placement(program, scope, None)
+                return program
+            if cfg is not None:
+                self._gate_manual_mode(
+                    program, "the explicit gradient pipeline "
+                    "(ReduceScatter / quant_comm)")
+                key = (id(program), program._version,
+                       tuple(sorted(cfg.items())))
+                rewritten = self._comm_cache.get(key)
+                if rewritten is None:
+                    rewritten = _grad_comm.comm_optimize_pass(
+                        program, self._dp, cfg)
+                    self._comm_cache[key] = rewritten
+                for v in rewritten.global_block().vars.values():
+                    if getattr(v, "dp_replica_state", False) \
+                            and not scope.has_var(v.name):
+                        scope.set_var(v.name, jax.device_put(
+                            np.zeros(v.shape, np.float32),
+                            self._state_sharding(rewritten, v.name)))
+                program = rewritten
+        if pcfg is not None:
+            program = self._apply_pipeline(program, pcfg)
+        marker = ((tuple(sorted(cfg.items())) if cfg else None),
+                  (tuple(sorted(pcfg.items())) if pcfg else None))
         self._reconcile_state_placement(
-            rewritten, scope, tuple(sorted(cfg.items())))
+            program, scope, marker if marker != (None, None) else None)
+        return program
+
+    def _apply_pipeline(self, program: Program, pcfg: Dict) -> Program:
+        """Apply pipeline_partition_pass (cached) for the resolved pipeline
+        config; validates the mesh carries a pp axis of the right size."""
+        enforce(PIPELINE_AXIS in self.mesh.axes
+                and self.mesh.axis_size(PIPELINE_AXIS) == pcfg["stages"],
+                f"BuildStrategy.pipeline_stages={pcfg['stages']} needs a "
+                f"{PIPELINE_AXIS!r} mesh axis of exactly that size; this "
+                f"mesh has axes {dict(self.mesh.axes)}",
+                exc=InvalidArgumentError)
+        self._gate_manual_mode(program, "pipeline-parallel execution")
+        key = (id(program), program._version, tuple(sorted(pcfg.items())))
+        rewritten = self._pp_cache.get(key)
+        if rewritten is None:
+            from ..framework.passes import get_pass
+            has_dp = DATA_AXIS in self.mesh.axes
+            rewritten = get_pass(
+                "pipeline_partition_pass",
+                num_stages=pcfg["stages"],
+                num_microbatches=pcfg["microbatches"],
+                schedule=pcfg["schedule"],
+                dp_axis=DATA_AXIS if has_dp else "",
+                # dp_grad_comm owns the dp reduction when the comm pass ran
+                reduce_dp=(has_dp and
+                           not getattr(program, "_dp_comm_applied", False)),
+            )(program)
+            self._pp_cache[key] = rewritten
         return rewritten
 
     def _reconcile_state_placement(self, program: Program, scope: Scope,
@@ -267,16 +322,35 @@ class ParallelExecutor(Executor):
 
     def _build_step_fn(self, program, feed_names, fetch_names, ro, rw,
                        state_out_names):
-        """Explicit mode: run the whole step as per-shard SPMD code —
-        shard_map manual over the data axis (other mesh axes stay with the
-        partitioner), so the dp_grad_comm / dp_shard_* ops the comm pass
-        spliced in can issue their own collectives. Feeds arrive as the
-        local batch slice; gradients leave the vjp as LOCAL partials and
-        cross the wire only through dp_grad_comm."""
+        """Manual modes: run the whole step as per-shard SPMD code —
+        shard_map full-manual over the mesh — so the dp_grad_comm /
+        dp_shard_* ops the comm pass spliced in (r08) and/or the
+        pp_pipeline_region schedule engine (r09) can issue their own
+        collectives. Feeds arrive as the local dp batch slice, replicated
+        over pp; gradients leave the vjp/pipeline region as LOCAL partials
+        and cross the wire only through dp_grad_comm (or the region's psum
+        when no explicit comm pipeline is configured)."""
         step = super()._build_step_fn(program, feed_names, fetch_names,
                                       ro, rw, state_out_names)
-        if not getattr(program, "_dp_comm_applied", False):
+        dp_mode = getattr(program, "_dp_comm_applied", False)
+        pp_mode = getattr(program, "_pp_applied", False)
+        if not (dp_mode or pp_mode):
             return step
+        if pp_mode:
+            hidden = getattr(program, "_pp_hidden", frozenset())
+            for name in fetch_names:
+                enforce(name not in hidden,
+                        f"fetch target {name!r} is a forward activation "
+                        f"(or a value derived from one — e.g. a pruned "
+                        f"metric head) computed inside the pipeline "
+                        f"region: its values only ever exist "
+                        f"per-microbatch on their stage's device, so "
+                        f"pipeline mode can fetch only the loss (and "
+                        f"values computed outside the region). Drop the "
+                        f"fetch or run without pipeline_stages",
+                        exc=InvalidArgumentError)
+        has_dp = DATA_AXIS in self.mesh.axes
+        has_pp = PIPELINE_AXIS in self.mesh.axes
 
         def dp_only(ns: NamedSharding) -> PartitionSpec:
             # manual specs may only name manual axes: keep the dp
@@ -300,41 +374,46 @@ class ParallelExecutor(Executor):
         state_specs = tuple(dp_only(self._state_sharding(program, n))
                             for n in state_out_names)
         batch_led = self._batch_led_fetches(program, fetch_names)
-        fetch_specs = tuple(PartitionSpec(DATA_AXIS) if led
+        fetch_specs = tuple(PartitionSpec(DATA_AXIS) if (led and has_dp)
                             else PartitionSpec() for led in batch_led)
         # fetch contract: non-batch-led fetches come back pmean'd — exact
         # for batch-mean statistics (loss, accuracy), WRONG by 1/dp for a
         # batch sum. Reject the directly-detectable sum fetches instead of
         # silently rescaling them (docs/data_parallel.md).
-        producers = {n: op.type for blk in program.blocks
-                     for op in blk.ops for n in op.output_names()}
-        for name, led in zip(fetch_names, batch_led):
-            if led:
-                continue
-            enforce(producers.get(name) not in ("reduce_sum", "sum"),
-                    f"fetch {name!r} is a sum reduction: the explicit "
-                    f"gradient pipeline returns non-batch-led fetches as "
-                    f"the MEAN over data shards, which would silently "
-                    f"divide a batch sum by {self._dp}. Fetch a mean-form "
-                    f"statistic (or the per-row tensor) instead, or use "
-                    f"the SPMD AllReduce/Reduce strategies",
-                    exc=InvalidArgumentError)
+        if has_dp:
+            producers = {n: op.type for blk in program.blocks
+                         for op in blk.ops for n in op.output_names()}
+            for name, led in zip(fetch_names, batch_led):
+                if led:
+                    continue
+                enforce(producers.get(name) not in ("reduce_sum", "sum"),
+                        f"fetch {name!r} is a sum reduction: manual-mode "
+                        f"execution returns non-batch-led fetches as "
+                        f"the MEAN over data shards, which would silently "
+                        f"divide a batch sum by {self._dp}. Fetch a "
+                        f"mean-form statistic (or the per-row tensor) "
+                        f"instead, or use the SPMD AllReduce/Reduce "
+                        f"strategies", exc=InvalidArgumentError)
 
-        def shard_step(dp_idx, feed_vals, ro_vals, rw_vals, seed):
-            # dp_idx: local slice of a dp-sharded arange — the shard's data
-            # index without a PartitionId instruction (lax.axis_index is
-            # rejected by the partitioner inside partial-manual regions)
+        def shard_step(dp_idx, pp_idx, feed_vals, ro_vals, rw_vals, seed):
+            # dp_idx/pp_idx: local slices of axis-sharded aranges — the
+            # shard's indices without a PartitionId instruction
+            # (lax.axis_index is rejected by the partitioner inside
+            # partial-manual regions)
             idx = dp_idx[0]
-            # decorrelate per-shard randomness (dropout masks must differ
-            # across batch shards like they do across rows in SPMD mode)
+            # decorrelate per-shard randomness across dp (dropout masks
+            # must differ across batch shards like they do across rows in
+            # SPMD mode); pp stages share the seed — the pipeline region
+            # re-folds per (microbatch, stage)
             seed = seed + idx.astype(jnp.uint32) * np.uint32(2654435761)
-            with _grad_comm.dp_index_scope(idx):
+            with _grad_comm.dp_index_scope(idx), \
+                    _pipeline.pp_index_scope(pp_idx[0]):
                 fetches, new_state = step(feed_vals, ro_vals, rw_vals, seed)
             merged = []
             for f, led in zip(fetches, batch_led):
                 if led:
                     merged.append(f)   # local rows; out_spec dp reassembles
-                elif (hasattr(f, "dtype")
+                elif (has_dp and hasattr(f, "dtype")
                         and jnp.issubdtype(f.dtype, jnp.inexact)):
                     # scalar/statistic fetches are batch means (loss,
                     # accuracy): mean of equal-size shard means == the
@@ -345,22 +424,27 @@ class ParallelExecutor(Executor):
                     merged.append(f)
             return tuple(merged), new_state
 
-        # FULL-manual over every mesh axis (dp-only specs replicate values
-        # across tp/sp, matching what SPMD mode computes for a pure-DP
-        # program on the same mesh). Partial-manual (auto=tp/sp) would be
-        # the composable form, but this jax/XLA rejects PartitionId and
-        # trips manual-subgroup checks inside partial-manual regions — the
-        # TP gate in _prepare_program keeps the contract honest instead.
+        # FULL-manual over every mesh axis (dp/pp-only specs replicate
+        # values across tp/sp, matching what SPMD mode computes for a
+        # pure-DP program on the same mesh). Partial-manual (auto=tp/sp)
+        # would be the composable form, but this jax/XLA rejects
+        # PartitionId and trips manual-subgroup checks inside
+        # partial-manual regions — the TP gate in _prepare_program keeps
+        # the contract honest instead.
+        dp_spec = PartitionSpec(DATA_AXIS) if has_dp else PartitionSpec()
+        pp_spec = PartitionSpec(PIPELINE_AXIS) if has_pp else PartitionSpec()
         mapped = _shard_map(shard_step, mesh=self.mesh.jax_mesh,
-                            in_specs=(PartitionSpec(DATA_AXIS), feed_specs,
+                            in_specs=(dp_spec, pp_spec, feed_specs,
                                       ro_specs, rw_specs, PartitionSpec()),
                             out_specs=(fetch_specs, state_specs),
                             check_vma=False)
         dp = self._dp
+        ppn = self.mesh.axis_size(PIPELINE_AXIS)
 
         def wrapped(feed_vals, ro_vals, rw_vals, seed):
-            return mapped(jnp.arange(dp, dtype=jnp.int32), feed_vals,
-                          ro_vals, rw_vals, seed)
+            return mapped(jnp.arange(dp, dtype=jnp.int32),
+                          jnp.arange(ppn, dtype=jnp.int32),
+                          feed_vals, ro_vals, rw_vals, seed)
 
         return wrapped
 
@@ -391,6 +475,15 @@ class ParallelExecutor(Executor):
                 f"(≙ SplitLoDTensor batch split needs one batch size)",
                 exc=InvalidArgumentError)
         b = sizes.pop()
+        m = getattr(program, "_pp_microbatches", 0)
+        if m:
+            enforce(b % (self._dp * m) == 0,
+                    f"feed batch size {b} is not divisible by "
+                    f"dp * num_microbatches = {self._dp} * {m}: the "
+                    f"pipeline schedule derives the global-mean loss from "
+                    f"EQUAL microbatches on EQUAL dp shards, so "
+                    f"wrap-padding would bias it. Feed divisible batches "
+                    f"in pipeline mode", exc=InvalidArgumentError)
         if b % self._dp == 0:
             return feed, b, b
         enforce(_grad_comm.explicit_comm_config(self.build_strategy) is None,

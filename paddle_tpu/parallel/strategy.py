@@ -77,6 +77,22 @@ class BuildStrategy:
     # capability, build_strategy.h fuse_all_reduce_ops_). 0 disables
     # bucketing (one collective per gradient — the probe_overlap A/B side).
     comm_bucket_bytes: int = 4 << 20
+    # --- program-level pipeline parallelism (framework/passes.py
+    # pipeline_partition_pass + parallel/pipeline.py schedule engine,
+    # ≙ the reference's pipeline_trainer section splitting) --------------
+    # Number of pipeline stages K. 0/1 = off; K >= 2 cuts the op DAG into K
+    # cost-balanced contiguous stages over the mesh's `pp` axis (whose size
+    # must equal K). Runtime kill switch: PTPU_PIPELINE=0 runs the program
+    # unpartitioned (SPMD, replicated over pp) regardless of this field.
+    pipeline_stages: int = 0
+    # Microbatches M per step: the global batch must be divisible by
+    # dp * M. Bubble fraction is (K-1)/(M+K-1) for both schedules — raise M
+    # to amortize the fill/drain bubble.
+    num_microbatches: int = 1
+    # 'gpipe' (all forwards, then all backwards — activation stash grows
+    # with M) or '1f1b' (warmup / 1-forward-1-backward steady state /
+    # drain — stash bounded at <= K in-flight microbatches; the default).
+    pipeline_schedule: str = "1f1b"
 
 
 @dataclass

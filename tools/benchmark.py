@@ -194,7 +194,10 @@ def _spawn_child(args, extra_env, extra_args=()):
             "--learning_rate", str(args.learning_rate),
             "--optimizer", args.optimizer,
             "--reduce_mode", args.reduce_mode,
-            "--comm_bucket_bytes", str(args.comm_bucket_bytes)] \
+            "--comm_bucket_bytes", str(args.comm_bucket_bytes),
+            "--pipeline_stages", str(args.pipeline_stages),
+            "--num_microbatches", str(args.num_microbatches),
+            "--pipeline_schedule", args.pipeline_schedule] \
         + list(extra_args)
     if args.no_bf16:
         argv.append("--no_bf16")
@@ -395,6 +398,20 @@ def main():
                    help="gradient transfer bucket cap; -1 = strategy "
                         "default (4 MiB), 0 = one collective per gradient "
                         "(the probe_overlap A/B side)")
+    p.add_argument("--pipeline_stages", type=int, default=0,
+                   help="collective runs: pipeline-parallel stages K "
+                        "(>= 2 cuts the op DAG over a pp mesh axis of "
+                        "size K; the remaining devices form the dp axis). "
+                        "0 = off (framework/passes.py "
+                        "pipeline_partition_pass)")
+    p.add_argument("--num_microbatches", type=int, default=4,
+                   help="pipeline runs: microbatches M per step (batch "
+                        "must divide by dp * M); bubble fraction is "
+                        "(K-1)/(M+K-1)")
+    p.add_argument("--pipeline_schedule", default="1f1b",
+                   choices=["gpipe", "1f1b"],
+                   help="pipeline runs: gpipe (all-fwd then all-bwd) or "
+                        "1f1b (bounded activation stash)")
     p.add_argument("--no_census", action="store_true",
                    help="skip the HLO comm census fields (saves one AOT "
                         "compile on big models)")
@@ -448,7 +465,20 @@ def main():
         bst.comm_error_feedback = args.comm_error_feedback
         if args.comm_bucket_bytes >= 0:
             bst.comm_bucket_bytes = args.comm_bucket_bytes
-        runner = ParallelExecutor(loss_name=loss.name, build_strategy=bst)
+        mesh = None
+        if args.pipeline_stages > 1:
+            from paddle_tpu.parallel.mesh import DeviceMesh
+            bst.pipeline_stages = args.pipeline_stages
+            bst.num_microbatches = args.num_microbatches
+            bst.pipeline_schedule = args.pipeline_schedule
+            devs = jax.devices()
+            k = args.pipeline_stages
+            if len(devs) % k:
+                p.error(f"--pipeline_stages {k} must divide the device "
+                        f"count {len(devs)}")
+            mesh = DeviceMesh(devs, {"dp": len(devs) // k, "pp": k})
+        runner = ParallelExecutor(loss_name=loss.name, build_strategy=bst,
+                                  mesh=mesh)
     else:
         runner = exe
 
@@ -493,12 +523,34 @@ def main():
                     or _gc.spmd_allreduce_wire_bytes(prog, dp))
         comm_fields = {
             "reduce_mode": args.reduce_mode,
-            "total_devices": dp,
+            "total_devices": runner.device_count,
             "grad_bytes_on_wire": analytic["grad_wire_bytes"],
             "param_allgather_bytes_on_wire":
                 analytic["param_allgather_wire_bytes"],
             "wire_bytes_per_step": analytic["wire_bytes"],
         }
+        if args.pipeline_stages > 1:
+            # same discipline as grad_bytes_on_wire: the analytic
+            # boundary-transfer model (probe_common ring accounting /
+            # collective-permute: one act + one grad buffer per tick),
+            # and the exact schedule-table bubble fraction
+            from paddle_tpu.parallel.pipeline import (
+                pp_boundary_wire_bytes, schedule_census)
+            sched_census = schedule_census(args.pipeline_schedule,
+                                           args.num_microbatches,
+                                           args.pipeline_stages)
+            mb_rows = args.batch_size // max(
+                1, dp * args.num_microbatches)
+            wire = pp_boundary_wire_bytes(rewritten, mb_rows)
+            comm_fields.update({
+                "pipeline_stages": args.pipeline_stages,
+                "num_microbatches": args.num_microbatches,
+                "pipeline_schedule": args.pipeline_schedule,
+                "bubble_fraction": sched_census["bubble_fraction"],
+                "peak_stash_microbatches": sched_census["peak_stash"],
+                "pp_boundary_bytes":
+                    wire["pp_boundary_bytes"] if wire else None,
+            })
         if not args.no_census:
             from probe_common import census_wire_bytes, collective_census
             cs = list(runner._cache.values())[-1]

@@ -48,6 +48,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.parallel",
     "paddle_tpu.parallel.collective",
     "paddle_tpu.parallel.grad_comm",
+    "paddle_tpu.parallel.pipeline",
     "paddle_tpu.data",
     "paddle_tpu.fusion",
 ]

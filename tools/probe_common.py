@@ -119,6 +119,94 @@ def census_wire_bytes(census: Dict[str, list], n_devices: int,
     return total
 
 
+# ---------------------------------------------------------------------------
+# Analytic per-op cost model — the balancing signal for the pipeline
+# partitioner (framework/passes.py pipeline_partition_pass) and the
+# per-stage compute model of tools/probe_bubble.py. Costs are RELATIVE
+# (batch dims unknown until feed time use `nominal_batch`); the roofline
+# combine max(flops/peak, bytes/bw) uses the same v5e constants as the
+# probes so one number means one thing everywhere.
+# ---------------------------------------------------------------------------
+
+# ops that are pure markers / bookkeeping: zero device cost
+_ZERO_COST_OPS = frozenset({"pp_send", "pp_recv", "feed", "fetch"})
+
+# per-output-element flop weights for transcendental-ish elementwise ops
+_ELEMENTWISE_FLOPS = {"softmax": 5.0, "exp": 4.0, "log": 4.0, "tanh": 6.0,
+                      "sigmoid": 5.0, "relu": 1.0, "sqrt": 4.0, "pow": 4.0,
+                      "elementwise_pow": 4.0, "gelu": 8.0,
+                      "layer_norm": 8.0, "batch_norm": 6.0,
+                      "softmax_with_cross_entropy": 8.0,
+                      "cross_entropy": 4.0, "dropout": 2.0}
+
+
+def _var_numel(block, name, nominal_batch):
+    try:
+        v = block.var(name)
+    except Exception:
+        return 0
+    shape = getattr(v, "shape", None) or ()
+    n = 1
+    for d in shape:
+        n *= (nominal_batch if d == -1 else int(d))
+    return n
+
+
+def _var_shape(block, name, nominal_batch):
+    try:
+        v = block.var(name)
+    except Exception:
+        return None
+    shape = getattr(v, "shape", None)
+    if shape is None:
+        return None
+    return [nominal_batch if d == -1 else int(d) for d in shape]
+
+
+def op_cost_flops_bytes(op, block, nominal_batch: int = 8) -> Tuple[float,
+                                                                    float]:
+    """(flops, bytes) estimate for one program op, from declared var shapes
+    (-1 batch dims resolved to `nominal_batch` — the model only needs to be
+    RELATIVELY right to balance contiguous stages)."""
+    if op.type in _ZERO_COST_OPS:
+        return 0.0, 0.0
+    in_n = sum(_var_numel(block, n, nominal_batch)
+               for n in op.input_names())
+    out_n = sum(_var_numel(block, n, nominal_batch)
+                for n in op.output_names())
+    bytes_ = 4.0 * (in_n + out_n)
+    t = op.type
+    if t in ("mul", "matmul"):
+        xs = _var_shape(block, op.inputs["X"][0], nominal_batch)
+        k = 1.0
+        if xs:
+            k = float(xs[-2] if op.attrs.get("transpose_X") and len(xs) >= 2
+                      else xs[-1])
+        return 2.0 * out_n * k, bytes_
+    if t in ("conv2d", "conv3d", "conv2d_transpose", "conv3d_transpose",
+             "depthwise_conv2d"):
+        # filter is [num_filters, cin/groups, k...] in both layouts, so
+        # per-output-element work = 2 * numel(filter) / num_filters
+        fn = _var_numel(block, op.inputs["Filter"][0], nominal_batch)
+        fs = _var_shape(block, op.inputs["Filter"][0], nominal_batch)
+        nf = float(fs[0]) if fs else 1.0
+        return 2.0 * out_n * (fn / max(nf, 1.0)), bytes_
+    if t in ("dynamic_lstm", "fused_lstm", "dynamic_gru", "fused_gru"):
+        wn = sum(_var_numel(block, n, nominal_batch)
+                 for slot in ("Weight", "WeightX", "WeightH")
+                 for n in op.inputs.get(slot, []))
+        return 2.0 * max(out_n, in_n) * max(wn, 1) ** 0.5, bytes_
+    if t == "lookup_table":
+        return float(out_n), bytes_
+    return _ELEMENTWISE_FLOPS.get(t, 1.0) * out_n, bytes_
+
+
+def op_time_cost(flops: float, bytes_: float) -> float:
+    """Roofline combine of one op's (flops, bytes): seconds on the v5e
+    peak — whichever engine bounds it."""
+    return max(flops / V5E_PEAK_TFLOPS, bytes_ / V5E_HBM_BPS)
+
+
 def measure_step(build: Callable[[], Tuple], make_feed: Callable[[], Dict],
                  iters: int = 15, windows: int = 3, hlo_path: str = None):
     """build() -> (loss_var, optimizer); make_feed() -> feed dict.

@@ -92,6 +92,69 @@ for quant in ("", "int8"):
 print("dp-comm smoke OK")
 PY
 
+echo "== pipeline-parallel smoke (gpipe + 1f1b parity, pp=2, M=4) =="
+# the program-level pipeline executor end to end: partition pass + both
+# schedules must reproduce the single-device fixed-seed loss curve, and
+# the compiled step must carry exactly one boundary-activation + one
+# boundary-gradient collective-permute per tick.
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+python - <<'PY'
+import numpy as np, jax
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.parallel import ParallelExecutor
+from paddle_tpu.parallel.mesh import DeviceMesh
+from paddle_tpu.parallel.strategy import BuildStrategy
+import sys
+sys.path.insert(0, "tools")
+from probe_common import collective_census
+
+def build():
+    x = layers.data("x", shape=[32])
+    label = layers.data("label", shape=[1], dtype="int64")
+    h = layers.fc(x, size=64, act="relu")
+    h = layers.fc(h, size=64, act="relu")
+    loss = layers.mean(layers.softmax_with_cross_entropy(
+        layers.fc(h, size=10), label))
+    pt.optimizer.MomentumOptimizer(0.1, momentum=0.9).minimize(loss)
+    return loss
+
+rng = np.random.RandomState(0)
+feeds = [{"x": np.random.RandomState(50 + i).rand(16, 32).astype("f4"),
+          "label": np.random.RandomState(60 + i)
+          .randint(0, 10, (16, 1)).astype("i8")} for i in range(3)]
+pt.reset_default_programs(); pt.reset_global_scope()
+with pt.core.unique_name.guard():
+    loss = build()
+exe = pt.Executor(); exe.run(pt.default_startup_program())
+base = [float(exe.run(feed=f, fetch_list=[loss])[0]) for f in feeds]
+for sched in ("gpipe", "1f1b"):
+    pt.reset_default_programs(); pt.reset_global_scope()
+    with pt.core.unique_name.guard():
+        loss = build()
+    bst = BuildStrategy(pipeline_stages=2, num_microbatches=4,
+                        pipeline_schedule=sched)
+    mesh = DeviceMesh(jax.devices()[:2], {"pp": 2})
+    pexe = ParallelExecutor(loss_name=loss.name, mesh=mesh,
+                            build_strategy=bst)
+    pt.Executor().run(pt.default_startup_program())
+    got = [float(pexe.run(feed=f, fetch_list=[loss])[0]) for f in feeds]
+    assert max(abs(a - b) for a, b in zip(base, got)) <= 1e-5, (sched,
+                                                                base, got)
+    import jax.numpy as jnp
+    cs = list(pexe._cache.values())[-1]
+    scope = pt.global_scope()
+    hlo = cs.fn.lower(tuple(jnp.asarray(feeds[-1][n])
+                            for n in cs.feed_names),
+                      tuple(scope.get(n) for n in cs.ro_names),
+                      tuple(scope.get(n) for n in cs.rw_names),
+                      np.uint32(0)).compile().as_text()
+    census = collective_census(hlo)
+    n_perm = len(census.get("collective-permute", []))
+    assert n_perm == 2, (sched, n_perm)
+print("pipeline smoke OK")
+PY
+
 echo "== serving-engine smoke =="
 # continuous-batching engine end to end: submit through the RPC server,
 # decode over the slot cache, check a mid-batch join completes (fast:

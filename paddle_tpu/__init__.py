@@ -22,6 +22,8 @@ from .framework.scope import Scope, global_scope, reset_global_scope  # noqa: F4
 from .framework.selected_rows import SelectedRows  # noqa: F401
 from .framework.passes import (Analyzer, Pass, get_pass,  # noqa: F401
                                register_pass, registered_passes)
+from .framework.analysis import (analyze_program, check_program,  # noqa: F401
+                                 infer_program, op_loc, verify_program)
 from .param_attr import ParamAttr  # noqa: F401
 from . import nets  # noqa: F401,E402
 from . import models  # noqa: F401,E402

@@ -41,7 +41,13 @@ class Pass:
         raise NotImplementedError
 
     def __call__(self, program, scope=None):
-        return self.apply(program, scope)
+        # Every pass apply runs under the pass sanitizer (verify-before /
+        # verify-after, framework/analysis.py): a rewrite that breaks a
+        # structural invariant is attributed to THIS pass by name instead
+        # of surfacing later as an opaque trace error — the role the HLO
+        # verifier plays between XLA passes. Kill switch PTPU_VERIFY_PASSES=0.
+        from .analysis import sanitized_apply
+        return sanitized_apply(self, program, scope)
 
 
 _REGISTRY: Dict[str, Callable[..., Pass]] = {}
@@ -496,16 +502,21 @@ class PipelinePartitionPass(Pass):
                 f"silently compute per-microbatch statistics instead. Run "
                 f"this program without pipeline_stages",
                 exc=InvalidArgumentError)
+        from .analysis import op_loc
         producer = next((o for o in reversed(seg_ops)
                          if loss_name in o.output_names()), None)
-        enforce(producer is not None and producer.type in _MEAN_LOSS_OPS,
-                f"pipeline execution requires a MEAN-reduced loss (got "
-                f"{loss_name!r} produced by "
-                f"{producer.type if producer else '<nothing>'!r}): "
-                f"per-microbatch mean losses average to the global-batch "
-                f"mean only for equal microbatches of a mean reduction. "
-                f"Reduce the loss with layers.mean / reduce_mean",
-                exc=InvalidArgumentError)
+        if producer is None or producer.type not in _MEAN_LOSS_OPS:
+            # provenance built only on the failing path: the index scan +
+            # formatting must not run on every successful apply
+            desc = (op_loc(block, block.ops.index(producer), producer)
+                    if producer else "<nothing>")
+            enforce(False,
+                    f"pipeline execution requires a MEAN-reduced loss (got "
+                    f"{loss_name!r} produced by {desc}): "
+                    f"per-microbatch mean losses average to the global-batch "
+                    f"mean only for equal microbatches of a mean reduction. "
+                    f"Reduce the loss with layers.mean / reduce_mean",
+                    exc=InvalidArgumentError)
 
         # --- cost-balanced contiguous partition -------------------------
         cost_fn, combine = _pipeline_cost_fns()
@@ -579,8 +590,9 @@ class PipelinePartitionPass(Pass):
             bad_reads = sorted(set(op.input_names()) & hidden)
             if not bad_reads:
                 continue
+            from .analysis import op_loc
             enforce(op.attrs.get("op_role") not in ("optimize", "backward"),
-                    f"op {op.type!r} (role "
+                    f"{op_loc(block, i, op)} (role "
                     f"{op.attrs.get('op_role')!r}) reads forward "
                     f"activation(s) {bad_reads} computed inside the "
                     f"pipeline region and cannot be pruned: pipeline mode "
@@ -718,71 +730,24 @@ class Analyzer:
 class CheckPass(Pass):
     """Validate program well-formedness before execution (≙ the
     multi_devices_check_pass + ir::HasCircle asserts the reference applies
-    at parallel_executor.cc:91 / multi_devices_graph_pass.cc:465): every op
-    input must be produced by an earlier op, fed (is_data), persistable, or
-    a recognized companion var. Raises with the full violation list."""
+    at parallel_executor.cc:91 / multi_devices_graph_pass.cc:465).
+
+    Folded into the static analyzer: this is now a thin alias over
+    `framework.analysis.verify_program` (def-before-use, duplicate-writer
+    hazards, attribute schemas, pipeline/dp-comm invariants), kept
+    registered so Analyzer(passes=["check_pass"]) callers and existing
+    tests keep working. Raises NotFoundError with the full violation list,
+    every line carrying block/op#/op.type provenance."""
 
     allowed_attrs = ("extra_feeds",)
 
     def apply(self, program, scope=None):
-        extra = set(self.attrs.get("extra_feeds", ()))
-        problems = []
-
-        # Sub-block binder names: a control-flow op (while/static_rnn/
-        # cond_block/...) binds inner vars (step views, carried memories,
-        # captures) at lowering time via string/string-list attrs; those
-        # names are defined inside the block the op references.
-        # control-flow ops store sub-block references under these attr
-        # keys (while/static_rnn/cond_block/switch_case); binder names are
-        # the string/string-list attrs of THAT op only
-        _SUB_KEYS = ("sub_block", "true_block", "false_block",
-                     "case_blocks", "default_block")
-        bound: dict = {}
-        for blk in program.blocks:
-            for op in blk.ops:
-                sub_idxs = []
-                for key in _SUB_KEYS:
-                    v = op.attrs.get(key)
-                    if isinstance(v, int) and not isinstance(v, bool):
-                        sub_idxs.append(v)
-                    elif isinstance(v, (list, tuple)):
-                        sub_idxs.extend(x for x in v if isinstance(x, int))
-                if not sub_idxs:
-                    continue
-                names = set()
-                for v in op.attrs.values():
-                    if isinstance(v, str):
-                        names.add(v)
-                    elif isinstance(v, (list, tuple)) and \
-                            all(isinstance(x, str) for x in v):
-                        names.update(v)
-                for si in sub_idxs:
-                    if 0 < si < len(program.blocks):
-                        bound.setdefault(si, set()).update(names)
-
-        for block in program.blocks:
-            defined = set(extra) | bound.get(block.idx, set())
-            for name, var in block.vars.items():
-                if (getattr(var, "persistable", False)
-                        or getattr(var, "is_data", False)):
-                    defined.add(name)
-                    defined.add(name + "@SEQLEN")
-            # parent-block vars are visible in sub-blocks
-            b = block
-            while b.parent is not None:
-                b = b.parent
-                defined |= set(b.vars)
-            for idx, op in enumerate(block.ops):
-                for name in op.input_names():
-                    if name not in defined:
-                        problems.append(
-                            f"block {block.idx} op#{idx} {op.type!r} reads "
-                            f"{name!r} before any producer/feed")
-                # vjp_region declares Grads/LossGrad outputs like any op;
-                # registering them keeps later grad reads honest without a
-                # blanket @GRAD exemption
-                defined.update(op.output_names())
+        from .analysis import verify_program
+        problems = [d for d in verify_program(
+            program, extra_feeds=self.attrs.get("extra_feeds", ()))
+            if d.severity == "error"]
         if problems:
             raise NotFoundError(
-                "program check failed:\n  " + "\n  ".join(problems))
+                "program check failed:\n  "
+                + "\n  ".join(str(d) for d in problems))
         return program

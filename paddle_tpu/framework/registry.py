@@ -21,6 +21,18 @@ from ..core.enforce import AlreadyExistsError, NotFoundError
 LowerFn = Callable[["LowerCtx", Dict[str, List[Any]], Dict[str, Any]],
                    Dict[str, List[Any]]]
 
+# An infer_spec takes (ctx, in_shapes, in_dtypes, attrs) where in_shapes /
+# in_dtypes mirror the lowering's ins layout (slot -> list of shape tuples /
+# numpy dtypes) and returns outs: slot -> list of (shape, dtype) pairs.
+# `ctx` is an analysis.InferCtx (declared-shape lookups, mesh axis sizes).
+# Most ops don't need one: the analyzer derives shapes by abstract-evaluating
+# the lowering itself (jax.eval_shape), so the kernel IS the shape function
+# and the two can never drift. An explicit spec is only registered where the
+# lowering cannot be abstractly evaluated standalone (collectives that need a
+# mesh axis, region pseudo-ops, sub-block control flow).
+InferFn = Callable[[Any, Dict[str, List[tuple]], Dict[str, List[Any]],
+                    Dict[str, Any]], Dict[str, List[tuple]]]
+
 
 @dataclass
 class OpDef:
@@ -30,6 +42,9 @@ class OpDef:
     stop_gradient: bool = False
     # extra metadata for passes/inspection
     tags: tuple = ()
+    # optional explicit shape/dtype rule (see InferFn above); None = derive
+    # from the lowering via jax.eval_shape (framework/analysis.py)
+    infer_spec: Optional[InferFn] = None
 
 
 _OPS: Dict[str, OpDef] = {}
@@ -45,7 +60,8 @@ def dim_prod(dims) -> Any:
     return out
 
 
-def register_op(op_type: str, *, stop_gradient: bool = False, tags=()):
+def register_op(op_type: str, *, stop_gradient: bool = False, tags=(),
+                infer_spec: Optional[InferFn] = None):
     """Decorator registering a lowering rule (≙ REGISTER_OPERATOR +
     REGISTER_OP_*_KERNEL, reference op_registry.h:185-217)."""
 
@@ -53,7 +69,27 @@ def register_op(op_type: str, *, stop_gradient: bool = False, tags=()):
         if op_type in _OPS:
             raise AlreadyExistsError(f"op {op_type!r} already registered")
         _OPS[op_type] = OpDef(op_type, fn, stop_gradient=stop_gradient,
-                              tags=tuple(tags))
+                              tags=tuple(tags), infer_spec=infer_spec)
+        return fn
+
+    return deco
+
+
+def register_infer_spec(op_type: str):
+    """Decorator attaching an explicit shape/dtype inference rule to an
+    already-registered op (≙ the reference's InferShape functions living
+    next to each OpMaker, framework/operator.h InferShapeContext) — used
+    where the analyzer cannot abstract-evaluate the lowering itself."""
+
+    def deco(fn: InferFn) -> InferFn:
+        op = _OPS.get(op_type)
+        if op is None:
+            raise NotFoundError(
+                f"cannot attach infer_spec: op {op_type!r} not registered")
+        if op.infer_spec is not None:
+            raise AlreadyExistsError(
+                f"op {op_type!r} already has an infer_spec")
+        op.infer_spec = fn
         return fn
 
     return deco

@@ -467,10 +467,15 @@ def increment(x: Variable, value: float = 1.0, in_place: bool = False,
 
 
 def _compare(op_type, x, y, cond=None):
+    from .math_ops import _broadcast_shape
     helper = LayerHelper(op_type)
     if cond is None:
-        cond = helper.create_tmp_variable(dtype="bool", shape=x.shape,
-                                          stop_gradient=True)
+        # declared shape must be the broadcast of both operands (the old
+        # x.shape under-declared broadcast dims — flagged by the static
+        # analyzer, framework/analysis.py)
+        cond = helper.create_tmp_variable(
+            dtype="bool", shape=_broadcast_shape(x.shape, y.shape),
+            stop_gradient=True)
     helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
                      outputs={"Out": [cond]})
     return cond

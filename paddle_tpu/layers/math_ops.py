@@ -30,6 +30,34 @@ def _fill_like_scalar(x, value):
                                        value=float(value))
 
 
+def _broadcast_shape(sa, sb):
+    """Declared shape of a trailing-aligned elementwise result. The old
+    rule ("higher-rank operand wins") under-declared broadcast dims of the
+    equal-rank case — e.g. [1, 1, T] < [S, 1, 1] really yields [S, 1, T] —
+    which the static analyzer (framework/analysis.py) flags as a
+    declared-shape lie. -1 (batch) dims broadcast like any size but stay
+    symbolic in the result."""
+    if not sa or not sb:
+        return sa if sa else sb
+    ra, rb = len(sa), len(sb)
+    out = []
+    for i in range(max(ra, rb)):
+        da = sa[ra - 1 - i] if i < ra else 1
+        db = sb[rb - 1 - i] if i < rb else 1
+        if da == db:
+            out.append(da)
+        elif da == 1:
+            out.append(db)
+        elif db == 1:
+            out.append(da)
+        elif -1 in (da, db):
+            out.append(-1)
+        else:
+            out.append(da)    # incompatible: runtime raises; keep a's view
+    out.reverse()
+    return tuple(out)
+
+
 def elementwise_binary_dispatch(x, other, op_type, reverse=False):
     """Implements Variable.__add__ & co."""
     if isinstance(other, numbers.Number):
@@ -52,8 +80,7 @@ def elementwise_binary_dispatch(x, other, op_type, reverse=False):
     a, b = (other, x) if reverse else (x, other)
     helper = LayerHelper(op_type)
     out_dtype = "bool" if op_type in _COMPARE_OPS else dtype_name(a.dtype)
-    shape = a.shape if (a.shape and b.shape and
-                        len(a.shape) >= len(b.shape)) else b.shape
+    shape = _broadcast_shape(a.shape, b.shape)
     out = helper.create_tmp_variable(dtype=out_dtype, shape=shape,
                                      stop_gradient=op_type in _COMPARE_OPS)
     helper.append_op(type=op_type, inputs={"X": [a], "Y": [b]},

@@ -191,16 +191,21 @@ def comm_optimize_pass(program: Program, dp: int, config: Dict) -> Program:
         producer = next((o for o in reversed(block0.ops)
                          if loss_name in o.output_names()
                          and o.type != "vjp_region"), None)
-        enforce(producer is not None
-                and producer.type in _MEAN_LOSS_OPS,
-                f"explicit data-parallel gradient pipeline requires a "
-                f"MEAN-reduced loss (got {loss_name!r} produced by "
-                f"{producer.type if producer else '<nothing>'!r}): the "
-                f"per-shard gradients are averaged across shards, which "
-                f"equals the global gradient only for a batch-mean loss. "
-                f"Reduce the loss with layers.mean / reduce_mean, or use "
-                f"the SPMD AllReduce/Reduce strategies",
-                exc=InvalidArgumentError)
+        if producer is None or producer.type not in _MEAN_LOSS_OPS:
+            # provenance built only on the failing path (index scan +
+            # formatting must not run on every successful apply)
+            from ..framework.analysis import op_loc
+            desc = (op_loc(block0, block0.ops.index(producer), producer)
+                    if producer else "<nothing>")
+            enforce(False,
+                    f"explicit data-parallel gradient pipeline requires a "
+                    f"MEAN-reduced loss (got {loss_name!r} produced by "
+                    f"{desc}): the per-shard gradients are averaged across "
+                    f"shards, which equals the global gradient only for a "
+                    f"batch-mean loss. Reduce the loss with layers.mean / "
+                    f"reduce_mean, or use the SPMD AllReduce/Reduce "
+                    f"strategies",
+                    exc=InvalidArgumentError)
 
     out = program.clone()
     block = out.global_block()
@@ -554,3 +559,59 @@ def _dp_grad_comm(ctx, ins, attrs):
             off += numels[i]
 
     return {"Out": outs, "ErrOut": err_outs}
+
+
+# ---------------------------------------------------------------------------
+# static-analysis infer specs (framework/analysis.py): these lowerings run
+# collectives over the dp mesh axis, so the analyzer cannot abstract-
+# evaluate them standalone — the explicit rules state the same shape
+# contract the lowerings implement.
+# ---------------------------------------------------------------------------
+
+from ..framework.registry import register_infer_spec  # noqa: E402
+
+
+@register_infer_spec("dp_shard_slice")
+def _infer_dp_shard_slice(ictx, in_shapes, in_dtypes, attrs):
+    shape = list(in_shapes["X"][0])
+    shape[0] = int(attrs["chunk"])
+    return {"Out": [(tuple(shape), in_dtypes["X"][0])]}
+
+
+@register_infer_spec("dp_shard_all_gather")
+def _infer_dp_shard_all_gather(ictx, in_shapes, in_dtypes, attrs):
+    # the gathered result restores the full parameter — its declared shape
+    # (the pass rewires Out to the original param name). With no declared
+    # shape the gather factor (dp) is unknowable here: raise rather than
+    # validate the un-gathered shard shape as correct (degrades to an
+    # infer-error warning in infer_program).
+    decl = ictx.declared(ictx.op.outputs["Out"][0]) if ictx else None
+    if decl is None:
+        raise NotImplementedError(
+            "dp_shard_all_gather inference needs the declared Out shape "
+            "(output dim0 is shard dim0 * dp, and dp is not an attr)")
+    return {"Out": [decl]}
+
+
+@register_infer_spec("dp_grad_comm")
+def _infer_dp_grad_comm(ictx, in_shapes, in_dtypes, attrs):
+    dp = max(int(attrs.get("dp", 1)), 1)
+    if not (len(attrs["kinds"]) == len(attrs["shapes"])
+            == len(in_dtypes["X"])):
+        # misaligned plan arrays must not silently truncate via zip — raise
+        # so infer_program degrades to an infer-error diagnostic (the
+        # attr-schema verifier reports the misalignment at error severity)
+        raise ValueError(
+            f"dp_grad_comm plan arrays misaligned: kinds="
+            f"{len(attrs['kinds'])} shapes={len(attrs['shapes'])} "
+            f"X={len(in_dtypes['X'])}")
+    outs = []
+    for kind, shape, dt in zip(attrs["kinds"], attrs["shapes"],
+                               in_dtypes["X"]):
+        shape = [int(d) for d in shape]
+        if kind == "sharded":
+            shape = [shape[0] // dp] + shape[1:]
+        outs.append((tuple(shape), np.dtype("float32")))
+    errs = [(tuple(s), d) for s, d in zip(in_shapes.get("ErrIn", ()),
+                                          in_dtypes.get("ErrIn", ()))]
+    return {"Out": outs, "ErrOut": errs}

@@ -366,6 +366,36 @@ def _pp_region_stub(ctx, ins, attrs):
         "(framework/lowering.py REGION_RUNNERS)")
 
 
+# static-analysis infer specs (framework/analysis.py): the boundary ops are
+# executed by the region scheduler, never lowered, so the analyzer needs
+# their shape contract stated explicitly. pp_pipeline_region itself is
+# engine-interpreted (Grads mirror the diff targets), like vjp_region.
+
+from ..framework.registry import register_infer_spec  # noqa: E402
+
+
+@register_infer_spec("pp_send")
+def _infer_pp_send(ictx, in_shapes, in_dtypes, attrs):
+    # Out is a zero-size token tying the cut into the DAG; the real
+    # transfer is the scheduler's packed f32 buffer
+    import numpy as _np
+    return {"Out": [((0,), _np.dtype("float32"))]}
+
+
+@register_infer_spec("pp_recv")
+def _infer_pp_recv(ictx, in_shapes, in_dtypes, attrs):
+    # re-binds the crossing activations on the consuming stage: shapes are
+    # exactly the declared shapes of the names it re-binds
+    outs = []
+    for name in ictx.op.outputs["Out"]:
+        decl = ictx.declared(name)
+        if decl is None:
+            raise NotImplementedError(
+                f"pp_recv output {name!r} has no declared shape")
+        outs.append(decl)
+    return {"Out": outs}
+
+
 # ---------------------------------------------------------------------------
 # the engine
 # ---------------------------------------------------------------------------
@@ -374,13 +404,17 @@ def _resolve_cuts(block, stage_ops):
     """[(cut names tuple)] for cuts 0..K-2, read off the spliced pp_send
     ops — the program IS the source of truth for what crosses each
     boundary."""
+    from ..framework.analysis import op_loc
     cuts = []
     for k, ops in enumerate(stage_ops[:-1]):
         send = [op for op in ops if op.type == "pp_send"]
-        enforce(len(send) == 1,
-                f"stage {k} must end in exactly one pp_send, found "
-                f"{len(send)} — program not produced by "
-                f"pipeline_partition_pass?", exc=InvalidArgumentError)
+        if len(send) != 1:
+            desc = (op_loc(block, block.ops.index(ops[0]), ops[0])
+                    if ops else "<empty stage>")
+            enforce(False,
+                    f"stage {k} ({desc} ...) must end in exactly one "
+                    f"pp_send, found {len(send)} — program not produced by "
+                    f"pipeline_partition_pass?", exc=InvalidArgumentError)
         cuts.append(tuple(send[0].inputs["X"]))
     return cuts
 

@@ -8,6 +8,12 @@ the multi-chip path via __graft_entry__.dryrun_multichip).
 import os
 import sys
 
+# Every pass apply in the suite runs under the pass sanitizer
+# (framework/analysis.py): existing pass tests double as sanitizer tests.
+# Hard-set (not setdefault): an inherited PTPU_VERIFY_PASSES=0 must not
+# silently un-verify the tier; use flags.set_flag in a test to opt out.
+os.environ["PTPU_VERIFY_PASSES"] = "1"
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # Single source of truth for the axon-plugin workaround + virtual-device
 # bootstrap (shared with the driver's multichip dryrun).

@@ -20,16 +20,27 @@ sort API.spec > /tmp/api_golden.txt
 diff /tmp/api_golden.txt /tmp/api_current.txt || {
     echo "API surface drifted — review and run tools/print_signatures.py --update"; exit 1; }
 
+echo "== static program lint (analyzer over mnist + transformer_lm) =="
+# whole-program shape/dtype inference + structural/parallel verification
+# (framework/analysis.py) over two flagship builders; exit 1 on any
+# error-severity diagnostic. docs/static_analysis.md has the catalog.
+JAX_PLATFORMS=cpu python tools/lint_program.py --model mnist
+JAX_PLATFORMS=cpu python tools/lint_program.py --model transformer_lm
+
 if [ "$TIER" = "quick" ]; then
     echo "== quick test tier (~5 min) =="
     # the fusion numeric-parity tests (tests/test_fusion.py) ride this
     # tier via their `quick` marks — the fuse passes are default-on, so
-    # every smoke must see them verified
+    # every smoke must see them verified. PTPU_VERIFY_PASSES=1 keeps the
+    # pass sanitizer active, so every pass test doubles as a sanitizer
+    # test (it is also the default; the env pins it).
+    PTPU_VERIFY_PASSES=1 \
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
         python -m pytest tests/ -q -x -m quick
 else
     echo "== full test pyramid (~29 min on 2 cores with -n 2; measured) =="
     # tier-1 selection: everything but the slow-marked A/B bench smokes
+    PTPU_VERIFY_PASSES=1 \
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
         python -m pytest tests/ -q -n 2 --dist load -m 'not slow'
 fi

@@ -138,6 +138,14 @@ define_bool("pipeline", True,
             "executor's compile cache key (framework/executor.py "
             "_fusion_flags_key; resolved by parallel/pipeline.py "
             "pipeline_config).")
+define_bool("tp_shard", True,
+            "Allow the static sharding-propagation rewrite (framework/"
+            "sharding.py tp_shard_pass) that makes tp-annotated parameters "
+            "executable under the full-manual execution modes (explicit "
+            "dp comm / pipeline). Kill switch: PTPU_TP_SHARD=0 restores "
+            "the old enforce gate — tp-sharded programs are then rejected "
+            "by the manual modes instead of rewritten. Part of the "
+            "executor's compile cache key.")
 define_bool("quant_comm", True,
             "Allow quantized gradient collectives when the BuildStrategy "
             "requests them (quant_comm='int8'/'bf16'). Kill switch: "

@@ -133,6 +133,19 @@ INFER_WAIVED: Dict[str, str] = {
 }
 
 
+def _tp_localized(v, shape, program) -> tuple:
+    """tp-sharded vars (tp_shard_pass marks them with `tp_spec`) are
+    declared at their GLOBAL shape but execute per-shard at the tp-local
+    shape: divide the sharded dims by the program's tp size (ONE rule,
+    owned by framework/sharding.py — the comm planner uses the same)."""
+    tp = int(getattr(program, "_tp_size", 0) or 0)
+    spec = getattr(v, "tp_spec", None)
+    if tp <= 1 or not spec or not getattr(program, "_tp_applied", False):
+        return tuple(shape)
+    from .sharding import tp_local_shape
+    return tp_local_shape(tuple(shape), spec, tp)
+
+
 @dataclass
 class InferCtx:
     """Context handed to explicit infer_spec rules (≙ InferShapeContext)."""
@@ -143,14 +156,17 @@ class InferCtx:
     extras: dict = field(default_factory=dict)
 
     def declared(self, name: str) -> Optional[Tuple[tuple, Any]]:
-        """(shape, dtype) of a declared var with -1 -> sentinel, or None."""
+        """(shape, dtype) of a declared var with -1 -> sentinel (and
+        tp-sharded dims localized), or None."""
         try:
             v = self.block.var(name)
         except NotFoundError:
             return None
         if v.shape is None:
             return None
-        return (_subst(v.shape, self.nominal_batch), np.dtype(v.dtype))
+        shape = _tp_localized(v, _subst(v.shape, self.nominal_batch),
+                              self.block.program)
+        return (shape, np.dtype(v.dtype))
 
 
 def _subst(shape, nominal_batch) -> tuple:
@@ -308,7 +324,10 @@ def infer_program(program: Program, nominal_batch: int = BATCH_SENTINEL,
                   if op.type == "dp_grad_comm"), default=1)
 
         def _shard_aware_shape(v):
-            shape = _subst(v.shape, nominal_batch)
+            # tp localization first (tp_shard_pass marks), then the r08
+            # dp-sharded-update split of (the tp-local) dim 0
+            shape = _tp_localized(v, _subst(v.shape, nominal_batch),
+                                  program)
             if (getattr(v, "dp_shard_update", False) and dp > 1
                     and shape and shape[0] % dp == 0):
                 shape = (shape[0] // dp,) + shape[1:]
@@ -431,7 +450,7 @@ def infer_program(program: Program, nominal_batch: int = BATCH_SENTINEL,
                     v = block.vars.get(n)
                     if v is None or v.shape is None:
                         continue
-                    declared = tuple(v.shape)
+                    declared = _tp_localized(v, tuple(v.shape), program)
                     if (getattr(v, "dp_shard_update", False) and dp > 1
                             and declared and declared[0] % dp == 0):
                         declared = (declared[0] // dp,) + declared[1:]
@@ -801,13 +820,22 @@ def verify_program(program: Program,
 
 def analyze_program(program: Program, extra_feeds: Sequence[str] = (),
                     nominal_batch: int = BATCH_SENTINEL,
-                    infer: bool = True) -> List[Diagnostic]:
+                    infer: bool = True,
+                    tp_size: Optional[int] = None) -> List[Diagnostic]:
     """Full static analysis: structural verification + (optionally)
-    whole-program shape/dtype inference. Returns all diagnostics."""
+    whole-program shape/dtype inference + — whenever the program carries
+    tp sharding annotations (or `tp_size` is given) — sharding propagation
+    (framework/sharding.py), so annotation conflicts surface with the same
+    op provenance as every other diagnostic. Returns all diagnostics."""
     diags = verify_program(program, extra_feeds=extra_feeds)
     if infer:
         diags += infer_program(program, nominal_batch=nominal_batch,
                                extra_feeds=extra_feeds).diagnostics
+    from . import sharding as _sharding
+    if tp_size is not None or _sharding.has_tp_annotations(program):
+        diags += _sharding.propagate_sharding(
+            program, tp_size=tp_size,
+            nominal_batch=nominal_batch).diagnostics
     return diags
 
 

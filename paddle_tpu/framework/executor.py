@@ -40,7 +40,8 @@ def _fusion_flags_key():
     return (flags.get_flag("fuse_recurrent_cells"),
             flags.get_flag("fuse_decode_attention"),
             flags.get_flag("quant_comm"),
-            flags.get_flag("pipeline"))
+            flags.get_flag("pipeline"),
+            flags.get_flag("tp_shard"))
 
 
 def _feed_signature(feed: Dict[str, Any]):

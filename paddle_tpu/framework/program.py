@@ -311,7 +311,8 @@ class Program:
                                   lod_level=v.lod_level, is_data=v.is_data)
                 for extra in ("sharding_spec", "is_optimizer_state",
                               "optimize_attr", "staging", "accumulator_of",
-                              "dp_shard_update", "dp_replica_state"):
+                              "dp_shard_update", "dp_replica_state",
+                              "tp_spec"):
                     if hasattr(v, extra):
                         setattr(nv, extra, getattr(v, extra))
                 nb.vars[name] = nv
@@ -324,6 +325,13 @@ class Program:
                 nop.outputs = {k: list(v) for k, v in op.outputs.items()}
                 nb.ops.append(nop)
             p.blocks.append(nb)
+        # program-level rewrite markers ride through clones: downstream
+        # passes clone the tp-rewritten program (grad_comm, pipeline), and
+        # the executor's placement/gate logic reads these off the FINAL
+        # program (framework/sharding.py tp_shard_pass sets them)
+        for marker in ("_tp_applied", "_tp_size", "_tp_n_collectives"):
+            if hasattr(self, marker):
+                setattr(p, marker, getattr(self, marker))
         p._current_block_idx = 0
         return p
 

@@ -95,6 +95,35 @@ def register_infer_spec(op_type: str):
     return deco
 
 
+# A shard-propagation rule mirrors infer_spec at the sharding layer
+# (framework/sharding.py): (ShardCtx, in_specs, attrs) -> out_specs, where a
+# spec is a per-dim tuple of mesh-axis-or-None. Rules are registered in a
+# side table (not on OpDef) so sharding rules for generic ops can be
+# declared without forcing the op module import graph; lookup falls back to
+# the default replicated rule in framework/sharding.py.
+_SHARD_RULES: Dict[str, Any] = {}
+
+
+def register_shard_spec(op_type: str):
+    """Decorator registering the sharding-propagation rule for `op_type`
+    (lives alongside register_infer_spec: same per-op contract, one layer
+    up — how shardings flow through the op instead of shapes)."""
+
+    def deco(fn):
+        if op_type in _SHARD_RULES:
+            raise AlreadyExistsError(
+                f"op {op_type!r} already has a shard-propagation rule")
+        _SHARD_RULES[op_type] = fn
+        return fn
+
+    return deco
+
+
+def lookup_shard_rule(op_type: str):
+    """The registered shard-propagation rule for `op_type`, or None."""
+    return _SHARD_RULES.get(op_type)
+
+
 def lookup_op(op_type: str) -> OpDef:
     op = _OPS.get(op_type)
     if op is None:

@@ -582,7 +582,8 @@ def transformer_lm_decode_tick(n_slots, vocab=32000, max_len=64,
 
 def transformer_lm(tokens=None, label=None, vocab=32000, max_len=128,
                    d_model=512, d_inner=2048, num_heads=8, num_layers=6,
-                   dropout=0.0, is_test=False, packed=False):
+                   dropout=0.0, is_test=False, packed=False,
+                   mean_loss=False):
     """Decoder-only causal LM — the flagship config used by
     __graft_entry__ (simplest shape that exercises dp/tp/sp sharding).
 
@@ -639,5 +640,13 @@ def transformer_lm(tokens=None, label=None, vocab=32000, max_len=128,
         mask = layers.sequence_mask(seqlen, maxlen=max_len)
     mask = layers.unsqueeze(mask, axes=[2])
     masked = layers.elementwise_mul(token_loss, mask)
-    loss = layers.reduce_sum(masked) / layers.reduce_sum(mask)
+    if mean_loss:
+        # mean over ALL positions instead of the mask-weighted sum/sum
+        # quotient — identical for full-length sequences, and the MEAN
+        # reduction form the explicit dp gradient pipeline requires
+        # (grad_comm averages per-shard gradients; that equals the global
+        # gradient only for a batch-mean loss — docs/data_parallel.md)
+        loss = layers.mean(masked)
+    else:
+        loss = layers.reduce_sum(masked) / layers.reduce_sum(mask)
     return loss, logits

@@ -44,8 +44,11 @@ def annotate_tp(program: Optional[Program] = None,
                 continue
             for pat, builder in rules:
                 if re.search(pat, v.name):
-                    if builder is _ROW and len(v.shape) < 2:
-                        continue  # biases of row-parallel layers replicate
+                    if builder in (_ROW, _VOCAB) and len(v.shape) < 2:
+                        # biases of row-parallel/vocab-sharded layers
+                        # replicate (a [V] lm-head bias adds to logits the
+                        # row-parallel psum already made replicated)
+                        continue
                     spec = builder(len(v.shape))
                     v.sharding_spec = spec
                     annotated[v.name] = spec

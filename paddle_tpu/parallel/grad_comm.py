@@ -214,26 +214,49 @@ def comm_optimize_pass(program: Program, dp: int, config: Dict) -> Program:
         out._dp_comm_applied = True
         return out
 
+    # tp-rewritten programs (framework/sharding.py tp_shard_pass) execute
+    # per-shard at tp-LOCAL shapes: the comm plan — bucket layout, chunk
+    # sizes, reshape targets — must be built over those, and the ZeRO-1
+    # sharded update slices dim 0 WITHIN each tp shard's local block
+    # (optimizer slices sharded over dp per tp shard).
+    tp = int(getattr(program, "_tp_size", 0) or 0) \
+        if getattr(program, "_tp_applied", False) else 0
+
+    def _tp_local(v):
+        from ..framework.sharding import tp_local_shape
+        shape = list(v.shape or ())
+        if tp > 1 and getattr(v, "tp_spec", None):
+            shape = list(tp_local_shape(shape, v.tp_spec, tp))
+        return shape
+
     # --- classify each gradient: sharded reduce-scatter path vs bucket ---
     entries = []       # aligned with the op's X/Out slots
     for param, gname in pairs:
         g = block.var(gname)
-        numel = int(np.prod(g.shape)) if g.shape else 1
+        lshape = _tp_local(g)
+        numel = int(np.prod(lshape)) if lshape else 1
         opt_op = _optimizer_op_for(block, param.name, gname)
         sole_consumer = (opt_op is not None
                          and len(_readers(block, gname)) == 1)
+        spec = getattr(param, "sharding_spec", None)
+        # tp-sharded params take the sharded path too once the tp pass has
+        # made them executable (the gate already rejected non-tp-sharded
+        # annotations); a live annotation WITHOUT the rewrite stays on the
+        # bucket path (annotation resolved replicated on this mesh)
+        spec_ok = spec is None or tp > 1
         sharded = (config["shard_update"]
                    and sole_consumer
-                   and getattr(param, "sharding_spec", None) is None
-                   and g.shape and len(g.shape) >= 1
-                   and g.shape[0] >= dp and g.shape[0] % dp == 0
+                   and spec_ok
+                   and lshape and len(lshape) >= 1
+                   and lshape[0] >= dp and lshape[0] % dp == 0
                    # quantized transfers pad every per-destination chunk to
                    # a scale block: a tensor whose chunk is smaller than one
                    # block would pay >= block x dp wire bytes — the bucket
                    # amortizes it with its neighbors instead
                    and (not config["quant"] or numel // dp >= config["block"]))
         entries.append({"grad": gname, "param": param.name,
-                        "numel": numel, "shape": list(g.shape or ()),
+                        "numel": numel, "shape": lshape,
+                        "gshape": list(g.shape or ()),
                         "kind": "sharded" if sharded else "bucket",
                         "opt_op": opt_op if sharded else None})
 
@@ -292,18 +315,23 @@ def comm_optimize_pass(program: Program, dp: int, config: Dict) -> Program:
         # id()s) so a multi-process world agrees on the var names.
         digest = hashlib.sha1(repr(
             ([e["grad"] for e in entries], buckets, config["quant"],
-             config["block"], dp)).encode()).hexdigest()[:8]
+             config["block"], dp, tp)).encode()).hexdigest()[:8]
         for k, (kind, idxs) in enumerate(transfers):
             flat = sum(entries[i]["numel"] for i in idxs)
             if kind == "bucket":
                 flat = -(-flat // dp) * dp   # bucket is padded to dp
+            # per-replica state: dim 0 IS the data axis (each shard carries
+            # only its own residual); ParallelExecutor shards + zero-inits.
+            # Under tp every (dp, tp) coordinate quantizes a DIFFERENT
+            # local gradient, so dim 0 covers the full dp x tp product
+            # (tp_spec makes _state_sharding split it over both axes)
             v = block.create_var(name=f"{ERR_PREFIX}_{digest}_{k}",
-                                 shape=[dp, flat],
+                                 shape=[dp * max(tp, 1), flat],
                                  dtype="float32", persistable=True)
             v.stop_gradient = True
-            # per-replica state: dim 0 IS the data axis (each shard carries
-            # only its own residual); ParallelExecutor shards + zero-inits
             v.dp_replica_state = True
+            if tp > 1:
+                v.tp_spec = ("tp",) + (None,)
             err_names.append(v.name)
 
     # --- rewire every consumer of a raw grad to the comm'd grad ----------
@@ -362,7 +390,7 @@ def comm_optimize_pass(program: Program, dp: int, config: Dict) -> Program:
                 owner = getattr(v, "accumulator_of", None)
                 if (getattr(v, "is_optimizer_state", False)
                         and (owner == pname or owner is None)
-                        and list(v.shape or ()) == e["shape"]):
+                        and list(v.shape or ()) == e["gshape"]):
                     v.dp_shard_update = True
         opt_op.inputs["Param"] = [pname + SHARD_SUFFIX]
         opt_op.outputs["ParamOut"] = [pname + SHARD_OUT_SUFFIX]
@@ -433,13 +461,19 @@ def analytic_wire_bytes(program: Program, dp: int) -> Optional[Dict]:
         else:
             grad += (npad * 4 // dp) * (dp - 1)    # reduce-scatter
             grad += (npad * 4) * (dp - 1) / dp     # all_gather
+    tp = int(getattr(program, "_tp_size", 0) or 0) \
+        if getattr(program, "_tp_applied", False) else 0
     param_ag = 0.0
     for op in block0.ops:
         if op.type != "dp_shard_all_gather":
             continue
         v = block0.var(op.outputs["Out"][0])
+        shape = list(v.shape)
+        if tp > 1 and getattr(v, "tp_spec", None):
+            from ..framework.sharding import tp_local_shape
+            shape = list(tp_local_shape(shape, v.tp_spec, tp))
         n = 1
-        for d in v.shape:
+        for d in shape:
             n *= d
         param_ag += (n * 4) * (dp - 1) / dp
     return {"grad_wire_bytes": int(grad),

@@ -36,8 +36,9 @@ from ..framework.program import Program, Variable, default_main_program
 from ..framework.scope import Scope, global_scope
 from . import grad_comm as _grad_comm
 from . import pipeline as _pipeline
-from .mesh import (DATA_AXIS, PIPELINE_AXIS, SEQUENCE_AXIS, DeviceMesh,
-                   get_default_mesh, shard_map as _shard_map)
+from . import tensor_parallel as _tensor_parallel
+from .mesh import (DATA_AXIS, MODEL_AXIS, PIPELINE_AXIS, SEQUENCE_AXIS,
+                   DeviceMesh, get_default_mesh, shard_map as _shard_map)
 from .strategy import (BuildStrategy, ExecutionStrategy,
                        GradientScaleStrategy, ReduceStrategy)
 
@@ -69,6 +70,7 @@ class ParallelExecutor(Executor):
         self._feed_shapes: Dict[str, tuple] = {}
         self._comm_cache: Dict[Any, Program] = {}
         self._pp_cache: Dict[Any, Program] = {}
+        self._tp_cache: Dict[Any, Program] = {}
         if (_grad_comm.explicit_comm_config(self.build_strategy) is not None):
             enforce(DATA_AXIS in self.mesh.axes,
                     f"the explicit gradient pipeline (ReduceScatter / "
@@ -101,17 +103,28 @@ class ParallelExecutor(Executor):
         if (getattr(program, "_dp_comm_applied", False)
                 or getattr(program, "_pp_applied", False)):
             # manual (explicit-comm and/or pipeline) modes: placement
-            # follows the rewrite passes' markers — sharded-update
-            # accumulators and per-replica error-feedback state live split
-            # on dim 0 over dp; everything else replicated (the Reduce
+            # follows the rewrite passes' markers — tp_shard_pass marks
+            # tensor-parallel state with `tp_spec` (lives split over tp);
+            # sharded-update accumulators and per-replica error-feedback
+            # state live split on dim 0 over dp, composing with tp as
+            # tp-major on a shared dim (dp_shard_slice slices WITHIN the
+            # tp-local block). Everything else is replicated (the Reduce
             # heuristic below must NOT apply: an accumulator left on the
-            # full-update path is consumed whole per shard)
-            if v is not None and v.shape and (
-                    getattr(v, "dp_shard_update", False)
+            # full-update path is consumed whole per shard).
+            if v is None or not v.shape:
+                return self.mesh.replicated()
+            rank = len(v.shape)
+            tp_spec = list(getattr(v, "tp_spec", None) or ())
+            tp_spec += [None] * (rank - len(tp_spec))
+            entries: List[Any] = [MODEL_AXIS if s == MODEL_AXIS else None
+                                  for s in tp_spec[:rank]]
+            if (getattr(v, "dp_shard_update", False)
                     or getattr(v, "dp_replica_state", False)):
-                return self.mesh.sharding(DATA_AXIS,
-                                          *([None] * (len(v.shape) - 1)))
-            return self.mesh.replicated()
+                entries[0] = ((MODEL_AXIS, DATA_AXIS)
+                              if entries[0] == MODEL_AXIS else DATA_AXIS)
+            if not any(e is not None for e in entries):
+                return self.mesh.replicated()
+            return self.mesh.sharding(*entries)
         if (self.build_strategy.reduce_strategy == ReduceStrategy.Reduce
                 and v is not None
                 and getattr(v, "is_optimizer_state", False)
@@ -187,15 +200,33 @@ class ParallelExecutor(Executor):
 
     # -- explicit gradient-comm pipeline (parallel/grad_comm.py) ----------
     def _gate_manual_mode(self, program: Program, what: str):
-        """Shared gates for the full-manual execution modes (explicit dp
-        comm, pipeline): they run the step manually over the WHOLE mesh,
-        so sp feed splitting and TP/EP-sharded parameters cannot compose."""
+        """Gates for the full-manual execution modes (explicit dp comm,
+        pipeline), naming exactly the combinations that remain
+        unsupported. tp-sharded parameters are NOT gated anymore: the
+        tp_shard_pass (framework/sharding.py) rewrites them into explicit
+        tp collectives before this gate runs (r11). Still rejected:
+
+          1. sequence-parallel feed splitting (enable_sequence_parallel):
+             the manual step consumes whole per-shard sequences, so an
+             sp-split feed — with or without TP — would hand each shard a
+             sequence fragment. Use the SPMD AllReduce/Reduce strategies
+             for sp programs.
+          2. parameters sharded over a NON-tp mesh axis (dp/sp-sharded
+             annotations): no rewrite pass owns those placements in the
+             manual modes.
+          3. tp-sharded parameters while the PTPU_TP_SHARD=0 kill switch
+             is down (the pass that makes them executable is disabled)."""
         enforce(not self.build_strategy.enable_sequence_parallel,
-                f"{what} runs the step manually over the WHOLE mesh, so "
+                f"{what} runs the step manually over the whole mesh and "
+                f"consumes each dp shard's sequences WHOLE, so "
                 f"sequence-parallel feed splitting "
-                f"(enable_sequence_parallel) cannot compose with it — use "
-                f"the SPMD AllReduce/Reduce strategies for sp programs",
+                f"(enable_sequence_parallel) cannot compose with it (with "
+                f"or without TP). Use the SPMD AllReduce/Reduce "
+                f"strategies for sp programs; tp-sharded params compose "
+                f"with {what} via the tp_shard_pass path",
                 exc=InvalidArgumentError)
+        from ..core import flags
+        from ..framework.sharding import tp_component
         for b in program.blocks:
             for v in b.vars.values():
                 spec = getattr(v, "sharding_spec", None)
@@ -203,26 +234,81 @@ class ParallelExecutor(Executor):
                 # truly sharded — an annotation resolving to all-None
                 # (general-mesh annotation run on a dp-only mesh) is
                 # replicated and composes fine
-                if (v.persistable and spec is not None
-                        and any(s is not None
-                                for s in self.mesh.pspec(*spec))):
+                if not v.persistable or spec is None:
+                    continue
+                axes = set()
+                for s in self.mesh.pspec(*spec):
+                    if isinstance(s, (tuple, list)):
+                        axes.update(s)
+                    elif s is not None:
+                        axes.add(s)
+                non_tp = sorted(axes - {MODEL_AXIS})
+                if non_tp:
                     raise InvalidArgumentError(
-                        f"parameter {v.name!r} is sharded over mesh axes "
-                        f"{spec} — {what} runs the step manually over the "
-                        f"whole mesh and would compute partial "
-                        f"tensor-parallel products without their "
-                        f"collectives. Use the SPMD AllReduce/Reduce "
-                        f"strategies for TP/EP-sharded programs")
+                        f"parameter {v.name!r} is sharded over mesh "
+                        f"axes {non_tp} — {what} runs the step manually "
+                        f"and only the tp axis has a rewrite pass "
+                        f"(tp_shard_pass) that splices the needed "
+                        f"collectives. Shard parameters over {MODEL_AXIS!r} "
+                        f"only, or use the SPMD AllReduce/Reduce "
+                        f"strategies for {non_tp}-sharded placements")
+                if axes and not getattr(program, "_tp_applied", False):
+                    if not flags.get_flag("tp_shard"):
+                        hint = ("the PTPU_TP_SHARD=0 kill switch disabled "
+                                "the tp_shard_pass rewrite; flip it back "
+                                "to 1")
+                    elif v.name not in program.global_block().vars:
+                        hint = ("the annotation sits on a SUB-BLOCK "
+                                "parameter; the sharding subsystem "
+                                "propagates over the global block only — "
+                                "hoist the parameter to block 0 or drop "
+                                "its annotation")
+                    else:
+                        hint = "tp_shard_pass did not run — executor bug"
+                    raise InvalidArgumentError(
+                        f"parameter {v.name!r} is tp-sharded "
+                        f"({tp_component(spec)}) but the program was not "
+                        f"rewritten for manual tp execution: {hint}. "
+                        f"Without the rewrite {what} would compute "
+                        f"partial tensor-parallel products without their "
+                        f"collectives; the SPMD AllReduce/Reduce "
+                        f"strategies also run tp-sharded programs")
+
+    def _apply_tp_shard(self, program: Program) -> Program:
+        """Apply tp_shard_pass (cached) when the manual modes will run a
+        tp-annotated program on a mesh with a live tp axis: the pass
+        splices the explicit tp collectives that make the per-shard step
+        compute exactly the single-device math. Kill switch
+        PTPU_TP_SHARD=0 skips the rewrite (the gate then rejects)."""
+        from ..core import flags
+        tpn = self.mesh.axis_size(MODEL_AXIS)
+        if (tpn <= 1 or not flags.get_flag("tp_shard")
+                or getattr(program, "_tp_applied", False)):
+            return program
+        from ..framework.sharding import has_tp_annotations
+        if not has_tp_annotations(program):
+            return program
+        key = (id(program), program._version, tpn)
+        rewritten = self._tp_cache.get(key)
+        if rewritten is None:
+            from ..framework.passes import get_pass
+            rewritten = get_pass("tp_shard_pass", tp=tpn)(program)
+            self._tp_cache[key] = rewritten
+        return rewritten
 
     def _prepare_program(self, program: Program, scope: Scope) -> Program:
-        """BuildStrategy-driven program rewrite, two ordered passes, each
+        """BuildStrategy-driven program rewrite, three ordered passes, each
         cached per (program, version, resolved config) and idempotent (the
         base Executor calls this again inside _compile):
 
-        1. explicit gradient comm (ReduceScatter / quant_comm):
+        1. tp sharding (tp-annotated params on a tp mesh, manual modes
+           only): framework/sharding.py tp_shard_pass splices explicit tp
+           collectives so per-shard execution is exact;
+        2. explicit gradient comm (ReduceScatter / quant_comm):
            grad_comm.comm_optimize_pass + zero-init of per-replica
-           error-feedback state;
-        2. pipeline partitioning (pipeline_stages >= 2, PTPU_PIPELINE=1):
+           error-feedback state (tp-aware: plans over tp-LOCAL shapes,
+           optimizer slices sharded over dp per tp shard);
+        3. pipeline partitioning (pipeline_stages >= 2, PTPU_PIPELINE=1):
            passes.pipeline_partition_pass on the (possibly comm-rewritten)
            program — the pp_pipeline_region leaves gradients as LOCAL dp
            partials when dp_grad_comm owns the dp reduction, and pmeans
@@ -237,6 +323,7 @@ class ParallelExecutor(Executor):
                 # left sharded state behind (kill-switch flip back to SPMD)
                 self._reconcile_state_placement(program, scope, None)
                 return program
+            program = self._apply_tp_shard(program)
             if cfg is not None:
                 self._gate_manual_mode(
                     program, "the explicit gradient pipeline "
@@ -258,9 +345,12 @@ class ParallelExecutor(Executor):
         if pcfg is not None:
             program = self._apply_pipeline(program, pcfg)
         marker = ((tuple(sorted(cfg.items())) if cfg else None),
-                  (tuple(sorted(pcfg.items())) if pcfg else None))
+                  (tuple(sorted(pcfg.items())) if pcfg else None),
+                  (self.mesh.axis_size(MODEL_AXIS)
+                   if getattr(program, "_tp_applied", False) else None))
         self._reconcile_state_placement(
-            program, scope, marker if marker != (None, None) else None)
+            program, scope,
+            marker if marker != (None, None, None) else None)
         return program
 
     def _apply_pipeline(self, program: Program, pcfg: Dict) -> Program:
@@ -351,27 +441,34 @@ class ParallelExecutor(Executor):
                         exc=InvalidArgumentError)
         has_dp = DATA_AXIS in self.mesh.axes
         has_pp = PIPELINE_AXIS in self.mesh.axes
+        has_tp = (MODEL_AXIS in self.mesh.axes
+                  and getattr(program, "_tp_applied", False))
+        manual_axes = {DATA_AXIS} | ({MODEL_AXIS} if has_tp else set())
 
-        def dp_only(ns: NamedSharding) -> PartitionSpec:
-            # manual specs may only name manual axes: keep the dp
-            # component, everything else (tp/sp placements ride the
-            # partitioner via the jit shardings) becomes None
+        def manual_only(ns: NamedSharding) -> PartitionSpec:
+            # manual specs may only name manual axes: keep the dp (and,
+            # for tp-rewritten programs, tp) components; everything else
+            # becomes None. The r11 full-manual mesh covers dp x pp x tp —
+            # sp remains gated out of the manual modes.
             cleaned = []
             for s in ns.spec:
-                if s == DATA_AXIS or (isinstance(s, (tuple, list))
-                                      and DATA_AXIS in s):
-                    cleaned.append(DATA_AXIS)
+                names = s if isinstance(s, (tuple, list)) else (s,)
+                kept = tuple(a for a in names if a in manual_axes)
+                if len(kept) == 1:
+                    cleaned.append(kept[0])
+                elif kept:
+                    cleaned.append(kept)
                 else:
                     cleaned.append(None)
             return PartitionSpec(*cleaned)
 
-        feed_specs = tuple(dp_only(self._feed_sharding(
+        feed_specs = tuple(manual_only(self._feed_sharding(
             program, n, self._feed_shapes.get(n))) for n in feed_names)
-        ro_specs = tuple(dp_only(self._state_sharding(program, n))
+        ro_specs = tuple(manual_only(self._state_sharding(program, n))
                          for n in ro)
-        rw_specs = tuple(dp_only(self._state_sharding(program, n))
+        rw_specs = tuple(manual_only(self._state_sharding(program, n))
                          for n in rw)
-        state_specs = tuple(dp_only(self._state_sharding(program, n))
+        state_specs = tuple(manual_only(self._state_sharding(program, n))
                             for n in state_out_names)
         batch_led = self._batch_led_fetches(program, fetch_names)
         fetch_specs = tuple(PartitionSpec(DATA_AXIS) if (led and has_dp)
@@ -395,19 +492,22 @@ class ParallelExecutor(Executor):
                         f"instead, or use the SPMD AllReduce/Reduce "
                         f"strategies", exc=InvalidArgumentError)
 
-        def shard_step(dp_idx, pp_idx, feed_vals, ro_vals, rw_vals, seed):
-            # dp_idx/pp_idx: local slices of axis-sharded aranges — the
-            # shard's indices without a PartitionId instruction
+        def shard_step(dp_idx, pp_idx, tp_idx, feed_vals, ro_vals, rw_vals,
+                       seed):
+            # dp_idx/pp_idx/tp_idx: local slices of axis-sharded aranges —
+            # the shard's indices without a PartitionId instruction
             # (lax.axis_index is rejected by the partitioner inside
             # partial-manual regions)
             idx = dp_idx[0]
             # decorrelate per-shard randomness across dp (dropout masks
             # must differ across batch shards like they do across rows in
             # SPMD mode); pp stages share the seed — the pipeline region
-            # re-folds per (microbatch, stage)
+            # re-folds per (microbatch, stage); tp shards ALSO share the
+            # seed (they jointly compute ONE logical value)
             seed = seed + idx.astype(jnp.uint32) * np.uint32(2654435761)
             with _grad_comm.dp_index_scope(idx), \
-                    _pipeline.pp_index_scope(pp_idx[0]):
+                    _pipeline.pp_index_scope(pp_idx[0]), \
+                    _tensor_parallel.tp_index_scope(tp_idx[0]):
                 fetches, new_state = step(feed_vals, ro_vals, rw_vals, seed)
             merged = []
             for f, led in zip(fetches, batch_led):
@@ -424,26 +524,31 @@ class ParallelExecutor(Executor):
                     merged.append(f)
             return tuple(merged), new_state
 
-        # FULL-manual over every mesh axis (dp/pp-only specs replicate
-        # values across tp/sp, matching what SPMD mode computes for a
-        # pure-DP program on the same mesh). Partial-manual (auto=tp/sp)
+        # FULL-manual over every mesh axis. dp/pp partition the batch and
+        # the stage chain; tp partitions weights when the tp_shard_pass
+        # rewrote the program (its spliced tp_* collectives are the ONLY
+        # cross-shard traffic on that axis) and is replicated otherwise;
+        # sp stays gated out of the manual modes. Partial-manual (auto=sp)
         # would be the composable form, but this jax/XLA rejects
         # PartitionId and trips manual-subgroup checks inside
-        # partial-manual regions — the TP gate in _prepare_program keeps
-        # the contract honest instead.
+        # partial-manual regions.
         dp_spec = PartitionSpec(DATA_AXIS) if has_dp else PartitionSpec()
         pp_spec = PartitionSpec(PIPELINE_AXIS) if has_pp else PartitionSpec()
+        tp_spec = (PartitionSpec(MODEL_AXIS)
+                   if MODEL_AXIS in self.mesh.axes else PartitionSpec())
         mapped = _shard_map(shard_step, mesh=self.mesh.jax_mesh,
-                            in_specs=(dp_spec, pp_spec, feed_specs,
+                            in_specs=(dp_spec, pp_spec, tp_spec, feed_specs,
                                       ro_specs, rw_specs, PartitionSpec()),
                             out_specs=(fetch_specs, state_specs),
                             check_vma=False)
         dp = self._dp
         ppn = self.mesh.axis_size(PIPELINE_AXIS)
+        tpn = self.mesh.axis_size(MODEL_AXIS)
 
         def wrapped(feed_vals, ro_vals, rw_vals, seed):
             return mapped(jnp.arange(dp, dtype=jnp.int32),
                           jnp.arange(ppn, dtype=jnp.int32),
+                          jnp.arange(tpn, dtype=jnp.int32),
                           feed_vals, ro_vals, rw_vals, seed)
 
         return wrapped

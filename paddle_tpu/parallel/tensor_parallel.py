@@ -74,3 +74,250 @@ def sequence_shard(x, batch_axis_spec="dp"):
     elementwise work is also divided (Megatron-SP). Attention/MLP regions
     re-gather via their own shardings."""
     return shard(x, batch_axis_spec, SEQUENCE_AXIS, None)
+
+
+# ===========================================================================
+# explicit tp collective ops — spliced by framework/sharding.py's
+# tp_shard_pass, executed INSIDE the ParallelExecutor's full-manual
+# shard_map region where the `tp` axis name is bound (the same contract as
+# grad_comm's dp_grad_comm / dp_shard_* ops on the dp axis).
+#
+# Every op carries "count-once" differentiation semantics: the manual
+# executor computes the (identical) loss on every tp shard and seeds each
+# shard's backward with 1, so jax's default collective transposes (psum ->
+# psum of cotangents) would multiply gradients by tp. The custom VJPs below
+# implement the Megatron f/g operator pair instead:
+#
+#   tp_allreduce  fwd psum        bwd identity      (g: row-parallel psum)
+#   tp_ident      fwd identity    bwd psum          (f: column-parallel in)
+#   tp_split      fwd local slice bwd all-gather    (lm-head row entry)
+#   tp_allgather  fwd all-gather  bwd local slice   (tp<->dp reshard)
+#   tp_vocab_lookup  masked local lookup + psum     (vocab-sharded / EP emb)
+# ===========================================================================
+
+from ..core.enforce import InvalidArgumentError, enforce  # noqa: E402
+from ..framework.registry import (register_infer_spec, register_op,  # noqa: E402
+                                  register_shard_spec)
+
+# The executor's shard_map wrapper publishes the traced tp shard index here
+# (same mechanism and rationale as grad_comm._CURRENT_DP_INDEX: a
+# tp-sharded arange sliced to the local entry is the index form every
+# jax/XLA version accepts inside the manual region).
+_CURRENT_TP_INDEX: list = []
+
+
+class tp_index_scope:
+    """Context manager binding the traced tp shard index for op lowerings."""
+
+    def __init__(self, idx):
+        self.idx = idx
+
+    def __enter__(self):
+        _CURRENT_TP_INDEX.append(self.idx)
+
+    def __exit__(self, *a):
+        _CURRENT_TP_INDEX.pop()
+
+
+def current_tp_index(axis_name: str):
+    if _CURRENT_TP_INDEX:
+        return _CURRENT_TP_INDEX[-1]
+    return jax.lax.axis_index(axis_name)
+
+
+def psum_once(x, axis_name: str):
+    """psum whose backward is the identity: the value becomes replicated,
+    and the (replicated, identical) downstream cotangent passes through
+    unscaled — Megatron's g operator."""
+
+    @jax.custom_vjp
+    def f(x):
+        return jax.lax.psum(x, axis_name)
+
+    f.defvjp(lambda x: (f(x), None), lambda _, g: (g,))
+    return f(x)
+
+
+def ident_psum_grad(x, axis_name: str):
+    """Identity whose backward psums the cotangent: wraps a replicated
+    activation entering tp-sharded compute, so the partial cotangents the
+    sharded branches produce are reduced — Megatron's f operator."""
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    f.defvjp(lambda x: (x, None),
+             lambda _, g: (jax.lax.psum(g, axis_name),))
+    return f(x)
+
+
+def split_once(x, axis_name: str, dim: int, parts: int, idx):
+    """Local slice of a replicated value along `dim`; backward all-gathers
+    the per-shard cotangent slices back into the full cotangent (each
+    shard's slice is the exact gradient of its chunk — disjoint, so gather
+    reassembles without a sum)."""
+    dim = dim if dim >= 0 else dim + x.ndim
+    chunk = x.shape[dim] // parts
+
+    @jax.custom_vjp
+    def f(x):
+        return jax.lax.dynamic_slice_in_dim(x, idx * chunk, chunk,
+                                            axis=dim)
+
+    f.defvjp(lambda x: (f(x), None),
+             lambda _, g: (jax.lax.all_gather(g, axis_name, axis=dim,
+                                              tiled=True),))
+    return f(x)
+
+
+def gather_once(x, axis_name: str, dim: int, idx):
+    """All-gather a sharded value back to replicated; backward slices the
+    (replicated) cotangent back to the local chunk."""
+    dim = dim if dim >= 0 else dim + x.ndim
+    chunk = x.shape[dim]
+
+    @jax.custom_vjp
+    def f(x):
+        return jax.lax.all_gather(x, axis_name, axis=dim, tiled=True)
+
+    f.defvjp(lambda x: (f(x), None),
+             lambda _, g: (jax.lax.dynamic_slice_in_dim(
+                 g, idx * chunk, chunk, axis=dim),))
+    return f(x)
+
+
+@register_op("tp_allreduce")
+def _tp_allreduce(ctx, ins, attrs):
+    return {"Out": [psum_once(ins["X"][0], attrs["axis"])]}
+
+
+@register_op("tp_ident")
+def _tp_ident(ctx, ins, attrs):
+    return {"Out": [ident_psum_grad(ins["X"][0], attrs["axis"])]}
+
+
+@register_op("tp_split")
+def _tp_split(ctx, ins, attrs):
+    axis = attrs["axis"]
+    return {"Out": [split_once(ins["X"][0], axis, int(attrs["dim"]),
+                               int(attrs["parts"]),
+                               current_tp_index(axis))]}
+
+
+@register_op("tp_allgather")
+def _tp_allgather(ctx, ins, attrs):
+    axis = attrs["axis"]
+    return {"Out": [gather_once(ins["X"][0], axis, int(attrs["dim"]),
+                                current_tp_index(axis))]}
+
+
+@register_op("tp_vocab_lookup")
+def _tp_vocab_lookup(ctx, ins, attrs):
+    """Embedding lookup over a vocab-row-sharded table (the distributed
+    lookup table / EP analogue, reference distribute_transpiler.py:212):
+    ids are global, each shard holds rows [i*V/p, (i+1)*V/p); out-of-range
+    rows contribute zero and the psum assembles the full lookup. The table
+    gradient stays local (scatter-add into the shard's rows only)."""
+    w = ins["W"][0]
+    ids = ins["Ids"][0]
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, axis=-1)
+    axis = attrs["axis"]
+    idx = current_tp_index(axis)
+    vshard = w.shape[0]
+    local = ids - (idx * vshard).astype(ids.dtype)
+    ok = (local >= 0) & (local < vshard)
+    padding_idx = attrs.get("padding_idx", None)
+    if padding_idx is not None:
+        pad = padding_idx if padding_idx >= 0 \
+            else padding_idx + int(attrs["vocab"])
+        ok = ok & (ids != pad)
+    out = jnp.take(w, jnp.clip(local, 0, vshard - 1), axis=0)
+    out = out * ok[..., None].astype(out.dtype)
+    return {"Out": [psum_once(out, axis)]}
+
+
+# -- static-analysis infer specs + sharding rules (registered alongside,
+# the framework/analysis.py + framework/sharding.py contract: these ops run
+# collectives over the tp axis, so the analyzer cannot abstract-evaluate
+# them standalone) ----------------------------------------------------------
+
+
+@register_infer_spec("tp_allreduce")
+def _infer_tp_allreduce(ictx, in_shapes, in_dtypes, attrs):
+    return {"Out": [(in_shapes["X"][0], in_dtypes["X"][0])]}
+
+
+@register_infer_spec("tp_ident")
+def _infer_tp_ident(ictx, in_shapes, in_dtypes, attrs):
+    return {"Out": [(in_shapes["X"][0], in_dtypes["X"][0])]}
+
+
+@register_infer_spec("tp_split")
+def _infer_tp_split(ictx, in_shapes, in_dtypes, attrs):
+    shape = list(in_shapes["X"][0])
+    dim = int(attrs["dim"])
+    parts = int(attrs["parts"])
+    enforce(shape[dim] % parts == 0,
+            f"tp_split dim {dim} of size {shape[dim]} not divisible by "
+            f"parts={parts}", exc=InvalidArgumentError)
+    shape[dim] //= parts
+    return {"Out": [(tuple(shape), in_dtypes["X"][0])]}
+
+
+@register_infer_spec("tp_allgather")
+def _infer_tp_allgather(ictx, in_shapes, in_dtypes, attrs):
+    shape = list(in_shapes["X"][0])
+    shape[int(attrs["dim"])] *= int(attrs["parts"])
+    return {"Out": [(tuple(shape), in_dtypes["X"][0])]}
+
+
+@register_infer_spec("tp_vocab_lookup")
+def _infer_tp_vocab_lookup(ictx, in_shapes, in_dtypes, attrs):
+    ids = list(in_shapes["Ids"][0])
+    if len(ids) >= 2 and ids[-1] == 1:
+        ids = ids[:-1]
+    w = in_shapes["W"][0]
+    return {"Out": [(tuple(ids) + tuple(w[1:]), in_dtypes["W"][0])]}
+
+
+@register_shard_spec("tp_allreduce")
+def _shardrule_tp_allreduce(sctx, in_specs, attrs):
+    xs = in_specs["X"][0]
+    return {"Out": [None if xs is None else (None,) * len(xs)]}
+
+
+@register_shard_spec("tp_ident")
+def _shardrule_tp_ident(sctx, in_specs, attrs):
+    return {"Out": [in_specs["X"][0]]}
+
+
+@register_shard_spec("tp_split")
+def _shardrule_tp_split(sctx, in_specs, attrs):
+    xs = in_specs["X"][0]
+    if xs is None:
+        return {}
+    out = list(xs)
+    out[int(attrs["dim"])] = sctx.axis
+    return {"Out": [tuple(out)]}
+
+
+@register_shard_spec("tp_allgather")
+def _shardrule_tp_allgather(sctx, in_specs, attrs):
+    xs = in_specs["X"][0]
+    if xs is None:
+        return {}
+    out = list(xs)
+    out[int(attrs["dim"])] = None
+    return {"Out": [tuple(out)]}
+
+
+@register_shard_spec("tp_vocab_lookup")
+def _shardrule_tp_vocab_lookup(sctx, in_specs, attrs):
+    ids_shape = sctx.in_shape("Ids")
+    rank = len(ids_shape) if ids_shape else 2
+    if ids_shape and len(ids_shape) >= 2 and ids_shape[-1] == 1:
+        rank -= 1
+    ws = in_specs["W"][0]
+    return {"Out": [(None,) * (rank + (len(ws) - 1 if ws else 1))]}

@@ -46,6 +46,18 @@ def _mt_train():
                         dict_size=200, embed_dim=16, hidden_dim=16)[0]
 
 
+def _tp_transformer():
+    """tp-annotated transformer (framework/sharding.py): analyze_program
+    folds sharding propagation in whenever live tp annotations exist, so
+    this builder keeps the propagation rules green on the flagship DAG."""
+    from paddle_tpu.parallel import annotate_tp
+    loss, _ = models.transformer.transformer_lm(
+        vocab=256, max_len=16, d_model=32, d_inner=64, num_heads=2,
+        num_layers=2, mean_loss=True)
+    annotate_tp()
+    return loss
+
+
 # one builder per model module (small configs: the analyzer only cares
 # about the op DAG, not widths)
 MODEL_BUILDERS = {
@@ -68,6 +80,7 @@ MODEL_BUILDERS = {
     "transformer_lm": lambda: models.transformer.transformer_lm(
         vocab=256, max_len=16, d_model=32, d_inner=64, num_heads=2,
         num_layers=2)[0],
+    "transformer_lm_tp": _tp_transformer,
     "machine_translation": _mt_train,
 }
 

@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""BENCH_TP_r11 generator: tensor-parallel subsystem evidence.
+
+Commits, per the r11 acceptance bar:
+- fixed-seed loss parity (3 steps) of the tp_shard_pass + full-manual
+  shard_map path vs the single-device baseline for tp2, dp2 x tp2, and
+  dp2 x pp2 x tp2 (1F1B) configurations of the transformer builder on the
+  CPU mesh, ReduceScatter mode throughout (f32 matmuls: splitting a bf16
+  contraction over tp changes its rounding);
+- the analytic tp-collective wire model (framework/sharding.py ring
+  accounting, shared probe_common.collective_wire_bytes discipline)
+  asserted EXACTLY against the compiled step's HLO all-reduce census on
+  the dp=1 x tp=2 mesh, plus per-kind tp op counts;
+- measured step times per configuration (CPU-mesh context numbers, not a
+  TPU speed claim — the tp win is wider-than-one-chip capacity).
+
+Usage:  JAX_PLATFORMS=cpu python tools/bench_tp.py --out BENCH_TP_r11.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+VOCAB, T, D, HEADS, LAYERS, BS = 64, 16, 64, 4, 2, 8
+
+
+def _build():
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer
+    loss, _ = transformer.transformer_lm(
+        vocab=VOCAB, max_len=T, d_model=D, d_inner=2 * D,
+        num_heads=HEADS, num_layers=LAYERS, mean_loss=True)
+    pt.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    return loss
+
+
+def _feeds(n=3):
+    import numpy as np
+    rng = np.random.RandomState(7)
+    return [{"tokens": rng.randint(0, VOCAB, (BS, T)).astype("int64"),
+             "tokens@SEQLEN": np.full((BS,), T, dtype="int32"),
+             "targets": rng.randint(0, VOCAB, (BS, T)).astype("int64")}
+            for _ in range(n)]
+
+
+def _baseline(feeds):
+    import paddle_tpu as pt
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    with pt.core.unique_name.guard():
+        loss = _build()
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    return [float(exe.run(feed=f, fetch_list=[loss])[0]) for f in feeds]
+
+
+def _tp_run(feeds, axes, stages=0, micro=0, iters=10):
+    import jax
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.parallel import ParallelExecutor, annotate_tp
+    from paddle_tpu.parallel.mesh import DeviceMesh
+    from paddle_tpu.parallel.strategy import BuildStrategy, ReduceStrategy
+
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    with pt.core.unique_name.guard():
+        loss = _build()
+    annotate_tp()
+    pt.Executor().run(pt.default_startup_program())
+    n = 1
+    for s in axes.values():
+        n *= s
+    kw = {}
+    if stages:
+        kw = dict(pipeline_stages=stages, num_microbatches=micro)
+    bst = BuildStrategy(**kw)
+    bst.reduce_strategy = ReduceStrategy.ReduceScatter
+    mesh = DeviceMesh(jax.devices()[:n], axes)
+    pe = ParallelExecutor(loss_name=loss.name, mesh=mesh,
+                          build_strategy=bst)
+    losses = [float(pe.run(feed=f, fetch_list=[loss])[0]) for f in feeds]
+    t0 = time.time()
+    out = None
+    for _ in range(iters):
+        out = pe.run(feed=feeds[-1], fetch_list=[loss],
+                     return_numpy=False)
+    jax.block_until_ready(out)
+    step_ms = (time.time() - t0) / iters * 1000
+    return losses, pe, loss, round(step_ms, 2)
+
+
+def _census_fields(pe, feed, tp):
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.framework.sharding import tp_analytic_wire_bytes
+    from probe_common import collective_census
+
+    scope = pt.global_scope()
+    prog = pe._prepare_program(pt.default_main_program(), scope)
+    w = tp_analytic_wire_bytes(prog, tp, nominal_batch=BS)
+    cs = list(pe._cache.values())[-1]
+    feed_vals = tuple(jnp.asarray(feed[n]) for n in cs.feed_names)
+    ro = tuple(scope.get(n) for n in cs.ro_names)
+    rw = tuple(scope.get(n) for n in cs.rw_names)
+    hlo = cs.fn.lower(feed_vals, ro, rw, np.uint32(0)).compile().as_text()
+    census = collective_census(hlo)
+    ar_census_out_bytes = sum(b for b, _ in census.get("all-reduce", [])
+                              if b >= 8)
+    ar_analytic_out_bytes = int(
+        w["tp_allreduce_wire_bytes"] / (2 * (tp - 1) / tp))
+    return {
+        "tp": tp,
+        "tp_allreduce_bytes_on_wire": w["tp_allreduce_wire_bytes"],
+        "tp_allgather_bytes_on_wire": w["tp_allgather_wire_bytes"],
+        "tp_wire_bytes_per_step": w["tp_wire_bytes"],
+        "tp_collective_counts": w["tp_op_counts"],
+        "census_allreduce_out_bytes": ar_census_out_bytes,
+        "analytic_allreduce_out_bytes": ar_analytic_out_bytes,
+        "census_matches_analytic":
+            ar_census_out_bytes == ar_analytic_out_bytes,
+        "census_collectives": {k: len(v) for k, v in census.items()},
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="BENCH_TP_r11.json")
+    p.add_argument("--iters", type=int, default=10)
+    args = p.parse_args()
+
+    sys.path.insert(0, REPO)
+    from __graft_entry__ import _ensure_virtual_cpu_devices
+    _ensure_virtual_cpu_devices(8)
+    import jax
+    from paddle_tpu.core import flags
+    flags.set_flag("use_bf16_matmul", False)
+
+    feeds = _feeds()
+    base = _baseline(feeds)
+    doc = {
+        "bench": "tensor_parallel_r11",
+        "device": jax.devices()[0].platform,
+        "device_count": len(jax.devices()),
+        "model": {"builder": "transformer_lm", "vocab": VOCAB,
+                  "max_len": T, "d_model": D, "num_heads": HEADS,
+                  "num_layers": LAYERS, "batch_size": BS,
+                  "reduce_mode": "reduce_scatter",
+                  "matmul_dtype": "f32"},
+        "steps": len(feeds),
+        "parity": {"single_device": base},
+    }
+
+    configs = [("tp2", {"dp": 1, "tp": 2}, 0, 0),
+               ("dp2_tp2", {"dp": 2, "tp": 2}, 0, 0),
+               ("dp2_pp2_tp2_1f1b", {"dp": 2, "pp": 2, "tp": 2}, 2, 4)]
+    census_pe = None
+    for name, axes, stages, micro in configs:
+        losses, pe, _, step_ms = _tp_run(feeds, axes, stages, micro,
+                                         iters=args.iters)
+        diff = max(abs(a - b) for a, b in zip(losses, base))
+        assert diff <= 1e-5, f"{name}: parity {diff} > 1e-5"
+        doc["parity"][name] = losses
+        doc["parity"][f"{name}_max_abs_diff"] = diff
+        doc.setdefault("step_ms", {})[name] = step_ms
+        if name == "tp2":
+            census_pe = pe
+
+    doc["wire"] = _census_fields(census_pe, feeds[-1], 2)
+    assert doc["wire"]["census_matches_analytic"], doc["wire"]
+
+    with open(os.path.join(REPO, args.out), "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps(doc, indent=1))
+
+
+if __name__ == "__main__":
+    main()

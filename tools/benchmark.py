@@ -134,9 +134,11 @@ def _transformer(args, rng):
     from paddle_tpu.models import transformer
     import numpy as np
     T = args.seq_len
+    # mean_loss: identical math for the full-length feed below, and the
+    # MEAN reduction form both manual modes (reduce_scatter, tp) require
     loss, _ = transformer.transformer_lm(
         vocab=32000, max_len=T, d_model=512, d_inner=2048, num_heads=8,
-        num_layers=6, dropout=0.0)
+        num_layers=6, dropout=0.0, mean_loss=True)
     b = args.batch_size
     feed = {"tokens": rng.randint(0, 32000, (b, T)).astype("int64"),
             "tokens@SEQLEN": np.full((b,), T, "int32"),
@@ -412,6 +414,15 @@ def main():
                    choices=["gpipe", "1f1b"],
                    help="pipeline runs: gpipe (all-fwd then all-bwd) or "
                         "1f1b (bounded activation stash)")
+    p.add_argument("--tp", type=int, default=0,
+                   help="collective runs: tensor-parallel degree T (>= 2 "
+                        "adds a tp mesh axis, annotates the model with "
+                        "the Megatron column/row/vocab recipe via "
+                        "parallel.auto_shard.annotate_tp, and — in the "
+                        "manual reduce_scatter/quantized modes — runs the "
+                        "framework/sharding.py tp_shard_pass rewrite). "
+                        "Composes with --pipeline_stages on a "
+                        "dp x pp x tp mesh")
     p.add_argument("--no_census", action="store_true",
                    help="skip the HLO comm census fields (saves one AOT "
                         "compile on big models)")
@@ -434,6 +445,12 @@ def main():
     import jax
     import jax.numpy as jnp
     import paddle_tpu as pt
+
+    if args.no_bf16:
+        # also flip the global matmul kill switch: builders that hardcode
+        # use_bf16=True (transformer) honor --no_bf16 through it
+        from paddle_tpu.core import flags as _flags
+        _flags.set_flag("use_bf16_matmul", False)
 
     from paddle_tpu.distributed import init_parallel_env
     denv = init_parallel_env()  # no-op without PADDLE_COORDINATOR_ENDPOINT
@@ -466,6 +483,14 @@ def main():
         if args.comm_bucket_bytes >= 0:
             bst.comm_bucket_bytes = args.comm_bucket_bytes
         mesh = None
+        t = max(args.tp, 1)
+        if args.tp > 1:
+            from paddle_tpu.parallel import annotate_tp
+            annotated = annotate_tp()
+            if not annotated:
+                p.error(f"--tp {args.tp}: no parameter of model "
+                        f"{args.model!r} matches the annotate_tp rules "
+                        f"(transformer-family names)")
         if args.pipeline_stages > 1:
             from paddle_tpu.parallel.mesh import DeviceMesh
             bst.pipeline_stages = args.pipeline_stages
@@ -473,10 +498,20 @@ def main():
             bst.pipeline_schedule = args.pipeline_schedule
             devs = jax.devices()
             k = args.pipeline_stages
-            if len(devs) % k:
-                p.error(f"--pipeline_stages {k} must divide the device "
-                        f"count {len(devs)}")
-            mesh = DeviceMesh(devs, {"dp": len(devs) // k, "pp": k})
+            if len(devs) % (k * t):
+                p.error(f"--pipeline_stages {k} x --tp {t} must divide "
+                        f"the device count {len(devs)}")
+            axes = {"dp": len(devs) // (k * t), "pp": k}
+            if t > 1:
+                axes["tp"] = t
+            mesh = DeviceMesh(devs, axes)
+        elif t > 1:
+            from paddle_tpu.parallel.mesh import DeviceMesh
+            devs = jax.devices()
+            if len(devs) % t:
+                p.error(f"--tp {t} must divide the device count "
+                        f"{len(devs)}")
+            mesh = DeviceMesh(devs, {"dp": len(devs) // t, "tp": t})
         runner = ParallelExecutor(loss_name=loss.name, build_strategy=bst,
                                   mesh=mesh)
     else:
@@ -529,6 +564,27 @@ def main():
                 analytic["param_allgather_wire_bytes"],
             "wire_bytes_per_step": analytic["wire_bytes"],
         }
+        if args.tp > 1:
+            # tp rows, same discipline as grad_bytes_on_wire: the
+            # analytic per-device tp-collective bytes from the rewritten
+            # program's spliced tp_* ops (framework/sharding.py ring
+            # accounting, shared probe_common.collective_wire_bytes
+            # model); None when the SPMD partitioner owns the tp
+            # collectives (reduce_mode=allreduce)
+            from paddle_tpu.framework.sharding import tp_analytic_wire_bytes
+            tpw = tp_analytic_wire_bytes(rewritten, args.tp,
+                                         nominal_batch=args.batch_size)
+            comm_fields.update({
+                "tp": args.tp,
+                "tp_allreduce_bytes_on_wire":
+                    tpw["tp_allreduce_wire_bytes"] if tpw else None,
+                "tp_allgather_bytes_on_wire":
+                    tpw["tp_allgather_wire_bytes"] if tpw else None,
+                "tp_wire_bytes_per_step":
+                    tpw["tp_wire_bytes"] if tpw else None,
+                "tp_collective_counts":
+                    tpw["tp_op_counts"] if tpw else None,
+            })
         if args.pipeline_stages > 1:
             # same discipline as grad_bytes_on_wire: the analytic
             # boundary-transfer model (probe_common ring accounting /

@@ -59,8 +59,22 @@ def _builders():
         "transformer_lm": lambda: models.transformer.transformer_lm(
             vocab=1000, max_len=32, d_model=64, d_inner=128, num_heads=4,
             num_layers=2)[0],
+        "transformer_lm_tp": _tp_transformer,
         "machine_translation": mt,
     }
+
+
+def _tp_transformer():
+    """tp-annotated transformer_lm: Megatron column/row/vocab shardings
+    applied by parallel.auto_shard.annotate_tp; lint with --tp 2 to also
+    run the tp_shard_pass rewrite and lint the spliced program."""
+    from paddle_tpu import models
+    from paddle_tpu.parallel import annotate_tp
+    loss, _ = models.transformer.transformer_lm(
+        vocab=1000, max_len=32, d_model=64, d_inner=128, num_heads=4,
+        num_layers=2, mean_loss=True)
+    annotate_tp()
+    return loss
 
 
 def _human(n):
@@ -87,6 +101,16 @@ def lint_one(name, build, args):
             pt.optimizer.MomentumOptimizer(
                 0.1, momentum=0.9).minimize(loss)
     prog = pt.default_main_program()
+    from paddle_tpu.framework import sharding as _sharding
+    shard_res = None
+    if args.tp >= 2 and _sharding.has_tp_annotations(prog):
+        from paddle_tpu.core.enforce import EnforceError
+        try:
+            prog = get_pass("tp_shard_pass", tp=args.tp)(prog)
+        except (EnforceError, analysis.ProgramAnalysisError) as e:
+            print(f"\n== {name} ==")
+            print(f"  ERROR  tp-shard-gate  tp_shard_pass  {e}")
+            return 1
     if args.pipeline_stages >= 2:
         from paddle_tpu.core.enforce import EnforceError
         try:
@@ -104,6 +128,10 @@ def lint_one(name, build, args):
     t1 = time.time()
     res = analysis.infer_program(prog)
     diags = analysis.verify_program(prog) + res.diagnostics
+    if args.tp >= 2 or _sharding.has_tp_annotations(prog):
+        shard_res = _sharding.propagate_sharding(
+            prog, tp_size=args.tp if args.tp >= 2 else None)
+        diags += shard_res.diagnostics
     mem = analysis.peak_live_bytes(prog, nominal_batch=args.batch_size)
     analyze_s = time.time() - t1
 
@@ -115,6 +143,34 @@ def lint_one(name, build, args):
           f"build={build_s:.2f}s analyze={analyze_s:.2f}s")
     print(f"  inference: {res.n_inferred}/{res.n_ops} ops inferred, "
           f"{res.n_skipped} skipped (waived/unknown inputs)")
+    if shard_res is not None:
+        sharded = shard_res.sharded_vars()
+        n_seed = len(shard_res.seeded)
+        n_coll = len(shard_res.actions)
+        print(f"  sharding: {n_seed} annotated var(s) propagated to "
+              f"{len(sharded)} sharded var(s), {n_coll} op(s) need tp "
+              f"collectives")
+        rows = []
+        for vn in sorted(sharded):
+            spec = sharded[vn]
+            v = next((b.var(vn) for b in prog.blocks if b.has_var(vn)),
+                     None)
+            shape = tuple(v.shape) if v is not None and v.shape else None
+            local = (_sharding.tp_local_shape(shape, spec, args.tp)
+                     if shape and args.tp >= 2 else None)
+            rows.append((vn, "[" + ",".join(s or "-" for s in spec) + "]",
+                         str(shape), str(local) if local else "-"))
+        if rows:
+            w0 = max(len(r[0]) for r in rows)
+            w1 = max(len(r[1]) for r in rows)
+            w2 = max(len(r[2]) for r in rows)
+            print(f"    {'VAR':<{w0}}  {'SPEC':<{w1}}  "
+                  f"{'DECLARED':<{w2}}  TP-LOCAL")
+            for vn, spec, shape, local in rows[:args.max_shard_rows]:
+                print(f"    {vn:<{w0}}  {spec:<{w1}}  {shape:<{w2}}  "
+                      f"{local}")
+            if len(rows) > args.max_shard_rows:
+                print(f"    ... {len(rows) - args.max_shard_rows} more")
     print(f"  memory (batch={args.batch_size}, block 0 lifetimes): "
           f"params+state {_human(mem['persistent_bytes'])}, "
           f"feeds {_human(mem['feed_bytes'])}, "
@@ -154,6 +210,13 @@ def main():
                    help="apply pipeline_partition_pass first and lint "
                         "the partitioned program")
     p.add_argument("--num_microbatches", type=int, default=4)
+    p.add_argument("--tp", type=int, default=0,
+                   help="tensor-parallel degree: apply tp_shard_pass to a "
+                        "tp-annotated program (e.g. --model "
+                        "transformer_lm_tp) and lint the spliced program; "
+                        "the propagated sharding-spec table prints per "
+                        "sharded var")
+    p.add_argument("--max_shard_rows", type=int, default=24)
     p.add_argument("--max_diags", type=int, default=40)
     args = p.parse_args()
 

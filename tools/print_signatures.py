@@ -46,6 +46,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.transpiler",
     "paddle_tpu.distributed",
     "paddle_tpu.framework.analysis",
+    "paddle_tpu.framework.sharding",
     "paddle_tpu.parallel",
     "paddle_tpu.parallel.collective",
     "paddle_tpu.parallel.grad_comm",

@@ -26,6 +26,11 @@ echo "== static program lint (analyzer over mnist + transformer_lm) =="
 # error-severity diagnostic. docs/static_analysis.md has the catalog.
 JAX_PLATFORMS=cpu python tools/lint_program.py --model mnist
 JAX_PLATFORMS=cpu python tools/lint_program.py --model transformer_lm
+# tp lint: tp-annotated transformer through tp_shard_pass at tp=2; prints
+# the propagated sharding-spec table and fails on any propagation conflict
+# (docs/tensor_parallel.md has the rule catalog)
+JAX_PLATFORMS=cpu python tools/lint_program.py --model transformer_lm_tp \
+    --tp 2
 
 if [ "$TIER" = "quick" ]; then
     echo "== quick test tier (~5 min) =="
@@ -101,6 +106,56 @@ for quant in ("", "int8"):
         assert any("s8[" in l for v in census.values() for _, l in v), \
             "quantized mode has no int8 on the wire"
 print("dp-comm smoke OK")
+PY
+
+echo "== tensor-parallel smoke (tp2 parity through tp_shard_pass) =="
+# the static sharding subsystem end to end: annotate_tp + tp_shard_pass +
+# the full-manual shard_map executor must reproduce the single-device
+# fixed-seed loss curve on a dp1 x tp2 mesh in ReduceScatter mode
+# (f32 matmuls: splitting a bf16 contraction changes its rounding).
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+python - <<'PY'
+import numpy as np, jax
+import paddle_tpu as pt
+from paddle_tpu.core import flags
+from paddle_tpu.parallel import ParallelExecutor, annotate_tp
+from paddle_tpu.parallel.mesh import DeviceMesh
+from paddle_tpu.parallel.strategy import BuildStrategy, ReduceStrategy
+
+flags.set_flag("use_bf16_matmul", False)
+
+def build():
+    from paddle_tpu.models import transformer
+    loss, _ = transformer.transformer_lm(
+        vocab=64, max_len=8, d_model=32, d_inner=64, num_heads=4,
+        num_layers=2, mean_loss=True)
+    pt.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    return loss
+
+rng = np.random.RandomState(7)
+feeds = [{"tokens": rng.randint(0, 64, (8, 8)).astype("int64"),
+          "tokens@SEQLEN": np.full((8,), 8, "int32"),
+          "targets": rng.randint(0, 64, (8, 8)).astype("int64")}
+         for _ in range(3)]
+pt.reset_default_programs(); pt.reset_global_scope()
+with pt.core.unique_name.guard():
+    loss = build()
+exe = pt.Executor(); exe.run(pt.default_startup_program())
+base = [float(exe.run(feed=f, fetch_list=[loss])[0]) for f in feeds]
+pt.reset_default_programs(); pt.reset_global_scope()
+with pt.core.unique_name.guard():
+    loss = build()
+assert annotate_tp()
+bst = BuildStrategy(); bst.reduce_strategy = ReduceStrategy.ReduceScatter
+mesh = DeviceMesh(jax.devices()[:2], {"dp": 1, "tp": 2})
+pexe = ParallelExecutor(loss_name=loss.name, mesh=mesh,
+                        build_strategy=bst)
+pt.Executor().run(pt.default_startup_program())
+got = [float(pexe.run(feed=f, fetch_list=[loss])[0]) for f in feeds]
+assert max(abs(a - b) for a, b in zip(base, got)) <= 1e-5, (base, got)
+prog = pexe._prepare_program(pt.default_main_program(), pt.global_scope())
+assert getattr(prog, "_tp_applied", False)
+print("tensor-parallel smoke OK")
 PY
 
 echo "== pipeline-parallel smoke (gpipe + 1f1b parity, pp=2, M=4) =="

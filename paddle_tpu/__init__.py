@@ -33,6 +33,7 @@ from .inferencer import Inferencer, Predictor  # noqa: F401,E402
 from . import serving  # noqa: F401,E402
 from . import serving_engine  # noqa: F401,E402
 from . import metrics  # noqa: F401,E402
+from . import observability  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import debugger  # noqa: F401,E402
 from .trainer import (BeginEpochEvent, BeginStepEvent,  # noqa: F401,E402

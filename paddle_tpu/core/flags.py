@@ -153,6 +153,19 @@ define_bool("quant_comm", True,
             "while keeping the explicit reduce-scatter pipeline — the "
             "escape hatch if quantization ever hurts a model's "
             "convergence in production (parallel/grad_comm.py).")
+define_bool("trace", True,
+            "Structured step tracing (observability/tracing.py): typed "
+            "nested spans (compile/step/tick/pass/dp_comm/pp_tick/"
+            "admission/feed_fetch) recorded into the in-process ring "
+            "buffer, exportable as Chrome trace / aggregate tables and "
+            "joined with analytic predictions by observability/ledger.py. "
+            "Kill switch: PTPU_TRACE=0 makes every span a no-op (span "
+            "enter/exit cost drops below the 0.5%%-of-step budget asserted "
+            "in tests/test_observability.py).")
+define_int("trace_ring", 65536,
+           "Capacity of the span ring buffer (observability/tracing.py). "
+           "Oldest spans are overwritten; the buffer is preallocated so "
+           "recording never allocates on the hot path.")
 # (num_iteration_per_drop_scope lives on ExecutionStrategy for API parity;
 # the functional executor has no per-iteration kid scopes to drop)
 define_int("sparse_dense_apply_max_bytes", 1 << 30,

@@ -1,0 +1,386 @@
+"""Analytic cost models as a first-class framework API.
+
+Five generations of probes each carried a private copy of some slice of
+this: the flop/byte roofline (tools/probe_common, r03+), the collective
+wire-byte ring model (r08), the pipeline bubble model (r09), the static
+peak-live-bytes estimator (r10), and the tp collective model (r11). This
+module is now the ONE home: `tools/probe_common` re-exports from here (so
+the r08/r09/r11 exact-census test assertions flow through this API
+unchanged), `framework/passes.py` balances pipeline stages with it, and
+`predict(program, ...)` joins every model into a single CostReport — the
+queryable substrate the auto-parallel planner (ROADMAP item 2) searches
+over and `observability/ledger.py` reconciles against measured traces.
+
+Accounting disciplines (unchanged from the probes they came from):
+
+- per-op (flops, bytes) from declared var shapes, -1 batch dims resolved
+  to `nominal_batch`; roofline combine max(flops/peak, bytes/bw) at the
+  v5e constants;
+- per-device interconnect bytes per collective from its (per-device)
+  OUTPUT bytes in the partitioned HLO — standard ring-algorithm costs;
+- pipeline bubbles from the executed schedule tables, not the closed
+  form (they agree exactly: (K-1)/(M+K-1));
+- peak live bytes from variable lifetimes (first writer .. last reader).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# hardware constants (v5e) — the probes, the pipeline partitioner, and the
+# benchmark roofline fields all quote the same peaks so one number means
+# one thing everywhere
+# ---------------------------------------------------------------------------
+
+V5E_PEAK_TFLOPS = 197e12
+V5E_HBM_BPS = 819e9
+
+# dtype byte widths for parsing XLA shape strings — the ONE copy shared by
+# the probes (probe_caps) and the comm-structure tests. Covers every XLA
+# scalar type that can appear in a typed shape (ADVICE r5 #4); an
+# unrecognized typed-shape token RAISES instead of silently counting 0
+# bytes (which would let byte-balance assertions pass/fail misleadingly
+# if dtypes drift).
+HLO_ITEM_BYTES = {"pred": 1,
+                  "s2": 1, "u2": 1, "s4": 1, "u4": 1,     # sub-byte types
+                  "s8": 1, "u8": 1, "s16": 2, "u16": 2,   # pack >= 1 byte
+                  "s32": 4, "u32": 4, "s64": 8, "u64": 8,
+                  "f8e4m3": 1, "f8e4m3fn": 1, "f8e4m3b11fnuz": 1,
+                  "f8e4m3fnuz": 1, "f8e5m2": 1, "f8e5m2fnuz": 1,
+                  "f8e3m4": 1, "f8e8m0fnu": 1,
+                  "bf16": 2, "f16": 2, "f32": 4, "f64": 8,
+                  "c64": 8, "c128": 16}
+
+# typed-shape tokens that are legitimately byte-free
+_HLO_ZERO_BYTE_TYPES = frozenset({"token", "opaque"})
+
+
+def hlo_shape_bytes(sh: str) -> int:
+    """Total bytes of every typed array in one HLO shape string (tuple
+    shapes sum their elements). Raises on a typed-shape token whose
+    element type is not in HLO_ITEM_BYTES."""
+    total = 0
+    matched_any = False
+    for m in re.finditer(r"([a-zA-Z][a-zA-Z0-9]*)\[([0-9,]*)\]", sh):
+        matched_any = True
+        dtype = m.group(1)
+        if dtype in _HLO_ZERO_BYTE_TYPES:
+            continue
+        if dtype not in HLO_ITEM_BYTES:
+            raise ValueError(
+                f"hlo_shape_bytes: unrecognized element type {dtype!r} in "
+                f"shape string {sh!r}; add it to HLO_ITEM_BYTES")
+        n = 1
+        for d in m.group(2).split(","):
+            if d:
+                n *= int(d)
+        total += n * HLO_ITEM_BYTES[dtype]
+    if not matched_any and "[" in sh:
+        raise ValueError(
+            f"hlo_shape_bytes: no typed shape recognized in {sh!r} "
+            f"(dynamic dims or unexpected syntax?)")
+    return total
+
+
+def collective_census(hlo: str) -> Dict[str, list]:
+    """{kind: [(output_bytes, line)]} for every collective instruction in a
+    compiled (per-device) HLO module. Async pairs are counted once, at the
+    -start; tuple-shaped outputs (all-to-all emits one operand per peer,
+    with /*index=N*/ comments past 5 elements) sum their elements."""
+    out: Dict[str, list] = {}
+    for line in hlo.splitlines():
+        # tuple shapes may nest one paren level INSIDE the tuple: TPU
+        # layouts print as {1,0:T(8,128)} — [^()] alone would stop there
+        # and silently drop the instruction from the census
+        m = re.match(
+            r"\s*(?:ROOT )?%?[\w.\-]+ = "
+            r"(\((?:[^()]|\([^()]*\))*\)|\S+)\s+"
+            r"(all-reduce|reduce-scatter|all-gather|collective-permute|"
+            r"all-to-all)(-start|-done)?\(", line)
+        if not m:
+            continue
+        if m.group(3) == "-done":
+            continue
+        kind = m.group(2)
+        out.setdefault(kind, []).append((hlo_shape_bytes(m.group(1)), line))
+    return out
+
+
+# Per-device bytes each collective puts on the interconnect, as a function
+# of its (per-device) OUTPUT bytes in the partitioned HLO — the standard
+# ring-algorithm accounting, shared by the comm-structure tests and the
+# benchmark's grad_bytes_on_wire field so both quote the same model:
+#   all-reduce out=n:        ring RS+AG, sends 2n(N-1)/N
+#   reduce-scatter out=c:    input N*c, sends c(N-1)
+#   all-gather out=n:        contributes n/N, sends n(N-1)/N
+#   all-to-all out total=t:  keeps its own chunk, sends t(N-1)/N
+#   collective-permute out=n: sends n
+def collective_wire_bytes(kind: str, out_bytes: int, n_devices: int) -> float:
+    n = n_devices
+    return {
+        "all-reduce": 2.0 * out_bytes * (n - 1) / n,
+        "reduce-scatter": float(out_bytes) * (n - 1),
+        "all-gather": float(out_bytes) * (n - 1) / n,
+        "all-to-all": float(out_bytes) * (n - 1) / n,
+        "collective-permute": float(out_bytes),
+    }[kind]
+
+
+def census_wire_bytes(census: Dict[str, list], n_devices: int,
+                      min_bytes: int = 0) -> float:
+    """Total per-device interconnect bytes for one step, from a
+    collective_census; instructions with output below `min_bytes` can be
+    excluded (scalar loss/metric reductions)."""
+    total = 0.0
+    for kind, items in census.items():
+        for b, _ in items:
+            if b >= min_bytes:
+                total += collective_wire_bytes(kind, b, n_devices)
+    return total
+
+
+# ---------------------------------------------------------------------------
+# analytic per-op cost model — the balancing signal for the pipeline
+# partitioner (framework/passes.py pipeline_partition_pass) and the
+# per-stage compute model of tools/probe_bubble.py. Costs are RELATIVE
+# (batch dims unknown until feed time use `nominal_batch`).
+# ---------------------------------------------------------------------------
+
+# ops that are pure markers / bookkeeping: zero device cost
+_ZERO_COST_OPS = frozenset({"pp_send", "pp_recv", "feed", "fetch"})
+
+# per-output-element flop weights for transcendental-ish elementwise ops
+_ELEMENTWISE_FLOPS = {"softmax": 5.0, "exp": 4.0, "log": 4.0, "tanh": 6.0,
+                      "sigmoid": 5.0, "relu": 1.0, "sqrt": 4.0, "pow": 4.0,
+                      "elementwise_pow": 4.0, "gelu": 8.0,
+                      "layer_norm": 8.0, "batch_norm": 6.0,
+                      "softmax_with_cross_entropy": 8.0,
+                      "cross_entropy": 4.0, "dropout": 2.0}
+
+
+def _var_numel(block, name, nominal_batch):
+    try:
+        v = block.var(name)
+    except Exception:
+        return 0
+    shape = getattr(v, "shape", None) or ()
+    n = 1
+    for d in shape:
+        n *= (nominal_batch if d == -1 else int(d))
+    return n
+
+
+def _var_shape(block, name, nominal_batch):
+    try:
+        v = block.var(name)
+    except Exception:
+        return None
+    shape = getattr(v, "shape", None)
+    if shape is None:
+        return None
+    return [nominal_batch if d == -1 else int(d) for d in shape]
+
+
+def op_cost_flops_bytes(op, block, nominal_batch: int = 8) -> Tuple[float,
+                                                                    float]:
+    """(flops, bytes) estimate for one program op, from declared var shapes
+    (-1 batch dims resolved to `nominal_batch` — the model only needs to be
+    RELATIVELY right to balance contiguous stages)."""
+    if op.type in _ZERO_COST_OPS:
+        return 0.0, 0.0
+    in_n = sum(_var_numel(block, n, nominal_batch)
+               for n in op.input_names())
+    out_n = sum(_var_numel(block, n, nominal_batch)
+                for n in op.output_names())
+    bytes_ = 4.0 * (in_n + out_n)
+    t = op.type
+    if t in ("mul", "matmul"):
+        xs = _var_shape(block, op.inputs["X"][0], nominal_batch)
+        k = 1.0
+        if xs:
+            k = float(xs[-2] if op.attrs.get("transpose_X") and len(xs) >= 2
+                      else xs[-1])
+        return 2.0 * out_n * k, bytes_
+    if t in ("conv2d", "conv3d", "conv2d_transpose", "conv3d_transpose",
+             "depthwise_conv2d"):
+        # filter is [num_filters, cin/groups, k...] in both layouts, so
+        # per-output-element work = 2 * numel(filter) / num_filters
+        fn = _var_numel(block, op.inputs["Filter"][0], nominal_batch)
+        fs = _var_shape(block, op.inputs["Filter"][0], nominal_batch)
+        nf = float(fs[0]) if fs else 1.0
+        return 2.0 * out_n * (fn / max(nf, 1.0)), bytes_
+    if t in ("dynamic_lstm", "fused_lstm", "dynamic_gru", "fused_gru"):
+        wn = sum(_var_numel(block, n, nominal_batch)
+                 for slot in ("Weight", "WeightX", "WeightH")
+                 for n in op.inputs.get(slot, []))
+        return 2.0 * max(out_n, in_n) * max(wn, 1) ** 0.5, bytes_
+    if t == "lookup_table":
+        return float(out_n), bytes_
+    return _ELEMENTWISE_FLOPS.get(t, 1.0) * out_n, bytes_
+
+
+def op_time_cost(flops: float, bytes_: float) -> float:
+    """Roofline combine of one op's (flops, bytes): seconds on the v5e
+    peak — whichever engine bounds it."""
+    return max(flops / V5E_PEAK_TFLOPS, bytes_ / V5E_HBM_BPS)
+
+
+def program_flops_bytes(program, nominal_batch: int = 8) -> Dict:
+    """Whole-program (block 0) analytic flops/bytes + roofline seconds —
+    the per-op model summed, with the per-op roofline combine (so
+    compute-bound and memory-bound ops each contribute their binding
+    engine's time, the same combine the pipeline partitioner balances)."""
+    block = program.global_block()
+    flops = bytes_ = secs = 0.0
+    for op in block.ops:
+        f, b = op_cost_flops_bytes(op, block, nominal_batch)
+        flops += f
+        bytes_ += b
+        secs += op_time_cost(f, b)
+    return {"flops": flops, "bytes": bytes_,
+            "roofline_s": secs, "n_ops": len(block.ops),
+            "nominal_batch": nominal_batch}
+
+
+def roofline_fields(step_s: float, flops: float, bytes_acc: float) -> Dict:
+    """The shared attribution fields; None where the cost model gave 0."""
+    out = {
+        "step_ms": round(step_s * 1e3, 2),
+        "bytes_GB": round(bytes_acc / 1e9, 2) if bytes_acc else None,
+        "flops_G": round(flops / 1e9, 1) if flops else None,
+        "intensity_flops_per_byte":
+            round(flops / bytes_acc, 1) if flops and bytes_acc else None,
+        "ideal_mxu_ms":
+            round(flops / V5E_PEAK_TFLOPS * 1e3, 3) if flops else None,
+        "ideal_hbm_ms":
+            round(bytes_acc / V5E_HBM_BPS * 1e3, 3) if bytes_acc else None,
+        "mfu": round(flops / step_s / V5E_PEAK_TFLOPS, 4) if flops else None,
+    }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# predict(): one call joining every analytic model for a (possibly
+# rewrite-passed) program — the ledger's prediction side and the planner's
+# objective function
+# ---------------------------------------------------------------------------
+
+
+def predict(program, strategy=None, *, dp: int = 1, tp: int = 0,
+            nominal_batch: int = 8) -> Dict:
+    """Joined analytic cost prediction for one program.
+
+    `program` should be the program the executor will actually run — for
+    the manual modes that is the REWRITTEN program
+    (`ParallelExecutor._prepare_program(prog, scope)`), whose markers
+    (`_dp_comm_applied`, `_pp_applied`, `_tp_applied`) select which wire
+    models apply. `strategy` (a BuildStrategy) is only consulted for
+    documentation fields; every byte/bubble number comes from the program
+    itself so prediction and execution cannot drift.
+
+    Returns a CostReport dict with sections:
+      compute:   program_flops_bytes (flop/byte roofline)
+      dp_comm:   grad_comm.analytic_wire_bytes (explicit pipeline) or
+                 spmd_allreduce_wire_bytes (SPMD), when dp > 1
+      tp_comm:   sharding.tp_analytic_wire_bytes, when the tp pass ran
+      pipeline:  schedule_census bubble/stash model +
+                 pp_boundary_wire_bytes, when the pp pass ran
+      memory:    analysis.peak_live_bytes
+    Sections that don't apply are None — a ledger row records that the
+    model was consulted and judged inapplicable, not silently skipped.
+    """
+    from ..parallel import grad_comm as _gc
+    from . import analysis as _analysis
+    from . import sharding as _sharding
+
+    report: Dict = {
+        "nominal_batch": nominal_batch,
+        "dp": dp,
+        "compute": program_flops_bytes(program, nominal_batch),
+        "dp_comm": None,
+        "tp_comm": None,
+        "pipeline": None,
+        "memory": _analysis.peak_live_bytes(program,
+                                            nominal_batch=nominal_batch),
+    }
+    if dp > 1:
+        report["dp_comm"] = (_gc.analytic_wire_bytes(program, dp)
+                             or _gc.spmd_allreduce_wire_bytes(program, dp))
+        report["dp_comm"]["explicit"] = bool(
+            getattr(program, "_dp_comm_applied", False))
+    if getattr(program, "_tp_applied", False):
+        tpn = tp or int(getattr(program, "_tp_size", 0) or 0)
+        if tpn > 1:
+            report["tp_comm"] = _sharding.tp_analytic_wire_bytes(
+                program, tpn, nominal_batch=nominal_batch)
+    if getattr(program, "_pp_applied", False):
+        from ..parallel.pipeline import (pp_boundary_wire_bytes,
+                                         schedule_census)
+        region = next((op for op in program.global_block().ops
+                       if op.type == "pp_pipeline_region"), None)
+        if region is not None:
+            m = int(region.attrs["num_microbatches"])
+            k = int(region.attrs["num_stages"])
+            sched = schedule_census(region.attrs["schedule"], m, k)
+            mb_rows = max(1, nominal_batch // max(1, dp * m))
+            wire = pp_boundary_wire_bytes(program, mb_rows)
+            report["pipeline"] = {**sched,
+                                  "boundary": wire,
+                                  "microbatch_rows": mb_rows,
+                                  "grad_psum_wire_bytes":
+                                      _pp_grad_psum_bytes(program, k)}
+    if strategy is not None:
+        report["strategy"] = {
+            "reduce_strategy": str(getattr(strategy, "reduce_strategy", "")),
+            "quant_comm": getattr(strategy, "quant_comm", ""),
+            "pipeline_stages": getattr(strategy, "pipeline_stages", 0),
+            "num_microbatches": getattr(strategy, "num_microbatches", 0),
+            "pipeline_schedule": getattr(strategy, "pipeline_schedule", ""),
+        }
+    return report
+
+
+def _pp_grad_psum_bytes(program, k: int) -> int:
+    """Per-device wire bytes of the pipeline region's ONE gradient psum
+    over the pp axis (run_pp_region: grads accumulate per stage, one
+    psum over pp replicates them for the optimizer) — an all-reduce of
+    every trainable gradient, ring 2n(K-1)/K. Grads live at tp-LOCAL
+    shapes when the tp pass rewrote the program."""
+    tp = int(getattr(program, "_tp_size", 0) or 0) \
+        if getattr(program, "_tp_applied", False) else 0
+    total = 0.0
+    for b in program.blocks:
+        for v in b.vars.values():
+            if not (getattr(v, "trainable", False) and v.persistable):
+                continue
+            shape = list(v.shape or ())
+            if tp > 1 and getattr(v, "tp_spec", None):
+                from .sharding import tp_local_shape
+                shape = list(tp_local_shape(shape, v.tp_spec, tp))
+            n = 4
+            for d in shape:
+                n *= d
+            total += 2.0 * n * (k - 1) / k
+    return int(total)
+
+
+def predicted_wire_bytes(report: Dict) -> float:
+    """Predicted per-device wire bytes per step on the ONCE-PER-STEP
+    collectives (dp gradient pipeline + tp collectives) — the number the
+    ledger reconciles EXACTLY with the HLO census. The pipeline's
+    boundary collective-permutes are deliberately excluded: they execute
+    2(M+K-1) times inside the tick scan but appear once in the static
+    HLO, so they are reconciled structurally instead
+    (ledger.check_pp_boundary: instruction count == 2, per-instruction
+    bytes == the predicted cut buffer)."""
+    total = 0.0
+    if report.get("dp_comm"):
+        total += report["dp_comm"].get("wire_bytes", 0)
+    if report.get("tp_comm"):
+        total += report["tp_comm"].get("tp_wire_bytes", 0)
+    pipe = report.get("pipeline")
+    if pipe:
+        total += pipe.get("grad_psum_wire_bytes", 0)
+    return total

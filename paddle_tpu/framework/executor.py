@@ -346,8 +346,10 @@ class Executor:
                _fusion_flags_key())
         compiled = self._cache.get(key)
         if compiled is None:
-            from .. import profiler as _prof
-            with _prof.RecordEvent("executor/trace_and_compile"):
+            from ..observability import tracing as _tracing
+            with _tracing.span("compile", "executor/trace_and_compile",
+                               program_version=program._version,
+                               n_fetches=len(fetch_names)):
                 compiled = self._compile(program, scope, list(feed.keys()),
                                          fetch_names)
             self._cache[key] = compiled
@@ -370,17 +372,22 @@ class Executor:
                        for f in fetch_list]
 
         from .. import profiler as _prof
+        from ..observability import tracing as _tracing
         compiled = self._lookup_or_compile(program, feed, fetch_names, scope)
 
-        feed_vals = tuple(jnp.asarray(feed[n]) for n in compiled.feed_names)
-        ro_vals = tuple(scope.get(n) for n in compiled.ro_names)
-        rw_vals = tuple(scope.get(n) for n in compiled.rw_names)
+        with _tracing.span("feed_fetch", "executor/feed",
+                           n_feeds=len(compiled.feed_names)):
+            feed_vals = tuple(jnp.asarray(feed[n])
+                              for n in compiled.feed_names)
+            ro_vals = tuple(scope.get(n) for n in compiled.ro_names)
+            rw_vals = tuple(scope.get(n) for n in compiled.rw_names)
         self._run_counter += 1
         seed = np.uint32((program.random_seed * 1000003 + self._run_counter)
                          % (2 ** 31))
 
         t0 = time.time()
-        with _prof.RecordEvent("executor/run"):
+        with _tracing.span("step", "executor/run",
+                           program_version=program._version):
             fetches, new_state = compiled.fn(feed_vals, ro_vals, rw_vals, seed)
             if _prof.profiler_enabled():
                 jax.block_until_ready(fetches)
@@ -398,8 +405,10 @@ class Executor:
                 list(zip(compiled.state_out_names, new_state)),
                 "rerun under JAX_PLATFORMS=cpu with PTPU_CHECK_NAN_INF=1 "
                 "to localize the op")
-        for name, val in zip(compiled.state_out_names, new_state):
-            scope.set_var(name, val)
+        with _tracing.span("feed_fetch", "executor/state_writeback",
+                           n_state=len(compiled.state_out_names)):
+            for name, val in zip(compiled.state_out_names, new_state):
+                scope.set_var(name, val)
         if flags.get_flag("benchmark"):
             jax.block_until_ready(fetches)
             print(f"[benchmark] program run took {time.time() - t0:.4f}s")
@@ -502,8 +511,10 @@ class Executor:
         seed = np.uint32((program.random_seed * 1000003
                           + self._run_counter + 1) % (2 ** 31))
         self._run_counter += k
-        fetches, final_state = compiled.fn(feed_stacks, ro_vals, rw_vals,
-                                           seed)
+        from ..observability import tracing as _tracing
+        with _tracing.span("step", "executor/run_steps", steps=k):
+            fetches, final_state = compiled.fn(feed_stacks, ro_vals, rw_vals,
+                                               seed)
         if flags.get_flag("check_nan_inf") and jax.default_backend() != "cpu":
             # same contract as run(): sweep BEFORE the scope write-back so
             # the last-good parameters stay checkpointable when a step in

@@ -12,7 +12,6 @@ batch norms, freezing quantization, pruning, rematerialization policy).
 
 from __future__ import annotations
 
-import os
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..core.enforce import (AlreadyExistsError, InvalidArgumentError,
@@ -46,8 +45,15 @@ class Pass:
         # structural invariant is attributed to THIS pass by name instead
         # of surfacing later as an opaque trace error — the role the HLO
         # verifier plays between XLA passes. Kill switch PTPU_VERIFY_PASSES=0.
+        # The apply is also recorded as a "pass" span carrying the pass
+        # name + attrs, so compile-time rewrite cost is attributable per
+        # pass in the trace (observability/tracing.py).
+        from ..observability import tracing as _tracing
         from .analysis import sanitized_apply
-        return sanitized_apply(self, program, scope)
+        with _tracing.span("pass", f"pass/{self.name}",
+                           **{k: v for k, v in self.attrs.items()
+                              if isinstance(v, (str, int, float, bool))}):
+            return sanitized_apply(self, program, scope)
 
 
 _REGISTRY: Dict[str, Callable[..., Pass]] = {}
@@ -358,40 +364,11 @@ class FuseDecodeAttentionPass(Pass):
 
 
 def _pipeline_cost_fns():
-    """(op_cost_flops_bytes, op_time_cost) from tools/probe_common — ONE
-    analytic cost model shared with the probes; numel fallback when the
-    tools tree is not importable (installed package without the repo)."""
-    try:
-        from tools.probe_common import op_cost_flops_bytes, op_time_cost
-        return op_cost_flops_bytes, op_time_cost
-    except ImportError:
-        # source checkout without the repo root on sys.path: load the
-        # module explicitly from its known location (no sys.path mutation
-        # — a library pass must not change process-wide import behavior)
-        path = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__)))), "tools", "probe_common.py")
-        if os.path.exists(path):
-            import importlib.util
-            spec = importlib.util.spec_from_file_location(
-                "_ptpu_probe_common", path)
-            mod = importlib.util.module_from_spec(spec)
-            spec.loader.exec_module(mod)
-            return mod.op_cost_flops_bytes, mod.op_time_cost
-
-    def _fallback_cost(op, block, nominal_batch=8):
-        n = 0
-        for name in op.input_names() + op.output_names():
-            try:
-                v = block.var(name)
-            except NotFoundError:
-                continue
-            m = 1
-            for d in (v.shape or ()):
-                m *= (nominal_batch if d == -1 else int(d))
-            n += m
-        return float(n), 4.0 * n
-
-    return _fallback_cost, lambda f, b: max(f / 197e12, b / 819e9)
+    """(op_cost_flops_bytes, op_time_cost) from framework/costs.py — the
+    ONE analytic cost model, shared with the probes (tools/probe_common
+    re-exports it) and the predict() ledger API."""
+    from .costs import op_cost_flops_bytes, op_time_cost
+    return op_cost_flops_bytes, op_time_cost
 
 
 def _balanced_partition(costs: List[float], k: int) -> List[Tuple[int, int]]:

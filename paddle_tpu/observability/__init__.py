@@ -1,0 +1,26 @@
+"""Unified observability layer (r12).
+
+Three pieces, one story — see docs/observability.md:
+
+- `tracing`: typed nested spans recorded into a lock-cheap ring buffer
+  by the executors, the rewrite passes, and the serving engine; exported
+  as Chrome traces and per-span aggregate tables. Kill switch
+  PTPU_TRACE=0.
+- `metrics`: operational counters/gauges/histograms with Prometheus text
+  exposition; the serving EngineServer serves them over HTTP `/metrics`.
+- `ledger`: joins `framework.costs.predict()` analytic cost reports with
+  measured spans and HLO collective censuses into one
+  predicted-vs-measured artifact per run (BENCH_OBS_*.json).
+
+The capability equivalent of the reference's platform/profiler +
+device_tracer + timeline stack, grown into the always-on,
+prediction-reconciling form the auto-parallel planner (ROADMAP item 2)
+and the serving load harness (item 3) consume.
+"""
+
+from . import ledger, metrics, tracing  # noqa: F401
+from .ledger import CostLedger, LedgerRow  # noqa: F401
+from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
+                      MetricsRegistry, default_registry)
+from .tracing import (SPAN_KINDS, Span, aggregate,  # noqa: F401
+                      export_chrome_trace, span, spans)

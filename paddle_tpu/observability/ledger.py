@@ -1,0 +1,187 @@
+"""Cost ledger: predicted-vs-measured reconciliation in one artifact.
+
+Every evidence round so far published its analytic-vs-census comparison
+through a bespoke script (bench_dp wire bytes, probe_bubble slot fits,
+bench_tp ring sums). The ledger is the common form: one row per
+(model, strategy) run joining
+
+  predicted:  a `framework.costs.predict()` CostReport
+  measured:   the HLO collective census (exact), span aggregates from the
+              tracer (timing), and any run-reported numbers (losses,
+              step_ms)
+  checks:     named predicted-vs-measured comparisons, each with the
+              tolerance it was held to and whether it passed.
+
+`write()` emits the BENCH_OBS artifact; `check_*` helpers implement the
+two standing reconciliation disciplines — EXACT byte balance for
+collectives (r08/r11) and banded agreement for bubbles (r09).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from ..core.enforce import InvalidArgumentError, enforce
+from ..framework.costs import census_wire_bytes, predicted_wire_bytes
+
+
+class LedgerRow:
+    """One run's predicted-vs-measured record."""
+
+    def __init__(self, name: str, config: Optional[Dict] = None):
+        self.name = name
+        self.config = dict(config or {})
+        self.predicted: Optional[Dict] = None
+        self.measured: Dict = {}
+        self.checks: List[Dict] = []
+
+    # -- inputs -----------------------------------------------------------
+    def set_prediction(self, report: Dict):
+        """Attach a framework.costs.predict() CostReport."""
+        self.predicted = report
+        return self
+
+    def set_census(self, census: Dict, n_devices: int,
+                   min_bytes: int = 8):
+        """Attach an HLO collective census (framework.costs
+        .collective_census output); stores per-kind counts/bytes and the
+        ring-model wire total. `min_bytes` excludes scalar loss/metric
+        reductions, matching the r08 test discipline."""
+        step_census = {k: v for k, v in census.items()
+                       if k != "collective-permute"}
+        self.measured["census"] = {
+            "collectives": {k: len(v) for k, v in census.items()},
+            "bytes_by_kind": {k: sum(b for b, _ in v)
+                              for k, v in census.items()},
+            # once-per-step collectives only: pipeline boundary permutes
+            # run per TICK inside the scan (see check_pp_boundary)
+            "wire_bytes": int(census_wire_bytes(step_census, n_devices,
+                                                min_bytes=min_bytes)),
+            "permute_bytes": [b for b, _ in
+                              census.get("collective-permute", [])],
+            "n_devices": n_devices,
+            "min_bytes": min_bytes,
+        }
+        return self
+
+    def set_spans(self, aggregate: Dict):
+        """Attach a tracing.aggregate() table (per-name timing rows)."""
+        self.measured["spans"] = {
+            k: {f: round(v, 4) if isinstance(v, float) else v
+                for f, v in row.items()}
+            for k, row in aggregate.items()}
+        return self
+
+    def set_measured(self, **fields):
+        self.measured.update(fields)
+        return self
+
+    # -- reconciliation ---------------------------------------------------
+    def _check(self, what, predicted, measured, tolerance, ok):
+        rec = {"what": what, "predicted": predicted, "measured": measured,
+               "tolerance": tolerance, "ok": bool(ok)}
+        self.checks.append(rec)
+        return rec
+
+    def check_wire_bytes_exact(self) -> Dict:
+        """Predicted per-device wire bytes must equal the census ring
+        total EXACTLY — the r08/r11 byte-balance discipline. Requires
+        set_prediction and set_census first."""
+        enforce(self.predicted is not None and "census" in self.measured,
+                f"ledger row {self.name!r}: need both a prediction and a "
+                f"census before check_wire_bytes_exact",
+                exc=InvalidArgumentError)
+        pred = int(predicted_wire_bytes(self.predicted))
+        meas = int(self.measured["census"]["wire_bytes"])
+        return self._check("wire_bytes", pred, meas, "exact", pred == meas)
+
+    def check_pp_boundary(self) -> Dict:
+        """Structural reconciliation of the pipeline boundary transfers
+        (the r09 discipline): the compiled step must carry EXACTLY 2
+        collective-permutes (one act shift + one grad shift), each moving
+        the predicted cut buffer's bytes. Their per-step total is
+        per-tick x ticks, which the static census cannot count — hence
+        structural, not summed."""
+        enforce(self.predicted is not None
+                and self.predicted.get("pipeline") is not None
+                and "census" in self.measured,
+                f"ledger row {self.name!r}: need a pipeline prediction "
+                f"and a census before check_pp_boundary",
+                exc=InvalidArgumentError)
+        boundary = self.predicted["pipeline"]["boundary"]
+        pred_bytes = int(boundary["buffer_numel"]) * 4
+        meas = sorted(self.measured["census"]["permute_bytes"])
+        ok = meas == [pred_bytes, pred_bytes]
+        return self._check("pp_boundary_permutes",
+                           [pred_bytes, pred_bytes], meas,
+                           "exactly 2, exact bytes", ok)
+
+    def check_bubble_fraction(self, measured_fraction: float,
+                              band: float = 0.02) -> Dict:
+        """Predicted schedule-table bubble fraction vs a measured one,
+        within `band` (the r09 2% wall-clock band)."""
+        enforce(self.predicted is not None
+                and self.predicted.get("pipeline") is not None,
+                f"ledger row {self.name!r}: prediction has no pipeline "
+                f"section", exc=InvalidArgumentError)
+        pred = self.predicted["pipeline"]["bubble_fraction"]
+        ok = abs(pred - measured_fraction) <= band
+        return self._check("bubble_fraction", pred, measured_fraction,
+                           f"abs<={band}", ok)
+
+    def check(self, what: str, predicted, measured, rel: float) -> Dict:
+        """Generic relative-tolerance comparison."""
+        denom = max(abs(measured), 1e-12)
+        ok = abs(predicted - measured) / denom <= rel
+        return self._check(what, predicted, measured, f"rel<={rel}", ok)
+
+    @property
+    def ok(self) -> bool:
+        return all(c["ok"] for c in self.checks)
+
+    def to_dict(self) -> Dict:
+        return {"name": self.name, "config": self.config,
+                "predicted": self.predicted, "measured": self.measured,
+                "checks": self.checks, "ok": self.ok}
+
+
+class CostLedger:
+    """A run's collection of rows + one artifact writer."""
+
+    def __init__(self, run: str, meta: Optional[Dict] = None):
+        self.run = run
+        self.meta = dict(meta or {})
+        self.rows: List[LedgerRow] = []
+
+    def row(self, name: str, **config) -> LedgerRow:
+        r = LedgerRow(name, config)
+        self.rows.append(r)
+        return r
+
+    @property
+    def ok(self) -> bool:
+        return all(r.ok for r in self.rows)
+
+    def to_dict(self) -> Dict:
+        return {"run": self.run, "meta": self.meta, "ok": self.ok,
+                "rows": [r.to_dict() for r in self.rows]}
+
+    def write(self, path: str) -> str:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=1, default=_json_default)
+            f.write("\n")
+        return path
+
+
+def _json_default(o):
+    try:
+        import numpy as np
+        if isinstance(o, np.generic):
+            return o.item()
+    except ImportError:
+        pass
+    return str(o)

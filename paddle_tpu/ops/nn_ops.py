@@ -103,7 +103,7 @@ def _conv1x1_mixed(x, w, dn):
     layout copies of every 1x1 activation and the custom_vjp boundary
     breaks the BN-backward fusions the conv path enjoys. Default OFF
     (flag conv1x1_mixed_vjp); kept as the committed falsification probe
-    for PROF_r04's irreducibility claim (tools/probe_dgrad4.py,
+    for PROF_r04's irreducibility claim (tools/probe_dgrad.py --exp mixed_1x1,
     tools/ab_conv1x1.py, PROBE_DGRAD_r05.json)."""
     return jax.lax.conv_general_dilated(
         x, w, window_strides=(1, 1), padding=[(0, 0), (0, 0)],

@@ -169,9 +169,19 @@ def _optimizer_op_for(block, param_name, grad_name):
 def comm_optimize_pass(program: Program, dp: int, config: Dict) -> Program:
     """Clone `program` and rewrite its gradient path for the explicit
     pipeline. Idempotent: a program the pass already produced is returned
-    unchanged."""
+    unchanged. The rewrite is recorded as a "dp_comm" span carrying the
+    resolved plan config (observability/tracing.py)."""
     if getattr(program, "_dp_comm_applied", False):
         return program
+    from ..observability import tracing as _tracing
+    with _tracing.span("dp_comm", "grad_comm/comm_optimize_pass", dp=dp,
+                       quant=str(config.get("quant", "")),
+                       bucket_bytes=int(config.get("bucket_bytes", 0) or 0)):
+        return _comm_optimize_pass_impl(program, dp, config)
+
+
+def _comm_optimize_pass_impl(program: Program, dp: int,
+                             config: Dict) -> Program:
     block0 = program.global_block()
     bad = sorted({op.type for op in block0.ops
                   if op.type in _BATCH_GLOBAL_OPS})

@@ -396,18 +396,24 @@ class ParallelExecutor(Executor):
             marks = self._scope_cfg = {}
         if marks.get(id(scope), "<unset>") == cfg_key:
             return
-        for b in program.blocks:
-            for v in b.vars.values():
-                if not v.persistable or not scope.has_var(v.name):
-                    continue
-                val = scope.get(v.name)
-                sh = getattr(val, "sharding", None)
-                if sh is None or not getattr(val, "is_fully_addressable",
-                                             True):
-                    continue
-                want = self._state_sharding(program, v.name)
-                if not sh.is_equivalent_to(want, getattr(val, "ndim", 0)):
-                    scope.set_var(v.name, jax.device_put(val, want))
+        from ..observability import tracing as _tracing
+        with _tracing.span("collective", "parallel/reconcile_state_placement",
+                           cfg=str(cfg_key)) as sp:
+            moved = 0
+            for b in program.blocks:
+                for v in b.vars.values():
+                    if not v.persistable or not scope.has_var(v.name):
+                        continue
+                    val = scope.get(v.name)
+                    sh = getattr(val, "sharding", None)
+                    if sh is None or not getattr(val, "is_fully_addressable",
+                                                 True):
+                        continue
+                    want = self._state_sharding(program, v.name)
+                    if not sh.is_equivalent_to(want, getattr(val, "ndim", 0)):
+                        scope.set_var(v.name, jax.device_put(val, want))
+                        moved += 1
+            sp.attrs["moved"] = moved
         marks[id(scope)] = cfg_key
 
     def _build_step_fn(self, program, feed_names, fetch_names, ro, rw,
@@ -746,19 +752,21 @@ class ParallelExecutor(Executor):
         afterwards every state output of the compiled step is already
         global."""
         from ..io import _is_persistable, _select_vars
+        from ..observability import tracing as _tracing
         key = (id(program), program._version, id(scope))
         if key in getattr(self, "_globalized", ()):
             return
-        for v in _select_vars(program, _is_persistable):
-            if not scope.has_var(v.name):
-                continue
-            val = scope.get(v.name)
-            sh = getattr(val, "sharding", None)
-            if sh is not None and not sh.is_fully_addressable:
-                continue  # already a global array
-            target = self._state_sharding(program, v.name)
-            scope.set_var(v.name, jax.device_put(np.asarray(val), target))
-        self._globalized = getattr(self, "_globalized", set()) | {key}
+        with _tracing.span("collective", "parallel/globalize_state"):
+            for v in _select_vars(program, _is_persistable):
+                if not scope.has_var(v.name):
+                    continue
+                val = scope.get(v.name)
+                sh = getattr(val, "sharding", None)
+                if sh is not None and not sh.is_fully_addressable:
+                    continue  # already a global array
+                target = self._state_sharding(program, v.name)
+                scope.set_var(v.name, jax.device_put(np.asarray(val), target))
+            self._globalized = getattr(self, "_globalized", set()) | {key}
 
     # -- run --------------------------------------------------------------
     def run(self,
@@ -807,6 +815,22 @@ class ParallelExecutor(Executor):
                 fetches, self._batch_led_fetches(program, fetch_list),
                 real_b)
         return fetches
+
+    def cost_report(self, program: Optional[Program] = None,
+                    scope: Optional[Scope] = None,
+                    nominal_batch: int = 8) -> Dict:
+        """framework.costs.predict() over the program AS THIS EXECUTOR
+        RUNS IT (after the tp/dp-comm/pipeline rewrites), with the mesh's
+        dp/tp degrees filled in — the prediction side of the r12 cost
+        ledger (observability/ledger.py)."""
+        from ..framework import costs as _costs
+        program = program or self.main_program or default_main_program()
+        scope = scope or self.scope
+        rewritten = self._prepare_program(program, scope)
+        return _costs.predict(rewritten, self.build_strategy,
+                              dp=self._dp,
+                              tp=self.mesh.axis_size(MODEL_AXIS),
+                              nominal_batch=nominal_batch)
 
     @property
     def device_count(self) -> int:

@@ -274,7 +274,20 @@ def build_schedule(name: str, num_microbatches: int,
     microbatches in flight (all forwards first, flush at the end); 1F1B
     caps stage k at min(K - k, M) — after its warmup a stage must retire a
     backward before admitting the next forward, which is exactly the
-    1-forward-1-backward steady state and the bounded activation stash."""
+    1-forward-1-backward steady state and the bounded activation stash.
+
+    Recorded as a "pp_tick" span (schedule/M/K provenance): the tick
+    tables are THE pipeline control artifact, so their construction cost
+    and config land in the trace next to the compile they feed."""
+    from ..observability import tracing as _tracing
+    with _tracing.span("pp_tick", "pipeline/build_schedule",
+                       schedule=str(name), microbatches=int(num_microbatches),
+                       stages=int(num_stages)):
+        return _build_schedule_impl(name, num_microbatches, num_stages)
+
+
+def _build_schedule_impl(name: str, num_microbatches: int,
+                         num_stages: int) -> PipelineSchedule:
     M, K = int(num_microbatches), int(num_stages)
     enforce(name in PIPELINE_SCHEDULES,
             f"unknown pipeline schedule {name!r}; known: "

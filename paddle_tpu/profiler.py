@@ -1,55 +1,50 @@
-"""Profiler: host-event timing + device trace capture.
+"""Profiler: fluid-compatible surface over the observability tracer.
 
 Capability equivalent of the reference profiler stack (reference:
 paddle/fluid/platform/profiler.h:73-121 RecordEvent/EnableProfiler,
 platform/device_tracer.h:49 CUPTI tracer, tools/timeline.py Chrome-trace
 export, python/paddle/fluid/profiler.py context managers).
 
-TPU-first mapping: per-op host interpretation doesn't exist (whole programs
-are XLA-compiled), so host events time the phases that exist here — trace,
-compile, execute, feed/fetch — while *device*-side op-level detail comes from
-jax.profiler's XPlane trace (viewable in TensorBoard / Perfetto), the XLA
-analogue of the CUPTI device tracer. Host events still support user-scoped
-`RecordEvent` annotation and export to Chrome trace format.
+Since r12 the actual recorder is `paddle_tpu.observability.tracing`: one
+ring buffer of typed nested spans shared by the executors, the rewrite
+passes, and the serving engine. This module keeps the fluid-shaped API
+as a thin WINDOW over that ring — `start_profiler` marks a position,
+`stop_profiler` aggregates/export everything recorded since — so the
+pre-r12 contract (RecordEvent records while a profiler context is open,
+even with PTPU_TRACE=0) still holds, and the global-state leakage the
+old module suffered (events and the enabled bit bleeding across test
+suites) is gone: `reset()` restores every module global, and the test
+conftest calls it around each test.
+
+Device-side (XPlane) tracing is unchanged: state 'All' starts a
+jax.profiler trace when a trace dir is configured, RecordEvent names
+ride onto the device timeline as TraceAnnotations, and export merges
+host + device events into one Chrome trace.
 """
 
 from __future__ import annotations
 
 import json
 import os
-import threading
-import time
 from contextlib import contextmanager
-from typing import Dict, List, Optional
+from typing import Optional
 
+from .core import flags
 from .core.enforce import InvalidArgumentError, enforce
+from .observability import tracing as _tracing
 
 _enabled = False
-_events_lock = threading.Lock()
-_completed: List["_Event"] = []
+_forced = False           # we hold one tracing.force_enable(True) ref
 _trace_dir: Optional[str] = None
-
-
-class _Event:
-    __slots__ = ("name", "thread_id", "start", "end")
-
-    def __init__(self, name, thread_id, start, end):
-        self.name = name
-        self.thread_id = thread_id
-        self.start = start
-        self.end = end
-
-    @property
-    def duration_ms(self):
-        return (self.end - self.start) * 1e3
-
-
 _device_tracing = False
+_window_mark = 0          # ring position where the current window began
 
 
-class RecordEvent:
-    """RAII scope annotation (≙ platform::RecordEvent, profiler.h:73).
-    Nesting shows up in the Chrome trace via overlapping ts/dur spans.
+class RecordEvent(_tracing.span):
+    """RAII scope annotation (≙ platform::RecordEvent, profiler.h:73) —
+    a thin alias over the observability span API (kind 'user'). Nesting
+    shows up in the Chrome trace via overlapping ts/dur spans and in the
+    span's parent/depth attribution.
 
     While a device (XPlane) trace is active, the same name is additionally
     entered as a jax.profiler.TraceAnnotation, so it appears ON the device
@@ -58,39 +53,35 @@ class RecordEvent:
     correlation ids (device_tracer.h:49 + tools/timeline.py:45)."""
 
     def __init__(self, name: str):
-        self.name = name
-        self._start = None
-        self._annotation = None
-
-    def __enter__(self):
-        if _enabled:
-            self._start = time.perf_counter()
-            if _device_tracing:
-                import jax
-                self._annotation = jax.profiler.TraceAnnotation(self.name)
-                self._annotation.__enter__()
-        return self
-
-    def __exit__(self, *exc):
-        if self._annotation is not None:
-            self._annotation.__exit__(*exc)
-            self._annotation = None
-        if self._start is not None:
-            ev = _Event(self.name, threading.get_ident(), self._start,
-                        time.perf_counter())
-            self._start = None
-            with _events_lock:
-                _completed.append(ev)
-        return False
+        super().__init__("user", name)
 
 
 record_event = RecordEvent  # snake_case alias used by layers/executor
 
 
 def reset_profiler():
-    """≙ fluid.profiler.reset_profiler — drop all recorded events."""
-    with _events_lock:
-        _completed.clear()
+    """≙ fluid.profiler.reset_profiler — drop all recorded events (the
+    summary/export window restarts here; the tracer ring itself keeps
+    spans for observability consumers)."""
+    global _window_mark
+    _window_mark = _tracing.mark()
+
+
+def reset():
+    """Full state reset for test isolation: disable recording, release
+    the force-enable ref, detach the device-annotation factory, and
+    restart the window. Safe to call at any point, any number of times
+    (tests/conftest.py runs it around every test so neither recorded
+    events nor the enabled bit bleed between suites)."""
+    global _enabled, _forced, _device_tracing, _trace_dir
+    if _forced:
+        _tracing.force_enable(False)
+        _forced = False
+    _enabled = False
+    _device_tracing = False
+    _trace_dir = None
+    _tracing.annotation_factory = None
+    reset_profiler()
 
 
 def start_profiler(state: str = "All", tracer_option: Optional[str] = None):
@@ -101,10 +92,15 @@ def start_profiler(state: str = "All", tracer_option: Optional[str] = None):
     ≙ EnableProfiler (reference profiler.h:116; states CPU/GPU/All map to
     host-only vs host+device here).
     """
-    global _enabled, _trace_dir, _device_tracing
+    global _enabled, _forced, _trace_dir, _device_tracing, _window_mark
     enforce(state in ("CPU", "GPU", "All", "TPU"),
             f"invalid profiler state {state!r}", exc=InvalidArgumentError)
+    if not _enabled:
+        _window_mark = _tracing.mark()
     _enabled = True
+    if not _forced:
+        _tracing.force_enable(True)
+        _forced = True
     if state in ("GPU", "All", "TPU"):
         trace_dir = _trace_dir or os.environ.get("PTPU_TRACE_DIR")
         if trace_dir:
@@ -112,21 +108,26 @@ def start_profiler(state: str = "All", tracer_option: Optional[str] = None):
             try:
                 jax.profiler.start_trace(trace_dir)
                 _device_tracing = True
+                _tracing.annotation_factory = jax.profiler.TraceAnnotation
             except RuntimeError:
                 pass  # already tracing
 
 
 def stop_profiler(sorted_key: Optional[str] = None,
                   profile_path: Optional[str] = None):
-    """Disable recording, print the per-event summary table, optionally dump
-    a Chrome trace JSON to profile_path (≙ DisableProfiler profiler.h:119 +
-    tools/timeline.py)."""
-    global _enabled, _device_tracing
+    """Disable recording, print the per-event summary table, optionally
+    dump a Chrome trace JSON to profile_path (≙ DisableProfiler
+    profiler.h:119 + tools/timeline.py)."""
+    global _enabled, _forced, _device_tracing
     if not _enabled:
         return
     _enabled = False
+    if _forced:
+        _tracing.force_enable(False)
+        _forced = False
     was_device = _device_tracing
     _device_tracing = False
+    _tracing.annotation_factory = None
     import jax
     try:
         jax.profiler.stop_trace()
@@ -140,34 +141,40 @@ def stop_profiler(sorted_key: Optional[str] = None,
     print_profiler_summary(sorted_key or "default")
 
 
+def _window_spans():
+    spans = _tracing.spans_since(_window_mark)
+    # the recorder is a bounded ring (PTPU_TRACE_RING, default 65536);
+    # a window longer than that has lost its oldest events — say so
+    # instead of printing a silently-truncated report (the pre-r12
+    # profiler kept an unbounded list)
+    if len(spans) >= int(flags.get_flag("trace_ring")):
+        print("[profiler] span ring capacity reached: oldest events in "
+              "this window were dropped — raise PTPU_TRACE_RING to keep "
+              "longer windows")
+    return spans
+
+
 def print_profiler_summary(sorted_key: str = "default"):
-    """Aggregate events by name: calls, total/min/max/avg ms (≙ the
-    reference's sorted profiling report, profiler.cc PrintProfiler)."""
+    """Aggregate the window's spans by name: calls, total/min/max/avg ms
+    (≙ the reference's sorted profiling report, profiler.cc
+    PrintProfiler)."""
     enforce(sorted_key in ("default", "calls", "total", "max", "min", "ave"),
             f"invalid sorted_key {sorted_key!r}", exc=InvalidArgumentError)
-    with _events_lock:
-        events = list(_completed)
-    if not events:
+    agg = _tracing.aggregate(_window_spans())
+    if not agg:
         print("[profiler] no events recorded")
         return
-    agg: Dict[str, List[float]] = {}
-    for ev in events:
-        agg.setdefault(ev.name, []).append(ev.duration_ms)
-    rows = []
-    for name, durs in agg.items():
-        rows.append((name, len(durs), sum(durs), max(durs), min(durs),
-                     sum(durs) / len(durs)))
-    key_idx = {"default": 2, "calls": 1, "total": 2, "max": 3, "min": 4,
-               "ave": 5}[sorted_key]
-    rows.sort(key=lambda r: -r[key_idx])
+    key = {"default": "total_ms", "calls": "calls", "total": "total_ms",
+           "max": "max_ms", "min": "min_ms", "ave": "avg_ms"}[sorted_key]
+    rows = sorted(agg.items(), key=lambda kv: -kv[1][key])
     hdr = f"{'Event':<44} {'Calls':>7} {'Total(ms)':>11} {'Max':>9} " \
           f"{'Min':>9} {'Ave':>9}"
     print("-" * len(hdr))
     print(hdr)
     print("-" * len(hdr))
-    for name, calls, tot, mx, mn, ave in rows:
-        print(f"{name[:44]:<44} {calls:>7} {tot:>11.3f} {mx:>9.3f} "
-              f"{mn:>9.3f} {ave:>9.3f}")
+    for name, r in rows:
+        print(f"{name[:44]:<44} {r['calls']:>7} {r['total_ms']:>11.3f} "
+              f"{r['max_ms']:>9.3f} {r['min_ms']:>9.3f} {r['avg_ms']:>9.3f}")
     print("-" * len(hdr))
 
 
@@ -203,18 +210,13 @@ def _collect_device_trace_events(trace_dir: str):
 
 
 def export_chrome_tracing(path: str, device_trace_dir: Optional[str] = None):
-    """Write recorded host events — and, when a device trace dir is given,
-    the jax.profiler device timeline — as ONE Chrome trace (catapult) JSON
-    (≙ tools/timeline.py, which merges host + CUPTI device records)."""
-    with _events_lock:
-        events = list(_completed)
-    trace = {"traceEvents": [], "displayTimeUnit": "ms"}
-    for ev in events:
-        trace["traceEvents"].append({
-            "name": ev.name, "cat": "host", "ph": "X",
-            "ts": ev.start * 1e6, "dur": (ev.end - ev.start) * 1e6,
-            "pid": 0, "tid": ev.thread_id,
-        })
+    """Write the window's host spans — and, when a device trace dir is
+    given, the jax.profiler device timeline — as ONE Chrome trace
+    (catapult) JSON (≙ tools/timeline.py, which merges host + CUPTI
+    device records)."""
+    trace = {"traceEvents": _tracing.chrome_trace_events(_window_spans(),
+                                                         pid=0),
+             "displayTimeUnit": "ms"}
     if device_trace_dir:
         trace["traceEvents"].extend(
             _collect_device_trace_events(device_trace_dir))
@@ -305,10 +307,12 @@ def device_tracer(log_dir: str):
     import jax
     jax.profiler.start_trace(log_dir)
     _device_tracing = True
+    _tracing.annotation_factory = jax.profiler.TraceAnnotation
     try:
         yield
     finally:
         _device_tracing = False
+        _tracing.annotation_factory = None
         jax.profiler.stop_trace()
 
 

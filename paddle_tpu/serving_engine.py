@@ -48,6 +48,8 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from .core.enforce import InvalidArgumentError, enforce
+from .observability import metrics as _obs_metrics
+from .observability import tracing as _tracing
 
 # atomic in CPython: concurrent engine construction must not mint the
 # same cache namespace (aliased slot caches in a shared scope)
@@ -188,6 +190,56 @@ class ContinuousBatchingEngine:
         self.busy_slot_ticks = 0
         self.total_slot_ticks = 0
         self.tokens_out = 0
+        self._started_at = time.time()
+        self._init_metrics()
+
+    def _init_metrics(self):
+        """Per-engine MetricsRegistry (observability/metrics.py) — the
+        serving telemetry EngineServer exposes over HTTP /metrics and the
+        ROADMAP-item-3 load harness scrapes: tokens/s, queue depth, slot
+        occupancy, tick-latency quantiles, KV-cache bytes."""
+        r = self.metrics_registry = _obs_metrics.MetricsRegistry()
+        self._m_tokens = r.counter(
+            "ptpu_engine_tokens_total", "Tokens sampled by the engine.")
+        self._m_ticks = r.counter(
+            "ptpu_engine_ticks_total", "Decode ticks executed.")
+        self._m_completed = r.counter(
+            "ptpu_engine_requests_completed_total", "Completed requests.")
+        r.gauge("ptpu_engine_queue_depth",
+                "Requests waiting for a slot.", fn=lambda: self.n_pending)
+        r.gauge("ptpu_engine_active_slots",
+                "Slots carrying an in-flight request.",
+                fn=lambda: self.n_active)
+        r.gauge("ptpu_engine_slot_occupancy",
+                "Fraction of slot-ticks that carried a request.",
+                fn=self.occupancy)
+        r.gauge("ptpu_engine_kv_cache_bytes",
+                "Bytes held by the slot-indexed KV caches.",
+                fn=self._kv_cache_bytes)
+        r.gauge("ptpu_engine_tokens_per_second",
+                "Tokens sampled per wall second since engine start.",
+                fn=lambda: (self.tokens_out
+                            / max(time.time() - self._started_at, 1e-9)))
+        self._m_tick_latency = r.histogram(
+            "ptpu_engine_tick_latency_seconds",
+            "Wall latency of one decode tick.",
+            buckets=(1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2,
+                     2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0, 2.5))
+        for q, name in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            r.gauge(f"ptpu_engine_tick_latency_{name}_seconds",
+                    f"{name} decode-tick latency (histogram estimate).",
+                    fn=(lambda q=q:
+                        self._m_tick_latency.quantile(q) or 0.0))
+
+    def _kv_cache_bytes(self) -> int:
+        total = 0
+        for name in self.cache_names:
+            if not self.scope.has_var(name):
+                continue
+            v = self.scope.get(name)
+            if hasattr(v, "dtype") and hasattr(v, "shape"):
+                total += int(np.prod(v.shape)) * np.dtype(v.dtype).itemsize
+        return total
 
     def _init_missing_vars(self, Scope):
         """Run the startup program into a throwaway scope and copy ONLY
@@ -223,7 +275,8 @@ class ContinuousBatchingEngine:
 
     # -- scheduler --------------------------------------------------------
     def _admit(self):
-        with self._lock:
+        with _tracing.span("admission", "engine/admit",
+                           pending=len(self._pending)), self._lock:
             if self.policy == "static" and (self._active
                                             or not self._pending):
                 return
@@ -252,21 +305,26 @@ class ContinuousBatchingEngine:
     def step(self) -> List[GenRequest]:
         """One decode tick: admit, run, collect. Returns the requests that
         COMPLETED on this tick. A no-op (returns []) when nothing is
-        active or pending."""
+        active or pending. Each executed tick is recorded as a "tick"
+        span and observed into the tick-latency histogram."""
         self._admit()
         with self._lock:
             active = dict(self._active)
         if not active:
             return []
-        tok, pos = self._tok, self._pos
-        tok[:] = 0
-        pos[:] = 0.0
-        for slot, req in active.items():
-            tok[slot, 0] = req.next_tok
-            pos[slot, 0, 0] = float(req.fed)
-        ids = self._step.run({"tick_tok": tok, "tick_pos": pos})[0]
-        ids = np.asarray(ids)              # realization barrier: the next
-        #                                    tick's feed depends on it
+        t0 = time.perf_counter()
+        with _tracing.span("tick", "engine/tick", active=len(active)):
+            tok, pos = self._tok, self._pos
+            tok[:] = 0
+            pos[:] = 0.0
+            for slot, req in active.items():
+                tok[slot, 0] = req.next_tok
+                pos[slot, 0, 0] = float(req.fed)
+            ids = self._step.run({"tick_tok": tok, "tick_pos": pos})[0]
+            ids = np.asarray(ids)          # realization barrier: the next
+            #                                tick's feed depends on it
+        self._m_tick_latency.observe(time.perf_counter() - t0)
+        self._m_ticks.inc()
         self.n_ticks += 1
         self.busy_slot_ticks += len(active)
         self.total_slot_ticks += self.n_slots
@@ -282,6 +340,7 @@ class ContinuousBatchingEngine:
                 req.first_token_at = time.time()
             req.tokens.append(t)
             self.tokens_out += 1
+            self._m_tokens.inc()
             req.next_tok = t
             hit_eos = (req.eos_id is not None and t == req.eos_id)
             out_of_room = req.fed >= self.max_len
@@ -294,6 +353,7 @@ class ContinuousBatchingEngine:
                     self._slots.free(req.slot)
             for req in finished:
                 req._complete()
+            self._m_completed.inc(len(finished))
         return finished
 
     def run_until_idle(self, max_ticks: Optional[int] = None
@@ -330,6 +390,58 @@ def _decode_tick_builder(n_slots, vocab, max_len, d_model, d_inner,
 
 
 # ---------------------------------------------------------------------------
+# Prometheus /metrics exposition
+# ---------------------------------------------------------------------------
+
+
+class _MetricsHTTPServer:
+    """Minimal threading HTTP listener serving GET /metrics as Prometheus
+    text exposition (0.0.4) from one MetricsRegistry."""
+
+    def __init__(self, addr, registry):
+        import http.server
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server contract)
+                if self.path.split("?", 1)[0] != "/metrics":
+                    self.send_error(404, "only /metrics is served here")
+                    return
+                body = registry.expose().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):   # scrapes must not spam stderr
+                pass
+
+        self._srv = http.server.ThreadingHTTPServer(addr, Handler)
+        self._srv.daemon_threads = True
+        self.server_address = self._srv.server_address
+
+    def serve_forever(self):
+        self._srv.serve_forever(poll_interval=0.1)
+
+    def shutdown(self):
+        self._srv.shutdown()
+
+    def server_close(self):
+        self._srv.server_close()
+
+
+def scrape_metrics(host: str, port: int, timeout: float = 5.0) -> str:
+    """One GET /metrics against an EngineServer's metrics address —
+    what run_ci.sh and the tests use; production scrapers point Prometheus
+    at the same URL."""
+    import urllib.request
+    with urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+# ---------------------------------------------------------------------------
 # generation RPC over the serving.py v2 transport
 # ---------------------------------------------------------------------------
 
@@ -351,7 +463,8 @@ class EngineServer:
     `_sendall_vec`), so socket I/O and the decode tick overlap."""
 
     def __init__(self, engine: ContinuousBatchingEngine,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 metrics_port: Optional[int] = 0):
         import socket as _socket
 
         self.engine = engine
@@ -365,6 +478,18 @@ class EngineServer:
         self._threads: List[threading.Thread] = []
         self._conns: List = []
         self._lock = threading.Lock()
+        # Prometheus exposition: a small HTTP listener serving GET
+        # /metrics from the engine's registry. A SEPARATE socket from the
+        # generation RPC (that one speaks the serving.py frame protocol;
+        # an HTTP GET on it would misparse as a frame header).
+        # metrics_port=None disables; 0 picks an ephemeral port
+        # (self.metrics_address after construction).
+        self._http = None
+        self.metrics_address = None
+        if metrics_port is not None:
+            self._http = _MetricsHTTPServer((host, metrics_port),
+                                            engine.metrics_registry)
+            self.metrics_address = self._http.server_address
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "EngineServer":
@@ -373,11 +498,24 @@ class EngineServer:
         self._threads += [t, a]
         t.start()
         a.start()
+        if self._http is not None:
+            h = threading.Thread(target=self._http.serve_forever,
+                                 daemon=True)
+            self._threads.append(h)
+            h.start()
+            self._http_started = True
         return self
 
     def shutdown(self):
         self._stop.set()
         self._wake.set()
+        if self._http is not None:
+            # socketserver's shutdown() blocks on an event only
+            # serve_forever() ever sets — calling it when start() never
+            # ran would hang forever; just close the listener then
+            if getattr(self, "_http_started", False):
+                self._http.shutdown()
+            self._http.server_close()
         try:
             self._sock.close()
         except OSError:

@@ -27,13 +27,21 @@ import pytest  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def fresh_state():
-    """Each test gets fresh default programs / scope / name generator."""
+    """Each test gets fresh default programs / scope / name generator,
+    and profiler/tracer state never bleeds between tests: the old
+    profiler's module globals (_completed events, the _enabled bit) used
+    to leak across suites — profiler.reset() restores every global and
+    tracing.clear() empties the span ring."""
     import paddle_tpu as pt
     from paddle_tpu.core import unique_name
+    from paddle_tpu.observability import tracing
     pt.reset_default_programs()
     pt.reset_global_scope()
+    pt.profiler.reset()
+    tracing.clear()
     with unique_name.guard():
         yield
+    pt.profiler.reset()
 
 
 @pytest.fixture

@@ -1,7 +1,7 @@
 """Flagship A/B of the mixed-emitter 1x1 conv backward (PROBE_DGRAD #1).
 
 ResNet-50's bottleneck/projection 1x1 convs are ~2/3 of its conv count;
-probe_dgrad4 measured the mixed custom_vjp (dot dgrad + conv wgrad) at
+probe_dgrad.py --exp mixed_1x1 measured the mixed custom_vjp (dot dgrad + conv wgrad) at
 1.52x on the worst-traffic 1x1 unit in isolation. This runs the WHOLE
 train step (bs256) with the lowering flag on / off / on (ABA bounds
 tunnel drift) and reports step time + cost-model traffic for each.

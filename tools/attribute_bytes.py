@@ -1,6 +1,6 @@
 """Per-phase HBM-traffic attribution of the flagship train step.
 
-Parses the optimized HLO (dumped by tools/profile_resnet4.py) and, for every
+Parses the optimized HLO (dumped by tools/profile_resnet.py --exp buffer_census) and, for every
 top-level instruction of the entry computation, charges
 `sum(operand buffer bytes) + output bytes` — the fusion's real HBM traffic —
 to a logical phase derived from its op_name metadata. Aliasing pseudo-ops

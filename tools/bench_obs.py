@@ -1,0 +1,211 @@
+"""BENCH_OBS: the r12 predicted-vs-measured cost-ledger artifact.
+
+Runs mnist (mlp) and transformer_lm through the manual parallel modes on
+the virtual 8-device CPU mesh — dp2 ReduceScatter and dp2 x pp2 (1F1B)
+— and commits one CostLedger joining:
+
+  predicted  framework.costs.predict() over the REWRITTEN program
+  measured   the compiled step's HLO collective census (exact bytes),
+             span aggregates from the observability tracer, step wall
+             time
+  checks     predicted wire bytes == census EXACTLY (r08/r11 balance),
+             pipeline boundary structure (exactly 2 permutes at the
+             predicted buffer size, r09), bubble fraction vs the
+             schedule tables within the r09 2% band, and the tracing
+             overhead budget (<= 3% of step time on, <= 0.5% off).
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python tools/bench_obs.py --out BENCH_OBS_r12.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _build_mnist_mlp(rng, batch):
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    x = layers.data("x", shape=[64])
+    label = layers.data("label", shape=[1], dtype="int64")
+    h = layers.fc(x, size=128, act="relu")
+    h2 = layers.fc(h, size=64, act="relu")
+    loss = layers.mean(layers.softmax_with_cross_entropy(
+        layers.fc(h2, size=10), label))
+    pt.optimizer.MomentumOptimizer(0.1, momentum=0.9).minimize(loss)
+    feed = {"x": rng.rand(batch, 64).astype("float32"),
+            "label": rng.randint(0, 10, (batch, 1)).astype("int64")}
+    return loss, feed
+
+
+def _build_transformer_lm(rng, batch):
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer
+    T = 8
+    loss, _ = transformer.transformer_lm(
+        vocab=64, max_len=T, d_model=32, d_inner=64, num_heads=4,
+        num_layers=2, dropout=0.0, mean_loss=True)
+    pt.optimizer.AdamOptimizer(1e-3).minimize(loss)
+    feed = {"tokens": rng.randint(0, 64, (batch, T)).astype("int64"),
+            "tokens@SEQLEN": np.full((batch,), T, "int32"),
+            "targets": rng.randint(0, 64, (batch, T)).astype("int64")}
+    return loss, feed
+
+
+BUILDERS = {"mnist": _build_mnist_mlp, "transformer_lm":
+            _build_transformer_lm}
+
+
+def _compiled_hlo(exe, feed):
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    cs = list(exe._cache.values())[-1]
+    scope = pt.global_scope()
+    feed_vals = tuple(jnp.asarray(feed[n]) if n in feed else scope.get(n)
+                      for n in cs.feed_names)
+    ro = tuple(scope.get(n) for n in cs.ro_names)
+    rw = tuple(scope.get(n) for n in cs.rw_names)
+    return cs.fn.lower(feed_vals, ro, rw,
+                       np.uint32(0)).compile().as_text()
+
+
+def run_config(led, model, mode, batch, iters):
+    """One ledger row: model x parallel config, predicted + measured +
+    checks."""
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.framework.costs import collective_census
+    from paddle_tpu.observability import tracing
+    from paddle_tpu.parallel import ParallelExecutor
+    from paddle_tpu.parallel.mesh import DeviceMesh
+    from paddle_tpu.parallel.strategy import BuildStrategy, ReduceStrategy
+
+    rng = np.random.RandomState(7)
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    with pt.core.unique_name.guard():
+        loss, feed = BUILDERS[model](rng, batch)
+
+    bst = BuildStrategy()
+    bst.reduce_strategy = ReduceStrategy.ReduceScatter
+    if mode == "dp2":
+        mesh = DeviceMesh(jax.devices()[:2], {"dp": 2})
+        pp = 0
+    elif mode == "dp2xpp2":
+        bst.pipeline_stages = 2
+        bst.num_microbatches = 4
+        bst.pipeline_schedule = "1f1b"
+        mesh = DeviceMesh(jax.devices()[:4], {"dp": 2, "pp": 2})
+        pp = 2
+    else:
+        raise ValueError(mode)
+    pexe = ParallelExecutor(loss_name=loss.name, build_strategy=bst,
+                            mesh=mesh)
+    pt.Executor().run(pt.default_startup_program())
+    pexe.run(feed=feed, fetch_list=[loss])       # compile + first step
+
+    mark = tracing.mark()
+    t0 = time.time()
+    for _ in range(iters):
+        out = pexe.run(feed=feed, fetch_list=[loss], return_numpy=False)
+    jax.block_until_ready(out)
+    step_ms = (time.time() - t0) / iters * 1e3
+    window = tracing.spans_since(mark)
+
+    report = pexe.cost_report(nominal_batch=batch)
+    census = collective_census(_compiled_hlo(pexe, feed))
+
+    row = led.row(f"{model}_{mode}", model=model, mode=mode,
+                  batch_size=batch, reduce_mode="reduce_scatter",
+                  devices=pexe.device_count)
+    row.set_prediction(report)
+    row.set_census(census, 2, min_bytes=8)       # dp degree = 2
+    row.set_spans(tracing.aggregate(window))
+    row.set_measured(step_ms=round(step_ms, 3), iters=iters,
+                     spans_per_step=len(window) / iters)
+    chk = row.check_wire_bytes_exact()
+    print(json.dumps({"row": row.name, "check": chk}), flush=True)
+    assert chk["ok"], chk
+    if pp:
+        b = row.check_pp_boundary()
+        print(json.dumps({"row": row.name, "check": b}), flush=True)
+        assert b["ok"], b
+        pipe = report["pipeline"]
+        bub = row.check_bubble_fraction(pipe["analytic_bubble_fraction"],
+                                        band=0.02)
+        print(json.dumps({"row": row.name, "check": bub}), flush=True)
+        assert bub["ok"], bub
+    return step_ms, len(window) / iters
+
+
+def overhead_census(led, step_ms, spans_per_step):
+    """Tracing overhead budget: measured per-span enter/exit cost x spans
+    per step vs the measured step time, both flag states."""
+    from paddle_tpu.core import flags
+    from paddle_tpu.observability import tracing
+
+    on_cost = tracing.span_overhead_s()
+    flags.set_flag("trace", False)
+    try:
+        off_cost = tracing.span_overhead_s()
+    finally:
+        flags.set_flag("trace", True)
+    frac_on = on_cost * spans_per_step / (step_ms / 1e3)
+    frac_off = off_cost * spans_per_step / (step_ms / 1e3)
+    row = led.row("tracing_overhead", step_ms=round(step_ms, 3),
+                  spans_per_step=spans_per_step)
+    row.set_measured(
+        per_span_us_enabled=round(on_cost * 1e6, 3),
+        per_span_us_disabled=round(off_cost * 1e6, 3),
+        overhead_fraction_enabled=round(frac_on, 6),
+        overhead_fraction_disabled=round(frac_off, 6))
+    c1 = row._check("overhead_enabled", round(frac_on, 6), 0.03,
+                    "<= 3% of step", frac_on <= 0.03)
+    c2 = row._check("overhead_disabled", round(frac_off, 6), 0.005,
+                    "<= 0.5% of step", frac_off <= 0.005)
+    print(json.dumps({"row": "tracing_overhead", "checks": [c1, c2]}),
+          flush=True)
+    assert c1["ok"] and c2["ok"], (c1, c2)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", default=os.path.join(REPO,
+                                                 "BENCH_OBS_r12.json"))
+    p.add_argument("--iters", type=int, default=10)
+    args = p.parse_args()
+
+    import jax
+    from paddle_tpu.observability.ledger import CostLedger
+
+    led = CostLedger("r12", meta={
+        "mesh": "virtual CPU x8 (byte/structure checks are exact "
+                "properties of the compiled HLO and transfer to TPU "
+                "unchanged; ms numbers are CPU-mesh)",
+        "devices": [str(d) for d in jax.devices()[:2]],
+    })
+    worst = (0.0, 0.0)
+    for model in ("mnist", "transformer_lm"):
+        for mode in ("dp2", "dp2xpp2"):
+            step_ms, sps = run_config(led, model, mode,
+                                      batch=16, iters=args.iters)
+            if model == "mnist" and mode == "dp2":
+                # budget vs the FASTEST benched step: the binding case
+                worst = (step_ms, sps)
+    overhead_census(led, *worst)
+    path = led.write(args.out)
+    print(json.dumps({"artifact": path, "ok": led.ok}), flush=True)
+    assert led.ok
+
+
+if __name__ == "__main__":
+    main()

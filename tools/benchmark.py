@@ -529,13 +529,22 @@ def main():
     if trace_events:
         pt.profiler.reset_profiler()
         pt.profiler.start_profiler("All")
+    # the timed window's spans come from the observability tracer — the
+    # same executor/engine instrumentation every run records — instead of
+    # per-tool perf_counter pairs; span_ms below is the per-step breakdown
+    from paddle_tpu.observability import tracing as _tracing
+    bench_mark = _tracing.mark()
     t0 = time.time()
     for i in range(args.iters):
-        with pt.profiler.RecordEvent(f"step_{i}"):
+        with _tracing.span("user", "bench/step", i=i):
             out = runner.run(feed=feed, fetch_list=[loss],
                              return_numpy=False)
     jax.block_until_ready(out)
     dt = time.time() - t0
+    span_agg = _tracing.aggregate(_tracing.spans_since(bench_mark))
+    span_ms = {name: round(row["total_ms"] / args.iters, 3)
+               for name, row in sorted(span_agg.items())
+               if name != "bench/step"}
     if args.profile:
         pt.profiler.stop_profiler(sorted_key="total")
     if trace_events:
@@ -632,6 +641,7 @@ def main():
         "batch_size": args.batch_size,
         "iters": args.iters,
         "latency_ms": round(dt / args.iters * 1000, 3),
+        "span_ms": span_ms,
         "throughput": round(units_per_step * args.iters / dt, 2),
         "unit": unit,
         "device": jax.devices()[0].platform,

@@ -46,7 +46,12 @@ PUBLIC_MODULES = [
     "paddle_tpu.transpiler",
     "paddle_tpu.distributed",
     "paddle_tpu.framework.analysis",
+    "paddle_tpu.framework.costs",
     "paddle_tpu.framework.sharding",
+    "paddle_tpu.observability",
+    "paddle_tpu.observability.tracing",
+    "paddle_tpu.observability.metrics",
+    "paddle_tpu.observability.ledger",
     "paddle_tpu.parallel",
     "paddle_tpu.parallel.collective",
     "paddle_tpu.parallel.grad_comm",

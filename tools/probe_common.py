@@ -1,213 +1,28 @@
 """Shared harness for the step-attribution probes (probe_lstm/probe_nmt).
 
-One place for the build → compile → cost_analysis → best-of-N timing
-boilerplate, so fixes to timing or cost-model handling land once.
+The analytic models that used to live here — the HLO byte parser, the
+collective ring wire model, the per-op flop/byte roofline — were promoted
+to `paddle_tpu/framework/costs.py` (r12): the framework owns ONE copy the
+pipeline partitioner, the cost ledger, and the planner can all query.
+This module re-exports them under their historical names so every probe,
+bench, and census test keeps importing from one place, and keeps the
+measurement-side boilerplate (build -> compile -> cost_analysis ->
+best-of-N timing) that only makes sense in the tools tree.
 """
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, Tuple
+from typing import Callable, Dict
 
 import numpy as np
 
-V5E_PEAK_TFLOPS = 197e12
-V5E_HBM_BPS = 819e9
-
-# dtype byte widths for parsing XLA shape strings — the ONE copy shared by
-# the probes (probe_caps) and the comm-structure tests. Covers every XLA
-# scalar type that can appear in a typed shape (ADVICE r5 #4); an
-# unrecognized typed-shape token RAISES instead of silently counting 0
-# bytes (which would let byte-balance assertions pass/fail misleadingly
-# if dtypes drift).
-HLO_ITEM_BYTES = {"pred": 1,
-                  "s2": 1, "u2": 1, "s4": 1, "u4": 1,     # sub-byte types
-                  "s8": 1, "u8": 1, "s16": 2, "u16": 2,   # pack >= 1 byte
-                  "s32": 4, "u32": 4, "s64": 8, "u64": 8,
-                  "f8e4m3": 1, "f8e4m3fn": 1, "f8e4m3b11fnuz": 1,
-                  "f8e4m3fnuz": 1, "f8e5m2": 1, "f8e5m2fnuz": 1,
-                  "f8e3m4": 1, "f8e8m0fnu": 1,
-                  "bf16": 2, "f16": 2, "f32": 4, "f64": 8,
-                  "c64": 8, "c128": 16}
-
-# typed-shape tokens that are legitimately byte-free
-_HLO_ZERO_BYTE_TYPES = frozenset({"token", "opaque"})
+from paddle_tpu.framework.costs import (  # noqa: F401
+    HLO_ITEM_BYTES, V5E_HBM_BPS, V5E_PEAK_TFLOPS, census_wire_bytes,
+    collective_census, collective_wire_bytes, hlo_shape_bytes,
+    op_cost_flops_bytes, op_time_cost, program_flops_bytes, roofline_fields)
 
 
-def hlo_shape_bytes(sh: str) -> int:
-    """Total bytes of every typed array in one HLO shape string (tuple
-    shapes sum their elements). Raises on a typed-shape token whose
-    element type is not in HLO_ITEM_BYTES."""
-    import re
-    total = 0
-    matched_any = False
-    for m in re.finditer(r"([a-zA-Z][a-zA-Z0-9]*)\[([0-9,]*)\]", sh):
-        matched_any = True
-        dtype = m.group(1)
-        if dtype in _HLO_ZERO_BYTE_TYPES:
-            continue
-        if dtype not in HLO_ITEM_BYTES:
-            raise ValueError(
-                f"hlo_shape_bytes: unrecognized element type {dtype!r} in "
-                f"shape string {sh!r}; add it to HLO_ITEM_BYTES")
-        n = 1
-        for d in m.group(2).split(","):
-            if d:
-                n *= int(d)
-        total += n * HLO_ITEM_BYTES[dtype]
-    if not matched_any and "[" in sh:
-        raise ValueError(
-            f"hlo_shape_bytes: no typed shape recognized in {sh!r} "
-            f"(dynamic dims or unexpected syntax?)")
-    return total
-
-
-def collective_census(hlo: str) -> Dict[str, list]:
-    """{kind: [(output_bytes, line)]} for every collective instruction in a
-    compiled (per-device) HLO module. Async pairs are counted once, at the
-    -start; tuple-shaped outputs (all-to-all emits one operand per peer,
-    with /*index=N*/ comments past 5 elements) sum their elements."""
-    import re
-    out: Dict[str, list] = {}
-    for line in hlo.splitlines():
-        # tuple shapes may nest one paren level INSIDE the tuple: TPU
-        # layouts print as {1,0:T(8,128)} — [^()] alone would stop there
-        # and silently drop the instruction from the census
-        m = re.match(
-            r"\s*(?:ROOT )?%?[\w.\-]+ = "
-            r"(\((?:[^()]|\([^()]*\))*\)|\S+)\s+"
-            r"(all-reduce|reduce-scatter|all-gather|collective-permute|"
-            r"all-to-all)(-start|-done)?\(", line)
-        if not m:
-            continue
-        if m.group(3) == "-done":
-            continue
-        kind = m.group(2)
-        out.setdefault(kind, []).append((hlo_shape_bytes(m.group(1)), line))
-    return out
-
-
-# Per-device bytes each collective puts on the interconnect, as a function
-# of its (per-device) OUTPUT bytes in the partitioned HLO — the standard
-# ring-algorithm accounting, shared by the comm-structure tests and the
-# benchmark's grad_bytes_on_wire field so both quote the same model:
-#   all-reduce out=n:        ring RS+AG, sends 2n(N-1)/N
-#   reduce-scatter out=c:    input N*c, sends c(N-1)
-#   all-gather out=n:        contributes n/N, sends n(N-1)/N
-#   all-to-all out total=t:  keeps its own chunk, sends t(N-1)/N
-#   collective-permute out=n: sends n
-def collective_wire_bytes(kind: str, out_bytes: int, n_devices: int) -> float:
-    n = n_devices
-    return {
-        "all-reduce": 2.0 * out_bytes * (n - 1) / n,
-        "reduce-scatter": float(out_bytes) * (n - 1),
-        "all-gather": float(out_bytes) * (n - 1) / n,
-        "all-to-all": float(out_bytes) * (n - 1) / n,
-        "collective-permute": float(out_bytes),
-    }[kind]
-
-
-def census_wire_bytes(census: Dict[str, list], n_devices: int,
-                      min_bytes: int = 0) -> float:
-    """Total per-device interconnect bytes for one step, from a
-    collective_census; instructions with output below `min_bytes` can be
-    excluded (scalar loss/metric reductions)."""
-    total = 0.0
-    for kind, items in census.items():
-        for b, _ in items:
-            if b >= min_bytes:
-                total += collective_wire_bytes(kind, b, n_devices)
-    return total
-
-
-# ---------------------------------------------------------------------------
-# Analytic per-op cost model — the balancing signal for the pipeline
-# partitioner (framework/passes.py pipeline_partition_pass) and the
-# per-stage compute model of tools/probe_bubble.py. Costs are RELATIVE
-# (batch dims unknown until feed time use `nominal_batch`); the roofline
-# combine max(flops/peak, bytes/bw) uses the same v5e constants as the
-# probes so one number means one thing everywhere.
-# ---------------------------------------------------------------------------
-
-# ops that are pure markers / bookkeeping: zero device cost
-_ZERO_COST_OPS = frozenset({"pp_send", "pp_recv", "feed", "fetch"})
-
-# per-output-element flop weights for transcendental-ish elementwise ops
-_ELEMENTWISE_FLOPS = {"softmax": 5.0, "exp": 4.0, "log": 4.0, "tanh": 6.0,
-                      "sigmoid": 5.0, "relu": 1.0, "sqrt": 4.0, "pow": 4.0,
-                      "elementwise_pow": 4.0, "gelu": 8.0,
-                      "layer_norm": 8.0, "batch_norm": 6.0,
-                      "softmax_with_cross_entropy": 8.0,
-                      "cross_entropy": 4.0, "dropout": 2.0}
-
-
-def _var_numel(block, name, nominal_batch):
-    try:
-        v = block.var(name)
-    except Exception:
-        return 0
-    shape = getattr(v, "shape", None) or ()
-    n = 1
-    for d in shape:
-        n *= (nominal_batch if d == -1 else int(d))
-    return n
-
-
-def _var_shape(block, name, nominal_batch):
-    try:
-        v = block.var(name)
-    except Exception:
-        return None
-    shape = getattr(v, "shape", None)
-    if shape is None:
-        return None
-    return [nominal_batch if d == -1 else int(d) for d in shape]
-
-
-def op_cost_flops_bytes(op, block, nominal_batch: int = 8) -> Tuple[float,
-                                                                    float]:
-    """(flops, bytes) estimate for one program op, from declared var shapes
-    (-1 batch dims resolved to `nominal_batch` — the model only needs to be
-    RELATIVELY right to balance contiguous stages)."""
-    if op.type in _ZERO_COST_OPS:
-        return 0.0, 0.0
-    in_n = sum(_var_numel(block, n, nominal_batch)
-               for n in op.input_names())
-    out_n = sum(_var_numel(block, n, nominal_batch)
-                for n in op.output_names())
-    bytes_ = 4.0 * (in_n + out_n)
-    t = op.type
-    if t in ("mul", "matmul"):
-        xs = _var_shape(block, op.inputs["X"][0], nominal_batch)
-        k = 1.0
-        if xs:
-            k = float(xs[-2] if op.attrs.get("transpose_X") and len(xs) >= 2
-                      else xs[-1])
-        return 2.0 * out_n * k, bytes_
-    if t in ("conv2d", "conv3d", "conv2d_transpose", "conv3d_transpose",
-             "depthwise_conv2d"):
-        # filter is [num_filters, cin/groups, k...] in both layouts, so
-        # per-output-element work = 2 * numel(filter) / num_filters
-        fn = _var_numel(block, op.inputs["Filter"][0], nominal_batch)
-        fs = _var_shape(block, op.inputs["Filter"][0], nominal_batch)
-        nf = float(fs[0]) if fs else 1.0
-        return 2.0 * out_n * (fn / max(nf, 1.0)), bytes_
-    if t in ("dynamic_lstm", "fused_lstm", "dynamic_gru", "fused_gru"):
-        wn = sum(_var_numel(block, n, nominal_batch)
-                 for slot in ("Weight", "WeightX", "WeightH")
-                 for n in op.inputs.get(slot, []))
-        return 2.0 * max(out_n, in_n) * max(wn, 1) ** 0.5, bytes_
-    if t == "lookup_table":
-        return float(out_n), bytes_
-    return _ELEMENTWISE_FLOPS.get(t, 1.0) * out_n, bytes_
-
-
-def op_time_cost(flops: float, bytes_: float) -> float:
-    """Roofline combine of one op's (flops, bytes): seconds on the v5e
-    peak — whichever engine bounds it."""
-    return max(flops / V5E_PEAK_TFLOPS, bytes_ / V5E_HBM_BPS)
-
-
-def measure_step(build: Callable[[], Tuple], make_feed: Callable[[], Dict],
+def measure_step(build: Callable, make_feed: Callable[[], Dict],
                  iters: int = 15, windows: int = 3, hlo_path: str = None):
     """build() -> (loss_var, optimizer); make_feed() -> feed dict.
 
@@ -257,20 +72,3 @@ def measure_step(build: Callable[[], Tuple], make_feed: Callable[[], Dict],
         dt = (time.time() - t0) / iters
         best = dt if best is None else min(best, dt)
     return {"step_s": best, "flops": flops, "bytes_acc": bytes_acc}
-
-
-def roofline_fields(step_s: float, flops: float, bytes_acc: float) -> Dict:
-    """The shared attribution fields; None where the cost model gave 0."""
-    out = {
-        "step_ms": round(step_s * 1e3, 2),
-        "bytes_GB": round(bytes_acc / 1e9, 2) if bytes_acc else None,
-        "flops_G": round(flops / 1e9, 1) if flops else None,
-        "intensity_flops_per_byte":
-            round(flops / bytes_acc, 1) if flops and bytes_acc else None,
-        "ideal_mxu_ms":
-            round(flops / V5E_PEAK_TFLOPS * 1e3, 3) if flops else None,
-        "ideal_hbm_ms":
-            round(bytes_acc / V5E_HBM_BPS * 1e3, 3) if bytes_acc else None,
-        "mfu": round(flops / step_s / V5E_PEAK_TFLOPS, 4) if flops else None,
-    }
-    return out

@@ -221,6 +221,76 @@ for sched in ("gpipe", "1f1b"):
 print("pipeline smoke OK")
 PY
 
+echo "== observability smoke (spans + ledger + /metrics) =="
+# the r12 layer end to end: a traced 3-step mnist run must record the
+# executor's compile/step/feed_fetch spans, the cost ledger's predicted
+# wire bytes must equal the HLO census EXACTLY on a dp2 reduce-scatter
+# step, and one Prometheus scrape of a live EngineServer must carry the
+# serving telemetry (docs/observability.md).
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+python - <<'PY'
+import numpy as np, jax
+import jax.numpy as jnp
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework.costs import collective_census
+from paddle_tpu.observability import tracing
+from paddle_tpu.observability.ledger import CostLedger
+from paddle_tpu.parallel import ParallelExecutor
+from paddle_tpu.parallel.strategy import BuildStrategy, ReduceStrategy
+from paddle_tpu.parallel.mesh import DeviceMesh
+
+pt.reset_default_programs(); pt.reset_global_scope()
+with pt.core.unique_name.guard():
+    x = layers.data("x", shape=[64])
+    label = layers.data("label", shape=[1], dtype="int64")
+    h = layers.fc(x, size=128, act="relu")
+    loss = layers.mean(layers.softmax_with_cross_entropy(
+        layers.fc(h, size=10), label))
+    pt.optimizer.MomentumOptimizer(0.1, momentum=0.9).minimize(loss)
+bst = BuildStrategy(); bst.reduce_strategy = ReduceStrategy.ReduceScatter
+mesh = DeviceMesh(jax.devices()[:2], {"dp": 2})
+exe = ParallelExecutor(loss_name=loss.name, build_strategy=bst, mesh=mesh)
+pt.Executor().run(pt.default_startup_program())
+rng = np.random.RandomState(0)
+feed = {"x": rng.rand(16, 64).astype("float32"),
+        "label": rng.randint(0, 10, (16, 1)).astype("int64")}
+mark = tracing.mark()
+for _ in range(3):                                   # traced 3-step run
+    exe.run(feed=feed, fetch_list=[loss])
+kinds = {(s.kind, s.name) for s in tracing.spans_since(mark)}
+assert ("step", "executor/run") in kinds, kinds
+assert ("feed_fetch", "executor/feed") in kinds, kinds
+
+cs = list(exe._cache.values())[-1]
+scope = pt.global_scope()
+hlo = cs.fn.lower(tuple(jnp.asarray(feed[n]) for n in cs.feed_names),
+                  tuple(scope.get(n) for n in cs.ro_names),
+                  tuple(scope.get(n) for n in cs.rw_names),
+                  np.uint32(0)).compile().as_text()
+row = CostLedger("ci").row("mnist_dp2_rs")
+row.set_prediction(exe.cost_report(nominal_batch=16))
+row.set_census(collective_census(hlo), 2, min_bytes=8)
+chk = row.check_wire_bytes_exact()
+assert chk["ok"], chk                     # predicted == census, exactly
+
+from paddle_tpu.serving_engine import (ContinuousBatchingEngine,
+                                       EngineClient, EngineServer,
+                                       scrape_metrics)
+eng = ContinuousBatchingEngine(n_slots=2, vocab=100, max_len=16,
+                               d_model=32, d_inner=64, num_heads=4,
+                               num_layers=2)
+with EngineServer(eng) as srv:
+    host, port = srv.address
+    with EngineClient(host, port) as c:
+        c.send_gen([3], max_new=2)
+        c.recv_done()
+    text = scrape_metrics(*srv.metrics_address)
+assert "ptpu_engine_tokens_total 2" in text, text[:400]
+assert "ptpu_engine_tick_latency_seconds_count" in text
+print("observability smoke OK")
+PY
+
 echo "== serving-engine smoke =="
 # continuous-batching engine end to end: submit through the RPC server,
 # decode over the slot cache, check a mid-batch join completes (fast:

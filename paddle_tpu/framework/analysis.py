@@ -23,6 +23,12 @@ checks as the precondition for safe graph rewriting):
    between the backward region and every gradient consumer, dp divisibility
    of sharded gradients.
 
+2b. **Dataflow detectors** (framework/dataflow.py, run inside
+   `verify_program`): SPMD collective-consistency/deadlock checks, GSPMD-
+   style replica-divergence taint propagation, and buffer-reuse/WAR race
+   checks over the variable interference graph. Pure Python over the IR —
+   the sanitizer gets them on every pass apply.
+
 3. **Pass sanitizer** (`sanitized_apply`, wired into `Pass.__call__`): every
    pass apply runs verify-before/verify-after, attributing any NEW violation
    to the offending pass by name. Always on; kill switch
@@ -41,7 +47,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..core import flags
-from ..core.enforce import EnforceError, NotFoundError
+from ..core.enforce import EnforceError, NotFoundError, enforce
 from .program import Block, Operator, Program
 
 __all__ = [
@@ -808,13 +814,18 @@ def _check_dp_comm_invariants(program, diags):
 def verify_program(program: Program,
                    extra_feeds: Sequence[str] = ()) -> List[Diagnostic]:
     """Layer-2 structural + parallel consistency verification. Returns the
-    full diagnostic list (empty = clean); never raises."""
+    full diagnostic list (empty = clean); never raises. The dataflow
+    detectors (framework/dataflow.py: collective consistency/deadlock,
+    replica divergence, buffer-reuse races) run here too — pure Python
+    over the IR, so every sanitized pass apply gets them for free."""
     diags: List[Diagnostic] = []
     _check_def_before_use(program, extra_feeds, diags)
     _check_duplicate_writers(program, diags)
     _check_attr_schemas(program, diags)
     _check_pipeline_invariants(program, diags)
     _check_dp_comm_invariants(program, diags)
+    from . import dataflow as _dataflow     # lazy: dataflow imports us
+    diags += _dataflow.dataflow_checks(program)
     return diags
 
 
@@ -896,17 +907,35 @@ def sanitized_apply(pass_obj, program: Program, scope=None):
 
 
 def peak_live_bytes(program: Program, nominal_batch: int = 8) -> Dict:
-    """Static peak-live-bytes estimate of block 0 from variable lifetimes:
-    a transient var is live from its first writer to its last reader
-    (inclusive); feeds/persistables are live for the whole program. -1 dims
-    count as `nominal_batch` rows. An *estimate* — XLA's buffer assignment
-    reuses and fuses further — but it ranks programs and partitionings the
-    same way (the lifetime census discipline of
-    transpiler/memory_optimization.py)."""
-    block = program.global_block()
-    n = len(block.ops)
+    """Static peak-live-bytes estimate from variable lifetimes: a transient
+    var is live from its first writer to its last reader (inclusive);
+    feeds/persistables are live for the whole program. -1 dims count as
+    `nominal_batch` rows. An *estimate* — XLA's buffer assignment reuses
+    and fuses further — but it ranks programs and partitionings the same
+    way (the lifetime census discipline of
+    transpiler/memory_optimization.py).
 
-    def nbytes(name):
+    The walk covers the WHOLE program, not just block 0's op list:
+
+    - backward regions (`vjp_region`/`pp_pipeline_region`) keep every
+      value their forward segment touches live until the region executes
+      (the backward re-runs the segment under jax.vjp, so activations are
+      backward inputs — dataflow.var_lifetimes owns this rule). The pp
+      region's *schedule-dependent* stash (≤K in-flight microbatches under
+      1F1B, =M under GPipe) is NOT modeled here — parallel/pipeline.py's
+      stash census owns that number;
+    - sub-blocks (while/cond_block/static_rnn/switch_case bodies) are
+      walked recursively: a sub-block's own transient peak is attributed
+      at its binder op's index in the parent — live for exactly the ops
+      that execute it.
+
+    Returns the block-0 keys of the r10 shape plus `sub_block_peaks`
+    ({block idx: transient bytes} for every bound sub-block)."""
+    from . import dataflow as _dataflow
+
+    def nbytes(block, name):
+        # only vars DECLARED in this block: parent vars are the parent
+        # sweep's to count (persistables/feeds are block 0's)
         v = block.vars.get(name)
         if v is None or v.shape is None:
             return 0
@@ -915,49 +944,67 @@ def peak_live_bytes(program: Program, nominal_batch: int = 8) -> Dict:
             numel *= d
         return numel * np.dtype(v.dtype).itemsize
 
+    block0 = program.global_block()
     persistent, feed = 0, 0
-    always = set()
-    for name, v in block.vars.items():
+    for name, v in block0.vars.items():
         if v.persistable:
-            persistent += nbytes(name)
-            always.add(name)
+            persistent += nbytes(block0, name)
         elif v.is_data:
-            feed += nbytes(name)
-            always.add(name)
+            feed += nbytes(block0, name)
 
-    first_w: Dict[str, int] = {}
-    last_r: Dict[str, int] = {}
-    for idx, op in enumerate(block.ops):
-        for name in op.output_names():
-            first_w.setdefault(name, idx)
-            last_r[name] = max(last_r.get(name, idx), idx)
-        for name in op.input_names():
-            last_r[name] = idx
+    # binder op -> sub-block indices (while/cond_block/... attrs)
+    def sub_idxs(op):
+        out = []
+        for key in _SUB_KEYS:
+            v = op.attrs.get(key)
+            if isinstance(v, int) and not isinstance(v, bool):
+                out.append(v)
+            elif isinstance(v, (list, tuple)):
+                out.extend(x for x in v if isinstance(x, int))
+        return [i for i in out if 0 < i < len(program.blocks)]
 
-    # single event sweep: +size at the first writer, -size after the last
-    # reader (sizes precomputed once per var)
-    alloc: Dict[int, int] = {}
-    free: Dict[int, int] = {}
-    for name, w in first_w.items():
-        if name in always:
-            continue
-        size = nbytes(name)
-        if not size:
-            continue
-        alloc[w] = alloc.get(w, 0) + size
-        end = last_r.get(name, w)
-        free[end + 1] = free.get(end + 1, 0) + size
+    sub_peaks: Dict[int, int] = {}
 
-    peak, peak_at, live = 0, None, 0
-    for t in range(n):
-        live += alloc.get(t, 0) - free.get(t, 0)
-        if live > peak:
-            peak, peak_at = live, t
-    loc = (op_loc(block, peak_at, block.ops[peak_at])
+    def block_peak(bidx, chain=()):
+        enforce(bidx not in chain,
+                f"peak_live_bytes: sub-block {bidx} binds itself "
+                f"(binder chain {chain}) — the lifetime walk cannot "
+                f"terminate on a cyclic block graph",
+                exc=EnforceError)
+        block = program.blocks[bidx]
+        n = len(block.ops)
+        lifetimes = _dataflow.var_lifetimes(block)
+        alloc: Dict[int, int] = {}
+        free: Dict[int, int] = {}
+        for name, (w, end) in lifetimes.items():
+            v = block.vars.get(name)
+            if v is not None and (v.persistable or v.is_data):
+                continue
+            size = nbytes(block, name)
+            if not size:
+                continue
+            alloc[w] = alloc.get(w, 0) + size
+            free[end + 1] = free.get(end + 1, 0) + size
+        for idx, op in enumerate(block.ops):
+            for si in sub_idxs(op):
+                sp = block_peak(si, chain + (bidx,))
+                sub_peaks[si] = sp
+                alloc[idx] = alloc.get(idx, 0) + sp
+                free[idx + 1] = free.get(idx + 1, 0) + sp
+        peak, peak_at, live = 0, None, 0
+        for t in range(n):
+            live += alloc.get(t, 0) - free.get(t, 0)
+            if live > peak:
+                peak, peak_at = live, t
+        return (peak, peak_at) if bidx == 0 else peak
+
+    peak, peak_at = block_peak(0)
+    loc = (op_loc(block0, peak_at, block0.ops[peak_at])
            if peak_at is not None else None)
     return {"persistent_bytes": persistent,
             "feed_bytes": feed,
             "peak_transient_bytes": peak,
             "peak_total_bytes": persistent + feed + peak,
             "peak_at": loc,
+            "sub_block_peaks": dict(sorted(sub_peaks.items())),
             "nominal_batch": nominal_batch}

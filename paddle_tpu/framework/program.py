@@ -312,7 +312,7 @@ class Program:
                 for extra in ("sharding_spec", "is_optimizer_state",
                               "optimize_attr", "staging", "accumulator_of",
                               "dp_shard_update", "dp_replica_state",
-                              "tp_spec"):
+                              "tp_spec", "buffer_slot"):
                     if hasattr(v, extra):
                         setattr(nv, extra, getattr(v, extra))
                 nb.vars[name] = nv

@@ -124,6 +124,44 @@ def lookup_shard_rule(op_type: str):
     return _SHARD_RULES.get(op_type)
 
 
+# An effect rule refines the dataflow effect set of one op
+# (framework/dataflow.py): (op) -> dict with any of the keys
+#   collective_axes: tuple of mesh axis names the op communicates over
+#                    (a collective both orders execution across shards of
+#                    those axes AND makes its outputs axis-consistent),
+#   rng:             True when the op draws per-step randomness (per-shard
+#                    decorrelated seeds on the dp axis),
+#   inplace:         ((in_name, out_name), ...) aliased buffer pairs beyond
+#                    the same-name read+write default.
+# reads/writes always derive from op.inputs/op.outputs; rules only ADD the
+# semantics the slot lists cannot express. Registered in a side table like
+# _SHARD_RULES so parallel modules can declare effects without forcing the
+# op module import graph.
+_EFFECT_RULES: Dict[str, Any] = {}
+
+
+def register_effects(op_type: str):
+    """Decorator registering the dataflow effect rule for `op_type` (lives
+    alongside register_infer_spec/register_shard_spec: same per-op
+    contract, one layer up — what the op DOES to buffers and mesh axes
+    instead of what shapes/shardings it emits)."""
+
+    def deco(fn):
+        if op_type in _EFFECT_RULES:
+            raise AlreadyExistsError(
+                f"op {op_type!r} already has an effect rule")
+        _EFFECT_RULES[op_type] = fn
+        return fn
+
+    return deco
+
+
+def lookup_effect_rule(op_type: str):
+    """The registered effect rule for `op_type`, or None (pure compute:
+    reads its inputs, writes its outputs, no collectives, no rng)."""
+    return _EFFECT_RULES.get(op_type)
+
+
 def lookup_op(op_type: str) -> OpDef:
     op = _OPS.get(op_type)
     if op is None:

@@ -314,6 +314,15 @@ def propagate_sharding(program: Program, tp_size: Optional[int] = None,
                     env[grad_var_name(loss)] = ls
             continue
 
+        if op.type in ("pp_send", "pp_recv"):
+            # pipeline boundary ops move values between pp shards and
+            # re-bind the crossing names on the consuming stage; the names
+            # keep their producers' specs (the pp axis is orthogonal to
+            # the tp component being propagated — letting the default
+            # replicated rule overwrite them manufactures conflicts on
+            # pipelined tp-annotated programs)
+            continue
+
         in_specs: Dict[str, List[Optional[tuple]]] = {}
         any_tp = False
         for slot, names in op.inputs.items():

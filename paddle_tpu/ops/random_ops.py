@@ -12,7 +12,19 @@ import jax
 import jax.numpy as jnp
 
 from ..core.dtypes import convert_dtype
-from ..framework.registry import register_op
+from ..framework.registry import register_effects, register_op
+
+
+def _rng_effect(op):
+    """Dataflow effect rule (framework/dataflow.py): the op draws from the
+    per-step PRNG — whose key the manual-mode executor decorrelates across
+    dp shards — UNLESS a fixed `seed` attr pins the stream (then every
+    shard draws the identical value and nothing diverges)."""
+    return {"rng": not op.attrs.get("seed")}
+
+
+def _register_rng(op_type, rule=_rng_effect):
+    register_effects(op_type)(rule)
 
 
 @register_op("uniform_random", stop_gradient=True)
@@ -131,3 +143,16 @@ def _gaussian_random_bsl(ctx, ins, attrs):
     out = attrs.get("mean", 0.0) + attrs.get("std", 1.0) * \
         jax.random.normal(key, shape, dtype=jnp.float32)
     return {"Out": [out.astype(dtype)]}
+
+
+for _t in ("uniform_random", "gaussian_random",
+           "truncated_gaussian_random", "sampling_id", "random_crop",
+           "uniform_random_batch_size_like",
+           "gaussian_random_batch_size_like"):
+    _register_rng(_t)
+
+# dropout's inference path is deterministic (mask of ones / (1-p) scale):
+# only the training path draws
+_register_rng("dropout",
+              lambda op: {"rng": not op.attrs.get("seed")
+                          and not op.attrs.get("is_test")})

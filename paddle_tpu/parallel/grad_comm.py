@@ -659,3 +659,32 @@ def _infer_dp_grad_comm(ictx, in_shapes, in_dtypes, attrs):
     errs = [(tuple(s), d) for s, d in zip(in_shapes.get("ErrIn", ()),
                                           in_dtypes.get("ErrIn", ()))]
     return {"Out": outs, "ErrOut": errs}
+
+
+# ---------------------------------------------------------------------------
+# dataflow effect sets (framework/dataflow.py): the dp gradient pipeline's
+# axis contract, for the collective-deadlock and replica-divergence
+# detectors. dp_grad_comm's per-output consistency (bucket outputs dp-
+# consistent, sharded outputs deliberate dp shards) is a custom transfer
+# in dataflow.divergence_taints — kinds are per-entry, not per-op.
+# ---------------------------------------------------------------------------
+
+from ..framework.registry import register_effects  # noqa: E402
+
+
+@register_effects("dp_grad_comm")
+def _eff_dp_grad_comm(op):
+    return {"collective_axes": (op.attrs.get("axis"),)}
+
+
+@register_effects("dp_shard_slice")
+def _eff_dp_shard_slice(op):
+    # no wire traffic, but the output is this shard's slice — deliberately
+    # dp-varying (the ZeRO-1 local update's input)
+    return {"shards_axes": (op.attrs.get("axis"),)}
+
+
+@register_effects("dp_shard_all_gather")
+def _eff_dp_shard_all_gather(op):
+    a = op.attrs.get("axis")
+    return {"collective_axes": (a,), "resolves_axes": (a,)}

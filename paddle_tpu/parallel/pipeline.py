@@ -409,6 +409,33 @@ def _infer_pp_recv(ictx, in_shapes, in_dtypes, attrs):
     return {"Out": outs}
 
 
+# dataflow effect sets (framework/dataflow.py): the boundary ops move a
+# value between pp shards (one ppermute each per tick) — a transfer, not a
+# reduction, so they neither resolve nor shard any axis's consistency; the
+# region op runs the whole schedule's collectives over pp (plus the dp
+# grad pmean when it owns the dp reduction, i.e. reduce_dp).
+
+from ..framework.registry import register_effects  # noqa: E402
+
+
+@register_effects("pp_send")
+def _eff_pp_send(op):
+    return {"collective_axes": (PIPELINE_AXIS,)}
+
+
+@register_effects("pp_recv")
+def _eff_pp_recv(op):
+    return {"collective_axes": (PIPELINE_AXIS,)}
+
+
+@register_effects(PP_REGION_TYPE)
+def _eff_pp_region(op):
+    axes = [op.attrs.get("axis") or PIPELINE_AXIS]
+    if op.attrs.get("reduce_dp") and op.attrs.get("dp_axis"):
+        axes.append(op.attrs["dp_axis"])
+    return {"collective_axes": tuple(axes)}
+
+
 # ---------------------------------------------------------------------------
 # the engine
 # ---------------------------------------------------------------------------

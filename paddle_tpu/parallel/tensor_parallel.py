@@ -96,8 +96,8 @@ def sequence_shard(x, batch_axis_spec="dp"):
 # ===========================================================================
 
 from ..core.enforce import InvalidArgumentError, enforce  # noqa: E402
-from ..framework.registry import (register_infer_spec, register_op,  # noqa: E402
-                                  register_shard_spec)
+from ..framework.registry import (register_effects, register_infer_spec,  # noqa: E402
+                                  register_op, register_shard_spec)
 
 # The executor's shard_map wrapper publishes the traced tp shard index here
 # (same mechanism and rationale as grad_comm._CURRENT_DP_INDEX: a
@@ -321,3 +321,43 @@ def _shardrule_tp_vocab_lookup(sctx, in_specs, attrs):
         rank -= 1
     ws = in_specs["W"][0]
     return {"Out": [(None,) * (rank + (len(ws) - 1 if ws else 1))]}
+
+
+# -- dataflow effect sets (framework/dataflow.py): which mesh axis each op
+# communicates over and what its output's consistency over that axis is.
+# The backward halves count too — tp_ident/tp_split's collectives live in
+# their custom VJPs, but a shard that skips the op skips those psums/
+# gathers just the same, so deadlock analysis treats them as collectives.
+
+
+@register_effects("tp_allreduce")
+def _eff_tp_allreduce(op):
+    a = op.attrs.get("axis")
+    # fwd psum: every shard's partial goes in, the identical sum comes out
+    return {"collective_axes": (a,), "resolves_axes": (a,)}
+
+
+@register_effects("tp_ident")
+def _eff_tp_ident(op):
+    # fwd identity (taints ride through); bwd psums the cotangent
+    return {"collective_axes": (op.attrs.get("axis"),)}
+
+
+@register_effects("tp_split")
+def _eff_tp_split(op):
+    a = op.attrs.get("axis")
+    # fwd local slice: the output deliberately VARIES per shard
+    return {"collective_axes": (a,), "shards_axes": (a,)}
+
+
+@register_effects("tp_allgather")
+def _eff_tp_allgather(op):
+    a = op.attrs.get("axis")
+    return {"collective_axes": (a,), "resolves_axes": (a,)}
+
+
+@register_effects("tp_vocab_lookup")
+def _eff_tp_vocab_lookup(op):
+    a = op.attrs.get("axis")
+    # masked local lookup + psum: replicated result from a sharded table
+    return {"collective_axes": (a,), "resolves_axes": (a,)}

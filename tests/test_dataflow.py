@@ -1,0 +1,663 @@
+"""Dataflow analysis layer tests (framework/dataflow.py).
+
+Four areas, mirroring the subsystem:
+1. effect sets — slot-derived defaults, registered collective/rng/in-place
+   rules, the registration API contract;
+2. def-use chains, lifetimes (backward-region extension), interference;
+3. the three whole-program detectors — one mutation test per diagnostic
+   code, each a seeded-bad program that ONLY that detector catches (the
+   assert pins the exact code set), on single-axis AND composed
+   dp2 x pp2 x tp2 programs;
+4. the satellites riding this layer: whole-program peak_live_bytes
+   (sub-blocks + regions) and the lint CLI's --json/exit-code contract.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, models
+from paddle_tpu.core.enforce import AlreadyExistsError, EnforceError
+from paddle_tpu.framework import analysis, dataflow
+from paddle_tpu.framework import sharding as _sharding  # registers tp_shard_pass
+from paddle_tpu.framework.passes import get_pass
+from paddle_tpu.framework.program import Operator
+from paddle_tpu.framework.registry import register_effects, register_op
+from paddle_tpu.parallel import annotate_tp
+from paddle_tpu.parallel.grad_comm import comm_optimize_pass
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_DP_CFG = {"shard_update": True, "quant": "", "block": 512,
+           "error_feedback": False, "bucket_bytes": 1 << 20}
+
+
+def _errors(diags):
+    return [d for d in diags if d.severity == "error"]
+
+
+def _codes(diags):
+    return {d.code for d in _errors(diags)}
+
+
+def _mlp_program():
+    x = layers.data("x", shape=[16])
+    label = layers.data("label", shape=[1], dtype="int64")
+    h = layers.fc(x, size=32, act="relu")
+    logits = layers.fc(h, size=10)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return pt.default_main_program(), h, logits, loss
+
+
+def _dp_program(dp=4):
+    prog, *_ = _mlp_program()
+    return comm_optimize_pass(prog, dp, dict(_DP_CFG))
+
+
+def _tp_spliced_program():
+    loss, _ = models.transformer.transformer_lm(
+        vocab=64, max_len=8, d_model=32, d_inner=64, num_heads=4,
+        num_layers=2, mean_loss=True, dropout=0.1)
+    pt.optimizer.MomentumOptimizer(0.1, momentum=0.9).minimize(loss)
+    annotate_tp()
+    return get_pass("tp_shard_pass", tp=2)(pt.default_main_program())
+
+
+def _composed_program():
+    """tp2 -> dp2 -> pp2/1F1B, the ParallelExecutor._prepare_program
+    order — the full 3D-mesh program the composed mutation tests seed."""
+    tp = _tp_spliced_program()
+    dp = comm_optimize_pass(tp, 2, dict(_DP_CFG))
+    return get_pass("pipeline_partition_pass", num_stages=2,
+                    num_microbatches=4, schedule="1f1b", dp_axis="dp",
+                    reduce_dp=False)(dp)
+
+
+# ---------------------------------------------------------------------------
+# effect sets
+# ---------------------------------------------------------------------------
+
+
+def test_default_effects_pure_compute():
+    prog, h, logits, loss = _mlp_program()
+    block = prog.global_block()
+    op = next(op for op in block.ops if op.type == "relu")
+    eff = dataflow.op_effects(op)
+    assert eff.reads and eff.writes
+    assert not eff.collective_axes and not eff.resolves_axes \
+        and not eff.shards_axes and not eff.rng and not eff.inplace
+
+
+def test_same_name_in_place_update_is_an_inplace_effect():
+    ctr = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+    layers.increment(ctr, value=1.0, in_place=True)
+    block = pt.default_main_program().global_block()
+    op = next(op for op in block.ops if op.type == "increment")
+    assert (ctr.name, ctr.name) in dataflow.op_effects(op).inplace
+
+
+def test_rng_effects_respect_seed_and_is_test():
+    prog = pt.Program()
+    blk = prog.global_block()
+    blk.create_var(name="r", shape=[4], dtype="float32")
+    op = blk.append_op("uniform_random", outputs={"Out": ["r"]},
+                       attrs={"shape": [4]})
+    assert dataflow.op_effects(op).rng
+    op.attrs["seed"] = 7             # pinned stream: identical on every shard
+    assert not dataflow.op_effects(op).rng
+    blk.create_var(name="d", shape=[4], dtype="float32")
+    blk.create_var(name="m", shape=[4], dtype="float32")
+    dop = blk.append_op("dropout", inputs={"X": ["r"]},
+                        outputs={"Out": ["d"], "Mask": ["m"]},
+                        attrs={"dropout_prob": 0.5})
+    assert dataflow.op_effects(dop).rng
+    dop.attrs["is_test"] = True      # inference path is deterministic
+    assert not dataflow.op_effects(dop).rng
+
+
+def test_collective_effects_of_the_parallel_ops():
+    tp = _tp_spliced_program()
+    block = tp.global_block()
+    ar = next(op for op in block.ops if op.type == "tp_allreduce")
+    eff = dataflow.op_effects(ar)
+    assert eff.collective_axes == ("tp",) and eff.resolves_axes == ("tp",)
+    sp = next(op for op in block.ops if op.type == "tp_split")
+    eff = dataflow.op_effects(sp)
+    assert eff.collective_axes == ("tp",) and eff.shards_axes == ("tp",)
+
+    dp = _dp_program()
+    block = dp.global_block()
+    comm = next(op for op in block.ops if op.type == "dp_grad_comm")
+    assert dataflow.op_effects(comm).collective_axes == ("dp",)
+    sl = next(op for op in block.ops if op.type == "dp_shard_slice")
+    assert dataflow.op_effects(sl).shards_axes == ("dp",)
+    ag = next(op for op in block.ops if op.type == "dp_shard_all_gather")
+    assert dataflow.op_effects(ag).resolves_axes == ("dp",)
+
+
+def test_effect_registration_is_once_only():
+    register_effects("_tdf_effect_dup_probe")(lambda op: {})
+    with pytest.raises(AlreadyExistsError):
+        register_effects("_tdf_effect_dup_probe")(lambda op: {})
+
+
+def test_axis_and_suffix_literals_stay_in_sync():
+    """framework/dataflow.py duplicates the mesh-axis names and the ZeRO
+    shard suffix as literals (framework/ must not import parallel/) —
+    this is the pin that keeps them honest."""
+    from paddle_tpu.parallel import grad_comm, mesh
+    assert dataflow.DP_AXIS == mesh.DATA_AXIS
+    assert dataflow.TP_AXIS == mesh.MODEL_AXIS
+    assert dataflow.PP_AXIS == mesh.PIPELINE_AXIS
+    assert dataflow._DP_SHARD_SUFFIX == grad_comm.SHARD_SUFFIX
+
+
+# ---------------------------------------------------------------------------
+# def-use, lifetimes, interference
+# ---------------------------------------------------------------------------
+
+
+def test_def_use_chains():
+    prog, h, logits, loss = _mlp_program()
+    block = prog.global_block()
+    du = dataflow.def_use_chains(block)
+    widx = du.producers[h.name]
+    assert len(widx) == 1
+    assert all(i > widx[0] for i in du.consumers[h.name])
+    assert du.uses_after(h.name, widx[0]) == du.consumers[h.name]
+
+
+def test_lifetimes_extend_to_the_backward_region():
+    prog, h, logits, loss = _mlp_program()
+    block = prog.global_block()
+    ridx = next(i for i, op in enumerate(block.ops)
+                if op.type == "vjp_region")
+    with_region = dataflow.var_lifetimes(block)
+    without = dataflow.var_lifetimes(block, include_regions=False)
+    # the hidden activation's last FORWARD reader is before the region,
+    # but the backward re-runs the segment — it must stay live to ridx
+    assert without[h.name][1] < ridx
+    assert with_region[h.name][1] == ridx
+
+
+def test_interference_graph_overlap_semantics():
+    prog = pt.Program()
+    blk = prog.global_block()
+    blk.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+    for n in ("a", "b", "c"):
+        blk.create_var(name=n, shape=[4], dtype="float32")
+    blk.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["a"]})
+    blk.append_op("tanh", inputs={"X": ["a"]}, outputs={"Out": ["b"]})
+    blk.append_op("relu", inputs={"X": ["b"]}, outputs={"Out": ["c"]})
+    g = dataflow.interference_graph(blk)
+    assert "b" in g["a"] and "a" in g["b"]      # a live [0,1], b [1,2]
+    assert "c" not in g["a"]                    # a dead before c is born
+    assert "x" not in g                         # feeds excluded
+
+
+# ---------------------------------------------------------------------------
+# taint engine + replica-divergence detector
+# ---------------------------------------------------------------------------
+
+
+def test_divergence_taints_raw_grads_and_comm_clearing():
+    dp = _dp_program()
+    block = dp.global_block()
+    env = dataflow.divergence_taints(dp)
+    comm = next(op for op in block.ops if op.type == "dp_grad_comm")
+    raw = comm.inputs["X"][0]
+    assert any(t.axis == "dp" and t.kind == "grad"
+               for t in env[(0, raw)])
+    kinds = comm.attrs["kinds"]
+    for i, out in enumerate(comm.outputs["Out"]):
+        dp_taints = {t.kind for t in env[(0, out)] if t.axis == "dp"}
+        if kinds[i] == "bucket":
+            assert not dp_taints               # psum'd: dp-consistent
+        else:
+            assert dp_taints == {"shard"}      # deliberate ZeRO slice
+
+
+def test_spmd_program_has_no_taints():
+    prog, *_ = _mlp_program()
+    assert dataflow.divergence_taints(prog) == {}
+
+
+def test_replica_divergence_rng_into_optimizer():
+    """Seeded-bad program ONLY the divergence detector catches: an rng-
+    scaled learning rate feeding the update. dp-comm-bypass cannot see it
+    (no raw-gradient name involved); shapes all agree."""
+    dp = _dp_program()
+    block = dp.global_block()
+    opt = next(op for op in block.ops if op.type == "sgd")
+    lr = opt.inputs["LearningRate"][0]
+    block.create_var(name="lr_noise", shape=[1], dtype="float32")
+    block.create_var(name="lr_noised", shape=[1], dtype="float32")
+    at = block.ops.index(opt)
+    block.ops.insert(at, Operator(
+        block, "uniform_random", outputs={"Out": ["lr_noise"]},
+        attrs={"shape": [1], "min": 0.9, "max": 1.1}))
+    block.ops.insert(at + 1, Operator(
+        block, "elementwise_mul",
+        inputs={"X": [lr], "Y": ["lr_noise"]},
+        outputs={"Out": ["lr_noised"]}, attrs={"axis": -1}))
+    opt.inputs["LearningRate"] = ["lr_noised"]
+    diags = analysis.verify_program(dp)
+    assert _codes(diags) == {"replica-divergence"}, diags
+    hit = next(d for d in _errors(diags)
+               if d.code == "replica-divergence")
+    assert "uniform_random" in hit.message and "lr_noised" in hit.message
+
+
+def test_replica_divergence_tp_partial_consumed_without_allreduce():
+    """Swap a tp_allreduce (Megatron g) for tp_ident: structurally intact,
+    shapes identical — only the partial-sum contract catches it."""
+    tp = _tp_spliced_program()
+    block = tp.global_block()
+    ar = next(op for op in block.ops if op.type == "tp_allreduce")
+    ar.type = "tp_ident"
+    diags = analysis.verify_program(tp)
+    assert _codes(diags) == {"replica-divergence"}, diags
+    assert any(_sharding.TP_PART_SUFFIX in d.message
+               for d in _errors(diags))
+
+
+def test_zero1_sharded_update_is_sanctioned():
+    """The r08 ZeRO-1 path feeds the optimizer dp-SHARDED values by
+    design (param slice, comm'd shard, sharded accumulators) — the
+    detector must not flag the sanctioned pattern."""
+    dp = _dp_program()
+    assert "replica-divergence" not in _codes(analysis.verify_program(dp))
+
+
+# ---------------------------------------------------------------------------
+# collective-consistency detector
+# ---------------------------------------------------------------------------
+
+
+def test_collective_axis_mismatch_tp():
+    tp = _tp_spliced_program()
+    block = tp.global_block()
+    ar = next(op for op in block.ops if op.type == "tp_allreduce")
+    ar.attrs["axis"] = "dp"
+    assert _codes(analysis.verify_program(tp)) == \
+        {"collective-axis-mismatch"}
+
+
+def test_collective_axis_mismatch_dp():
+    dp = _dp_program()
+    block = dp.global_block()
+    comm = next(op for op in block.ops if op.type == "dp_grad_comm")
+    comm.attrs["axis"] = "tp"
+    assert "collective-axis-mismatch" in _codes(analysis.verify_program(dp))
+
+
+def test_collective_order_send_in_wrong_stage():
+    pp = get_pass("pipeline_partition_pass", num_stages=2,
+                  num_microbatches=4,
+                  schedule="1f1b")(_mlp_program()[0])
+    block = pp.global_block()
+    region = next(op for op in block.ops
+                  if op.type == "pp_pipeline_region")
+    sidx = next(i for i, op in enumerate(block.ops)
+                if op.type == "pp_send")
+    stages = [list(s) for s in region.attrs["stages"]]
+    stages[0].remove(sidx)
+    stages[1].insert(0, sidx)        # the send now lives on the consumer
+    region.attrs["stages"] = stages
+    diags = analysis.verify_program(pp)
+    assert _codes(diags) == {"collective-order"}, diags
+    assert "deadlock" in next(d for d in _errors(diags)).message
+
+
+def test_collective_order_send_before_recv_within_stage():
+    pp = get_pass("pipeline_partition_pass", num_stages=3,
+                  num_microbatches=4,
+                  schedule="1f1b")(_mlp_program()[0])
+    block = pp.global_block()
+    region = next(op for op in block.ops
+                  if op.type == "pp_pipeline_region")
+    stages = [list(s) for s in region.attrs["stages"]]
+    # stage 1 owns recv(cut 0) first and send(cut 1) last: reverse them
+    stages[1] = [stages[1][-1]] + stages[1][1:-1] + [stages[1][0]]
+    region.attrs["stages"] = stages
+    assert "collective-order" in _codes(analysis.verify_program(pp))
+
+
+def test_collective_divergent_control():
+    """A dp collective under control flow whose condition is rng-divergent
+    over dp: shards disagree on entering the branch — static deadlock."""
+    dp = _dp_program()
+    block = dp.global_block()
+    h = next(op for op in block.ops if op.type == "relu").outputs["Out"][0]
+    block.create_var(name="cflag", shape=[1], dtype="float32")
+    block.append_op("uniform_random", outputs={"Out": ["cflag"]},
+                    attrs={"shape": [1]})
+    sub = dp._create_block(parent_idx=0)
+    dp._rollback()
+    sub.create_var(name="sub_gathered", shape=[64, 32], dtype="float32")
+    sub.append_op("dp_shard_all_gather", inputs={"X": [h]},
+                  outputs={"Out": ["sub_gathered"]}, attrs={"axis": "dp"})
+    block.append_op("cond_block",
+                    inputs={"Cond": ["cflag"], "Captures": [h]},
+                    outputs={"Out": []},
+                    attrs={"true_block": sub.idx})
+    diags = analysis.verify_program(dp)
+    assert _codes(diags) == {"collective-divergent-control"}, diags
+    hit = next(d for d in _errors(diags))
+    assert "uniform_random" in hit.message and "deadlock" in hit.message
+
+
+# ---------------------------------------------------------------------------
+# buffer-reuse / WAR detector
+# ---------------------------------------------------------------------------
+
+
+def test_buffer_reuse_race_on_interfering_slot_mates():
+    prog, h, logits, loss = _mlp_program()
+    block = prog.global_block()
+    g = dataflow.interference_graph(block)
+    other = sorted(g[h.name])[0]
+    block.vars[h.name].buffer_slot = 0
+    block.vars[other].buffer_slot = 0
+    diags = analysis.verify_program(prog)
+    assert _codes(diags) == {"buffer-reuse-race"}, diags
+
+
+def test_buffer_war_race_write_lands_on_last_read():
+    prog = pt.Program()
+    blk = prog.global_block()
+    blk.create_var(name="x", shape=[8], dtype="float32", is_data=True)
+    blk.create_var(name="a", shape=[8], dtype="float32")
+    blk.create_var(name="b", shape=[8], dtype="float32")
+    blk.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["a"]})
+    blk.append_op("tanh", inputs={"X": ["a"]}, outputs={"Out": ["b"]})
+    blk.vars["a"].buffer_slot = "s0"
+    blk.vars["b"].buffer_slot = "s0"   # b is written BY a's last reader
+    diags = analysis.verify_program(prog)
+    assert _codes(diags) == {"buffer-war-race"}, diags
+
+
+def test_buffer_slot_on_disjoint_lifetimes_is_clean():
+    prog = pt.Program()
+    blk = prog.global_block()
+    blk.create_var(name="x", shape=[8], dtype="float32", is_data=True)
+    for n in ("a", "b", "c"):
+        blk.create_var(name=n, shape=[8], dtype="float32")
+    blk.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["a"]})
+    blk.append_op("tanh", inputs={"X": ["a"]}, outputs={"Out": ["b"]})
+    blk.append_op("relu", inputs={"X": ["b"]}, outputs={"Out": ["c"]})
+    blk.vars["a"].buffer_slot = 1
+    blk.vars["c"].buffer_slot = 1      # a dead (last read op#1) before c
+    assert not _errors(analysis.verify_program(prog))
+
+
+def test_buffer_reuse_catches_non_adjacent_overlap():
+    """A short-lived slot mate nested inside a long-lived one must be
+    caught even when a third interval sorts between them (adjacent-only
+    interval comparison missed this)."""
+    prog = pt.Program()
+    blk = prog.global_block()
+    blk.create_var(name="x", shape=[8], dtype="float32", is_data=True)
+    for n in ("long", "t1", "mid", "t2", "sink"):
+        blk.create_var(name=n, shape=[8], dtype="float32")
+    blk.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["long"]})
+    blk.append_op("tanh", inputs={"X": ["x"]}, outputs={"Out": ["t1"]})
+    blk.append_op("relu", inputs={"X": ["t1"]}, outputs={"Out": ["mid"]})
+    blk.append_op("tanh", inputs={"X": ["mid"]}, outputs={"Out": ["t2"]})
+    blk.append_op("elementwise_add", inputs={"X": ["long"], "Y": ["t2"]},
+                  outputs={"Out": ["sink"]}, attrs={"axis": -1})
+    # long lives [0,4]; mid [2,3] nested inside it; t1 [1,2] sorts between
+    for n in ("long", "t1", "mid"):
+        blk.vars[n].buffer_slot = "s"
+    diags = _errors(analysis.verify_program(prog))
+    pairs = {d.message for d in diags if d.code == "buffer-reuse-race"}
+    assert any("'mid'" in m and "'long'" in m for m in pairs), diags
+
+
+def test_divergent_capture_without_divergent_condition_is_clean():
+    """A shard-varying value CAPTURED into a branch body is sanctioned
+    state flow; only a divergent CONDITION deadlocks. The binder check
+    must read the Cond slot, not every input."""
+    dp = _dp_program()
+    block = dp.global_block()
+    h = next(op for op in block.ops if op.type == "relu").outputs["Out"][0]
+    # rng-divergent value captured, replicated constant as the condition
+    block.create_var(name="noise", shape=[1], dtype="float32")
+    block.append_op("uniform_random", outputs={"Out": ["noise"]},
+                    attrs={"shape": [1]})
+    block.create_var(name="flag", shape=[1], dtype="float32")
+    block.append_op("fill_constant", outputs={"Out": ["flag"]},
+                    attrs={"shape": [1], "value": 1.0, "dtype": "float32"})
+    sub = dp._create_block(parent_idx=0)
+    dp._rollback()
+    sub.create_var(name="gathered", shape=[64, 32], dtype="float32")
+    sub.append_op("dp_shard_all_gather", inputs={"X": [h]},
+                  outputs={"Out": ["gathered"]}, attrs={"axis": "dp"})
+    block.append_op("cond_block",
+                    inputs={"Cond": ["flag"], "Captures": ["noise", h]},
+                    outputs={"Out": []},
+                    attrs={"true_block": sub.idx})
+    assert not _errors(analysis.verify_program(dp))
+
+
+def test_buffer_slot_on_persistable_reports():
+    prog, h, logits, loss = _mlp_program()
+    block = prog.global_block()
+    param = next(n for n, v in block.vars.items() if v.persistable)
+    block.vars[param].buffer_slot = 2
+    block.vars[h.name].buffer_slot = 2
+    assert "buffer-reuse-race" in _codes(analysis.verify_program(prog))
+
+
+@register_op("_tdf_inplace_bump", stop_gradient=True)
+def _tdf_inplace_bump(ctx, ins, attrs):
+    return {"Out": [ins["X"][0] + 1.0]}
+
+
+@register_effects("_tdf_inplace_bump")
+def _tdf_inplace_bump_effects(op):
+    # declares Out ALIASES X's buffer (a donation-style update)
+    return {"inplace": ((op.inputs["X"][0], op.outputs["Out"][0]),)}
+
+
+def test_inplace_alias_with_later_reader_is_a_war_race():
+    prog = pt.Program()
+    blk = prog.global_block()
+    blk.create_var(name="x", shape=[8], dtype="float32", is_data=True)
+    for n in ("a", "a2", "late"):
+        blk.create_var(name=n, shape=[8], dtype="float32")
+    blk.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["a"]})
+    blk.append_op("_tdf_inplace_bump", inputs={"X": ["a"]},
+                  outputs={"Out": ["a2"]})
+    blk.append_op("tanh", inputs={"X": ["a"]},       # reads the OLD buffer
+                  outputs={"Out": ["late"]})
+    assert _codes(analysis.verify_program(prog)) == {"buffer-war-race"}
+
+
+# ---------------------------------------------------------------------------
+# composed dp2 x pp2 x tp2 programs
+# ---------------------------------------------------------------------------
+
+
+def test_composed_3d_program_is_clean():
+    pp = _composed_program()
+    errs = _errors(analysis.verify_program(pp))
+    assert not errs, "\n".join(str(d) for d in errs)
+
+
+def test_composed_axis_mismatch_caught():
+    pp = _composed_program()
+    block = pp.global_block()
+    ar = next(op for op in block.ops if op.type == "tp_allreduce")
+    ar.attrs["axis"] = "pp"
+    assert _codes(analysis.verify_program(pp)) == \
+        {"collective-axis-mismatch"}
+
+
+def test_composed_tp_partial_leak_caught():
+    pp = _composed_program()
+    block = pp.global_block()
+    ar = next(op for op in block.ops if op.type == "tp_allreduce")
+    ar.type = "tp_ident"
+    assert _codes(analysis.verify_program(pp)) == {"replica-divergence"}
+
+
+def test_composed_stage_reorder_caught():
+    pp = _composed_program()
+    block = pp.global_block()
+    region = next(op for op in block.ops
+                  if op.type == "pp_pipeline_region")
+    ridx = next(i for i, op in enumerate(block.ops)
+                if op.type == "pp_recv")
+    stages = [list(s) for s in region.attrs["stages"]]
+    stages[1].remove(ridx)
+    stages[0].append(ridx)           # recv moved onto the producing stage
+    region.attrs["stages"] = stages
+    assert "collective-order" in _codes(analysis.verify_program(pp))
+
+
+def test_composed_optimizer_bypass_caught_by_divergence_too():
+    """Rewiring an optimizer back to a raw gradient on the composed mesh:
+    dp-comm-bypass (r10) still fires, and the taint detector now names
+    the divergence — both layers see the same hazard."""
+    pp = _composed_program()
+    block = pp.global_block()
+    comm = next(op for op in block.ops if op.type == "dp_grad_comm")
+    raw = comm.inputs["X"][0]
+    consumer = next(op for op in block.ops
+                    if raw + "@COMM" in op.input_names())
+    for slot, names in consumer.inputs.items():
+        consumer.inputs[slot] = [raw if n == raw + "@COMM" else n
+                                 for n in names]
+    codes = _codes(analysis.verify_program(pp))
+    assert "dp-comm-bypass" in codes
+    if consumer.attrs.get("op_role") == "optimize":
+        assert "replica-divergence" in codes
+
+
+# ---------------------------------------------------------------------------
+# zero false positives: every builder x every admissible config
+# ---------------------------------------------------------------------------
+
+import test_static_analysis as _tsa  # noqa: E402  (pytest puts tests/ on sys.path)
+
+
+@pytest.mark.parametrize("name", sorted(_tsa.MODEL_BUILDERS))
+def test_detectors_zero_false_positives(name):
+    """The acceptance sweep: every model builder, under every parallelism
+    rewrite its gates admit (plain / dp2 / pp2 / tp2), produces zero
+    error-severity diagnostics. Gate rejections are skips, not failures —
+    a pass refusing a config is the documented contract."""
+    loss = _tsa.MODEL_BUILDERS[name]()
+    if loss is not None:
+        pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    prog = pt.default_main_program()
+    configs = {"plain": lambda p: p}
+    if loss is not None:
+        configs["dp2"] = lambda p: comm_optimize_pass(p, 2, dict(_DP_CFG))
+        configs["pp2"] = get_pass("pipeline_partition_pass", num_stages=2,
+                                  num_microbatches=4, schedule="1f1b")
+        if _sharding.has_tp_annotations(prog):
+            configs["tp2"] = get_pass("tp_shard_pass", tp=2)
+    for cname, apply in configs.items():
+        try:
+            rewritten = apply(prog)
+        except (EnforceError, analysis.ProgramAnalysisError):
+            continue                 # gate-rejected: config does not apply
+        errs = _errors(analysis.verify_program(rewritten))
+        assert not errs, (name, cname,
+                          "\n".join(str(d) for d in errs))
+
+
+# ---------------------------------------------------------------------------
+# peak_live_bytes beyond block 0 (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_peak_live_bytes_counts_backward_activations():
+    """Two activations whose forward lifetimes are disjoint BOTH feed the
+    backward recompute — the whole-program walk must count them live
+    together at the region."""
+    x = layers.data("x", shape=[256])
+    label = layers.data("label", shape=[1], dtype="int64")
+    a = layers.fc(x, size=4096, act="relu")
+    b = layers.fc(a, size=4096, act="relu")
+    loss = layers.mean(layers.softmax_with_cross_entropy(
+        layers.fc(b, size=10), label))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    mem = analysis.peak_live_bytes(pt.default_main_program(),
+                                   nominal_batch=8)
+    floor = 2 * (8 * 4096 * 4)       # a AND b live at the region
+    assert mem["peak_transient_bytes"] >= floor, mem
+
+
+def test_peak_live_bytes_walks_sub_blocks():
+    """A While body's transient peak is attributed at its binder op."""
+    x = layers.data("x", shape=[64])
+    i = layers.fill_constant([1], "int64", 0)
+    n = layers.fill_constant([1], "int64", 4)
+    cond = layers.less_than(i, n)
+    acc = layers.fc(x, size=64)
+    w = layers.While(cond)
+    with w.block():
+        big = layers.fc(acc, size=2048, act="relu")   # sub-block transient
+        layers.fc(big, size=64)
+        layers.increment(i, value=1.0, in_place=True)
+        layers.less_than(i, n, cond=cond)
+    prog = pt.default_main_program()
+    mem = analysis.peak_live_bytes(prog, nominal_batch=8)
+    assert mem["sub_block_peaks"], mem
+    sub_peak = sum(mem["sub_block_peaks"].values())
+    assert sub_peak >= 8 * 2048 * 4
+    # and the binder carries it: the whole-program peak covers the body
+    assert mem["peak_transient_bytes"] >= sub_peak
+
+
+def test_peak_live_bytes_on_pipelined_program():
+    pp = get_pass("pipeline_partition_pass", num_stages=2,
+                  num_microbatches=4,
+                  schedule="1f1b")(_mlp_program()[0])
+    mem = analysis.peak_live_bytes(pp, nominal_batch=8)
+    assert mem["peak_transient_bytes"] > 0
+    assert "op#" in mem["peak_at"]
+
+
+# ---------------------------------------------------------------------------
+# lint CLI --json + exit-code contract (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _run_lint(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint_program.py"),
+         *args], capture_output=True, text=True, env=env, cwd=REPO,
+        timeout=300)
+
+
+def test_lint_json_contract_and_exit_codes():
+    # clean model: exit 0, one JSON list on stdout, documented row keys
+    r = _run_lint("--model", "mnist", "--json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    rows = json.loads(r.stdout)
+    assert len(rows) == 1 and rows[0]["model"] == "mnist"
+    row = rows[0]
+    for key in ("config", "gate_rejected", "errors", "warnings",
+                "diagnostics", "ops", "memory", "peak_at"):
+        assert key in row, key
+    assert row["errors"] == 0 and row["gate_rejected"] is None
+
+    # gate-rejected config: exit 2 without the sweep flag...
+    r2 = _run_lint("--model", "mnist", "--tp", "2", "--json")
+    assert r2.returncode == 2, r2.stdout + r2.stderr
+    assert json.loads(r2.stdout)[0]["gate_rejected"]
+
+    # ...and exit 0 (a skip) with it
+    r3 = _run_lint("--model", "mnist", "--tp", "2", "--json",
+                   "--allow_gate_rejects")
+    assert r3.returncode == 0, r3.stdout + r3.stderr

@@ -58,6 +58,25 @@ def _tp_transformer():
     return loss
 
 
+def _decode_tick():
+    """The continuous-batching engine's compiled step: an INFERENCE
+    program (returns None — no loss to minimize), linted plain-config
+    only like the serving path in tools/lint_program.py."""
+    models.transformer.transformer_lm_decode_tick(
+        n_slots=2, vocab=100, max_len=16, d_model=32, d_inner=64,
+        num_heads=4, num_layers=2)
+    return None
+
+
+def _prefill():
+    """The teacher-forced prefill + generation program the engine's
+    prompt phase shares weights with."""
+    models.transformer.transformer_lm_generate(
+        vocab=100, max_gen=4, d_model=32, d_inner=64, num_heads=4,
+        num_layers=2, beam_size=4)
+    return None
+
+
 # one builder per model module (small configs: the analyzer only cares
 # about the op DAG, not widths)
 MODEL_BUILDERS = {
@@ -81,6 +100,8 @@ MODEL_BUILDERS = {
         vocab=256, max_len=16, d_model=32, d_inner=64, num_heads=2,
         num_layers=2)[0],
     "transformer_lm_tp": _tp_transformer,
+    "transformer_lm_decode_tick": _decode_tick,
+    "transformer_lm_prefill": _prefill,
     "machine_translation": _mt_train,
 }
 
@@ -111,7 +132,8 @@ def test_builder_tables_cover_the_same_models():
 @pytest.mark.parametrize("name", sorted(MODEL_BUILDERS))
 def test_model_programs_analyze_clean(name):
     loss = MODEL_BUILDERS[name]()
-    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    if loss is not None:            # None = inference/serving program
+        pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
     prog = pt.default_main_program()
     errs = _errors(analysis.analyze_program(prog))
     assert not errs, "\n".join(str(d) for d in errs)

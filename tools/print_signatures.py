@@ -47,6 +47,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.distributed",
     "paddle_tpu.framework.analysis",
     "paddle_tpu.framework.costs",
+    "paddle_tpu.framework.dataflow",
     "paddle_tpu.framework.sharding",
     "paddle_tpu.observability",
     "paddle_tpu.observability.tracing",

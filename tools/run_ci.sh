@@ -20,17 +20,60 @@ sort API.spec > /tmp/api_golden.txt
 diff /tmp/api_golden.txt /tmp/api_current.txt || {
     echo "API surface drifted — review and run tools/print_signatures.py --update"; exit 1; }
 
-echo "== static program lint (analyzer over mnist + transformer_lm) =="
-# whole-program shape/dtype inference + structural/parallel verification
-# (framework/analysis.py) over two flagship builders; exit 1 on any
+echo "== static program lint (analyzer over the flagship builders) =="
+# whole-program shape/dtype inference + structural/parallel/dataflow
+# verification (framework/analysis.py + framework/dataflow.py) over the
+# flagship builders AND the serving-engine programs; exit 1 on any
 # error-severity diagnostic. docs/static_analysis.md has the catalog.
 JAX_PLATFORMS=cpu python tools/lint_program.py --model mnist
 JAX_PLATFORMS=cpu python tools/lint_program.py --model transformer_lm
+# the serving path: the engine's compiled decode tick + the prefill/
+# generate program must be analyzer-clean too (docs/serving.md)
+JAX_PLATFORMS=cpu python tools/lint_program.py \
+    --model transformer_lm_decode_tick
+JAX_PLATFORMS=cpu python tools/lint_program.py --model transformer_lm_prefill
 # tp lint: tp-annotated transformer through tp_shard_pass at tp=2; prints
 # the propagated sharding-spec table and fails on any propagation conflict
 # (docs/tensor_parallel.md has the rule catalog)
 JAX_PLATFORMS=cpu python tools/lint_program.py --model transformer_lm_tp \
     --tp 2
+
+if [ "$TIER" != "quick" ]; then
+    echo "== lint-all sweep: every builder x {plain, dp2, pp2, tp2} =="
+    # the zero-false-positive acceptance gate: every model builder, under
+    # every parallelism rewrite its gates admit, must produce zero
+    # error-severity diagnostics. --json is the contract (machine-readable
+    # code/severity/op_loc rows; documented exit codes in
+    # tools/lint_program.py) — no table scraping. Pass gates rejecting a
+    # (model, config) pair are expected sweep noise (--allow_gate_rejects).
+    rm -f /tmp/lint_sweep_*.json
+    i=0
+    for flags in "" "--dp 2" "--pipeline_stages 2 --num_microbatches 4" \
+                 "--tp 2"; do
+        # don't let set -e kill the sweep on a lint exit(1): the Python
+        # aggregator below owns the gating AND prints which model/config/
+        # code failed (a hard crash leaves truncated JSON, which the
+        # aggregator's json.load turns into a failure too)
+        JAX_PLATFORMS=cpu python tools/lint_program.py --all --json \
+            --allow_gate_rejects $flags > /tmp/lint_sweep_$i.json || true
+        i=$((i+1))
+    done
+    python - <<'PY'
+import glob, json
+rows = [r for f in sorted(glob.glob("/tmp/lint_sweep_*.json"))
+        for r in json.load(open(f))]
+bad = [r for r in rows if r["errors"]]
+gated = [r for r in rows if r["gate_rejected"]]
+for r in bad:
+    for d in r["diagnostics"]:
+        if d["severity"] == "error":
+            print(f"{r['model']} {r['config']}: [{d['code']}] "
+                  f"{d['loc']}: {d['message']}")
+assert not bad, f"{len(bad)} builder/config pair(s) with error diagnostics"
+print(f"lint-all sweep OK: {len(rows) - len(gated)} program(s) clean, "
+      f"{len(gated)} gate-skipped across {len(rows)} (model, config) pairs")
+PY
+fi
 
 if [ "$TIER" = "quick" ]; then
     echo "== quick test tier (~5 min) =="

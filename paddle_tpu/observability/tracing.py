@@ -46,6 +46,7 @@ SPAN_KINDS = frozenset({
     "pp_tick",     # pipeline schedule construction / tick tables
     "dp_comm",     # explicit gradient-comm rewrite planning
     "pass",        # any registered Pass application (provenance = name)
+    "checkpoint",  # elastic snapshot/restore phases (parallel/elastic.py)
     "user",        # RecordEvent-style user annotation
 })
 

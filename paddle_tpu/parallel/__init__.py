@@ -23,3 +23,6 @@ from . import ring_attention  # noqa: F401
 from . import sharded_embedding  # noqa: F401
 from . import auto_shard  # noqa: F401
 from .auto_shard import annotate_tp  # noqa: F401
+from . import elastic  # noqa: F401
+from .elastic import (latest_snapshot, restore_train_state,  # noqa: F401
+                      save_train_state)

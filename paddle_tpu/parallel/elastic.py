@@ -1,0 +1,877 @@
+"""Elastic fault-tolerant training: atomic snapshots + preemption recovery.
+
+Production scale means preemptible hardware (ROADMAP item 5). The
+reference framework survives preemption through its parameter-server
+checkpoint handler and per-trainer `_save_checkpoint` artifacts
+(reference listen_and_serv_op.cc checkpoint handler + trainer.py:641);
+the TPU-native reproduction checkpoints the arrays themselves — sharding
+lives on them — and this module makes that crash-safe and elastic:
+
+- `save_train_state` snapshots the COMPLETE training state: parameters,
+  dp-sharded ZeRO-1 optimizer accumulators (`accumulator_of` backrefs),
+  per-replica error-feedback residuals (`dp_comm_err_*`), the RNG
+  seed/step counters that drive the executor's seed stream, and the
+  BuildStrategy/mesh config — with an ATOMIC TWO-PHASE COMMIT: all files
+  land in a hidden staging directory, every byte is fsync'd, the staging
+  directory is renamed into place, and only then a `COMMIT` marker (an
+  integrity record of every file and its size) is atomically renamed in.
+  A kill at ANY byte offset leaves either a committed snapshot (which
+  restores exactly) or an uncommitted one (which restore skips/rejects) —
+  never a restorable half-write.
+- the ASYNC path: the device→host copy happens synchronously at the step
+  boundary (`sharded_checkpoint.collect_chunks`), then a background
+  thread does the file writes and the commit, so the step critical path
+  pays only the d2h copy. Every phase records a "checkpoint" span
+  (observability/tracing.py) and save/restore durations + bytes land in
+  this module's MetricsRegistry.
+- `restore_train_state` is ELASTIC: given an executor over a DIFFERENT
+  dp world (N→M replicas), each array is re-placed via
+  `jax.make_array_from_callback` onto the new mesh (the r08 kill-switch
+  state reconciliation, generalized across process boundaries), ZeRO-1
+  optimizer slices re-shard automatically from their full-shape chunks,
+  and error-feedback residuals are re-mapped N→M with the pending
+  gradient mass preserved (see `_resize_replica_rows`). Before the first
+  step the restored program's placement is verified statically through
+  the r10/r13 analyzer (`verify_program`) and every restored array's
+  sharding is checked against the executor's placement policy.
+- `PTPU_FAULT_INJECT` makes preemption recovery TESTABLE: crash-at-step,
+  crash-mid-save (SIGKILL at a chosen byte offset of the snapshot
+  payload), slow-writer. tests/test_elastic.py and
+  tools/recovery_smoke.py kill real processes through it.
+
+Grounding (PAPERS.md): the ZeRO-1 shard layout that must round-trip is
+"Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training"; the N→M re-placement on restore is the checkpoint-mediated
+form of "Memory-efficient array redistribution through portable
+collective communication".
+
+Directory layout (docs/fault_tolerance.md):
+
+    <root>/
+      snapshot-00000003/          committed snapshot, serial 3
+        shard-0.pts               this process's chunks (tensor_store)
+        manifest-0.json           chunk -> global-offset map
+        train_meta.json           step/seed counters, strategy, EF layout
+        COMMIT                    atomic commit marker + integrity record
+      .tmp-00000004-1234/         staging dir of an interrupted save
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import signal
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core import flags
+from ..core.enforce import InvalidArgumentError, NotFoundError, enforce
+
+SNAPSHOT_PREFIX = "snapshot-"
+STAGING_PREFIX = ".tmp-"
+COMMIT_MARKER = "COMMIT"
+META_FILE = "train_meta.json"
+META_FORMAT = 1
+
+
+# ---------------------------------------------------------------------------
+# fault injection (PTPU_FAULT_INJECT)
+# ---------------------------------------------------------------------------
+
+def fault_injection_config() -> Dict[str, float]:
+    """Parse PTPU_FAULT_INJECT: comma-separated `directive:value` pairs.
+
+      crash_at_step:<k>     SIGKILL self when maybe_crash_at_step(k) fires
+      crash_mid_save:<b>    SIGKILL during the snapshot protocol at byte
+                            offset b of the staged payload (b < payload:
+                            truncated staging files; b == payload: after
+                            the directory rename, BEFORE the COMMIT
+                            marker; b > payload: just after commit)
+      slow_writer:<s>       sleep s seconds in the background writer
+                            before touching disk (widens the async
+                            window; exercises drain paths)
+
+    Parsed per call — tests flip the env var between runs."""
+    raw = os.environ.get("PTPU_FAULT_INJECT", "")
+    out: Dict[str, float] = {}
+    if not raw:
+        return out
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        enforce(":" in part,
+                f"PTPU_FAULT_INJECT directive {part!r} must be "
+                f"`name:value`", exc=InvalidArgumentError)
+        name, val = part.split(":", 1)
+        enforce(name in ("crash_at_step", "crash_mid_save", "slow_writer"),
+                f"unknown PTPU_FAULT_INJECT directive {name!r}",
+                exc=InvalidArgumentError)
+        out[name] = float(val)
+    return out
+
+
+def _sigkill_self():  # pragma: no cover - the process dies here
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_crash_at_step(step: int):
+    """Training loops call this once per step: under
+    `PTPU_FAULT_INJECT=crash_at_step:<k>` the process SIGKILLs itself
+    when step == k — the supervisor/recovery tests' preemption."""
+    cfg = fault_injection_config()
+    k = cfg.get("crash_at_step")
+    if k is not None and int(step) == int(k):
+        flags.vlog(0, "fault injection: SIGKILL at step %d", step)
+        _sigkill_self()  # pragma: no cover
+
+
+def _payload_files(staging: str) -> List[str]:
+    """Deterministic order of the staged payload files the
+    crash_mid_save byte offset indexes into."""
+    names = sorted(n for n in os.listdir(staging)
+                   if n != COMMIT_MARKER and not n.endswith(".tmp"))
+    return names
+
+
+def _crash_mid_staging(staging: str, offset: int) -> bool:
+    """crash_mid_save with offset inside the payload: make the staging
+    dir look exactly as if the writer died `offset` bytes into its
+    sequential write — truncate the file holding that offset, remove
+    everything after it — then SIGKILL. Returns False when the offset
+    lies beyond the payload (the caller crashes later in the protocol)."""
+    names = _payload_files(staging)
+    sizes = [os.path.getsize(os.path.join(staging, n)) for n in names]
+    total = sum(sizes)
+    if offset >= total:
+        return False
+    cum = 0
+    for i, (n, sz) in enumerate(zip(names, sizes)):
+        if offset < cum + sz:
+            with open(os.path.join(staging, n), "r+b") as f:
+                f.truncate(offset - cum)
+            for later in names[i + 1:]:
+                os.unlink(os.path.join(staging, later))
+            break
+        cum += sz
+    _sigkill_self()  # pragma: no cover
+    return True
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+_registry = None
+_reg_lock = threading.Lock()
+
+
+def metrics_registry():
+    """Module-level MetricsRegistry for checkpoint telemetry: save/restore
+    durations, bytes written, snapshots committed, pending async writes.
+    Scrapeable alongside any other registry (observability/metrics.py)."""
+    global _registry
+    with _reg_lock:
+        if _registry is None:
+            from ..observability import metrics as m
+            r = m.MetricsRegistry()
+            r.counter("ptpu_ckpt_saves_total",
+                      "Snapshots committed by this process.")
+            r.counter("ptpu_ckpt_save_bytes_total",
+                      "Payload bytes written across committed snapshots.")
+            r.counter("ptpu_ckpt_restores_total", "Snapshots restored.")
+            r.histogram("ptpu_ckpt_save_seconds",
+                        "Wall time of the write+commit phase.",
+                        buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                                 5.0, 10.0, 30.0))
+            r.histogram("ptpu_ckpt_restore_seconds",
+                        "Wall time of restore_train_state.",
+                        buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                                 5.0, 10.0, 30.0))
+            r.gauge("ptpu_ckpt_pending_async",
+                    "Async snapshot writes not yet committed.",
+                    fn=lambda: float(len(_PENDING)))
+            _registry = r
+    return _registry
+
+
+def _metric(name):
+    return metrics_registry().get(name)
+
+
+# ---------------------------------------------------------------------------
+# snapshot directory bookkeeping
+# ---------------------------------------------------------------------------
+
+_SNAP_RE = re.compile(re.escape(SNAPSHOT_PREFIX) + r"(\d+)$")
+
+
+def is_committed(dirname: str) -> bool:
+    return os.path.exists(os.path.join(dirname, COMMIT_MARKER))
+
+
+def list_snapshots(root: str, committed_only: bool = True):
+    """[(serial, path)] ascending. committed_only=True (the default —
+    restore's view) skips snapshot dirs without a COMMIT marker: an
+    interrupted save must never be picked as "latest"."""
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        m = _SNAP_RE.match(name)
+        if not m:
+            continue
+        path = os.path.join(root, name)
+        if committed_only and not is_committed(path):
+            continue
+        out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def latest_snapshot(root: str) -> Optional[str]:
+    """Path of the newest COMMITTED snapshot under root, or None."""
+    snaps = list_snapshots(root, committed_only=True)
+    return snaps[-1][1] if snaps else None
+
+
+def validate_snapshot(dirname: str):
+    """Raise a clear enforce error unless `dirname` is a complete,
+    committed snapshot: COMMIT marker present and parseable, every file
+    it records present at exactly the recorded size, manifest count
+    matching. The property the crash-mid-save test pins: a directory
+    that passes here restores exactly; one that fails is rejected with
+    the directory and the missing/damaged piece named."""
+    enforce(os.path.isdir(dirname),
+            f"snapshot dir {dirname!r} does not exist",
+            exc=NotFoundError)
+    marker = os.path.join(dirname, COMMIT_MARKER)
+    enforce(os.path.exists(marker),
+            f"snapshot dir {dirname!r} has no {COMMIT_MARKER} marker — an "
+            f"interrupted (uncommitted) save; it is not restorable. "
+            f"restore_train_state(root) picks the latest COMMITTED "
+            f"snapshot automatically", exc=InvalidArgumentError)
+    try:
+        with open(marker) as f:
+            record = json.load(f)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise InvalidArgumentError(
+            f"snapshot dir {dirname!r}: {COMMIT_MARKER} marker is corrupt "
+            f"({e})") from e
+    files = record.get("files", {})
+    for name, size in files.items():
+        path = os.path.join(dirname, name)
+        enforce(os.path.exists(path),
+                f"snapshot dir {dirname!r} is missing {name!r} recorded "
+                f"in its {COMMIT_MARKER} marker",
+                exc=InvalidArgumentError)
+        got = os.path.getsize(path)
+        enforce(got == int(size),
+                f"snapshot dir {dirname!r}: {name!r} is {got} bytes but "
+                f"the {COMMIT_MARKER} marker recorded {size} — truncated "
+                f"or overwritten after commit",
+                exc=InvalidArgumentError)
+    n_manifests = len([n for n in os.listdir(dirname)
+                       if n.startswith("manifest-")
+                       and n.endswith(".json")])
+    want = int(record.get("manifests", n_manifests))
+    enforce(n_manifests == want,
+            f"snapshot dir {dirname!r} holds {n_manifests} manifest(s) "
+            f"but the {COMMIT_MARKER} marker recorded {want} — shard "
+            f"files from another world mixed in?",
+            exc=InvalidArgumentError)
+
+
+def _resolve_snapshot_dir(path: str) -> str:
+    """Accept either a snapshot dir or a root of snapshot-* dirs."""
+    if os.path.basename(os.path.normpath(path)).startswith(SNAPSHOT_PREFIX):
+        return path
+    if os.path.isdir(path) and any(
+            _SNAP_RE.match(n) for n in os.listdir(path)):
+        latest = latest_snapshot(path)
+        enforce(latest is not None,
+                f"checkpoint root {path!r} holds snapshot dirs but none "
+                f"is committed (no {COMMIT_MARKER} markers) — every save "
+                f"was interrupted before its commit point",
+                exc=NotFoundError)
+        return latest
+    return path
+
+
+# ---------------------------------------------------------------------------
+# train-state metadata
+# ---------------------------------------------------------------------------
+
+def _strategy_dict(strategy) -> Dict[str, Any]:
+    if strategy is None:
+        return {}
+    from .strategy import ReduceStrategy
+    return {
+        "reduce_strategy": ReduceStrategy(strategy.reduce_strategy).name,
+        "quant_comm": strategy.quant_comm,
+        "quant_comm_block": strategy.quant_comm_block,
+        "comm_error_feedback": strategy.comm_error_feedback,
+        "comm_bucket_bytes": strategy.comm_bucket_bytes,
+        "pipeline_stages": strategy.pipeline_stages,
+        "num_microbatches": strategy.num_microbatches,
+        "pipeline_schedule": strategy.pipeline_schedule,
+    }
+
+
+def _ef_layout(program) -> Optional[Dict[str, Any]]:
+    """The error-feedback transfer layout of a comm-rewritten program:
+    which grads ride which transfer, in which order, at which flat
+    sizes — everything `_remap_error_feedback` needs to re-map residual
+    state onto a DIFFERENT dp world (var names and row counts both
+    change with dp)."""
+    if not getattr(program, "_dp_comm_applied", False):
+        return None
+    block = program.global_block()
+    comm = next((op for op in block.ops if op.type == "dp_grad_comm"), None)
+    if comm is None or not comm.attrs.get("error_feedback"):
+        return None
+    err_names = list(comm.inputs.get("ErrIn", []))
+    if not err_names:
+        return None
+    kinds = comm.attrs["kinds"]
+    numels = comm.attrs["numels"]
+    grads = list(comm.inputs["X"])
+    dp = int(comm.attrs["dp"])
+    tp = int(getattr(program, "_tp_size", 0) or 0) \
+        if getattr(program, "_tp_applied", False) else 0
+    transfers = []
+    # the pass lays err state out sharded-transfers-first, then buckets —
+    # mirror that order (grad_comm.py _comm_optimize_pass_impl)
+    for i, kind in enumerate(kinds):
+        if kind == "sharded":
+            transfers.append({"kind": "sharded", "grads": [grads[i]],
+                              "numels": [numels[i]], "flat": numels[i]})
+    for idxs in comm.attrs["buckets"]:
+        flat = sum(numels[i] for i in idxs)
+        transfers.append({"kind": "bucket",
+                          "grads": [grads[i] for i in idxs],
+                          "numels": [numels[i] for i in idxs],
+                          "flat": -(-flat // dp) * dp})
+    enforce(len(transfers) == len(err_names),
+            f"error-feedback layout mismatch: {len(transfers)} transfers "
+            f"vs {len(err_names)} state vars", exc=InvalidArgumentError)
+    for t, name in zip(transfers, err_names):
+        t["var"] = name
+    return {"dp": dp, "tp": max(tp, 1),
+            "quant": comm.attrs["quant"], "block": comm.attrs["block"],
+            "transfers": transfers}
+
+
+# ---------------------------------------------------------------------------
+# save
+# ---------------------------------------------------------------------------
+
+_PENDING: List["AsyncSnapshot"] = []
+_pending_lock = threading.Lock()
+_serial_lock = threading.Lock()
+_last_serial = -1
+
+
+def _alloc_serial(root: str) -> int:
+    """Monotone snapshot serial: max(disk, in-process counter) under a
+    lock, so two async saves racing before either's directory exists
+    cannot mint the same serial (their staging dirs would collide and
+    the second rename would clobber the first commit)."""
+    global _last_serial
+    with _serial_lock:
+        snaps = list_snapshots(root, committed_only=False)
+        serial = max(_last_serial + 1,
+                     (snaps[-1][0] + 1) if snaps else 0)
+        _last_serial = serial
+        return serial
+
+
+class AsyncSnapshot:
+    """Handle for a background snapshot write. The device→host copy
+    already happened when this handle exists — the training loop may
+    mutate state freely. result() blocks until the commit (re-raising
+    any writer exception) and returns the committed snapshot path."""
+
+    def __init__(self, serial: Optional[int] = None):
+        self._event = threading.Event()
+        self._path: Optional[str] = None
+        self._exc: Optional[BaseException] = None
+        self._serial = serial
+
+    @property
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> str:
+        if not self._event.wait(timeout):
+            raise TimeoutError("snapshot write not committed in time")
+        if self._exc is not None:
+            raise self._exc
+        return self._path
+
+    def _finish(self, path=None, exc=None):
+        self._path = path
+        self._exc = exc
+        with _pending_lock:
+            if self in _PENDING:
+                _PENDING.remove(self)
+        self._event.set()
+
+
+def wait_for_pending(timeout: Optional[float] = None):
+    """Block until every in-flight async snapshot committed — the drain
+    hook (EngineServer SIGTERM drain, supervisor shutdown, end of
+    training) that guarantees no writer thread is still holding dirty
+    state when the process exits."""
+    with _pending_lock:
+        pending = list(_PENDING)
+    for h in pending:
+        h.result(timeout)
+
+
+def _collect_train_arrays(program, scope) -> Dict[str, object]:
+    from ..io import _is_persistable, _select_vars
+    arrays = {}
+    for v in _select_vars(program, _is_persistable):
+        if scope.has_var(v.name):
+            arrays[v.name] = scope.get(v.name)
+    enforce(arrays, "no persistable state in scope — run the startup "
+            "program before snapshotting", exc=InvalidArgumentError)
+    return arrays
+
+
+def _prepared_view(executor, program, scope):
+    """The program AS THE EXECUTOR RUNS IT: ParallelExecutor rewrites
+    (tp/dp-comm/pipeline) before compiling, and checkpoint contents +
+    placement policy must follow the REWRITTEN view (sharded
+    accumulators, error-feedback vars)."""
+    if executor is not None and hasattr(executor, "prepare_program"):
+        return executor.prepare_program(program, scope)
+    return program
+
+
+def save_train_state(root: str,
+                     program=None, scope=None, executor=None,
+                     step: int = 0, extra_meta: Optional[dict] = None,
+                     max_snapshots: int = 3,
+                     block: bool = True):
+    """Snapshot the complete training state under `root` with the atomic
+    two-phase commit. Returns the committed snapshot path (block=True)
+    or an AsyncSnapshot handle (block=False: only the device→host copy
+    happens on the caller's thread; a background writer does the file
+    writes + commit off the step critical path).
+
+    `executor` is the executor DRIVING training (Executor or
+    ParallelExecutor): its run counter — the RNG seed stream position —
+    rides the metadata, so a restored run draws exactly the seeds the
+    uninterrupted run would have. ParallelExecutor additionally
+    contributes its BuildStrategy/mesh config and the rewritten program
+    view (sharded accumulators, error-feedback state)."""
+    import jax
+
+    from ..framework.program import default_main_program
+    from ..framework.scope import global_scope
+    from ..observability import tracing as _tracing
+    from ..sharded_checkpoint import collect_chunks
+
+    # single-writer protocol: the rmtree-leftovers + rename + retention
+    # steps assume ONE process owns the snapshot root. In a multi-process
+    # world each process would clobber its siblings' shard files (silent
+    # checkpoint loss) — reject up front; the chief-commits barrier
+    # protocol (trainer.save_checkpoint's multi-phase form) is the
+    # planned extension (ROUND14_NOTES.md).
+    enforce(jax.process_count() == 1,
+            f"elastic save_train_state is single-process today "
+            f"(process_count={jax.process_count()}): concurrent writers "
+            f"would overwrite each other's snapshot serials. Use "
+            f"trainer.save_checkpoint(sharded=True) — its barrier "
+            f"protocol commits multi-host checkpoints safely",
+            exc=InvalidArgumentError)
+    program = program or default_main_program()
+    scope = scope or global_scope()
+    prepared = _prepared_view(executor, program, scope)
+    arrays = _collect_train_arrays(prepared, scope)
+
+    mesh = getattr(executor, "mesh", None)
+    strategy = getattr(executor, "build_strategy", None)
+    meta = {
+        "format": META_FORMAT,
+        "step": int(step),
+        "run_counter": int(getattr(executor, "_run_counter", 0) or 0),
+        "random_seed": int(program.random_seed),
+        "world": dict(getattr(mesh, "axes", {}) or {}),
+        "strategy": _strategy_dict(strategy),
+        "ef_layout": _ef_layout(prepared),
+        "extra": dict(extra_meta or {}),
+        "var_names": sorted(arrays),
+    }
+
+    with _tracing.span("checkpoint", "elastic/snapshot_d2h",
+                       n_vars=len(arrays), step=int(step)):
+        chunks, manifest, pid = collect_chunks(arrays)
+
+    os.makedirs(root, exist_ok=True)
+    serial = _alloc_serial(root)
+    final = os.path.join(root, f"{SNAPSHOT_PREFIX}{serial:08d}")
+    staging = os.path.join(root,
+                           f"{STAGING_PREFIX}{serial:08d}-{os.getpid()}")
+
+    if block:
+        return _write_and_commit(staging, final, chunks, manifest, pid,
+                                 meta, root, max_snapshots, step,
+                                 serial)
+    handle = AsyncSnapshot(serial)
+    with _pending_lock:
+        _PENDING.append(handle)
+
+    def _writer():
+        try:
+            path = _write_and_commit(staging, final, chunks, manifest,
+                                     pid, meta, root, max_snapshots,
+                                     step, serial)
+            handle._finish(path=path)
+        except BaseException as e:  # noqa: BLE001 - surfaced via result()
+            handle._finish(exc=e)
+
+    t = threading.Thread(target=_writer, name=f"ckpt-writer-{serial}",
+                         daemon=True)
+    t.start()
+    return handle
+
+
+def _write_and_commit(staging, final, chunks, manifest, pid, meta,
+                      root, max_snapshots, step, serial) -> str:
+    """Phase 2: staged writes, fsync, rename, COMMIT marker, retention.
+    The fault-injection crash points live here (see
+    fault_injection_config)."""
+    from ..observability import tracing as _tracing
+    from ..sharded_checkpoint import _fsync_file, write_chunks
+
+    fault = fault_injection_config()
+    slow = fault.get("slow_writer")
+    if slow:
+        time.sleep(float(slow))
+    t0 = time.perf_counter()
+    with _tracing.span("checkpoint", "elastic/snapshot_write",
+                       step=int(step)):
+        if os.path.isdir(staging):
+            shutil.rmtree(staging)
+        os.makedirs(staging)
+        write_chunks(staging, chunks, manifest, pid, fsync=True)
+        meta_path = os.path.join(staging, META_FILE)
+        with open(meta_path, "w") as f:
+            json.dump(meta, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+
+        mid = fault.get("crash_mid_save")
+        if mid is not None:
+            _crash_mid_staging(staging, int(mid))  # may not return
+        payload = {n: os.path.getsize(os.path.join(staging, n))
+                   for n in _payload_files(staging)}
+        n_manifests = len([n for n in payload if n.startswith("manifest-")])
+
+    with _tracing.span("checkpoint", "elastic/commit", step=int(step)):
+        if os.path.isdir(final):
+            # leftovers of a preempted save that never committed (a
+            # COMMITTED dir at this serial is impossible: the serial scan
+            # above counted it)
+            shutil.rmtree(final)
+        os.replace(staging, final)
+        _fsync_file(root)
+        if mid is not None and int(mid) == sum(payload.values()):
+            # crash point "after rename, before COMMIT": the snapshot dir
+            # is visible but uncommitted — restore must skip it
+            _sigkill_self()  # pragma: no cover
+        marker = os.path.join(final, COMMIT_MARKER)
+        with open(marker + ".tmp", "w") as f:
+            json.dump({"manifests": n_manifests, "files": payload,
+                       "step": int(step)}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(marker + ".tmp", marker)
+        _fsync_file(final)
+    if mid is not None and int(mid) > sum(payload.values()):
+        _sigkill_self()  # pragma: no cover
+
+    # retention: keep the newest max_snapshots COMMITTED snapshots; also
+    # sweep stale staging dirs from earlier preempted/dead saves — but
+    # never one a LIVE async writer of this process still owns (its
+    # serial is >= the oldest pending serial)
+    if max_snapshots and max_snapshots > 0:
+        committed = list_snapshots(root, committed_only=True)
+        for _, old in committed[:-max_snapshots]:
+            shutil.rmtree(old, ignore_errors=True)
+    with _pending_lock:
+        live = {h._serial for h in _PENDING if h._serial is not None}
+    floor = min(live | {serial})
+    stale_re = re.compile(re.escape(STAGING_PREFIX) + r"(\d+)-")
+    for name in os.listdir(root):
+        m = stale_re.match(name)
+        if m and int(m.group(1)) < floor and \
+                os.path.join(root, name) != staging:
+            shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+
+    dt = time.perf_counter() - t0
+    _metric("ptpu_ckpt_saves_total").inc()
+    _metric("ptpu_ckpt_save_bytes_total").inc(sum(payload.values()))
+    _metric("ptpu_ckpt_save_seconds").observe(dt)
+    flags.vlog(1, "committed snapshot %s (%d bytes, %.3fs)", final,
+               sum(payload.values()), dt)
+    return final
+
+
+# ---------------------------------------------------------------------------
+# error-feedback N→M re-mapping
+# ---------------------------------------------------------------------------
+
+def _resize_replica_rows(rows: np.ndarray, new_n: int) -> np.ndarray:
+    """Re-map per-replica residual rows [N, n] onto M replicas while
+    preserving the EFFECTIVE pending gradient: each step applies
+    mean_i(g_i + e_i), so the pending correction is (1/N)·Σe — rows are
+    scaled by M/N so (1/M)·Σe' == (1/N)·Σe exactly. Growing pads zero
+    rows (new replicas start with no residual); shrinking folds rows
+    modulo M. pad-then-fold is the identity, so an N→M→N round trip with
+    M ≥ N restores the original rows bit-exactly when M/N is a power of
+    two (f32 scaling by powers of two is exact)."""
+    n_old = rows.shape[0]
+    scale = np.float32(new_n) / np.float32(n_old)
+    out = np.zeros((new_n,) + rows.shape[1:], rows.dtype)
+    if new_n >= n_old:
+        out[:n_old] = rows
+    else:
+        for i in range(n_old):
+            out[i % new_n] += rows[i]
+    return (out * scale).astype(rows.dtype)
+
+
+def _remap_error_feedback(ckpt, old_layout: Dict, new_layout: Dict,
+                          new_dp: int) -> Dict[str, np.ndarray]:
+    """Saved residual state (old transfer layout, N rows) → host arrays
+    for the NEW layout's error-feedback vars (M rows). Per-gradient
+    segments are extracted from the old flat vectors, dp rows re-mapped
+    within each tp group, and re-packed at the new offsets — gradients
+    may move between transfers when the dp divisibility classification
+    changes with the resize. Bucket pad regions carry an identically
+    zero residual (quantizing an exact zero leaves no residual), so
+    dropping/re-padding them is lossless."""
+    enforce(old_layout["tp"] == new_layout["tp"],
+            f"elastic restore resizes the dp axis only: checkpoint has "
+            f"tp={old_layout['tp']}, target program tp={new_layout['tp']}",
+            exc=InvalidArgumentError)
+    enforce((old_layout["quant"], old_layout["block"])
+            == (new_layout["quant"], new_layout["block"]),
+            f"error-feedback state is only meaningful under the wire "
+            f"config that produced it: checkpoint quant="
+            f"{old_layout['quant']!r}/block={old_layout['block']} vs "
+            f"target {new_layout['quant']!r}/{new_layout['block']} — "
+            f"restore with the same quant_comm config, or drop "
+            f"comm_error_feedback to start residuals at zero",
+            exc=InvalidArgumentError)
+    tp = old_layout["tp"]
+    old_dp = int(old_layout["dp"])
+
+    # old per-grad residual matrices: grad -> [tp, N, numel]
+    per_grad: Dict[str, np.ndarray] = {}
+    for t in old_layout["transfers"]:
+        arr = np.asarray(ckpt.read(t["var"]))
+        enforce(arr.shape == (old_dp * tp, t["flat"]),
+                f"saved error-feedback var {t['var']!r} has shape "
+                f"{arr.shape}, expected {(old_dp * tp, t['flat'])} — "
+                f"checkpoint metadata disagrees with its contents",
+                exc=InvalidArgumentError)
+        arr = arr.reshape(tp, old_dp, t["flat"])
+        off = 0
+        for g, n in zip(t["grads"], t["numels"]):
+            per_grad[g] = arr[:, :, off:off + n]
+            off += n
+
+    out: Dict[str, np.ndarray] = {}
+    for t in new_layout["transfers"]:
+        new = np.zeros((tp, new_dp, t["flat"]), np.float32)
+        off = 0
+        for g, n in zip(t["grads"], t["numels"]):
+            old = per_grad.get(g)
+            if old is not None:
+                enforce(old.shape[-1] == n,
+                        f"gradient {g!r} changed size across the resize "
+                        f"({old.shape[-1]} vs {n}) — the checkpoint does "
+                        f"not match this program",
+                        exc=InvalidArgumentError)
+                for ti in range(tp):
+                    new[ti, :, off:off + n] = _resize_replica_rows(
+                        old[ti], new_dp)
+            off += n
+        out[t["var"]] = new.reshape(tp * new_dp, t["flat"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# restore
+# ---------------------------------------------------------------------------
+
+def read_meta(dirname: str) -> Dict[str, Any]:
+    """The train_meta.json of a snapshot dir (resolves a root to its
+    latest committed snapshot first)."""
+    dirname = _resolve_snapshot_dir(dirname)
+    validate_snapshot(dirname)
+    with open(os.path.join(dirname, META_FILE)) as f:
+        return json.load(f)
+
+
+def verify_restored_placement(executor, program, scope,
+                              names=None) -> List[str]:
+    """Static placement check of live state vs the executor's policy:
+    for every persistable in `names` (default: all in scope), the
+    array's sharding must be equivalent to what
+    ParallelExecutor._state_sharding demands for this program. Returns a
+    list of violation strings (empty = clean) — restore_train_state
+    enforces on them; tools/lint_program.py --restore_dir reports them."""
+    from ..io import _is_persistable, _select_vars
+    problems = []
+    if not hasattr(executor, "state_sharding"):
+        return problems
+    for v in _select_vars(program, _is_persistable):
+        if names is not None and v.name not in names:
+            continue
+        if not scope.has_var(v.name):
+            continue
+        val = scope.get(v.name)
+        sh = getattr(val, "sharding", None)
+        if sh is None:
+            continue
+        want = executor.state_sharding(program, v.name)
+        if not sh.is_equivalent_to(want, getattr(val, "ndim", 0)):
+            problems.append(
+                f"{v.name}: restored with {sh.spec}, executor places it "
+                f"{want.spec}")
+    return problems
+
+
+def restore_train_state(path: str,
+                        program=None, scope=None, executor=None,
+                        strict: bool = True,
+                        verify: bool = True) -> Dict[str, Any]:
+    """Restore the latest committed snapshot under `path` (or `path`
+    itself when it is a snapshot dir) into `scope`, re-placing every
+    array onto the CURRENT executor's mesh — which may have a different
+    dp degree than the one that saved (elastic N→M resize): parameters
+    and full-shape ZeRO-1 accumulator chunks re-shard through
+    make_array_from_callback; error-feedback residuals re-map through
+    `_remap_error_feedback`. Restores the executor's run counter (the
+    RNG seed stream position), so a fixed-seed resumed run replays
+    exactly the seeds of the uninterrupted one.
+
+    verify=True (default) runs the r10/r13 static analyzer
+    (`verify_program`) over the program as the executor rewrites it and
+    checks every restored array's placement against the executor's
+    policy BEFORE returning — a mis-placed restore fails here, not in
+    jit's arg-sharding check mid-step.
+
+    strict=True errors on persistables the checkpoint lacks; False
+    leaves them at their startup values (warm-starting a grown model).
+
+    Returns the snapshot metadata (step, extra, world, strategy...)."""
+    import time as _time
+
+    from ..framework.program import default_main_program
+    from ..framework.scope import global_scope
+    from ..io import _is_persistable, _select_vars
+    from ..observability import tracing as _tracing
+    from ..sharded_checkpoint import ShardedCheckpoint, restore_array
+
+    t0 = _time.perf_counter()
+    program = program or default_main_program()
+    scope = scope or global_scope()
+    dirname = _resolve_snapshot_dir(path)
+    validate_snapshot(dirname)
+    with open(os.path.join(dirname, META_FILE)) as f:
+        meta = json.load(f)
+
+    prepared = _prepared_view(executor, program, scope)
+    new_ef = _ef_layout(prepared)
+    old_ef = meta.get("ef_layout")
+    mesh = getattr(executor, "mesh", None)
+    new_dp = int(mesh.axis_size("dp")) if mesh is not None else 1
+
+    with _tracing.span("checkpoint", "elastic/restore",
+                       snapshot=os.path.basename(dirname)):
+        ckpt = ShardedCheckpoint(dirname)
+        saved = set(ckpt.names())
+        ef_vars = {t["var"] for t in (new_ef or {}).get("transfers", ())}
+        restorable, missing = [], []
+        for v in _select_vars(prepared, _is_persistable):
+            name = v.name
+            if name in ef_vars:
+                continue  # handled below via the layout re-map
+            if name not in saved:
+                if getattr(v, "dp_replica_state", False):
+                    continue  # stale EF var of another config: skip
+                missing.append(name)
+                continue
+            restorable.append(name)
+        # the strict check fires BEFORE any scope mutation: a caller that
+        # catches it and falls back must not be left with exactly the
+        # half-restored mixed state the error exists to prevent
+        enforce(not (strict and missing),
+                f"snapshot {dirname!r} lacks persistable var(s) "
+                f"{missing[:8]}{'...' if len(missing) > 8 else ''} that "
+                f"this program declares — restoring it would silently "
+                f"mix checkpointed and freshly initialized state. Pass "
+                f"strict=False to warm-start the missing vars from their "
+                f"startup values", exc=InvalidArgumentError)
+        for name in restorable:
+            sharding = (executor.state_sharding(prepared, name)
+                        if hasattr(executor, "state_sharding") else None)
+            scope.set_var(name, restore_array(ckpt, name, sharding))
+
+        if new_ef is not None:
+            enforce(old_ef is not None,
+                    f"this program carries error-feedback state "
+                    f"(comm_error_feedback) but snapshot {dirname!r} "
+                    f"recorded none — it was saved without quantized "
+                    f"error feedback. Restore with the saving config, or "
+                    f"disable comm_error_feedback to start residuals at "
+                    f"zero", exc=InvalidArgumentError)
+            import jax
+            remapped = _remap_error_feedback(ckpt, old_ef, new_ef, new_dp)
+            for name, host in remapped.items():
+                sharding = (executor.state_sharding(prepared, name)
+                            if hasattr(executor, "state_sharding")
+                            else None)
+                val = (jax.device_put(host, sharding)
+                       if sharding is not None else host)
+                scope.set_var(name, val)
+
+    if executor is not None and "run_counter" in meta:
+        executor._run_counter = int(meta["run_counter"])
+    if strict and "random_seed" in meta:
+        enforce(int(program.random_seed) == int(meta["random_seed"]),
+                f"program.random_seed={program.random_seed} but the "
+                f"snapshot was trained with random_seed="
+                f"{meta['random_seed']}: the resumed seed stream would "
+                f"diverge from the uninterrupted run. Rebuild the "
+                f"program with the saved seed (or strict=False to accept "
+                f"the divergence)", exc=InvalidArgumentError)
+
+    if verify:
+        from ..framework.analysis import verify_program
+        errors = [d for d in verify_program(prepared)
+                  if d.severity == "error"]
+        enforce(not errors,
+                "restored program failed static verification:\n  "
+                + "\n  ".join(str(d) for d in errors[:10]),
+                exc=InvalidArgumentError)
+        problems = verify_restored_placement(executor, prepared, scope)
+        enforce(not problems,
+                "restored state placement disagrees with the executor's "
+                "policy:\n  " + "\n  ".join(problems[:10]),
+                exc=InvalidArgumentError)
+
+    _metric("ptpu_ckpt_restores_total").inc()
+    _metric("ptpu_ckpt_restore_seconds").observe(_time.perf_counter() - t0)
+    return meta

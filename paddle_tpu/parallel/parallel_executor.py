@@ -353,6 +353,20 @@ class ParallelExecutor(Executor):
             marker if marker != (None, None, None) else None)
         return program
 
+    # public views for the elastic checkpoint runtime (parallel/elastic.py)
+    # and tooling: the program AS THIS EXECUTOR RUNS IT and the placement
+    # its policy assigns a state var — snapshot contents (sharded ZeRO-1
+    # accumulators, error-feedback state) and restore-time re-placement
+    # must both follow the REWRITTEN view, not the user's program.
+    def prepare_program(self, program: Optional[Program] = None,
+                        scope: Optional[Scope] = None) -> Program:
+        return self._prepare_program(
+            program or self.main_program or default_main_program(),
+            scope or self.scope)
+
+    def state_sharding(self, program: Program, name: str) -> NamedSharding:
+        return self._state_sharding(program, name)
+
     def _apply_pipeline(self, program: Program, pcfg: Dict) -> Program:
         """Apply pipeline_partition_pass (cached) for the resolved pipeline
         config; validates the mesh carries a pp axis of the right size."""

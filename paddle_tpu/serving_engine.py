@@ -40,6 +40,7 @@ Scheduling policies (the A/B in tools/bench_serve.py):
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -347,12 +348,17 @@ class ContinuousBatchingEngine:
             if len(req.tokens) >= req.max_new or hit_eos or out_of_room:
                 finished.append(req)
         if finished:
+            # complete (firing on_done -> writer.offer) BEFORE dropping
+            # the request from _active: a drain poll reading
+            # n_active==0 must imply every completion frame is already
+            # in its writer queue, or the drain could close the writer
+            # ahead of the final frame and silently drop it
+            for req in finished:
+                req._complete()
             with self._lock:
                 for req in finished:
                     del self._active[req.slot]
                     self._slots.free(req.slot)
-            for req in finished:
-                req._complete()
             self._m_completed.inc(len(finished))
         return finished
 
@@ -475,9 +481,12 @@ class EngineServer:
         self.address = self._sock.getsockname()
         self._stop = threading.Event()
         self._wake = threading.Event()     # submissions kick the engine
+        self._draining = threading.Event()  # admit nothing new, finish rest
         self._threads: List[threading.Thread] = []
         self._conns: List = []
+        self._writers: List = []
         self._lock = threading.Lock()
+        self._prev_sigterm = None
         # Prometheus exposition: a small HTTP listener serving GET
         # /metrics from the engine's registry. A SEPARATE socket from the
         # generation RPC (that one speaks the serving.py frame protocol;
@@ -505,6 +514,85 @@ class EngineServer:
             h.start()
             self._http_started = True
         return self
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown (the SIGTERM path): stop admitting — the
+        listener closes and new `gen` frames on live connections are
+        answered with a draining error — finish every in-flight AND
+        already-queued request, flush the per-connection writer threads
+        so every completion frame reaches its client, then shut down.
+        Returns True when the engine fully drained within `timeout`
+        (False: timed out; shutdown still ran, undelivered work was
+        dropped)."""
+        # flag flips under the admission lock: every reader thread either
+        # observed draining (and rejects) or completed its submit before
+        # this point (and the idle wait below sees that request) — no
+        # window where a request is admitted into a stopping engine
+        with self._lock:
+            self._draining.set()
+        try:
+            # closing the listener unblocks accept(); in-flight conns
+            # stay open so completions can still go out
+            self._sock.close()
+        except OSError:
+            pass
+        deadline = None if timeout is None else time.time() + timeout
+        drained = True
+        while self.engine.n_active or self.engine.n_pending:
+            self._wake.set()
+            if deadline is not None and time.time() > deadline:
+                drained = False
+                break
+            time.sleep(0.01)
+        # flush writers BEFORE shutdown closes the sockets: close()
+        # enqueues EOF and joins, so every queued completion frame is
+        # vectored out first
+        with self._lock:
+            writers = list(self._writers)
+        for w in writers:
+            w.close()
+        self.shutdown()
+        return drained
+
+    def install_sigterm_handler(self, exit_process: bool = True,
+                                timeout: Optional[float] = None):
+        """Wire SIGTERM to a graceful drain (main thread only — the
+        signal module's contract). The handler returns immediately; a
+        daemon thread performs the drain so the signal context never
+        blocks, then — with exit_process — exits 0 (the k8s/preemption
+        contract: SIGTERM means finish what you hold and leave
+        cleanly)."""
+        import signal as _signal
+
+        def _handler(signum, frame):
+            t = threading.Thread(target=self._drain_then_exit,
+                                 args=(exit_process, timeout),
+                                 daemon=True)
+            t.start()
+
+        self._prev_sigterm = _signal.signal(_signal.SIGTERM, _handler)
+        return self
+
+    def _drain_then_exit(self, exit_process: bool, timeout):
+        try:
+            self.drain(timeout=timeout)
+            from .parallel import elastic as _elastic
+            # a co-resident elastic checkpoint writer must commit before
+            # the process goes away (same drill as Trainer's
+            # end-of-train flush)
+            _elastic.wait_for_pending(timeout)
+        except Exception as e:
+            # a timed-out flush must not kill this thread BEFORE the
+            # exit below: the SIGTERM disposition was replaced by our
+            # handler, so skipping os._exit would leave a process that
+            # ignores every further SIGTERM (undrainable zombie). The
+            # exit-0 contract holds, but the failure must be visible —
+            # operators need to tell a clean drain from a failed one
+            from .core import flags
+            flags.vlog(0, "SIGTERM drain did not complete cleanly: "
+                       "%s: %s (exiting anyway)", type(e).__name__, e)
+        if exit_process:  # pragma: no cover - exits the interpreter
+            os._exit(0)
 
     def shutdown(self):
         self._stop.set()
@@ -579,6 +667,8 @@ class EngineServer:
         # evicted (connection closed), frames for a dead connection are
         # dropped.
         writer = _BatchingWriter(conn)
+        with self._lock:
+            self._writers.append(writer)
 
         def on_done(req, tag):
             writer.offer(_encode_msg({"done": {
@@ -592,15 +682,34 @@ class EngineServer:
                     break
                 g = header["gen"]
                 tag = g.get("tag")
-                try:
-                    self.engine.submit(
-                        g["prompt"], g.get("max_new", 16),
-                        on_done=(lambda req, tag=tag: on_done(req, tag)))
+                err = None
+                admitted = False
+                # check-and-submit under the admission lock (paired with
+                # drain()'s locked flag flip): a submit can never slip in
+                # after drain decided the engine is idle
+                with self._lock:
+                    if self._draining.is_set():
+                        # graceful drain: in-flight work completes, but
+                        # nothing new is admitted — the client gets an
+                        # explicit rejection, never a silent drop
+                        err = ("server draining (SIGTERM): not "
+                               "admitting new requests")
+                    else:
+                        try:
+                            self.engine.submit(
+                                g["prompt"], g.get("max_new", 16),
+                                on_done=(lambda req, tag=tag:
+                                         on_done(req, tag)))
+                            admitted = True
+                        except Exception as e:
+                            err = f"{type(e).__name__}: {e}"
+                if admitted:
                     self._wake.set()
-                except Exception as e:
-                    writer.respond(_encode_msg(
-                        {"error": f"{type(e).__name__}: {e}",
-                         "tag": tag}))
+                else:
+                    # respond OUTSIDE the lock: it may block on writer
+                    # backpressure
+                    writer.respond(_encode_msg({"error": err,
+                                                "tag": tag}))
         except (ConnectionError, OSError):
             pass
         finally:
@@ -612,6 +721,8 @@ class EngineServer:
             with self._lock:
                 if conn in self._conns:
                     self._conns.remove(conn)
+                if writer in self._writers:
+                    self._writers.remove(writer)
 
 
 class EngineClient:

@@ -54,28 +54,20 @@ def _slice_starts(index, shape) -> List[int]:
     return starts
 
 
-def save_sharded(dirname: str, arrays: Dict[str, object],
-                 process_index: Optional[int] = None,
-                 world_size: Optional[int] = None,
-                 only_devices=None) -> str:
-    """Write this process's addressable shards of `arrays` to dirname.
-
-    world_size (default jax.process_count()) is recorded in the manifest;
-    the reader refuses a directory whose manifest count does not match it,
-    so a re-save from a SMALLER world over an old checkpoint directory
-    errors instead of silently stitching stale shard files in.
-
-    only_devices: restrict to shards living on these devices — used by
-    single-process tests to emulate the per-host split of a multi-host
-    save (in a real multi-host world addressable_shards IS that split).
-    Returns the manifest path.
-    """
+def collect_chunks(arrays: Dict[str, object],
+                   process_index: Optional[int] = None,
+                   world_size: Optional[int] = None,
+                   only_devices=None):
+    """The device→host phase of a sharded save, split out so an async
+    snapshot (parallel/elastic.py) can copy state off-device at a step
+    boundary and hand the file writes to a background thread. Returns
+    (chunks, manifest, pid): `chunks` maps chunk key → host numpy array,
+    `manifest` is the per-process manifest dict referencing them."""
     import jax
     import jax.numpy as jnp
 
     pid = jax.process_index() if process_index is None else int(process_index)
     world = jax.process_count() if world_size is None else int(world_size)
-    os.makedirs(dirname, exist_ok=True)
     chunks: Dict[str, np.ndarray] = {}
     manifest: Dict[str, dict] = {"__meta__": {"world_size": world}}
     shard_file = f"{SHARD_PREFIX}{pid}.pts"
@@ -109,7 +101,28 @@ def save_sharded(dirname: str, arrays: Dict[str, object],
                                     "file": shard_file, "key": key})
         if entry["chunks"]:
             manifest[name] = entry
+    return chunks, manifest, pid
+
+
+def _fsync_file(path: str):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def write_chunks(dirname: str, chunks: Dict[str, np.ndarray],
+                 manifest: Dict[str, dict], pid: int,
+                 fsync: bool = False) -> str:
+    """The file-write phase of a sharded save (see collect_chunks).
+    fsync=True forces shard container and manifest to stable storage
+    before returning — the elastic commit protocol requires it (the
+    COMMIT marker must never become visible before the data it names).
+    Returns the manifest path."""
     from .data.tensor_store import save_tensors
+    os.makedirs(dirname, exist_ok=True)
+    shard_file = f"{SHARD_PREFIX}{pid}.pts"
     # write-then-replace: re-saving into an existing checkpoint dir must not
     # clobber the shard container the still-valid old manifest points to if
     # we crash mid-write (the manifest swap below is only atomic if the data
@@ -121,8 +134,36 @@ def save_sharded(dirname: str, arrays: Dict[str, object],
     tmp = mpath + ".tmp"
     with open(tmp, "w") as f:
         json.dump(manifest, f)
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
     os.replace(tmp, mpath)  # atomic: a crash never clobbers a good manifest
+    if fsync:
+        _fsync_file(spath)
+        _fsync_file(dirname)
     return mpath
+
+
+def save_sharded(dirname: str, arrays: Dict[str, object],
+                 process_index: Optional[int] = None,
+                 world_size: Optional[int] = None,
+                 only_devices=None, fsync: bool = False) -> str:
+    """Write this process's addressable shards of `arrays` to dirname.
+
+    world_size (default jax.process_count()) is recorded in the manifest;
+    the reader refuses a directory whose manifest count does not match it,
+    so a re-save from a SMALLER world over an old checkpoint directory
+    errors instead of silently stitching stale shard files in.
+
+    only_devices: restrict to shards living on these devices — used by
+    single-process tests to emulate the per-host split of a multi-host
+    save (in a real multi-host world addressable_shards IS that split).
+    Returns the manifest path.
+    """
+    chunks, manifest, pid = collect_chunks(
+        arrays, process_index=process_index, world_size=world_size,
+        only_devices=only_devices)
+    return write_chunks(dirname, chunks, manifest, pid, fsync=fsync)
 
 
 class ShardedCheckpoint:
@@ -140,8 +181,18 @@ class ShardedCheckpoint:
         self.vars: Dict[str, dict] = {}
         world_sizes = set()
         for p in paths:
-            with open(p) as f:
-                m = json.load(f)
+            try:
+                with open(p) as f:
+                    m = json.load(f)
+            except (json.JSONDecodeError, UnicodeDecodeError) as e:
+                # a raw json error names neither the checkpoint nor the
+                # hazard — say what is actually wrong (an interrupted save
+                # left a truncated/corrupt manifest behind)
+                raise InvalidArgumentError(
+                    f"checkpoint dir {dirname!r}: manifest "
+                    f"{os.path.basename(p)!r} is truncated or corrupt "
+                    f"({e}) — an interrupted save? Restore from a "
+                    f"committed snapshot instead") from e
             meta = m.pop("__meta__", None)
             if meta is not None:
                 world_sizes.add(int(meta.get("world_size", len(paths))))
@@ -166,6 +217,17 @@ class ShardedCheckpoint:
                     f"save with a different process count? Save into a "
                     f"fresh directory.", exc=InvalidArgumentError)
         self._cache: Dict[tuple, np.ndarray] = {}
+        # every shard container a manifest references must exist: a clear
+        # error up front beats a per-chunk IO error mid-restore
+        missing = sorted({e["file"] for v in self.vars.values()
+                          for e in v["chunks"]
+                          if not os.path.exists(
+                              os.path.join(dirname, e["file"]))})
+        enforce(not missing,
+                f"checkpoint dir {dirname!r} is missing shard container(s) "
+                f"{missing} referenced by its manifest(s) — a partially "
+                f"written or partially deleted save. Restore from a "
+                f"committed snapshot instead", exc=InvalidArgumentError)
 
     def names(self) -> List[str]:
         return sorted(self.vars)
@@ -174,8 +236,15 @@ class ShardedCheckpoint:
         key = (c["file"], c["key"])
         if key not in self._cache:
             from .data.tensor_store import load_tensors
-            got = load_tensors(os.path.join(self.dirname, c["file"]),
-                               [c["key"]])
+            try:
+                got = load_tensors(os.path.join(self.dirname, c["file"]),
+                                   [c["key"]])
+            except (IOError, OSError, KeyError, ValueError) as e:
+                raise InvalidArgumentError(
+                    f"checkpoint dir {self.dirname!r}: shard container "
+                    f"{c['file']!r} is truncated or corrupt reading chunk "
+                    f"{c['key']!r} ({e}) — an interrupted save? Restore "
+                    f"from a committed snapshot instead") from e
             self._cache[key] = got[c["key"]]
         return self._cache[key]
 

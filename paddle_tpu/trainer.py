@@ -51,17 +51,32 @@ class EndStepEvent:
 
 
 class CheckpointConfig:
-    """≙ trainer.CheckpointConfig (reference trainer.py:100)."""
+    """≙ trainer.CheckpointConfig (reference trainer.py:100).
+
+    elastic=True routes through the atomic elastic runtime
+    (parallel/elastic.py): two-phase-committed snapshots carrying the
+    COMPLETE training state (params, sharded optimizer accumulators,
+    error-feedback residuals, RNG seed counters, parallel config), with
+    deterministic resume and dp-world resize on restore — the
+    preemption-safe mode (docs/fault_tolerance.md). async_save
+    additionally moves the file writes off the step critical path (only
+    the device→host copy runs at the step boundary)."""
 
     def __init__(self, checkpoint_dir: Optional[str] = None,
                  max_num_checkpoints: int = 3,
                  epoch_interval: int = 1,
                  step_interval: int = 10,
-                 sharded: bool = False):
+                 sharded: bool = False,
+                 elastic: bool = False,
+                 async_save: bool = False):
         self.checkpoint_dir = checkpoint_dir or \
             os.path.join(os.getcwd(), "checkpoint")
         enforce(epoch_interval >= 1 and step_interval >= 1,
                 "checkpoint intervals must be >= 1",
+                exc=InvalidArgumentError)
+        enforce(not (async_save and not elastic),
+                "async_save requires elastic=True (only the elastic "
+                "runtime has the background commit protocol)",
                 exc=InvalidArgumentError)
         self.max_num_checkpoints = max_num_checkpoints
         self.epoch_interval = epoch_interval
@@ -69,6 +84,8 @@ class CheckpointConfig:
         # sharded=True: per-process shard files via sharded_checkpoint —
         # the at-scale mode (ZeRO-1/EP state never gathered to one host)
         self.sharded = sharded
+        self.elastic = elastic
+        self.async_save = async_save
         self.epoch_id = 0
         self.step_id = 0
         self.load_serial: Optional[int] = None
@@ -249,7 +266,18 @@ class Trainer:
                                         main_program=self.train_program,
                                         scope=self.scope)
 
-        if self.checkpoint_cfg:
+        if self.checkpoint_cfg and self.checkpoint_cfg.elastic:
+            from .parallel import elastic as _elastic
+            snap = _elastic.latest_snapshot(
+                self.checkpoint_cfg.checkpoint_dir)
+            if snap is not None:
+                meta = _elastic.restore_train_state(
+                    snap, program=self.train_program, scope=self.scope,
+                    executor=self._train_executor())
+                extra = meta.get("extra", {})
+                self.checkpoint_cfg.epoch_id = int(extra.get("epoch_id", 0))
+                self.checkpoint_cfg.step_id = int(extra.get("step_id", 0))
+        elif self.checkpoint_cfg:
             args = load_checkpoint(self.exe,
                                    self.checkpoint_cfg.checkpoint_dir,
                                    self.train_program, scope=self.scope,
@@ -261,6 +289,11 @@ class Trainer:
                     get_latest_checkpoint_serial(
                         self.checkpoint_cfg.checkpoint_dir)
 
+    def _train_executor(self):
+        """The executor whose run counter drives the training seed
+        stream — what the elastic snapshot must record/restore."""
+        return self._pe if self._pe is not None else self.exe
+
     def stop(self):
         """Ask train() to exit after the current step (callable from the
         event handler — ≙ trainer.stop)."""
@@ -271,12 +304,14 @@ class Trainer:
         """Saved trainer args are the NEXT work item (resume_epoch,
         resume_step): a resumed run skips everything already trained —
         including the whole run when it had completed."""
+        from .parallel import elastic as _elastic
         feeder = DataFeeder(feed_list=[
             self.train_program.global_block().var(n) for n in feed_order])
         start_epoch = (self.checkpoint_cfg.epoch_id
                        if self.checkpoint_cfg else 0)
         skip_steps = (self.checkpoint_cfg.step_id
                       if self.checkpoint_cfg else 0)
+        elastic = bool(self.checkpoint_cfg and self.checkpoint_cfg.elastic)
         for epoch_id in range(start_epoch, num_epochs):
             event_handler(BeginEpochEvent(epoch_id))
             for step_id, batch in enumerate(reader()):
@@ -285,7 +320,18 @@ class Trainer:
                 if self.stop_flag:
                     if self.checkpoint_cfg:
                         self._save_checkpoint(epoch_id, step_id)
+                    if elastic:
+                        # the stop-checkpoint may be async: it must
+                        # commit before train() returns, or a prompt
+                        # process exit kills the writer mid-write
+                        _elastic.wait_for_pending()
                     return
+                if elastic:
+                    # PTPU_FAULT_INJECT=crash_at_step preemption point —
+                    # BEFORE the step, so the snapshot interval decides
+                    # how much work a preemption replays
+                    _elastic.maybe_crash_at_step(
+                        self._train_executor()._run_counter)
                 begin = BeginStepEvent(epoch_id, step_id)
                 event_handler(begin)
                 fetch = [m.name for m in self.metrics] \
@@ -308,6 +354,9 @@ class Trainer:
                 self._save_checkpoint(epoch_id + 1, 0)
         if self.checkpoint_cfg:
             self._save_checkpoint(num_epochs, 0)
+        if elastic:
+            # no writer thread may still hold dirty state at exit
+            _elastic.wait_for_pending()
 
     def test(self, reader: Callable, feed_order: Sequence[str]):
         """Average the metric values over the reader, on the forward-only
@@ -344,8 +393,96 @@ class Trainer:
                                  scope=self.scope)
 
     def _save_checkpoint(self, resume_epoch: int, resume_step: int):
+        if self.checkpoint_cfg.elastic:
+            from .parallel import elastic as _elastic
+            exe = self._train_executor()
+            _elastic.save_train_state(
+                self.checkpoint_cfg.checkpoint_dir,
+                program=self.train_program, scope=self.scope, executor=exe,
+                step=exe._run_counter,
+                extra_meta={"epoch_id": resume_epoch,
+                            "step_id": resume_step},
+                max_snapshots=self.checkpoint_cfg.max_num_checkpoints,
+                block=not self.checkpoint_cfg.async_save)
+            return
         save_checkpoint(
             self.exe, self.checkpoint_cfg.checkpoint_dir, self.train_program,
             trainer_args={"epoch_id": resume_epoch, "step_id": resume_step},
             max_num_checkpoints=self.checkpoint_cfg.max_num_checkpoints,
             scope=self.scope, sharded=self.checkpoint_cfg.sharded)
+
+
+class Supervisor:
+    """Retry/backoff supervisor for preemptible training processes.
+
+    The process-level half of elastic recovery (≙ the reference's
+    pserver/trainer restart story, checkpoint-mediated here): run the
+    training command as a child, and when it dies of a crash/preemption
+    (SIGKILL, OOM, nonzero exit), relaunch it after an exponential
+    backoff — the restarted run resumes from the latest COMMITTED
+    elastic snapshot (CheckpointConfig(elastic=True) or
+    parallel.elastic.restore_train_state in the child). A clean exit 0
+    ends supervision.
+
+        Supervisor([sys.executable, "train.py"], max_restarts=20).run()
+
+    Fault injection (PTPU_FAULT_INJECT, parallel/elastic.py) makes the
+    crash side testable: tests/test_elastic.py and
+    tools/recovery_smoke.py supervise children that SIGKILL themselves
+    mid-run and mid-save.
+    """
+
+    def __init__(self, argv: Sequence[str],
+                 max_restarts: int = 10,
+                 backoff_s: float = 1.0,
+                 backoff_factor: float = 2.0,
+                 max_backoff_s: float = 60.0,
+                 env: Optional[dict] = None,
+                 sleep_fn: Optional[Callable[[float], None]] = None):
+        enforce(len(argv) >= 1, "Supervisor needs a command",
+                exc=InvalidArgumentError)
+        enforce(max_restarts >= 0 and backoff_s >= 0
+                and backoff_factor >= 1.0,
+                "Supervisor: max_restarts >= 0, backoff_s >= 0, "
+                "backoff_factor >= 1 required", exc=InvalidArgumentError)
+        self.argv = list(argv)
+        self.max_restarts = max_restarts
+        self.backoff_s = backoff_s
+        self.backoff_factor = backoff_factor
+        self.max_backoff_s = max_backoff_s
+        self.env = env
+        self._sleep = sleep_fn or __import__("time").sleep
+        #: restarts performed by the last run()
+        self.restarts = 0
+        #: exit codes observed, in order (negative = killed by signal)
+        self.exit_codes: List[int] = []
+
+    def run(self) -> int:
+        """Supervise until the child exits 0 or the restart budget is
+        spent. Returns the final exit code (0 on success; the child's
+        last code — negative for a signal death — when the budget ran
+        out)."""
+        import subprocess
+        self.restarts = 0
+        self.exit_codes = []
+        delay = self.backoff_s
+        while True:
+            proc = subprocess.run(self.argv, env=self.env)
+            rc = proc.returncode
+            self.exit_codes.append(rc)
+            if rc == 0:
+                return 0
+            if self.restarts >= self.max_restarts:
+                from .core import flags
+                flags.vlog(0, "Supervisor: restart budget (%d) exhausted; "
+                           "last exit code %d", self.max_restarts, rc)
+                return rc
+            from .core import flags
+            flags.vlog(0, "Supervisor: child exited %d (%s); restart %d/%d "
+                       "after %.1fs backoff", rc,
+                       "signal" if rc < 0 else "error",
+                       self.restarts + 1, self.max_restarts, delay)
+            if delay > 0:
+                self._sleep(delay)
+            delay = min(delay * self.backoff_factor, self.max_backoff_s)
+            self.restarts += 1

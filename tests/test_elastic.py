@@ -1,0 +1,645 @@
+"""Elastic fault-tolerant training (parallel/elastic.py).
+
+Atomic two-phase-commit snapshots, async writer, deterministic resume,
+dp-world resize with error-feedback re-mapping, fault injection, the
+Supervisor retry/backoff loop, and the crash-mid-save atomicity property
+(subprocess SIGKILL at randomized byte offsets of the staged payload).
+docs/fault_tolerance.md documents the protocol these tests pin.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core.enforce import EnforceError
+from paddle_tpu.parallel import ParallelExecutor, elastic
+from paddle_tpu.parallel.mesh import DeviceMesh
+from paddle_tpu.parallel.strategy import BuildStrategy, ReduceStrategy
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RECOVERY_SMOKE = os.path.join(REPO, "tools", "recovery_smoke.py")
+
+
+def _build_model():
+    x = layers.data("x", shape=[16])
+    label = layers.data("label", shape=[1], dtype="int64")
+    h = layers.fc(x, size=32, act="relu")
+    loss = layers.mean(layers.softmax_with_cross_entropy(
+        layers.fc(h, size=4), label))
+    pt.optimizer.MomentumOptimizer(0.1, momentum=0.9).minimize(loss)
+    return loss
+
+
+def _feeds(n, batch=8):
+    rng = np.random.RandomState(0)
+    return [{"x": rng.rand(batch, 16).astype("float32"),
+             "label": rng.randint(0, 4, (batch, 1)).astype("int64")}
+            for _ in range(n)]
+
+
+def _strategy(quant=""):
+    bst = BuildStrategy()
+    bst.reduce_strategy = ReduceStrategy.ReduceScatter
+    if quant:
+        bst.quant_comm = quant
+        bst.comm_error_feedback = True
+    return bst
+
+
+def _fresh_world(dp, quant=""):
+    """(loss, pexe) over a fresh program/scope on a dp-device mesh."""
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    with pt.core.unique_name.guard():
+        loss = _build_model()
+    pexe = ParallelExecutor(loss_name=loss.name,
+                            build_strategy=_strategy(quant),
+                            mesh=DeviceMesh(jax.devices()[:dp],
+                                            {"dp": dp}))
+    pt.Executor().run(pt.default_startup_program())
+    return loss, pexe
+
+
+def _host_snapshot_args(seed=7):
+    rng = np.random.RandomState(seed)
+    return {f"w_{k}": rng.randn(16, 4).astype("f4") for k in range(3)}
+
+
+def _save_host_arrays(root, arrays, step=0, **kw):
+    """Mesh-free save: a program declaring the vars + a scope holding
+    them is all save_train_state needs."""
+    from paddle_tpu.framework.program import Program, program_guard
+    from paddle_tpu.framework.scope import Scope
+    prog, startup = Program(), Program()
+    scope = Scope()
+    with program_guard(prog, startup):
+        for name, val in arrays.items():
+            prog.global_block().create_var(name=name,
+                                           shape=list(val.shape),
+                                           dtype="float32",
+                                           persistable=True)
+            scope.set_var(name, val)
+    out = elastic.save_train_state(root, program=prog, scope=scope,
+                                   step=step, **kw)
+    return out, prog, scope
+
+
+def _restore_host_arrays(path, arrays_template, **kw):
+    from paddle_tpu.framework.program import Program, program_guard
+    from paddle_tpu.framework.scope import Scope
+    prog, startup = Program(), Program()
+    scope = Scope()
+    with program_guard(prog, startup):
+        for name, val in arrays_template.items():
+            prog.global_block().create_var(name=name,
+                                           shape=list(val.shape),
+                                           dtype="float32",
+                                           persistable=True)
+    meta = elastic.restore_train_state(path, program=prog, scope=scope,
+                                       **kw)
+    return meta, {n: np.asarray(scope.get(n)) for n in arrays_template
+                  if scope.has_var(n)}
+
+
+# ---------------------------------------------------------------------------
+# commit protocol
+# ---------------------------------------------------------------------------
+
+class TestCommitProtocol:
+    def test_commit_marker_written_last_and_validates(self, tmp_path):
+        arrays = _host_snapshot_args()
+        path, _, _ = _save_host_arrays(str(tmp_path), arrays, step=5)
+        assert os.path.basename(path).startswith(elastic.SNAPSHOT_PREFIX)
+        assert elastic.is_committed(path)
+        elastic.validate_snapshot(path)           # no raise
+        marker = json.load(open(os.path.join(path, elastic.COMMIT_MARKER)))
+        # the marker records every payload file at its exact size
+        for name, size in marker["files"].items():
+            assert os.path.getsize(os.path.join(path, name)) == size
+        meta = elastic.read_meta(path)
+        assert meta["step"] == 5 and meta["format"] == 1
+
+    def test_uncommitted_dir_skipped_and_rejected(self, tmp_path):
+        arrays = _host_snapshot_args()
+        p0, _, _ = _save_host_arrays(str(tmp_path), arrays, step=1)
+        p1, _, _ = _save_host_arrays(str(tmp_path), arrays, step=2)
+        os.unlink(os.path.join(p1, elastic.COMMIT_MARKER))
+        # latest committed is the OLDER dir: uncommitted ones are skipped
+        assert elastic.latest_snapshot(str(tmp_path)) == p0
+        meta, _ = _restore_host_arrays(str(tmp_path), arrays)
+        assert meta["step"] == 1
+        # restoring the uncommitted dir EXPLICITLY raises a clear error
+        with pytest.raises(EnforceError) as ei:
+            elastic.validate_snapshot(p1)
+        assert elastic.COMMIT_MARKER in str(ei.value)
+        assert p1 in str(ei.value)
+
+    def test_truncated_shard_rejected_naming_file(self, tmp_path):
+        arrays = _host_snapshot_args()
+        path, _, _ = _save_host_arrays(str(tmp_path), arrays)
+        shard = os.path.join(path, "shard-0.pts")
+        with open(shard, "r+b") as f:
+            f.truncate(os.path.getsize(shard) // 2)
+        with pytest.raises(EnforceError) as ei:
+            elastic.validate_snapshot(path)
+        assert "shard-0.pts" in str(ei.value)
+        assert "truncated" in str(ei.value)
+
+    def test_missing_manifest_rejected(self, tmp_path):
+        arrays = _host_snapshot_args()
+        path, _, _ = _save_host_arrays(str(tmp_path), arrays)
+        os.unlink(os.path.join(path, "manifest-0.json"))
+        with pytest.raises(EnforceError) as ei:
+            elastic.validate_snapshot(path)
+        assert "manifest-0.json" in str(ei.value)
+
+    def test_no_committed_snapshot_message(self, tmp_path):
+        arrays = _host_snapshot_args()
+        path, _, _ = _save_host_arrays(str(tmp_path), arrays)
+        os.unlink(os.path.join(path, elastic.COMMIT_MARKER))
+        with pytest.raises(EnforceError) as ei:
+            _restore_host_arrays(str(tmp_path), arrays)
+        assert "committed" in str(ei.value)
+
+    def test_retention_keeps_newest_committed(self, tmp_path):
+        arrays = _host_snapshot_args()
+        for step in range(5):
+            _save_host_arrays(str(tmp_path), arrays, step=step,
+                              max_snapshots=2)
+        snaps = elastic.list_snapshots(str(tmp_path))
+        assert len(snaps) == 2
+        assert elastic.read_meta(snaps[-1][1])["step"] == 4
+
+    def test_strict_missing_var_and_seed_mismatch(self, tmp_path):
+        arrays = _host_snapshot_args()
+        _save_host_arrays(str(tmp_path), arrays)
+        grown = dict(arrays)
+        grown["w_new"] = np.zeros((4, 4), np.float32)
+        with pytest.raises(EnforceError) as ei:
+            _restore_host_arrays(str(tmp_path), grown)
+        assert "w_new" in str(ei.value)
+        # strict=False warm-starts the missing var instead
+        meta, back = _restore_host_arrays(str(tmp_path), grown,
+                                          strict=False)
+        for k in arrays:
+            np.testing.assert_array_equal(back[k], arrays[k])
+
+    def test_fault_config_parse(self, monkeypatch):
+        monkeypatch.setenv("PTPU_FAULT_INJECT",
+                           "crash_at_step:3, slow_writer:0.5")
+        cfg = elastic.fault_injection_config()
+        assert cfg == {"crash_at_step": 3.0, "slow_writer": 0.5}
+        monkeypatch.setenv("PTPU_FAULT_INJECT", "bogus:1")
+        with pytest.raises(EnforceError):
+            elastic.fault_injection_config()
+
+
+# ---------------------------------------------------------------------------
+# async snapshot path
+# ---------------------------------------------------------------------------
+
+class TestAsyncSnapshot:
+    def test_async_copy_at_boundary_write_in_background(self, tmp_path,
+                                                        monkeypatch):
+        from paddle_tpu.observability import tracing
+        monkeypatch.setenv("PTPU_FAULT_INJECT", "slow_writer:0.3")
+        arrays = _host_snapshot_args()
+        mark = tracing.mark()
+        saves0 = elastic.metrics_registry().get(
+            "ptpu_ckpt_saves_total").value
+        handle, prog, scope = _save_host_arrays(str(tmp_path), arrays,
+                                                step=3, block=False)
+        assert isinstance(handle, elastic.AsyncSnapshot)
+        # the d2h copy already happened: mutating live state NOW must not
+        # leak into the snapshot the writer commits later
+        for name in arrays:
+            scope.set_var(name, np.zeros_like(arrays[name]))
+        path = handle.result(timeout=30)
+        assert elastic.is_committed(path)
+        _, back = _restore_host_arrays(str(tmp_path), arrays)
+        for k, v in arrays.items():
+            np.testing.assert_array_equal(back[k], v)
+        kinds = {(s.kind, s.name) for s in tracing.spans_since(mark)}
+        assert ("checkpoint", "elastic/snapshot_d2h") in kinds
+        assert ("checkpoint", "elastic/snapshot_write") in kinds
+        assert ("checkpoint", "elastic/commit") in kinds
+        reg = elastic.metrics_registry()
+        assert reg.get("ptpu_ckpt_saves_total").value == saves0 + 1
+        assert reg.get("ptpu_ckpt_save_bytes_total").value > 0
+        assert reg.get("ptpu_ckpt_save_seconds").count >= 1
+
+    def test_overlapping_async_saves_commit_distinct_serials(
+            self, tmp_path, monkeypatch):
+        """Two async saves in flight at once: serial allocation is
+        locked and the staging sweep spares live writers, so BOTH
+        commit — the second must not clobber or delete the first."""
+        monkeypatch.setenv("PTPU_FAULT_INJECT", "slow_writer:0.2")
+        arrays = _host_snapshot_args()
+        h1, _, _ = _save_host_arrays(str(tmp_path), arrays, step=1,
+                                     block=False)
+        h2, _, _ = _save_host_arrays(
+            str(tmp_path), {k: v + 1 for k, v in arrays.items()},
+            step=2, block=False)
+        p1, p2 = h1.result(timeout=30), h2.result(timeout=30)
+        assert p1 != p2
+        assert elastic.is_committed(p1) and elastic.is_committed(p2)
+        steps = {elastic.read_meta(p)["step"] for _, p in
+                 elastic.list_snapshots(str(tmp_path))}
+        assert steps == {1, 2}
+
+    def test_wait_for_pending_flushes(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PTPU_FAULT_INJECT", "slow_writer:0.2")
+        arrays = _host_snapshot_args()
+        handle, _, _ = _save_host_arrays(str(tmp_path), arrays,
+                                         block=False)
+        elastic.wait_for_pending(timeout=30)
+        assert handle.done
+        assert elastic.latest_snapshot(str(tmp_path)) is not None
+
+
+# ---------------------------------------------------------------------------
+# deterministic resume + elastic resize
+# ---------------------------------------------------------------------------
+
+class TestDeterministicResume:
+    def test_same_world_resume_is_bitwise_exact(self, tmp_path):
+        """SIGKILL-equivalent resume at the same dp: params, ZeRO-1
+        accumulator shards, int8 error-feedback residuals, and the RNG
+        run counter all round-trip, so the resumed fixed-seed loss
+        trajectory equals the uninterrupted one EXACTLY."""
+        feeds = _feeds(6)
+        loss, pexe = _fresh_world(2, quant="int8")
+        ref = []
+        for i, f in enumerate(feeds):
+            ref.append(float(pexe.run(feed=f, fetch_list=[loss])[0]))
+            if i == 2:
+                elastic.save_train_state(str(tmp_path), executor=pexe,
+                                         step=3)
+        loss, pexe2 = _fresh_world(2, quant="int8")
+        meta = elastic.restore_train_state(str(tmp_path), executor=pexe2)
+        assert meta["step"] == 3
+        assert pexe2._run_counter == meta["run_counter"]
+        got = [float(pexe2.run(feed=f, fetch_list=[loss])[0])
+               for f in feeds[3:]]
+        assert got == ref[3:], (got, ref[3:])
+
+    def test_dp_resize_2_to_4_loss_parity(self, tmp_path):
+        """N→M restore: fp32-wire trajectories agree to reduction-order
+        ulps (the r09/r11 parity regime); placement is statically
+        verified before the first step."""
+        feeds = _feeds(6)
+        loss, pexe = _fresh_world(2)
+        ref = []
+        for i, f in enumerate(feeds):
+            ref.append(float(pexe.run(feed=f, fetch_list=[loss])[0]))
+            if i == 2:
+                elastic.save_train_state(str(tmp_path), executor=pexe,
+                                         step=3)
+        loss, pexe4 = _fresh_world(4)
+        meta = elastic.restore_train_state(str(tmp_path), executor=pexe4)
+        assert meta["world"] == {"dp": 2}
+        got = [float(pexe4.run(feed=f, fetch_list=[loss])[0])
+               for f in feeds[3:]]
+        assert max(abs(a - b) for a, b in zip(ref[3:], got)) <= 1e-5
+
+    def test_restored_placement_matches_policy(self, tmp_path):
+        """Restored ZeRO-1 accumulators land dp-sharded, params
+        replicated — verified through the executor's own policy (what
+        restore_train_state enforces internally)."""
+        feeds = _feeds(3)
+        loss, pexe = _fresh_world(2)
+        for f in feeds:
+            pexe.run(feed=f, fetch_list=[loss])
+        elastic.save_train_state(str(tmp_path), executor=pexe, step=3)
+        loss, pexe4 = _fresh_world(4)
+        elastic.restore_train_state(str(tmp_path), executor=pexe4)
+        prog = pexe4.prepare_program()
+        scope = pt.global_scope()
+        assert elastic.verify_restored_placement(pexe4, prog, scope) == []
+        # and a deliberately mis-placed var is caught
+        name = next(v.name for b in prog.blocks for v in b.vars.values()
+                    if getattr(v, "dp_shard_update", False))
+        scope.set_var(name, jax.device_put(
+            np.asarray(scope.get(name)), pexe4.mesh.replicated()))
+        bad = elastic.verify_restored_placement(pexe4, prog, scope)
+        assert bad and name in bad[0]
+
+    def test_random_seed_mismatch_rejected(self, tmp_path):
+        arrays = _host_snapshot_args()
+        _, prog, scope = _save_host_arrays(str(tmp_path), arrays)
+        from paddle_tpu.framework.program import Program, program_guard
+        prog2, startup2 = Program(), Program()
+        prog2.random_seed = 1234
+        with program_guard(prog2, startup2):
+            for name, val in arrays.items():
+                prog2.global_block().create_var(
+                    name=name, shape=list(val.shape), dtype="float32",
+                    persistable=True)
+        from paddle_tpu.framework.scope import Scope
+        with pytest.raises(EnforceError) as ei:
+            elastic.restore_train_state(str(tmp_path), program=prog2,
+                                        scope=Scope())
+        assert "random_seed" in str(ei.value)
+
+
+class TestErrorFeedbackResize:
+    def test_resize_rows_pad_fold_identity(self):
+        rows = np.arange(12, dtype=np.float32).reshape(4, 3) + 1.0
+        up = elastic._resize_replica_rows(rows, 8)
+        assert up.shape == (8, 3)
+        np.testing.assert_array_equal(up[:4], rows * 2.0)  # scaled M/N
+        np.testing.assert_array_equal(up[4:], 0.0)
+        back = elastic._resize_replica_rows(up, 4)
+        np.testing.assert_array_equal(back, rows)  # exact round trip
+        # shrink folds rows modulo M, preserving the effective mass:
+        # (1/N)·Σ == (1/M)·Σ' exactly for power-of-two ratios
+        down = elastic._resize_replica_rows(rows, 2)
+        np.testing.assert_array_equal(
+            down, (rows[:2] + rows[2:]) * np.float32(0.5))
+        assert np.sum(down) / 2 == np.sum(rows) / 4
+
+    def test_ef_state_n_to_m_to_n_round_trip(self, tmp_path):
+        """The satellite parity bar: snapshot at dp2 (int8 + error
+        feedback), restore onto dp4, snapshot again, restore back onto
+        dp2 — params, optimizer accumulators AND error-feedback
+        residuals come back bit-exact (pad-then-fold identity at a
+        power-of-two ratio)."""
+        feeds = _feeds(4)
+        loss, pexe = _fresh_world(2, quant="int8")
+        for f in feeds:
+            pexe.run(feed=f, fetch_list=[loss])
+        root_a = str(tmp_path / "a")
+        root_b = str(tmp_path / "b")
+        elastic.save_train_state(root_a, executor=pexe, step=4)
+        from paddle_tpu.sharded_checkpoint import ShardedCheckpoint
+        snap_a = elastic.latest_snapshot(root_a)
+        orig = {n: ShardedCheckpoint(snap_a).read(n)
+                for n in ShardedCheckpoint(snap_a).names()}
+        ef_names_2 = [n for n in orig if n.startswith("dp_comm_err")]
+        assert ef_names_2, "test premise: error-feedback state exists"
+        assert any(np.abs(orig[n]).max() > 0 for n in ef_names_2), \
+            "test premise: residuals are non-trivial"
+
+        # dp2 -> dp4: restore, snapshot WITHOUT stepping
+        loss, pexe4 = _fresh_world(4, quant="int8")
+        elastic.restore_train_state(root_a, executor=pexe4)
+        elastic.save_train_state(root_b, executor=pexe4, step=4)
+        meta_b = elastic.read_meta(root_b)
+        assert meta_b["ef_layout"]["dp"] == 4
+        # EF var names are layout-digested: the dp4 snapshot holds
+        # DIFFERENT vars than the dp2 one
+        snap_b = elastic.latest_snapshot(root_b)
+        ef_names_4 = [n for n in ShardedCheckpoint(snap_b).names()
+                      if n.startswith("dp_comm_err")]
+        assert ef_names_4 and set(ef_names_4) != set(ef_names_2)
+
+        # dp4 -> dp2: every piece of state returns bit-exact
+        loss, pexe2 = _fresh_world(2, quant="int8")
+        elastic.restore_train_state(root_b, executor=pexe2)
+        scope = pt.global_scope()
+        for name, want in orig.items():
+            got = np.asarray(scope.get(name))
+            np.testing.assert_array_equal(
+                got, want, err_msg=f"{name} did not round-trip")
+
+    def test_quant_config_mismatch_rejected(self, tmp_path):
+        feeds = _feeds(2)
+        loss, pexe = _fresh_world(2, quant="int8")
+        for f in feeds:
+            pexe.run(feed=f, fetch_list=[loss])
+        elastic.save_train_state(str(tmp_path), executor=pexe, step=2)
+        loss, pexe_b = _fresh_world(2, quant="bf16")
+        with pytest.raises(EnforceError) as ei:
+            elastic.restore_train_state(str(tmp_path), executor=pexe_b)
+        assert "quant" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# trainer integration + supervisor
+# ---------------------------------------------------------------------------
+
+class TestTrainerIntegration:
+    def _trainer(self, tmp_path, **cfg_kw):
+        from paddle_tpu.trainer import CheckpointConfig, Trainer
+
+        def train_func():
+            x = layers.data("x", shape=[4])
+            y = layers.fc(x, size=2)
+            return layers.reduce_mean(y)
+
+        def opt_func():
+            return pt.optimizer.SGD(learning_rate=0.01)
+
+        cfg = CheckpointConfig(checkpoint_dir=str(tmp_path),
+                               step_interval=2, elastic=True, **cfg_kw)
+        # fresh name generator per construction: the resumed trainer must
+        # rebuild the SAME var names the saving trainer used
+        with pt.core.unique_name.guard():
+            return Trainer(train_func, opt_func,
+                           checkpoint_config=cfg), cfg
+
+    def test_elastic_trainer_resumes_step(self, tmp_path):
+        def reader():
+            rng = np.random.RandomState(3)
+            for _ in range(6):
+                yield [(rng.rand(4).astype("f4"),)]
+
+        pt.reset_default_programs()
+        pt.reset_global_scope()
+        trainer, cfg = self._trainer(tmp_path)
+        seen = []
+        trainer.train(num_epochs=1, event_handler=lambda e: seen.append(e),
+                      reader=reader, feed_order=["x"])
+        assert elastic.latest_snapshot(str(tmp_path)) is not None
+        meta = elastic.read_meta(str(tmp_path))
+        assert meta["extra"]["epoch_id"] == 1
+        # a new trainer over the same dir resumes past the trained work
+        pt.reset_default_programs()
+        pt.reset_global_scope()
+        trainer2, cfg2 = self._trainer(tmp_path)
+        assert cfg2.epoch_id == 1
+        steps = []
+        trainer2.train(num_epochs=1,
+                       event_handler=lambda e: steps.append(e),
+                       reader=reader, feed_order=["x"])
+        from paddle_tpu.trainer import BeginStepEvent
+        assert not any(isinstance(e, BeginStepEvent) for e in steps)
+
+    def test_async_save_requires_elastic(self, tmp_path):
+        from paddle_tpu.trainer import CheckpointConfig
+        with pytest.raises(EnforceError):
+            CheckpointConfig(checkpoint_dir=str(tmp_path),
+                             async_save=True)
+
+
+class TestSupervisor:
+    def test_restarts_with_backoff_until_success(self, tmp_path):
+        from paddle_tpu.trainer import Supervisor
+        marker = str(tmp_path / "attempts")
+        prog = (f"import os,sys\n"
+                f"p={marker!r}\n"
+                f"n=int(open(p).read()) if os.path.exists(p) else 0\n"
+                f"open(p,'w').write(str(n+1))\n"
+                f"sys.exit(0 if n >= 2 else 9)\n")
+        delays = []
+        sup = Supervisor([sys.executable, "-c", prog], max_restarts=5,
+                         backoff_s=0.1, backoff_factor=2.0,
+                         sleep_fn=delays.append)
+        assert sup.run() == 0
+        assert sup.restarts == 2
+        assert sup.exit_codes == [9, 9, 0]
+        assert delays == [0.1, 0.2]
+
+    def test_budget_exhaustion_returns_last_code(self):
+        from paddle_tpu.trainer import Supervisor
+        delays = []
+        sup = Supervisor([sys.executable, "-c", "import sys; sys.exit(7)"],
+                         max_restarts=1, backoff_s=0.05,
+                         sleep_fn=delays.append)
+        assert sup.run() == 7
+        assert sup.exit_codes == [7, 7]
+
+
+# ---------------------------------------------------------------------------
+# subprocess crash tests (real SIGKILL through PTPU_FAULT_INJECT)
+# ---------------------------------------------------------------------------
+
+def _child_env(fault=None):
+    env = dict(os.environ)
+    env.pop("PTPU_FAULT_INJECT", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    if fault:
+        env["PTPU_FAULT_INJECT"] = fault
+    return env
+
+
+def _run_atomic_child(root, fault=None, timeout=120):
+    return subprocess.run(
+        [sys.executable, RECOVERY_SMOKE, "--atomic-child", "--root",
+         str(root)] + (["--fault", fault] if fault else []),
+        env=_child_env(), timeout=timeout).returncode
+
+
+class TestCrashMidSaveAtomicity:
+    """The property the two-phase commit exists for: SIGKILL the writer
+    at ANY byte offset of the staged payload — every surviving directory
+    either restores exactly (a committed generation) or is cleanly
+    skipped/rejected; a partially written generation is NEVER
+    restorable. The child commits generation 0, then saves generation 1
+    under the fault."""
+
+    def _check_surviving_state(self, root):
+        arrays0 = _host_snapshot_args()       # the child's generation 0
+        snaps = elastic.list_snapshots(str(root), committed_only=True)
+        assert len(snaps) >= 1, "generation 0 must have survived"
+        for _, path in elastic.list_snapshots(str(root),
+                                              committed_only=False):
+            if not elastic.is_committed(path):
+                with pytest.raises(EnforceError):
+                    elastic.validate_snapshot(path)   # cleanly rejected
+            else:
+                elastic.validate_snapshot(path)
+        latest = elastic.latest_snapshot(str(root))
+        meta, back = _restore_host_arrays(latest, arrays0)
+        if meta["step"] == 0:
+            expect = arrays0
+        else:
+            assert meta["step"] == 1
+            expect = {k: v + 1.0 for k, v in arrays0.items()}
+        for k, v in expect.items():
+            np.testing.assert_array_equal(back[k], v)
+
+    def test_killed_at_randomized_offsets(self, tmp_path):
+        # learn the payload size from an unfaulted run
+        ref_root = tmp_path / "ref"
+        assert _run_atomic_child(ref_root) == 0
+        snaps = elastic.list_snapshots(str(ref_root))
+        assert len(snaps) == 2
+        marker = json.load(open(os.path.join(snaps[-1][1],
+                                             elastic.COMMIT_MARKER)))
+        total = sum(marker["files"].values())
+
+        rng = np.random.RandomState(20260804)
+        offsets = sorted({0, total // 2, total, total + 1,
+                          *rng.randint(1, total, size=3)})
+        for off in offsets:
+            root = tmp_path / f"off{off}"
+            rc = _run_atomic_child(root, fault=f"crash_mid_save:{off}")
+            assert rc == -9, f"offset {off}: child exited {rc}, " \
+                             f"expected SIGKILL"
+            self._check_surviving_state(root)
+            committed = {elastic.read_meta(p)["step"] for _, p in
+                         elastic.list_snapshots(str(root))}
+            if off <= total:
+                assert committed == {0}, \
+                    f"offset {off}: generation 1 committed early"
+            else:
+                assert committed == {0, 1}, \
+                    f"offset {off}: post-commit kill lost generation 1"
+
+
+class TestKillMidRunRecovery:
+    """The acceptance bar: SIGKILL a real training process mid-run,
+    restart, restore the latest committed snapshot, and reproduce the
+    uninterrupted fixed-seed loss trajectory — exactly at the same dp,
+    within the fp32 parity band after an N→M dp resize."""
+
+    STEPS = 6
+    CRASH = 4
+
+    def _run_train_child(self, root, out, dp=2, fault=None, timeout=240):
+        return subprocess.run(
+            [sys.executable, RECOVERY_SMOKE, "--child", "--root",
+             str(root), "--out", str(out), "--dp", str(dp),
+             "--steps", str(self.STEPS), "--snap_every", "2"],
+            env=_child_env(fault), timeout=timeout).returncode
+
+    def _losses(self, out):
+        got = {}
+        with open(out) as f:
+            for line in f:
+                row = json.loads(line)
+                got[row["step"]] = row["loss"]
+        return got
+
+    def test_sigkill_restart_and_resize(self, tmp_path):
+        ref_out = tmp_path / "ref.jsonl"
+        assert self._run_train_child(tmp_path / "ref", ref_out) == 0
+        ref = self._losses(ref_out)
+        assert sorted(ref) == list(range(self.STEPS))
+
+        # crash a run mid-step-stream, then restart twice from copies:
+        # once at the same dp (exact), once resized to dp4 (parity band)
+        root = tmp_path / "crash"
+        out = tmp_path / "crash.jsonl"
+        rc = self._run_train_child(root, out,
+                                   fault=f"crash_at_step:{self.CRASH}")
+        assert rc == -9, f"expected SIGKILL death, got {rc}"
+        import shutil as _sh
+        root4 = tmp_path / "crash4"
+        out4 = tmp_path / "crash4.jsonl"
+        _sh.copytree(root, root4)
+        _sh.copy(out, out4)
+
+        assert self._run_train_child(root, out, dp=2) == 0
+        got = self._losses(out)
+        assert all(got[i] == ref[i] for i in range(self.STEPS)), \
+            f"same-dp resume not exact: {got} vs {ref}"
+
+        assert self._run_train_child(root4, out4, dp=4) == 0
+        got4 = self._losses(out4)
+        worst = max(abs(got4[i] - ref[i]) for i in range(self.STEPS))
+        assert worst <= 1e-5, f"dp4 resume parity {worst} > 1e-5"
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-x", "-q"]))

@@ -397,3 +397,52 @@ class TestTransportV2:
                     break
                 time.sleep(0.1)
             assert threading.active_count() <= before
+
+
+class TestGracefulDrain:
+    """EngineServer SIGTERM drain (the robustness satellite): stop
+    admitting, finish in-flight generations, flush the writer threads,
+    exit cleanly."""
+
+    def test_drain_idle_server_immediate(self, shared_eng):
+        srv = EngineServer(shared_eng).start()
+        assert srv.drain(timeout=10) is True
+        assert srv._stop.is_set()
+
+    def test_sigterm_finishes_in_flight_and_rejects_new(self, shared_eng):
+        import os
+        import signal
+        import time
+
+        srv = EngineServer(shared_eng).start()
+        srv.install_sigterm_handler(exit_process=False)
+        try:
+            with EngineClient(*srv.address) as c:
+                tag = c.send_gen([5], max_new=12)
+                deadline = time.time() + 10
+                while (shared_eng.n_active == 0
+                       and shared_eng.n_pending == 0):
+                    assert time.time() < deadline, "never admitted"
+                    time.sleep(0.005)
+                os.kill(os.getpid(), signal.SIGTERM)
+                while not srv._draining.is_set():
+                    assert time.time() < deadline, "drain never started"
+                    time.sleep(0.005)
+                # new work is rejected with an explicit draining error...
+                c.send_gen([6], max_new=2)
+                with pytest.raises(RuntimeError, match="draining"):
+                    c.recv_done()
+                # ...while the in-flight generation completes in full and
+                # its frame is flushed before the socket closes
+                got_tag, tokens, _ = c.recv_done()
+                assert got_tag == tag
+                assert len(tokens) == 12
+            deadline = time.time() + 15
+            while not srv._stop.is_set():
+                assert time.time() < deadline, "drain never shut down"
+                time.sleep(0.01)
+            assert shared_eng.n_active == 0 and shared_eng.n_pending == 0
+        finally:
+            if srv._prev_sigterm is not None:
+                signal.signal(signal.SIGTERM, srv._prev_sigterm)
+            srv.shutdown()

@@ -154,6 +154,51 @@ class TestReshardRestore:
         np.testing.assert_array_equal(got, w[2:14])
 
 
+class TestCorruptCheckpointRejection:
+    """Partially written checkpoints surface CLEAR enforce errors naming
+    the directory and the damaged piece — never a raw JSON/IO error
+    (the elastic commit protocol's reject-side, docs/fault_tolerance.md)."""
+
+    def _saved(self, tmp_path):
+        save_sharded(str(tmp_path),
+                     {"w": jnp.arange(32, dtype=jnp.float32).reshape(8, 4)})
+        return str(tmp_path)
+
+    def test_truncated_manifest_names_file(self, tmp_path):
+        import os
+        d = self._saved(tmp_path)
+        mpath = os.path.join(d, "manifest-0.json")
+        with open(mpath, "r+") as f:
+            f.truncate(os.path.getsize(mpath) // 2)
+        with pytest.raises(Exception) as ei:
+            ShardedCheckpoint(d)
+        msg = str(ei.value)
+        assert "manifest-0.json" in msg and "truncated" in msg
+        assert d in msg
+
+    def test_missing_shard_container_named_up_front(self, tmp_path):
+        import os
+        d = self._saved(tmp_path)
+        os.unlink(os.path.join(d, "shard-0.pts"))
+        with pytest.raises(Exception) as ei:
+            ShardedCheckpoint(d)
+        msg = str(ei.value)
+        assert "shard-0.pts" in msg and "missing" in msg
+
+    def test_truncated_shard_container_clear_error(self, tmp_path):
+        import os
+        d = self._saved(tmp_path)
+        spath = os.path.join(d, "shard-0.pts")
+        with open(spath, "r+b") as f:
+            f.truncate(os.path.getsize(spath) // 2)
+        ckpt = ShardedCheckpoint(d)
+        with pytest.raises(Exception) as ei:
+            ckpt.read("w")
+        msg = str(ei.value)
+        assert "shard-0.pts" in msg
+        assert "truncated or corrupt" in msg
+
+
 class TestIoIntegration:
     def test_save_load_persistables_sharded(self, tmp_path):
         """io.save_persistables(sharded=True) end to end through a real

@@ -176,6 +176,82 @@ def _apply_config(prog, name, args):
     return prog, None
 
 
+def _restore_diagnostics(prog, args):
+    """--restore_dir: statically check that an elastic snapshot restores
+    onto THIS program/config (parallel/elastic.py; the run_ci.sh recovery
+    stanza's lint half). Emitted as error-severity diagnostics:
+
+      restore-uncommitted     no committed snapshot / integrity failure
+      restore-missing-var     program declares state the snapshot lacks
+      restore-shape-mismatch  saved shape != declared shape
+      restore-dp-indivisible  a ZeRO-1-sharded var cannot split over --dp
+      restore-ef-unmappable   error-feedback state cannot re-map N→M
+
+    verify_program over the (rewritten) program runs as part of the
+    normal lint — a clean report therefore means "the restored program's
+    sharded-state placement passes verify_program AND the snapshot's
+    contents fit it"."""
+    from paddle_tpu.core.enforce import EnforceError
+    from paddle_tpu.framework.analysis import Diagnostic
+    from paddle_tpu.io import _is_persistable, _select_vars
+    from paddle_tpu.parallel import elastic
+    from paddle_tpu.sharded_checkpoint import ShardedCheckpoint
+
+    diags = []
+    try:
+        snap = elastic._resolve_snapshot_dir(args.restore_dir)
+        elastic.validate_snapshot(snap)
+    except EnforceError as e:
+        return [Diagnostic("restore-uncommitted", args.restore_dir, str(e))]
+    meta = elastic.read_meta(snap)
+    ckpt = ShardedCheckpoint(snap)
+    saved = ckpt.vars
+    dp = args.dp if args.dp >= 2 else int(meta.get("world", {})
+                                          .get("dp", 1))
+    new_ef = elastic._ef_layout(prog)
+    old_ef = meta.get("ef_layout")
+    ef_vars = {t["var"] for t in (new_ef or {}).get("transfers", ())}
+    if new_ef is not None:
+        if old_ef is None:
+            diags.append(Diagnostic(
+                "restore-ef-unmappable", snap,
+                "program carries error-feedback state but the snapshot "
+                "recorded no ef_layout"))
+        else:
+            old_grads = {g for t in old_ef["transfers"]
+                         for g in t["grads"]}
+            lost = sorted({g for t in new_ef["transfers"]
+                           for g in t["grads"]} - old_grads)
+            if lost:
+                diags.append(Diagnostic(
+                    "restore-ef-unmappable", snap,
+                    f"no saved residuals for gradient(s) {lost[:4]}"))
+    for v in _select_vars(prog, _is_persistable):
+        if v.name in ef_vars or getattr(v, "dp_replica_state", False):
+            continue  # re-mapped from ef_layout, not restored by name
+        entry = saved.get(v.name)
+        if entry is None:
+            diags.append(Diagnostic(
+                "restore-missing-var", snap,
+                f"program declares persistable {v.name!r} but the "
+                f"snapshot lacks it"))
+            continue
+        decl = list(v.shape or ())
+        if decl and -1 not in decl and list(entry["shape"]) != decl:
+            diags.append(Diagnostic(
+                "restore-shape-mismatch", snap,
+                f"{v.name!r}: saved {entry['shape']} vs declared {decl}"))
+            continue
+        if getattr(v, "dp_shard_update", False) and dp >= 2:
+            if not entry["shape"] or entry["shape"][0] % dp != 0:
+                diags.append(Diagnostic(
+                    "restore-dp-indivisible", snap,
+                    f"ZeRO-1-sharded {v.name!r} dim0 "
+                    f"{entry['shape'] and entry['shape'][0]} does not "
+                    f"split over dp={dp}"))
+    return diags
+
+
 def lint_one(name, build, args):
     """Returns the per-model report dict (the --json row)."""
     import paddle_tpu as pt
@@ -214,6 +290,8 @@ def lint_one(name, build, args):
     t1 = time.time()
     res = analysis.infer_program(prog)
     diags = analysis.verify_program(prog) + res.diagnostics
+    if args.restore_dir:
+        diags += _restore_diagnostics(prog, args)
     shard_res = None
     if args.tp >= 2 or _sharding.has_tp_annotations(prog):
         shard_res = _sharding.propagate_sharding(
@@ -337,6 +415,14 @@ def main():
                         "transformer_lm_tp) and lint the spliced program; "
                         "the propagated sharding-spec table prints per "
                         "sharded var")
+    p.add_argument("--restore_dir", default="",
+                   help="elastic snapshot dir (or root of snapshot-* "
+                        "dirs, parallel/elastic.py): statically verify "
+                        "the snapshot restores onto this model/config — "
+                        "commit integrity, every declared persistable "
+                        "present at its declared shape, ZeRO-1 dim0 "
+                        "divisibility at --dp, error-feedback "
+                        "re-mappability (the run_ci.sh recovery stanza)")
     p.add_argument("--max_shard_rows", type=int, default=24)
     p.add_argument("--max_diags", type=int, default=40)
     args = p.parse_args()

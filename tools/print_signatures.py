@@ -55,6 +55,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.observability.ledger",
     "paddle_tpu.parallel",
     "paddle_tpu.parallel.collective",
+    "paddle_tpu.parallel.elastic",
     "paddle_tpu.parallel.grad_comm",
     "paddle_tpu.parallel.pipeline",
     "paddle_tpu.data",

@@ -334,6 +334,21 @@ assert "ptpu_engine_tick_latency_seconds_count" in text
 print("observability smoke OK")
 PY
 
+echo "== recovery smoke (kill -9 mid-run, dp resize, fixed-seed parity) =="
+# the elastic fault-tolerance runtime end to end (parallel/elastic.py,
+# docs/fault_tolerance.md): a supervised child SIGKILLs itself mid-run and
+# resumes BITWISE-exact from the latest committed snapshot; a second crashed
+# run restarts with dp resized 2 -> 4 and matches the uninterrupted
+# fixed-seed loss trajectory within the fp32 parity band; a kill DURING a
+# snapshot write leaves only an uncommitted dir that restore skips. Then
+# lint the restored program's sharded-state placement against the resized
+# snapshot (exit 1 on any restore-* or verify_program diagnostic).
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python tools/recovery_smoke.py --keep_root /tmp/ptpu_recovery_ci
+JAX_PLATFORMS=cpu python tools/lint_program.py --model mnist \
+    --optimizer momentum --dp 4 --restore_dir /tmp/ptpu_recovery_ci/b
+rm -rf /tmp/ptpu_recovery_ci
+
 echo "== serving-engine smoke =="
 # continuous-batching engine end to end: submit through the RPC server,
 # decode over the slot cache, check a mid-batch join completes (fast:

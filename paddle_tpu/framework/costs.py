@@ -128,6 +128,42 @@ def collective_wire_bytes(kind: str, out_bytes: int, n_devices: int) -> float:
     }[kind]
 
 
+def reshard_wire_bytes(nbytes: int, old_factors, new_factors) -> float:
+    """Per-device interconnect bytes of the CANONICAL mesh-resize
+    redistribution of one array (parallel/reshard.py emits the matching
+    schedule; elastic restore is its checkpoint-mediated form):
+
+    - a dim whose new shard factor is a multiple of its current one
+      refines by dynamic-slice — 0 wire;
+    - every remaining incompatible dim all-gathers over its old group
+      (ring accounting, `collective_wire_bytes`), output priced at the
+      CURRENT factors of the other dims (refinement first — the
+      memory-efficient ordering), then slices to the new factor.
+
+    Closed-form twin of reshard.schedule_steps: the step-priced schedule
+    and this prediction must agree exactly (pinned by test)."""
+    cur = list(old_factors)
+    new = list(new_factors)
+    if len(cur) != len(new):
+        raise ValueError(f"reshard_wire_bytes: factor ranks differ "
+                         f"({len(cur)} vs {len(new)})")
+    for d in range(len(cur)):
+        if new[d] % max(cur[d], 1) == 0:
+            cur[d] = new[d]
+    total = 0.0
+    for d in range(len(cur)):
+        if cur[d] == new[d]:
+            continue
+        others = 1
+        for d2 in range(len(cur)):
+            if d2 != d:
+                others *= cur[d2]
+        out = nbytes // others
+        total += collective_wire_bytes("all-gather", out, cur[d])
+        cur[d] = new[d]
+    return total
+
+
 def census_wire_bytes(census: Dict[str, list], n_devices: int,
                       min_bytes: int = 0) -> float:
     """Total per-device interconnect bytes for one step, from a

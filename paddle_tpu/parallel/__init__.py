@@ -26,3 +26,6 @@ from .auto_shard import annotate_tp  # noqa: F401
 from . import elastic  # noqa: F401
 from .elastic import (latest_snapshot, restore_train_state,  # noqa: F401
                       save_train_state)
+from . import process_world  # noqa: F401
+from .process_world import ProcessWorld  # noqa: F401
+from . import reshard  # noqa: F401

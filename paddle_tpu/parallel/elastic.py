@@ -24,20 +24,38 @@ lives on them — and this module makes that crash-safe and elastic:
   pays only the d2h copy. Every phase records a "checkpoint" span
   (observability/tracing.py) and save/restore durations + bytes land in
   this module's MetricsRegistry.
-- `restore_train_state` is ELASTIC: given an executor over a DIFFERENT
-  dp world (N→M replicas), each array is re-placed via
+- MULTI-WRITER saves run the CHIEF-COMMITS BARRIER over a simulated
+  process world (`save_train_state(world=ProcessWorld(N))`,
+  parallel/process_world.py): every rank stages + fsyncs its OWN shard
+  files in a rank-private staging dir and acks a per-file digest
+  manifest to the chief; the chief waits with a deadline, binds every
+  rank's manifest into ONE COMMIT record, and a single atomic rename
+  makes the snapshot visible. A SIGKILL of any rank (chief included) at
+  any phase, a straggler past the deadline, or a torn shard file leaves
+  either a fully-restorable snapshot or a cleanly-rejected one — never a
+  half-write; aborts are counted and training continues.
+- `restore_train_state` is ELASTIC across ARBITRARY mesh changes: given
+  an executor over a DIFFERENT dp × pp × tp world (dp2×tp2 → dp4,
+  dp2×pp2 → dp2×tp2), each array is re-placed via
   `jax.make_array_from_callback` onto the new mesh (the r08 kill-switch
   state reconciliation, generalized across process boundaries), ZeRO-1
   optimizer slices re-shard automatically from their full-shape chunks,
-  and error-feedback residuals are re-mapped N→M with the pending
-  gradient mass preserved (see `_resize_replica_rows`). Before the first
-  step the restored program's placement is verified statically through
-  the r10/r13 analyzer (`verify_program`) and every restored array's
+  and error-feedback residuals are re-mapped across dp AND tp changes
+  with the pending gradient mass preserved (see `_resize_replica_rows` /
+  `_remap_error_feedback`). The re-layout is planned up front
+  (parallel/reshard.py): per-variable read ranges + the equivalent
+  collective redistribution schedule, validated exactly against
+  `framework.costs.reshard_wire_bytes`. Before the first step the
+  restored program's placement is verified statically through the
+  r10/r13 analyzer (`verify_program`) and every restored array's
   sharding is checked against the executor's placement policy.
 - `PTPU_FAULT_INJECT` makes preemption recovery TESTABLE: crash-at-step,
   crash-mid-save (SIGKILL at a chosen byte offset of the snapshot
-  payload), slow-writer. tests/test_elastic.py and
-  tools/recovery_smoke.py kill real processes through it.
+  payload), slow-writer, and the world-aware per-rank/per-phase
+  directives (crash_rank/drop_rank/straggle_rank,
+  process_world.world_fault_plan). tests/test_elastic.py,
+  tests/test_process_world.py and tools/recovery_smoke.py kill real
+  processes through it.
 
 Grounding (PAPERS.md): the ZeRO-1 shard layout that must round-trip is
 "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
@@ -49,11 +67,17 @@ Directory layout (docs/fault_tolerance.md):
 
     <root>/
       snapshot-00000003/          committed snapshot, serial 3
-        shard-0.pts               this process's chunks (tensor_store)
-        manifest-0.json           chunk -> global-offset map
-        train_meta.json           step/seed counters, strategy, EF layout
-        COMMIT                    atomic commit marker + integrity record
-      .tmp-00000004-1234/         staging dir of an interrupted save
+        shard-<r>.pts             rank r's chunks (tensor_store)
+        manifest-<r>.json         rank r's chunk -> global-offset map
+        train_meta.json           step/seed counters, strategy, EF
+                                  layout, per-var placements
+        COMMIT                    atomic commit marker + integrity
+                                  record (per-file sizes AND crc32
+                                  digests, commit timestamp, world)
+      .tmp-00000004-1234/         staging dir of an interrupted
+                                  single-writer save
+      .tmp-00000004-rank2/        rank 2's private staging (barrier)
+      .tmp-00000004-world1234/    the chief's assembly dir (barrier)
 """
 
 from __future__ import annotations
@@ -65,6 +89,7 @@ import shutil
 import signal
 import threading
 import time
+import zlib
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -72,11 +97,22 @@ import numpy as np
 from ..core import flags
 from ..core.enforce import InvalidArgumentError, NotFoundError, enforce
 
+class SnapshotDigestError(InvalidArgumentError):
+    """A committed snapshot file's content digest disagrees with the
+    COMMIT integrity record (silent corruption after commit) — its own
+    type so tooling (lint_program --restore_dir) classifies it
+    structurally, not by matching error text."""
+
+
 SNAPSHOT_PREFIX = "snapshot-"
 STAGING_PREFIX = ".tmp-"
 COMMIT_MARKER = "COMMIT"
 META_FILE = "train_meta.json"
-META_FORMAT = 1
+META_FORMAT = 2
+#: version of the COMMIT integrity record THIS reader understands; a
+#: snapshot committed by a newer protocol is skipped (warn-once), never
+#: half-understood
+COMMIT_FORMAT = 2
 
 
 # ---------------------------------------------------------------------------
@@ -96,7 +132,12 @@ def fault_injection_config() -> Dict[str, float]:
                             before touching disk (widens the async
                             window; exercises drain paths)
 
+    World-aware directives (crash_rank/drop_rank/straggle_rank) are
+    parsed by process_world.world_fault_plan — they pass through here
+    unchecked so one env var carries both families.
+
     Parsed per call — tests flip the env var between runs."""
+    from .process_world import WORLD_DIRECTIVES
     raw = os.environ.get("PTPU_FAULT_INJECT", "")
     out: Dict[str, float] = {}
     if not raw:
@@ -109,6 +150,8 @@ def fault_injection_config() -> Dict[str, float]:
                 f"PTPU_FAULT_INJECT directive {part!r} must be "
                 f"`name:value`", exc=InvalidArgumentError)
         name, val = part.split(":", 1)
+        if name in WORLD_DIRECTIVES:
+            continue  # structured values, owned by process_world
         enforce(name in ("crash_at_step", "crash_mid_save", "slow_writer"),
                 f"unknown PTPU_FAULT_INJECT directive {name!r}",
                 exc=InvalidArgumentError)
@@ -142,23 +185,14 @@ def _payload_files(staging: str) -> List[str]:
 def _crash_mid_staging(staging: str, offset: int) -> bool:
     """crash_mid_save with offset inside the payload: make the staging
     dir look exactly as if the writer died `offset` bytes into its
-    sequential write — truncate the file holding that offset, remove
-    everything after it — then SIGKILL. Returns False when the offset
-    lies beyond the payload (the caller crashes later in the protocol)."""
-    names = _payload_files(staging)
-    sizes = [os.path.getsize(os.path.join(staging, n)) for n in names]
-    total = sum(sizes)
-    if offset >= total:
+    sequential write (sharded_checkpoint.truncate_payload_at — shared
+    with the world-aware crash_rank stage faults), then SIGKILL.
+    Returns False when the offset lies beyond the payload (the caller
+    crashes later in the protocol)."""
+    from ..sharded_checkpoint import truncate_payload_at
+    if not truncate_payload_at(staging, offset,
+                               exclude=(COMMIT_MARKER,)):
         return False
-    cum = 0
-    for i, (n, sz) in enumerate(zip(names, sizes)):
-        if offset < cum + sz:
-            with open(os.path.join(staging, n), "r+b") as f:
-                f.truncate(offset - cum)
-            for later in names[i + 1:]:
-                os.unlink(os.path.join(staging, later))
-            break
-        cum += sz
     _sigkill_self()  # pragma: no cover
     return True
 
@@ -185,6 +219,20 @@ def metrics_registry():
             r.counter("ptpu_ckpt_save_bytes_total",
                       "Payload bytes written across committed snapshots.")
             r.counter("ptpu_ckpt_restores_total", "Snapshots restored.")
+            r.counter("ptpu_ckpt_barrier_aborts_total",
+                      "Multi-rank snapshot attempts aborted at the "
+                      "chief's barrier (straggler past the deadline or a "
+                      "dead rank); training continues, the snapshot is "
+                      "discarded.")
+            r.counter("ptpu_ckpt_skipped_foreign_total",
+                      "Snapshot dirs skipped during latest-snapshot "
+                      "selection because their COMMIT record was written "
+                      "by a newer protocol/world config than this "
+                      "process understands.")
+            r.counter("ptpu_ckpt_digest_failures_total",
+                      "Snapshot files whose content digest disagreed "
+                      "with the COMMIT integrity record (silent "
+                      "bit-flips caught at validate/restore).")
             r.histogram("ptpu_ckpt_save_seconds",
                         "Wall time of the write+commit phase.",
                         buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
@@ -215,10 +263,22 @@ def is_committed(dirname: str) -> bool:
     return os.path.exists(os.path.join(dirname, COMMIT_MARKER))
 
 
+def file_digest(path: str) -> str:
+    """Content digest recorded per file in the COMMIT integrity record:
+    crc32 over the full file, rendered as 8 hex chars. Catches the
+    silent bit-flips a size check cannot (cheap enough to verify on
+    every restore)."""
+    crc = 0
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(block, crc)
+    return f"{crc & 0xFFFFFFFF:08x}"
+
+
 def list_snapshots(root: str, committed_only: bool = True):
-    """[(serial, path)] ascending. committed_only=True (the default —
-    restore's view) skips snapshot dirs without a COMMIT marker: an
-    interrupted save must never be picked as "latest"."""
+    """[(serial, path)] ascending by serial. committed_only=True (the
+    default — restore's view) skips snapshot dirs without a COMMIT
+    marker: an interrupted save must never be picked as "latest"."""
     if not os.path.isdir(root):
         return []
     out = []
@@ -233,19 +293,77 @@ def list_snapshots(root: str, committed_only: bool = True):
     return sorted(out)
 
 
+def _read_commit_record(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(os.path.join(path, COMMIT_MARKER)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+        return None
+
+
+_warned_foreign: set = set()
+
+
+def _ranked_snapshots(root: str) -> List[str]:
+    """Committed snapshot paths ascending by (step, commit_ts, serial) —
+    the ONE ranking shared by latest-snapshot selection AND retention,
+    so retention can never delete the snapshot selection would pick.
+    Directories whose COMMIT record declares a NEWER protocol format
+    than this reader understands (a newer world config writing into the
+    same root) are excluded — never selected, never pruned — with a
+    warn-once vlog + the ptpu_ckpt_skipped_foreign_total counter:
+    adopting (or deleting) a half-understood snapshot would turn the
+    integrity story into noise."""
+    ranked = []
+    for serial, path in list_snapshots(root, committed_only=True):
+        record = _read_commit_record(path) or {}
+        fmt = int(record.get("format", 1))
+        if fmt > COMMIT_FORMAT:
+            if path not in _warned_foreign:
+                _warned_foreign.add(path)
+                flags.vlog(0, "skipping snapshot %s: COMMIT format %d is "
+                           "newer than this process understands (%d) — "
+                           "written by a newer world config?", path, fmt,
+                           COMMIT_FORMAT)
+                _metric("ptpu_ckpt_skipped_foreign_total").inc()
+            continue
+        key = (int(record.get("step", -1)),
+               float(record.get("commit_ts", 0.0)), serial)
+        ranked.append((key, path))
+    return [p for _, p in sorted(ranked)]
+
+
 def latest_snapshot(root: str) -> Optional[str]:
-    """Path of the newest COMMITTED snapshot under root, or None."""
-    snaps = list_snapshots(root, committed_only=True)
-    return snaps[-1][1] if snaps else None
+    """Path of the newest COMMITTED snapshot under root, or None.
+
+    Deterministic under concurrent/stale writers: candidates order by
+    (step, commit_ts, serial) from the COMMIT record — two snapshots at
+    the SAME step (a stale supervisor racing a live one on one root)
+    tie-break by commit timestamp, then serial, instead of whichever
+    serial a racing _alloc_serial happened to mint last (see
+    `_ranked_snapshots` for the foreign-format skip)."""
+    ranked = _ranked_snapshots(root)
+    return ranked[-1] if ranked else None
 
 
-def validate_snapshot(dirname: str):
+def _record_size_digest(entry) -> (int, Optional[str]):
+    """A COMMIT `files` entry: format 1 recorded a bare byte size;
+    format 2 records {"size": s, "crc32": "xxxxxxxx"}."""
+    if isinstance(entry, dict):
+        return int(entry["size"]), entry.get("crc32")
+    return int(entry), None
+
+
+def validate_snapshot(dirname: str, digests: bool = True):
     """Raise a clear enforce error unless `dirname` is a complete,
     committed snapshot: COMMIT marker present and parseable, every file
-    it records present at exactly the recorded size, manifest count
-    matching. The property the crash-mid-save test pins: a directory
-    that passes here restores exactly; one that fails is rejected with
-    the directory and the missing/damaged piece named."""
+    it records present at exactly the recorded size AND (digests=True,
+    the default) matching its recorded content digest — a silent
+    bit-flip inside a shard container is rejected with an error naming
+    the file, not surfaced as garbage weights — manifest count matching.
+    The property the crash-anywhere tests pin: a directory that passes
+    here restores exactly; one that fails is rejected with the directory
+    and the missing/damaged piece named."""
     enforce(os.path.isdir(dirname),
             f"snapshot dir {dirname!r} does not exist",
             exc=NotFoundError)
@@ -262,19 +380,37 @@ def validate_snapshot(dirname: str):
         raise InvalidArgumentError(
             f"snapshot dir {dirname!r}: {COMMIT_MARKER} marker is corrupt "
             f"({e})") from e
+    fmt = int(record.get("format", 1))
+    enforce(fmt <= COMMIT_FORMAT,
+            f"snapshot dir {dirname!r}: {COMMIT_MARKER} format {fmt} is "
+            f"newer than this process understands ({COMMIT_FORMAT}) — "
+            f"restore with the world config that wrote it",
+            exc=InvalidArgumentError)
     files = record.get("files", {})
-    for name, size in files.items():
+    for name, entry in files.items():
         path = os.path.join(dirname, name)
         enforce(os.path.exists(path),
                 f"snapshot dir {dirname!r} is missing {name!r} recorded "
                 f"in its {COMMIT_MARKER} marker",
                 exc=InvalidArgumentError)
+        size, digest = _record_size_digest(entry)
         got = os.path.getsize(path)
-        enforce(got == int(size),
+        enforce(got == size,
                 f"snapshot dir {dirname!r}: {name!r} is {got} bytes but "
                 f"the {COMMIT_MARKER} marker recorded {size} — truncated "
                 f"or overwritten after commit",
                 exc=InvalidArgumentError)
+        if digests and digest is not None:
+            got_digest = file_digest(path)
+            if got_digest != digest:
+                _metric("ptpu_ckpt_digest_failures_total").inc()
+            enforce(got_digest == digest,
+                    f"snapshot dir {dirname!r}: {name!r} content digest "
+                    f"crc32:{got_digest} does not match the "
+                    f"{COMMIT_MARKER} marker's crc32:{digest} — the file "
+                    f"was corrupted (bit-flip/partial overwrite) after "
+                    f"commit; restore from another committed snapshot",
+                    exc=SnapshotDigestError)
     n_manifests = len([n for n in os.listdir(dirname)
                        if n.startswith("manifest-")
                        and n.endswith(".json")])
@@ -343,19 +479,47 @@ def _ef_layout(program) -> Optional[Dict[str, Any]]:
     dp = int(comm.attrs["dp"])
     tp = int(getattr(program, "_tp_size", 0) or 0) \
         if getattr(program, "_tp_applied", False) else 0
+
+    def _grad_geometry(gname):
+        """(global shape, tp-sharded dim index or None) of a gradient —
+        what lets the restore re-map a residual segment through the
+        GLOBAL gradient space when the tp degree changes across a
+        resize. The comm plan's numels are tp-LOCAL; the grad var's
+        declared shape is global, its `tp_spec` (tp_shard_pass marker)
+        names the dim the tp axis splits."""
+        from ..framework.sharding import tp_component
+        g = block.var(gname)
+        gshape = list(g.shape or ())
+        comp = tp_component(getattr(g, "tp_spec", None)) if tp > 1 \
+            else None
+        tp_dim = None
+        if comp is not None:
+            dims = [d for d, s in enumerate(comp) if s is not None]
+            enforce(len(dims) == 1,
+                    f"gradient {gname!r} is tp-sharded on {len(dims)} "
+                    f"dims — the error-feedback resize re-map supports "
+                    f"single-dim tp sharding", exc=InvalidArgumentError)
+            tp_dim = dims[0]
+        return gshape, tp_dim
+
     transfers = []
     # the pass lays err state out sharded-transfers-first, then buckets —
     # mirror that order (grad_comm.py _comm_optimize_pass_impl)
     for i, kind in enumerate(kinds):
         if kind == "sharded":
+            gshape, tp_dim = _grad_geometry(grads[i])
             transfers.append({"kind": "sharded", "grads": [grads[i]],
-                              "numels": [numels[i]], "flat": numels[i]})
+                              "numels": [numels[i]], "flat": numels[i],
+                              "gshapes": [gshape], "tp_dims": [tp_dim]})
     for idxs in comm.attrs["buckets"]:
         flat = sum(numels[i] for i in idxs)
+        geo = [_grad_geometry(grads[i]) for i in idxs]
         transfers.append({"kind": "bucket",
                           "grads": [grads[i] for i in idxs],
                           "numels": [numels[i] for i in idxs],
-                          "flat": -(-flat // dp) * dp})
+                          "flat": -(-flat // dp) * dp,
+                          "gshapes": [g for g, _ in geo],
+                          "tp_dims": [d for _, d in geo]})
     enforce(len(transfers) == len(err_names),
             f"error-feedback layout mismatch: {len(transfers)} transfers "
             f"vs {len(err_names)} state vars", exc=InvalidArgumentError)
@@ -444,6 +608,30 @@ def _collect_train_arrays(program, scope) -> Dict[str, object]:
     return arrays
 
 
+def _placements(arrays: Dict[str, object]) -> Dict[str, Any]:
+    """Per-var partition spec of the LIVE arrays at save time, recorded
+    in train_meta.json — the `old placement` side of the mesh-resize
+    planner (parallel/reshard.py): which mesh axes shard which dim.
+    Host arrays (no sharding) record null."""
+    out = {}
+    for name, arr in arrays.items():
+        sh = getattr(arr, "sharding", None)
+        spec = getattr(sh, "spec", None)
+        if spec is None:
+            out[name] = None
+            continue
+        entry = []
+        for s in spec:
+            if s is None:
+                entry.append(None)
+            elif isinstance(s, (tuple, list)):
+                entry.append(list(s))
+            else:
+                entry.append([s])
+        out[name] = entry
+    return out
+
+
 def _prepared_view(executor, program, scope):
     """The program AS THE EXECUTOR RUNS IT: ParallelExecutor rewrites
     (tp/dp-comm/pipeline) before compiling, and checkpoint contents +
@@ -458,7 +646,9 @@ def save_train_state(root: str,
                      program=None, scope=None, executor=None,
                      step: int = 0, extra_meta: Optional[dict] = None,
                      max_snapshots: int = 3,
-                     block: bool = True):
+                     block: bool = True,
+                     world=None,
+                     barrier_deadline_s: float = 60.0):
     """Snapshot the complete training state under `root` with the atomic
     two-phase commit. Returns the committed snapshot path (block=True)
     or an AsyncSnapshot handle (block=False: only the device→host copy
@@ -470,7 +660,18 @@ def save_train_state(root: str,
     rides the metadata, so a restored run draws exactly the seeds the
     uninterrupted run would have. ParallelExecutor additionally
     contributes its BuildStrategy/mesh config and the rewritten program
-    view (sharded accumulators, error-feedback state)."""
+    view (sharded accumulators, error-feedback state).
+
+    `world` (a process_world.ProcessWorld) switches to the MULTI-WRITER
+    chief-commits barrier: the mesh's devices are partitioned across the
+    world's ranks, every rank stages + fsyncs its OWN shard files in a
+    rank-private directory and reports a per-file digest manifest to the
+    chief, and the chief — after collecting every live rank's ack within
+    `barrier_deadline_s` — binds all of them into ONE COMMIT record
+    whose atomic rename is the only thing that makes the snapshot
+    visible. A straggler past the deadline or a dead rank ABORTS the
+    snapshot (returns None / AsyncSnapshot.result() -> None; counted in
+    ptpu_ckpt_barrier_aborts_total) and training continues."""
     import jax
 
     from ..framework.program import default_main_program
@@ -478,19 +679,22 @@ def save_train_state(root: str,
     from ..observability import tracing as _tracing
     from ..sharded_checkpoint import collect_chunks
 
-    # single-writer protocol: the rmtree-leftovers + rename + retention
-    # steps assume ONE process owns the snapshot root. In a multi-process
-    # world each process would clobber its siblings' shard files (silent
-    # checkpoint loss) — reject up front; the chief-commits barrier
-    # protocol (trainer.save_checkpoint's multi-phase form) is the
-    # planned extension (ROUND14_NOTES.md).
+    # BOTH paths are single-OS-process protocols today: the single-writer
+    # path because rmtree-leftovers + rename + retention assume one owner
+    # of the root, and the ProcessWorld barrier because its ranks are
+    # SIMULATED in-process (rank staging dirs carry no pid; two real OS
+    # processes passing worlds would mint one serial and clobber each
+    # other's rank staging — exactly the silent checkpoint loss this
+    # enforce exists to reject). On a real jax.distributed deployment the
+    # rank surface transplants onto actual processes; until then, reject.
     enforce(jax.process_count() == 1,
-            f"elastic save_train_state is single-process today "
-            f"(process_count={jax.process_count()}): concurrent writers "
-            f"would overwrite each other's snapshot serials. Use "
-            f"trainer.save_checkpoint(sharded=True) — its barrier "
-            f"protocol commits multi-host checkpoints safely",
-            exc=InvalidArgumentError)
+            f"elastic save_train_state runs in one OS process today "
+            f"(process_count={jax.process_count()}): the ProcessWorld "
+            f"barrier simulates its ranks in-process, and concurrent "
+            f"REAL processes would overwrite each other's snapshot "
+            f"serials and rank staging. Use "
+            f"trainer.save_checkpoint(sharded=True) for real multi-host "
+            f"saves", exc=InvalidArgumentError)
     program = program or default_main_program()
     scope = scope or global_scope()
     prepared = _prepared_view(executor, program, scope)
@@ -504,11 +708,42 @@ def save_train_state(root: str,
         "run_counter": int(getattr(executor, "_run_counter", 0) or 0),
         "random_seed": int(program.random_seed),
         "world": dict(getattr(mesh, "axes", {}) or {}),
+        "world_size": world.world_size if world is not None else 1,
         "strategy": _strategy_dict(strategy),
         "ef_layout": _ef_layout(prepared),
+        "placements": _placements(arrays),
         "extra": dict(extra_meta or {}),
         "var_names": sorted(arrays),
     }
+
+    if world is not None:
+        with _tracing.span("checkpoint", "elastic/snapshot_d2h",
+                           n_vars=len(arrays), step=int(step),
+                           world_size=world.world_size):
+            rank_payloads = _collect_rank_chunks(world, arrays, mesh)
+        os.makedirs(root, exist_ok=True)
+        serial = _alloc_serial(root)
+        if block:
+            return _barrier_write_and_commit(
+                world, root, serial, rank_payloads, meta, max_snapshots,
+                step, barrier_deadline_s)
+        handle = AsyncSnapshot(serial)
+        with _pending_lock:
+            _PENDING.append(handle)
+
+        def _bwriter():
+            try:
+                path = _barrier_write_and_commit(
+                    world, root, serial, rank_payloads, meta,
+                    max_snapshots, step, barrier_deadline_s)
+                handle._finish(path=path)
+            except BaseException as e:  # noqa: BLE001 - via result()
+                handle._finish(exc=e)
+
+        t = threading.Thread(target=_bwriter,
+                             name=f"ckpt-barrier-{serial}", daemon=True)
+        t.start()
+        return handle
 
     with _tracing.span("checkpoint", "elastic/snapshot_d2h",
                        n_vars=len(arrays), step=int(step)):
@@ -543,6 +778,44 @@ def save_train_state(root: str,
     return handle
 
 
+def _stage_digests(staging: str) -> Dict[str, dict]:
+    """Per-file {size, crc32} integrity entries for a staging dir's
+    payload. The digest re-reads the just-written files: page-cache-hot,
+    so it is a memory-speed pass rather than a second disk round trip,
+    and hashing the on-disk container bytes keeps the digest's meaning
+    independent of the writer's serialization internals (a streamed
+    in-memory hash would silently diverge from disk if the container
+    format ever buffered/reordered)."""
+    return {n: {"size": os.path.getsize(os.path.join(staging, n)),
+                "crc32": file_digest(os.path.join(staging, n))}
+            for n in _payload_files(staging)}
+
+
+def _commit_marker_and_retain(root: str, final: str, files: Dict,
+                              n_manifests: int, step: int,
+                              world_info: Dict, max_snapshots: int):
+    """THE commit point, shared by the single-writer save and the
+    chief's barrier commit so the COMMIT record format and the
+    retention rule exist exactly once: write the integrity record to a
+    temp name, fsync, atomically rename it in, fsync the dir, then
+    prune retention by the SAME (step, commit_ts, serial) ranking
+    selection uses — a stale writer minting later serials at earlier
+    steps must never push the newest-step snapshot out of retention."""
+    from ..sharded_checkpoint import _fsync_file
+    marker = os.path.join(final, COMMIT_MARKER)
+    with open(marker + ".tmp", "w") as f:
+        json.dump({"format": COMMIT_FORMAT, "manifests": n_manifests,
+                   "files": files, "step": int(step),
+                   "commit_ts": time.time(), "world": world_info}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(marker + ".tmp", marker)
+    _fsync_file(final)
+    if max_snapshots and max_snapshots > 0:
+        for old in _ranked_snapshots(root)[:-max_snapshots]:
+            shutil.rmtree(old, ignore_errors=True)
+
+
 def _write_and_commit(staging, final, chunks, manifest, pid, meta,
                       root, max_snapshots, step, serial) -> str:
     """Phase 2: staged writes, fsync, rename, COMMIT marker, retention.
@@ -571,8 +844,7 @@ def _write_and_commit(staging, final, chunks, manifest, pid, meta,
         mid = fault.get("crash_mid_save")
         if mid is not None:
             _crash_mid_staging(staging, int(mid))  # may not return
-        payload = {n: os.path.getsize(os.path.join(staging, n))
-                   for n in _payload_files(staging)}
+        payload = _stage_digests(staging)
         n_manifests = len([n for n in payload if n.startswith("manifest-")])
 
     with _tracing.span("checkpoint", "elastic/commit", step=int(step)):
@@ -583,33 +855,28 @@ def _write_and_commit(staging, final, chunks, manifest, pid, meta,
             shutil.rmtree(final)
         os.replace(staging, final)
         _fsync_file(root)
-        if mid is not None and int(mid) == sum(payload.values()):
+        payload_bytes = sum(e["size"] for e in payload.values())
+        if mid is not None and int(mid) == payload_bytes:
             # crash point "after rename, before COMMIT": the snapshot dir
             # is visible but uncommitted — restore must skip it
             _sigkill_self()  # pragma: no cover
-        marker = os.path.join(final, COMMIT_MARKER)
-        with open(marker + ".tmp", "w") as f:
-            json.dump({"manifests": n_manifests, "files": payload,
-                       "step": int(step)}, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(marker + ".tmp", marker)
-        _fsync_file(final)
-    if mid is not None and int(mid) > sum(payload.values()):
+        _commit_marker_and_retain(
+            root, final, payload, n_manifests, step,
+            {"world_size": meta.get("world_size", 1),
+             "axes": meta.get("world", {})}, max_snapshots)
+    if mid is not None and int(mid) > payload_bytes:
         _sigkill_self()  # pragma: no cover
 
-    # retention: keep the newest max_snapshots COMMITTED snapshots; also
-    # sweep stale staging dirs from earlier preempted/dead saves — but
-    # never one a LIVE async writer of this process still owns (its
-    # serial is >= the oldest pending serial)
-    if max_snapshots and max_snapshots > 0:
-        committed = list_snapshots(root, committed_only=True)
-        for _, old in committed[:-max_snapshots]:
-            shutil.rmtree(old, ignore_errors=True)
+    # sweep stale SINGLE-WRITER staging dirs (.tmp-<serial>-<pid>) from
+    # earlier preempted/dead saves — never one a LIVE async writer of
+    # this process still owns (its serial is >= the oldest pending
+    # serial), and never the barrier protocol's -rank<r>/-world<pid>
+    # dirs, whose rounds are not tracked in _PENDING (blocking barrier
+    # saves) and are swept by the barrier's own commit
     with _pending_lock:
         live = {h._serial for h in _PENDING if h._serial is not None}
     floor = min(live | {serial})
-    stale_re = re.compile(re.escape(STAGING_PREFIX) + r"(\d+)-")
+    stale_re = re.compile(re.escape(STAGING_PREFIX) + r"(\d+)-(\d+)$")
     for name in os.listdir(root):
         m = stale_re.match(name)
         if m and int(m.group(1)) < floor and \
@@ -618,11 +885,271 @@ def _write_and_commit(staging, final, chunks, manifest, pid, meta,
 
     dt = time.perf_counter() - t0
     _metric("ptpu_ckpt_saves_total").inc()
-    _metric("ptpu_ckpt_save_bytes_total").inc(sum(payload.values()))
+    _metric("ptpu_ckpt_save_bytes_total").inc(payload_bytes)
     _metric("ptpu_ckpt_save_seconds").observe(dt)
     flags.vlog(1, "committed snapshot %s (%d bytes, %.3fs)", final,
-               sum(payload.values()), dt)
+               payload_bytes, dt)
     return final
+
+
+# ---------------------------------------------------------------------------
+# chief-commits multi-writer barrier (over a simulated ProcessWorld)
+# ---------------------------------------------------------------------------
+
+def _collect_rank_chunks(world, arrays: Dict[str, object], mesh):
+    """The per-rank device→host phase of a multi-writer save: partition
+    the mesh's devices into world_size contiguous groups and collect,
+    per rank, ONLY the chunks whose replica-0 shard lives on that rank's
+    devices (sharded_checkpoint.collect_chunks only_devices — in a real
+    multi-host world `addressable_shards` IS this split). Host arrays
+    (no device placement) are written by the chief alone. Returns
+    {rank: (chunks, manifest)}."""
+    from ..sharded_checkpoint import collect_chunks
+
+    enforce(mesh is not None,
+            "a multi-writer save needs the executor's mesh to partition "
+            "device ownership across ranks", exc=InvalidArgumentError)
+    devices = list(mesh.jax_mesh.devices.flat)
+    n = world.world_size
+    enforce(len(devices) % n == 0,
+            f"mesh has {len(devices)} device(s), not divisible by "
+            f"world_size={n}: every rank must own an equal device group",
+            exc=InvalidArgumentError)
+    per = len(devices) // n
+    device_arrays = {k: v for k, v in arrays.items()
+                     if hasattr(v, "addressable_shards")}
+    host_arrays = {k: v for k, v in arrays.items()
+                   if not hasattr(v, "addressable_shards")}
+    payloads = {}
+    for r in range(n):
+        group = set(devices[r * per:(r + 1) * per])
+        rank_arrays = dict(device_arrays)
+        if world.is_chief(r):
+            rank_arrays.update(host_arrays)
+        chunks, manifest, _ = collect_chunks(
+            rank_arrays, process_index=r, world_size=n,
+            only_devices=group)
+        payloads[r] = (chunks, manifest)
+    return payloads
+
+
+def _rank_staging_dir(root: str, serial: int, rank: int) -> str:
+    return os.path.join(root, f"{STAGING_PREFIX}{serial:08d}-rank{rank}")
+
+
+def _stage_rank_files(world, root: str, serial: int, rank: int,
+                      chunks, manifest) -> Dict[str, dict]:
+    """Phase `stage` + `ack` of one rank: write this rank's shard
+    container + manifest into its RANK-PRIVATE staging directory, fsync
+    everything, then build the per-file digest manifest the ack carries.
+    The two fault points bracket exactly the states the crash matrix
+    needs: died mid-write (possibly at a byte offset) vs staged-durable-
+    but-ack-unsent."""
+    from ..sharded_checkpoint import write_chunks
+
+    staging = _rank_staging_dir(root, serial, rank)
+    if os.path.isdir(staging):
+        shutil.rmtree(staging)
+    os.makedirs(staging)
+    write_chunks(staging, chunks, manifest, rank, fsync=True)
+    world.fault(rank, "stage", staging=staging)
+    digests = _stage_digests(staging)
+    world.fault(rank, "ack")
+    return digests
+
+
+def _chief_commit(world, root: str, serial: int, own_files: Dict,
+                  expected: List[int], meta: Dict, max_snapshots: int,
+                  step: int, deadline_s: float) -> Optional[str]:
+    """The chief's half of the barrier: collect every expected rank's
+    digest ack within the deadline, then make the ensemble atomic —
+    assemble every rank's staged files into one directory, write the
+    train metadata, rename the directory into place, and only then
+    atomically rename in the ONE global COMMIT record binding every
+    rank's manifest. Any rank missing at the deadline aborts the
+    snapshot (training continues; the attempt's staging is swept).
+    Returns (committed path, payload bytes) — (None, 0) on abort."""
+    from ..sharded_checkpoint import _fsync_file
+
+    chief = world.chief
+    acks: Dict[int, Dict] = {chief: own_files}
+    deadline = time.monotonic() + deadline_s
+    while set(acks) < set(expected):
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        msg = world.recv(chief, timeout=remaining)
+        if msg is None:
+            break
+        if (msg.get("kind") == "ack"
+                and int(msg.get("serial", -1)) == serial):
+            acks[int(msg["rank"])] = msg["files"]
+
+    missing = sorted(set(expected) - set(acks))
+    if missing:
+        flags.vlog(0, "barrier abort: snapshot serial %d missing ack(s) "
+                   "from rank(s) %s after %.1fs deadline — training "
+                   "continues without this snapshot", serial, missing,
+                   deadline_s)
+        _metric("ptpu_ckpt_barrier_aborts_total").inc()
+        # sweep only ACKED ranks' staging: a missing rank may be a
+        # straggler STILL writing its private dir — it cleans up itself
+        # on the abort verdict, or the next commit's stale sweep does
+        for r in acks:
+            shutil.rmtree(_rank_staging_dir(root, serial, r),
+                          ignore_errors=True)
+        for r in range(world.world_size):
+            if r != chief:
+                world.send(chief, r, "abort", serial=serial)
+        return None, 0
+
+    # every live rank's shards are durable on disk — the commit point
+    world.fault(chief, "barrier")
+    assembly = os.path.join(
+        root, f"{STAGING_PREFIX}{serial:08d}-world{os.getpid()}")
+    if os.path.isdir(assembly):
+        shutil.rmtree(assembly)
+    os.makedirs(assembly)
+    files: Dict[str, dict] = {}
+    for r, rank_files in sorted(acks.items()):
+        staging = _rank_staging_dir(root, serial, r)
+        for name, entry in rank_files.items():
+            enforce(name not in files,
+                    f"barrier commit: rank {r} staged {name!r} which "
+                    f"another rank already owns — rank file namespaces "
+                    f"must be disjoint", exc=InvalidArgumentError)
+            os.replace(os.path.join(staging, name),
+                       os.path.join(assembly, name))
+            files[name] = entry
+        shutil.rmtree(staging, ignore_errors=True)
+    meta_path = os.path.join(assembly, META_FILE)
+    with open(meta_path, "w") as f:
+        json.dump(meta, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    files[META_FILE] = {"size": os.path.getsize(meta_path),
+                        "crc32": file_digest(meta_path)}
+    _fsync_file(assembly)
+
+    final = os.path.join(root, f"{SNAPSHOT_PREFIX}{serial:08d}")
+    if os.path.isdir(final):
+        # leftovers of a preempted attempt that never committed (a
+        # COMMITTED dir at this serial is impossible: _alloc_serial
+        # scanned past it)
+        shutil.rmtree(final)
+    os.replace(assembly, final)
+    _fsync_file(root)
+    world.fault(chief, "commit")
+    n_manifests = len([n for n in files if n.startswith("manifest-")])
+    _commit_marker_and_retain(
+        root, final, files, n_manifests, step,
+        {"world_size": world.world_size, "axes": meta.get("world", {})},
+        max_snapshots)
+    world.fault(chief, "post")
+
+    # sweep staging leftovers of EARLIER barrier rounds (aborted or
+    # crashed attempts); rounds are serialized on world.barrier_lock, so
+    # a lower serial can never belong to a live writer
+    stale_re = re.compile(re.escape(STAGING_PREFIX)
+                          + r"(\d+)-(?:rank\d+|world\d+)$")
+    for name in os.listdir(root):
+        m = stale_re.match(name)
+        if m and int(m.group(1)) < serial:
+            shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+    for r in range(world.world_size):
+        if r != chief:
+            world.send(chief, r, "committed", serial=serial, path=final)
+    return final, sum(e["size"] for e in files.values())
+
+
+def _barrier_write_and_commit(world, root: str, serial: int,
+                              rank_payloads: Dict, meta: Dict,
+                              max_snapshots: int, step: int,
+                              deadline_s: float) -> Optional[str]:
+    """Run the chief-commits barrier over the world: every rank stages
+    and acks; the chief waits, binds, and commits. Returns the committed
+    path, or None when the barrier aborted (straggler/dead rank)."""
+    from ..observability import tracing as _tracing
+
+    fault = fault_injection_config()
+    slow = fault.get("slow_writer")
+    if slow:
+        time.sleep(float(slow))
+    if world.dead:
+        # a dead rank can never stage its shard of the state, so no
+        # COMPLETE snapshot can commit in this world again: fail fast
+        # instead of letting the live ranks stage and time out. The
+        # recovery is a whole-gang restart (Supervisor world_size
+        # semantics), not a partial commit.
+        flags.vlog(0, "barrier abort: rank(s) %s are dead — no complete "
+                   "snapshot can commit in this world; restart the gang",
+                   sorted(world.dead))
+        _metric("ptpu_ckpt_barrier_aborts_total").inc()
+        return None
+    t0 = time.perf_counter()
+    committed_bytes: List[int] = []   # filled by the chief on commit
+
+    def rank_fn(rank: int):
+        chunks, manifest = rank_payloads[rank]
+        if world.is_chief(rank):
+            # the whole chief branch — its OWN staging included — is
+            # wrapped: a chief dying at ANY phase (stage/ack/barrier/
+            # commit) must not leave the other ranks blocked on a
+            # verdict that will never come, and the abort must be
+            # visible in the metrics
+            try:
+                digests = _stage_rank_files(world, root, serial, rank,
+                                            chunks, manifest)
+                path, nbytes = _chief_commit(world, root, serial,
+                                             digests, expected, meta,
+                                             max_snapshots, step,
+                                             deadline_s)
+                committed_bytes.append(nbytes)
+                return path
+            except BaseException:
+                _metric("ptpu_ckpt_barrier_aborts_total").inc()
+                for r in range(world.world_size):
+                    if r != rank:
+                        world.send(rank, r, "abort", serial=serial)
+                raise
+        digests = _stage_rank_files(world, root, serial, rank, chunks,
+                                    manifest)
+        world.send(rank, world.chief, "ack", serial=serial, rank=rank,
+                   files=digests)
+        # wait for the chief's verdict; a silent timeout (chief dead)
+        # counts as an abort from this rank's perspective
+        limit = time.monotonic() + deadline_s + 30.0
+        while True:
+            msg = world.recv(rank, timeout=max(0.1,
+                                               limit - time.monotonic()))
+            if msg is None and time.monotonic() >= limit:
+                return None
+            if msg and int(msg.get("serial", -1)) == serial:
+                if msg["kind"] == "committed":
+                    return msg["path"]
+                if msg["kind"] == "abort":
+                    shutil.rmtree(_rank_staging_dir(root, serial, rank),
+                                  ignore_errors=True)
+                    return None
+
+    with _tracing.span("checkpoint", "elastic/barrier_commit",
+                       step=int(step), world_size=world.world_size), \
+            world.barrier_lock:
+        # EVERY rank's shard is needed for a complete snapshot: a rank
+        # dying mid-round surfaces as a missing ack -> abort
+        expected = list(range(world.world_size))
+        world.drain(world.chief)  # no stale acks from an aborted round
+        results = world.run(rank_fn)
+    path = results[world.chief]
+    if path is not None:
+        dt = time.perf_counter() - t0
+        nbytes = committed_bytes[0] if committed_bytes else 0
+        _metric("ptpu_ckpt_saves_total").inc()
+        _metric("ptpu_ckpt_save_bytes_total").inc(nbytes)
+        _metric("ptpu_ckpt_save_seconds").observe(dt)
+        flags.vlog(1, "barrier-committed snapshot %s (%d ranks, %d "
+                   "bytes, %.3fs)", path, len(expected), nbytes, dt)
+    return path
 
 
 # ---------------------------------------------------------------------------
@@ -651,18 +1178,30 @@ def _resize_replica_rows(rows: np.ndarray, new_n: int) -> np.ndarray:
 
 def _remap_error_feedback(ckpt, old_layout: Dict, new_layout: Dict,
                           new_dp: int) -> Dict[str, np.ndarray]:
-    """Saved residual state (old transfer layout, N rows) → host arrays
-    for the NEW layout's error-feedback vars (M rows). Per-gradient
-    segments are extracted from the old flat vectors, dp rows re-mapped
-    within each tp group, and re-packed at the new offsets — gradients
-    may move between transfers when the dp divisibility classification
-    changes with the resize. Bucket pad regions carry an identically
-    zero residual (quantizing an exact zero leaves no residual), so
-    dropping/re-padding them is lossless."""
-    enforce(old_layout["tp"] == new_layout["tp"],
-            f"elastic restore resizes the dp axis only: checkpoint has "
-            f"tp={old_layout['tp']}, target program tp={new_layout['tp']}",
-            exc=InvalidArgumentError)
+    """Saved residual state (old transfer layout, old dp×tp rows) → host
+    arrays for the NEW layout's error-feedback vars (new dp×tp rows),
+    across an ARBITRARY mesh resize of the dp and tp axes.
+
+    Per-gradient segments are extracted from the old flat vectors; dp
+    replica rows re-map via `_resize_replica_rows` (grow pads zero rows,
+    shrink folds mod M, scaled M/N so the effective pending correction
+    (1/N)·Σe is preserved); gradients may move between transfers when
+    their dp/quant-block classification changes with the resize. Bucket
+    pad regions carry an identically zero residual (quantizing an exact
+    zero leaves no residual), so dropping/re-padding them is lossless.
+
+    The tp axis: when the tp degree is UNCHANGED, every (tp, dp)
+    coordinate's rows re-map independently — a same-world restore is
+    bitwise. When it CHANGES, segments travel through the gradient's
+    GLOBAL coordinate space (`ef_layout` gshapes/tp_dims): a tp-sharded
+    gradient's per-shard segments reassemble along the recorded tp dim
+    and re-slice into the new degree's locals EXACTLY; a gradient
+    replicated over tp collapses to the MEAN of its per-shard rows and
+    broadcasts to the new shards — per-shard residuals legitimately
+    differ there (quantization scale blocks span neighboring tp-local
+    bucket segments), so no bijection exists across a tp change and the
+    mean is the unbiased mass-preserving choice, off from any single
+    shard's rows by at most the wire-format quantization noise."""
     enforce((old_layout["quant"], old_layout["block"])
             == (new_layout["quant"], new_layout["block"]),
             f"error-feedback state is only meaningful under the wire "
@@ -672,41 +1211,114 @@ def _remap_error_feedback(ckpt, old_layout: Dict, new_layout: Dict,
             f"restore with the same quant_comm config, or drop "
             f"comm_error_feedback to start residuals at zero",
             exc=InvalidArgumentError)
-    tp = old_layout["tp"]
+    old_tp = int(old_layout["tp"])
+    new_tp = int(new_layout["tp"])
     old_dp = int(old_layout["dp"])
+    tp_resize = old_tp != new_tp
+    enforce(not tp_resize or all("gshapes" in t
+                                 for t in old_layout["transfers"]),
+            f"elastic restore across a tp resize ({old_tp}→{new_tp}) "
+            f"needs the gradient geometry in the snapshot's ef_layout — "
+            f"this snapshot predates it (format 1); restore at "
+            f"tp={old_tp}, or drop comm_error_feedback to start "
+            f"residuals at zero", exc=InvalidArgumentError)
 
-    # old per-grad residual matrices: grad -> [tp, N, numel]
+    # old per-grad residual segments: grad -> [old_tp, old_dp, n_local]
     per_grad: Dict[str, np.ndarray] = {}
+    geometry: Dict[str, tuple] = {}   # grad -> (gshape, tp_dim)
     for t in old_layout["transfers"]:
         arr = np.asarray(ckpt.read(t["var"]))
-        enforce(arr.shape == (old_dp * tp, t["flat"]),
+        enforce(arr.shape == (old_dp * old_tp, t["flat"]),
                 f"saved error-feedback var {t['var']!r} has shape "
-                f"{arr.shape}, expected {(old_dp * tp, t['flat'])} — "
+                f"{arr.shape}, expected {(old_dp * old_tp, t['flat'])} — "
                 f"checkpoint metadata disagrees with its contents",
                 exc=InvalidArgumentError)
-        arr = arr.reshape(tp, old_dp, t["flat"])
+        arr = arr.reshape(old_tp, old_dp, t["flat"])
+        gshapes = t.get("gshapes") or [None] * len(t["grads"])
+        tp_dims = t.get("tp_dims") or [None] * len(t["grads"])
         off = 0
-        for g, n in zip(t["grads"], t["numels"]):
+        for g, n, gshape, tp_dim in zip(t["grads"], t["numels"],
+                                        gshapes, tp_dims):
             per_grad[g] = arr[:, :, off:off + n]
+            geometry[g] = (gshape, tp_dim)
             off += n
+
+    def _to_global(g, seg):
+        """[old_tp, old_dp, n] -> [old_dp, *gshape] (tp resize only)."""
+        gshape, tp_dim = geometry[g]
+        if tp_dim is None or old_tp == 1:
+            # replicated over tp: collapse to the per-shard mean (see
+            # docstring — no bijection exists; the mean preserves the
+            # average pending correction)
+            return seg.mean(axis=0).reshape((old_dp,) + tuple(gshape))
+        loc = list(gshape)
+        enforce(loc[tp_dim] % old_tp == 0,
+                f"gradient {g!r} dim {tp_dim} ({loc[tp_dim]}) does not "
+                f"divide over tp={old_tp}", exc=InvalidArgumentError)
+        loc[tp_dim] //= old_tp
+        parts = [seg[ti].reshape((old_dp,) + tuple(loc))
+                 for ti in range(old_tp)]
+        return np.concatenate(parts, axis=1 + tp_dim)
 
     out: Dict[str, np.ndarray] = {}
     for t in new_layout["transfers"]:
-        new = np.zeros((tp, new_dp, t["flat"]), np.float32)
+        new = np.zeros((new_tp, new_dp, t["flat"]), np.float32)
+        gshapes = t.get("gshapes") or [None] * len(t["grads"])
+        tp_dims = t.get("tp_dims") or [None] * len(t["grads"])
         off = 0
-        for g, n in zip(t["grads"], t["numels"]):
-            old = per_grad.get(g)
-            if old is not None:
-                enforce(old.shape[-1] == n,
+        for g, n, gshape, tp_dim in zip(t["grads"], t["numels"],
+                                        gshapes, tp_dims):
+            seg = per_grad.get(g)
+            if seg is None:
+                off += n
+                continue
+            if not tp_resize:
+                # tp unchanged: every (tp, dp) coordinate re-maps its
+                # own rows independently — same-world restores are
+                # bitwise, per-shard residual identity preserved
+                enforce(seg.shape[-1] == n,
                         f"gradient {g!r} changed size across the resize "
-                        f"({old.shape[-1]} vs {n}) — the checkpoint does "
-                        f"not match this program",
+                        f"({seg.shape[-1]} vs {n}) — the checkpoint "
+                        f"does not match this program",
                         exc=InvalidArgumentError)
-                for ti in range(tp):
+                for ti in range(new_tp):
                     new[ti, :, off:off + n] = _resize_replica_rows(
-                        old[ti], new_dp)
+                        seg[ti], new_dp)
+                off += n
+                continue
+            old_gshape, _ = geometry[g]
+            enforce(gshape is not None and old_gshape is not None
+                    and list(old_gshape) == list(gshape),
+                    f"gradient {g!r} changed global shape across the "
+                    f"resize ({old_gshape and list(old_gshape)} vs "
+                    f"{gshape and list(gshape)}) — the checkpoint does "
+                    f"not match this program", exc=InvalidArgumentError)
+            glob = _to_global(g, seg)
+            resized = _resize_replica_rows(
+                glob.reshape(old_dp, -1), new_dp) \
+                .reshape((new_dp,) + tuple(gshape))
+            if tp_dim is None or new_tp == 1:
+                flat = resized.reshape(new_dp, -1)
+                enforce(flat.shape[-1] == n,
+                        f"gradient {g!r}: global numel {flat.shape[-1]} "
+                        f"vs transfer segment {n} — tp geometry "
+                        f"mismatch", exc=InvalidArgumentError)
+                for ti in range(new_tp):
+                    new[ti, :, off:off + n] = flat
+            else:
+                k = gshape[tp_dim]
+                enforce(k % new_tp == 0,
+                        f"gradient {g!r} dim {tp_dim} ({k}) does not "
+                        f"divide over tp={new_tp}",
+                        exc=InvalidArgumentError)
+                step = k // new_tp
+                for ti in range(new_tp):
+                    idx = (slice(None),) * (1 + tp_dim) + \
+                        (slice(ti * step, (ti + 1) * step),)
+                    new[ti, :, off:off + n] = \
+                        resized[idx].reshape(new_dp, n)
             off += n
-        out[t["var"]] = new.reshape(tp * new_dp, t["flat"])
+        out[t["var"]] = new.reshape(new_tp * new_dp, t["flat"])
     return out
 
 
@@ -716,9 +1328,12 @@ def _remap_error_feedback(ckpt, old_layout: Dict, new_layout: Dict,
 
 def read_meta(dirname: str) -> Dict[str, Any]:
     """The train_meta.json of a snapshot dir (resolves a root to its
-    latest committed snapshot first)."""
+    latest committed snapshot first). Validates sizes/commit structure
+    but NOT content digests — a metadata peek must not re-hash the whole
+    payload; restore_train_state runs the full digest validation before
+    any state is read."""
     dirname = _resolve_snapshot_dir(dirname)
-    validate_snapshot(dirname)
+    validate_snapshot(dirname, digests=False)
     with open(os.path.join(dirname, META_FILE)) as f:
         return json.load(f)
 
@@ -758,13 +1373,19 @@ def restore_train_state(path: str,
                         verify: bool = True) -> Dict[str, Any]:
     """Restore the latest committed snapshot under `path` (or `path`
     itself when it is a snapshot dir) into `scope`, re-placing every
-    array onto the CURRENT executor's mesh — which may have a different
-    dp degree than the one that saved (elastic N→M resize): parameters
-    and full-shape ZeRO-1 accumulator chunks re-shard through
-    make_array_from_callback; error-feedback residuals re-map through
-    `_remap_error_feedback`. Restores the executor's run counter (the
-    RNG seed stream position), so a fixed-seed resumed run replays
-    exactly the seeds of the uninterrupted one.
+    array onto the CURRENT executor's mesh — which may be an ARBITRARILY
+    different dp × pp × tp world than the one that saved (dp2×tp2 → dp4,
+    dp2×pp2 → dp2×tp2, ...): parameters and full-shape ZeRO-1
+    accumulator chunks re-shard through make_array_from_callback (each
+    device reads only the byte ranges its new slice intersects);
+    error-feedback residuals re-map through `_remap_error_feedback`
+    across both dp and tp changes. When the world changed, the re-layout
+    is planned first (parallel/reshard.py): the per-variable collective
+    redistribution schedule is emitted, cross-checked exactly against
+    `framework.costs.reshard_wire_bytes`, and its summary returned as
+    meta["reshard"]. Restores the executor's run counter (the RNG seed
+    stream position), so a fixed-seed resumed run replays exactly the
+    seeds of the uninterrupted one.
 
     verify=True (default) runs the r10/r13 static analyzer
     (`verify_program`) over the program as the executor rewrites it and
@@ -801,6 +1422,27 @@ def restore_train_state(path: str,
     with _tracing.span("checkpoint", "elastic/restore",
                        snapshot=os.path.basename(dirname)):
         ckpt = ShardedCheckpoint(dirname)
+
+        old_world = dict(meta.get("world", {}) or {})
+        new_world = dict(getattr(mesh, "axes", {}) or {})
+        if mesh is not None and old_world != new_world:
+            # mesh-to-mesh resize: plan the re-layout up front — per-var
+            # old coverage → new placement, the byte ranges each device
+            # reads, and the equivalent on-hardware collective schedule,
+            # cross-checked against the costs.py wire-byte prediction
+            from . import reshard as _reshard
+            plan = _reshard.plan_restore(ckpt, meta, prepared, executor)
+            bad = _reshard.validate_schedule(plan)
+            enforce(not bad,
+                    "mesh-resize redistribution schedule does not "
+                    "balance against framework.costs predictions:\n  "
+                    + "\n  ".join(bad[:10]), exc=InvalidArgumentError)
+            meta["reshard"] = plan.summary()
+            flags.vlog(1, "mesh resize %s -> %s: %d var(s), %d moved, "
+                       "%.0f wire bytes equivalent, %d bytes read",
+                       old_world, new_world, len(plan.variables),
+                       len(plan.moved_vars()), plan.wire_bytes,
+                       plan.read_bytes)
         saved = set(ckpt.names())
         ef_vars = {t["var"] for t in (new_ef or {}).get("transfers", ())}
         restorable, missing = [], []

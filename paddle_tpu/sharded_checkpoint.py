@@ -112,6 +112,30 @@ def _fsync_file(path: str):
         os.close(fd)
 
 
+def truncate_payload_at(dirname: str, offset: int,
+                        exclude: Sequence[str] = ()) -> bool:
+    """Make `dirname` look exactly as if a sequential writer died
+    `offset` bytes into its payload: truncate the file holding that
+    offset, delete everything after it (deterministic name order;
+    `.tmp` files and `exclude` names are not payload). Returns False
+    when the offset lies beyond the payload (nothing touched) — the ONE
+    copy of the crash-offset accounting both fault-injection paths
+    (elastic crash_mid_save, process_world crash_rank stage) share."""
+    names = sorted(n for n in os.listdir(dirname)
+                   if n not in exclude and not n.endswith(".tmp"))
+    cum = 0
+    for i, n in enumerate(names):
+        sz = os.path.getsize(os.path.join(dirname, n))
+        if offset < cum + sz:
+            with open(os.path.join(dirname, n), "r+b") as f:
+                f.truncate(offset - cum)
+            for later in names[i + 1:]:
+                os.unlink(os.path.join(dirname, later))
+            return True
+        cum += sz
+    return False
+
+
 def write_chunks(dirname: str, chunks: Dict[str, np.ndarray],
                  manifest: Dict[str, dict], pid: int,
                  fsync: bool = False) -> str:

@@ -412,6 +412,17 @@ class Trainer:
             scope=self.scope, sharded=self.checkpoint_cfg.sharded)
 
 
+class SupervisorExhaustedError(RuntimeError):
+    """The Supervisor's restart budget ran out without a clean exit —
+    the terminal crash-loop signal (raise_on_exhaust=True)."""
+
+    def __init__(self, message: str, exit_code: int,
+                 exit_codes: Sequence[int]):
+        super().__init__(message)
+        self.exit_code = exit_code
+        self.exit_codes = list(exit_codes)
+
+
 class Supervisor:
     """Retry/backoff supervisor for preemptible training processes.
 
@@ -426,10 +437,34 @@ class Supervisor:
 
         Supervisor([sys.executable, "train.py"], max_restarts=20).run()
 
-    Fault injection (PTPU_FAULT_INJECT, parallel/elastic.py) makes the
-    crash side testable: tests/test_elastic.py and
-    tools/recovery_smoke.py supervise children that SIGKILL themselves
-    mid-run and mid-save.
+    Hardening knobs:
+
+    - the restart budget is a HARD cap: when it runs out, run() logs a
+      clear terminal crash-loop error and returns the last exit code —
+      or raises SupervisorExhaustedError with raise_on_exhaust=True — so
+      a persistently broken child fails loudly instead of looping under
+      ever-longer backoffs;
+    - `backoff_jitter` decorrelates a gang of supervisors restarting
+      after a shared failure (thundering herd): each delay is scaled by
+      a uniform factor in [1-j, 1+j];
+    - `healthy_run_s` resets the backoff to its base after a child that
+      ran at least that long: a crash every few hours is a preemption
+      pattern and deserves fast restarts, not the accumulated backoff of
+      a morning's crash loop.
+
+    world_size > 1 supervises a GANG of rank processes: the same argv is
+    launched once per rank with PTPU_WORLD_RANK/PTPU_WORLD_SIZE in the
+    env; any rank dying kills the rest of the gang (SIGTERM, then wait)
+    and the whole world restarts together — the restart granularity the
+    chief-commits barrier assumes (a half-restarted world would dead-ack
+    the barrier). Structure-pinned for hardware; in this container the
+    gang members cannot form a jax process world (jaxlib 0.4.x), so
+    multi-rank children run the simulated ProcessWorld internally.
+
+    Fault injection (PTPU_FAULT_INJECT, parallel/elastic.py +
+    parallel/process_world.py) makes the crash side testable:
+    tests/test_elastic.py and tools/recovery_smoke.py supervise children
+    that SIGKILL themselves mid-run, mid-save, and mid-barrier.
     """
 
     def __init__(self, argv: Sequence[str],
@@ -437,52 +472,139 @@ class Supervisor:
                  backoff_s: float = 1.0,
                  backoff_factor: float = 2.0,
                  max_backoff_s: float = 60.0,
+                 backoff_jitter: float = 0.0,
+                 healthy_run_s: Optional[float] = None,
+                 world_size: int = 1,
+                 raise_on_exhaust: bool = False,
                  env: Optional[dict] = None,
-                 sleep_fn: Optional[Callable[[float], None]] = None):
+                 sleep_fn: Optional[Callable[[float], None]] = None,
+                 rng=None):
         enforce(len(argv) >= 1, "Supervisor needs a command",
                 exc=InvalidArgumentError)
         enforce(max_restarts >= 0 and backoff_s >= 0
                 and backoff_factor >= 1.0,
                 "Supervisor: max_restarts >= 0, backoff_s >= 0, "
                 "backoff_factor >= 1 required", exc=InvalidArgumentError)
+        enforce(0.0 <= backoff_jitter < 1.0,
+                "Supervisor: backoff_jitter must be in [0, 1)",
+                exc=InvalidArgumentError)
+        enforce(world_size >= 1, "Supervisor: world_size must be >= 1",
+                exc=InvalidArgumentError)
+        enforce(healthy_run_s is None or healthy_run_s > 0,
+                "Supervisor: healthy_run_s must be positive",
+                exc=InvalidArgumentError)
         self.argv = list(argv)
         self.max_restarts = max_restarts
         self.backoff_s = backoff_s
         self.backoff_factor = backoff_factor
         self.max_backoff_s = max_backoff_s
+        self.backoff_jitter = backoff_jitter
+        self.healthy_run_s = healthy_run_s
+        self.world_size = world_size
+        self.raise_on_exhaust = raise_on_exhaust
         self.env = env
         self._sleep = sleep_fn or __import__("time").sleep
+        self._rng = rng or __import__("random").Random()
         #: restarts performed by the last run()
         self.restarts = 0
-        #: exit codes observed, in order (negative = killed by signal)
+        #: True when the last run() ended by exhausting the budget
+        self.exhausted = False
+        #: exit codes observed, in order (negative = killed by signal);
+        #: for a gang, the FIRST nonzero code of each incarnation
         self.exit_codes: List[int] = []
 
+    def _launch_gang(self):
+        """One incarnation: world_size children with rank identities in
+        env. Returns the incarnation's exit code: 0 iff every rank
+        exited 0; otherwise the first failing rank's code, after the
+        rest of the gang was terminated (the barrier protocol assumes
+        whole-world restarts)."""
+        import subprocess
+        if self.world_size == 1:
+            return subprocess.run(self.argv, env=self.env).returncode
+        procs = []
+        for r in range(self.world_size):
+            env = dict(self.env if self.env is not None else os.environ)
+            env["PTPU_WORLD_RANK"] = str(r)
+            env["PTPU_WORLD_SIZE"] = str(self.world_size)
+            procs.append(subprocess.Popen(self.argv, env=env))
+        import time as _time
+        rc = 0
+        kill_deadline = None
+        live = set(range(self.world_size))
+        while live:
+            for r in sorted(live):
+                code = procs[r].poll()
+                if code is None:
+                    continue
+                live.discard(r)
+                if code != 0 and rc == 0:
+                    rc = code
+                    # gang semantics: one death restarts the world
+                    for r2 in sorted(live):
+                        procs[r2].terminate()
+                    kill_deadline = _time.monotonic() + 10.0
+            if live and kill_deadline is not None \
+                    and _time.monotonic() >= kill_deadline:
+                # a rank ignoring SIGTERM (wedged in native code) must
+                # not hang the supervisor — escalate to SIGKILL; the
+                # barrier protocol is kill-safe by construction
+                for r2 in sorted(live):
+                    procs[r2].kill()
+                kill_deadline = float("inf")
+            if live:
+                _time.sleep(0.05)
+        if rc != 0:
+            for p in procs:
+                p.wait()
+        return rc
+
     def run(self) -> int:
-        """Supervise until the child exits 0 or the restart budget is
+        """Supervise until the world exits 0 or the restart budget is
         spent. Returns the final exit code (0 on success; the child's
         last code — negative for a signal death — when the budget ran
-        out)."""
-        import subprocess
+        out; raises SupervisorExhaustedError instead when
+        raise_on_exhaust=True)."""
+        import time as _time
+
+        from .core import flags
         self.restarts = 0
+        self.exhausted = False
         self.exit_codes = []
         delay = self.backoff_s
         while True:
-            proc = subprocess.run(self.argv, env=self.env)
-            rc = proc.returncode
+            t0 = _time.monotonic()
+            rc = self._launch_gang()
+            ran_s = _time.monotonic() - t0
             self.exit_codes.append(rc)
             if rc == 0:
                 return 0
             if self.restarts >= self.max_restarts:
-                from .core import flags
-                flags.vlog(0, "Supervisor: restart budget (%d) exhausted; "
-                           "last exit code %d", self.max_restarts, rc)
+                self.exhausted = True
+                msg = (f"Supervisor: restart budget ({self.max_restarts})"
+                       f" exhausted — the child is crash-looping, not "
+                       f"being preempted (exit codes {self.exit_codes});"
+                       f" last exit code {rc}. Fix the persistent "
+                       f"failure; restarting further would only mask it")
+                flags.vlog(0, "%s", msg)
+                if self.raise_on_exhaust:
+                    raise SupervisorExhaustedError(msg, rc,
+                                                   self.exit_codes)
                 return rc
-            from .core import flags
-            flags.vlog(0, "Supervisor: child exited %d (%s); restart %d/%d "
-                       "after %.1fs backoff", rc,
-                       "signal" if rc < 0 else "error",
+            if (self.healthy_run_s is not None
+                    and ran_s >= self.healthy_run_s):
+                # a long healthy run before this death: preemption
+                # pattern, not a crash loop — restart fast again
+                delay = self.backoff_s
+            flags.vlog(0, "Supervisor: child exited %d (%s) after %.1fs; "
+                       "restart %d/%d after %.1fs backoff", rc,
+                       "signal" if rc < 0 else "error", ran_s,
                        self.restarts + 1, self.max_restarts, delay)
+            jitter = 1.0
+            if self.backoff_jitter:
+                jitter += self._rng.uniform(-self.backoff_jitter,
+                                            self.backoff_jitter)
             if delay > 0:
-                self._sleep(delay)
+                self._sleep(delay * jitter)
             delay = min(delay * self.backoff_factor, self.max_backoff_s)
             self.restarts += 1

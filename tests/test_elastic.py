@@ -121,11 +121,18 @@ class TestCommitProtocol:
         assert elastic.is_committed(path)
         elastic.validate_snapshot(path)           # no raise
         marker = json.load(open(os.path.join(path, elastic.COMMIT_MARKER)))
-        # the marker records every payload file at its exact size
-        for name, size in marker["files"].items():
-            assert os.path.getsize(os.path.join(path, name)) == size
+        # the marker records every payload file at its exact size AND
+        # content digest (format 2), plus the commit timestamp and world
+        # config the tie-break/skip rules read
+        assert marker["format"] == elastic.COMMIT_FORMAT
+        assert marker["commit_ts"] > 0
+        assert marker["world"]["world_size"] == 1
+        for name, entry in marker["files"].items():
+            fpath = os.path.join(path, name)
+            assert os.path.getsize(fpath) == entry["size"]
+            assert elastic.file_digest(fpath) == entry["crc32"]
         meta = elastic.read_meta(path)
-        assert meta["step"] == 5 and meta["format"] == 1
+        assert meta["step"] == 5 and meta["format"] == 2
 
     def test_uncommitted_dir_skipped_and_rejected(self, tmp_path):
         arrays = _host_snapshot_args()
@@ -423,6 +430,416 @@ class TestErrorFeedbackResize:
 
 
 # ---------------------------------------------------------------------------
+# COMMIT integrity: digests, deterministic selection
+# ---------------------------------------------------------------------------
+
+class TestDigestIntegrity:
+    def test_bit_flip_rejected_naming_file(self, tmp_path):
+        """The satellite bar: a SILENT bit-flip (size unchanged) inside
+        a shard container is caught by the content digest in the COMMIT
+        record — size-only validation (digests=False) is blind to it."""
+        arrays = _host_snapshot_args()
+        path, _, _ = _save_host_arrays(str(tmp_path), arrays)
+        shard = os.path.join(path, "shard-0.pts")
+        with open(shard, "r+b") as f:
+            f.seek(os.path.getsize(shard) // 2)
+            byte = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([byte[0] ^ 0xFF]))
+        elastic.validate_snapshot(path, digests=False)   # blind
+        fails0 = elastic.metrics_registry().get(
+            "ptpu_ckpt_digest_failures_total").value
+        with pytest.raises(EnforceError) as ei:
+            elastic.validate_snapshot(path)
+        assert "shard-0.pts" in str(ei.value)
+        assert "digest" in str(ei.value)
+        assert elastic.metrics_registry().get(
+            "ptpu_ckpt_digest_failures_total").value == fails0 + 1
+        # restore re-validates: the corrupted snapshot cannot restore
+        with pytest.raises(EnforceError) as ei:
+            _restore_host_arrays(path, arrays)
+        assert "digest" in str(ei.value)
+
+    def test_meta_corruption_caught_too(self, tmp_path):
+        arrays = _host_snapshot_args()
+        path, _, _ = _save_host_arrays(str(tmp_path), arrays)
+        meta = os.path.join(path, elastic.META_FILE)
+        size = os.path.getsize(meta)
+        with open(meta, "r+b") as f:
+            f.write(b"X" * min(4, size))
+        with pytest.raises(EnforceError) as ei:
+            elastic.validate_snapshot(path)
+        assert elastic.META_FILE in str(ei.value)
+
+
+class TestLatestSelection:
+    def test_equal_step_tie_breaks_by_commit_ts(self, tmp_path):
+        """Two committed snapshots at the SAME step (concurrent/stale
+        writers racing on one root): the one with the LATER commit
+        timestamp wins regardless of serial order."""
+        arrays = _host_snapshot_args()
+        p0, _, _ = _save_host_arrays(str(tmp_path), arrays, step=7)
+        p1, _, _ = _save_host_arrays(str(tmp_path), arrays, step=7)
+        assert elastic.latest_snapshot(str(tmp_path)) == p1
+        # forge a newer commit_ts on the OLDER serial: it must now win
+        marker = os.path.join(p0, elastic.COMMIT_MARKER)
+        record = json.load(open(marker))
+        record["commit_ts"] = record["commit_ts"] + 1e6
+        with open(marker, "w") as f:
+            json.dump(record, f)
+        assert elastic.latest_snapshot(str(tmp_path)) == p0
+
+    def test_higher_step_wins_over_higher_serial(self, tmp_path):
+        """A stale writer minting a LATER serial at an EARLIER step must
+        not shadow newer training state."""
+        arrays = _host_snapshot_args()
+        p0, _, _ = _save_host_arrays(str(tmp_path), arrays, step=9)
+        p1, _, _ = _save_host_arrays(str(tmp_path), arrays, step=4)
+        assert int(os.path.basename(p1)[len(elastic.SNAPSHOT_PREFIX):]) \
+            > int(os.path.basename(p0)[len(elastic.SNAPSHOT_PREFIX):])
+        assert elastic.latest_snapshot(str(tmp_path)) == p0
+
+    def test_retention_ranks_like_selection(self, tmp_path):
+        """Retention prunes by the SAME (step, commit_ts, serial) key
+        selection uses: a stale writer minting LATER serials at EARLIER
+        steps must never push the newest-step snapshot out of the
+        retention window."""
+        arrays = _host_snapshot_args()
+        p_new, _, _ = _save_host_arrays(str(tmp_path), arrays, step=100)
+        for step in (50, 51, 52):    # stale writer: later serials
+            _save_host_arrays(str(tmp_path), arrays, step=step,
+                              max_snapshots=2)
+        assert os.path.isdir(p_new), \
+            "retention evicted the newest-step snapshot"
+        assert elastic.latest_snapshot(str(tmp_path)) == p_new
+        kept = {elastic.read_meta(p)["step"]
+                for _, p in elastic.list_snapshots(str(tmp_path))}
+        assert kept == {52, 100}
+
+    def test_newer_world_config_skipped_with_warn_once(self, tmp_path):
+        """A COMMIT record written by a NEWER protocol/world config is
+        skipped (never half-understood) and counted/warned exactly once
+        per directory."""
+        arrays = _host_snapshot_args()
+        p0, _, _ = _save_host_arrays(str(tmp_path), arrays, step=1)
+        p1, _, _ = _save_host_arrays(str(tmp_path), arrays, step=2)
+        marker = os.path.join(p1, elastic.COMMIT_MARKER)
+        record = json.load(open(marker))
+        record["format"] = elastic.COMMIT_FORMAT + 1
+        with open(marker, "w") as f:
+            json.dump(record, f)
+        skipped0 = elastic.metrics_registry().get(
+            "ptpu_ckpt_skipped_foreign_total").value
+        assert elastic.latest_snapshot(str(tmp_path)) == p0
+        assert elastic.latest_snapshot(str(tmp_path)) == p0  # again
+        assert elastic.metrics_registry().get(
+            "ptpu_ckpt_skipped_foreign_total").value == skipped0 + 1
+        # named explicitly, the foreign dir is rejected with the reason
+        with pytest.raises(EnforceError) as ei:
+            elastic.validate_snapshot(p1)
+        assert "newer" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# mesh-to-mesh resize: the resharding planner + three distinct re-layouts
+# ---------------------------------------------------------------------------
+
+class TestReshardPlanner:
+    def test_schedule_algebra_matches_costs_prediction(self):
+        from paddle_tpu.framework import costs
+        from paddle_tpu.parallel import reshard
+        # refinement (dp2 -> dp4 on dim 0): dynamic-slice, zero wire
+        steps = reshard.schedule_steps("v", (8, 4), 4, (2, 1), (4, 1))
+        assert [s.kind for s in steps] == ["refine-slice"]
+        assert sum(s.wire_bytes for s in steps) == 0.0
+        assert costs.reshard_wire_bytes(128, (2, 1), (4, 1)) == 0.0
+        # unshard (tp2 on dim 1 -> replicated): one all-gather; ring
+        # accounting sends out*(g-1)/g = nbytes/2 per device
+        nbytes = 16 * 48 * 4
+        steps = reshard.schedule_steps("w", (16, 48), 4, (1, 2), (1, 1))
+        assert [s.kind for s in steps] == ["all-gather"]
+        assert steps[0].group == 2 and steps[0].out_bytes == nbytes
+        assert steps[0].wire_bytes == nbytes / 2
+        assert costs.reshard_wire_bytes(nbytes, (1, 2), (1, 1)) \
+            == nbytes / 2
+        # dim move (dp2 on dim 0 -> tp2 on dim 1): refine dim 1 FIRST
+        # (memory-efficient ordering), then gather dim 0 at the refined
+        # other-dim factor — out = nbytes/2, wire = nbytes/4
+        nbytes = 8 * 8 * 4
+        steps = reshard.schedule_steps("m", (8, 8), 4, (2, 1), (1, 2))
+        assert [s.kind for s in steps] == ["refine-slice", "all-gather"]
+        assert steps[1].out_bytes == nbytes // 2
+        assert steps[1].wire_bytes == nbytes / 4
+        assert costs.reshard_wire_bytes(nbytes, (2, 1), (1, 2)) \
+            == nbytes / 4
+        # identity
+        steps = reshard.schedule_steps("i", (4,), 4, (2,), (2,))
+        assert [s.kind for s in steps] == ["identity"]
+
+    def test_random_factor_sweep_balances_exactly(self):
+        """Property: for every (old, new) factor pair the step-priced
+        schedule equals the closed-form prediction EXACTLY."""
+        from paddle_tpu.framework import costs
+        from paddle_tpu.parallel import reshard
+        rng = np.random.RandomState(0)
+        factors = (1, 2, 4, 8)
+        for _ in range(60):
+            rank = int(rng.randint(1, 4))
+            old = tuple(int(rng.choice(factors)) for _ in range(rank))
+            new = tuple(int(rng.choice(factors)) for _ in range(rank))
+            shape = tuple(8 * max(o, n) for o, n in zip(old, new))
+            steps = reshard.schedule_steps("v", shape, 4, old, new)
+            nbytes = int(np.prod(shape)) * 4
+            got = sum(s.wire_bytes for s in steps)
+            want = costs.reshard_wire_bytes(nbytes, old, new)
+            assert got == want, (old, new, got, want)
+
+    def test_coverage_factors_from_chunk_grid(self):
+        from paddle_tpu.parallel import reshard
+        entry = {"chunks": [
+            {"start": [0, 0], "shape": [4, 8]},
+            {"start": [4, 0], "shape": [4, 8]}]}
+        assert reshard._coverage_factors(entry, (8, 8)) == (2, 1)
+        entry = {"chunks": [{"start": [], "shape": []}]}
+        assert reshard._coverage_factors(entry, ()) == ()
+
+    def test_plan_reads_match_what_restore_actually_loads(self,
+                                                          tmp_path):
+        """"Reads only the byte ranges each new rank needs" is pinned
+        against the real reader: the chunks the plan lists for a var are
+        EXACTLY the chunks ShardedCheckpoint loads when restoring it
+        onto the new placement."""
+        from paddle_tpu.parallel import reshard
+        from paddle_tpu.sharded_checkpoint import (ShardedCheckpoint,
+                                                   restore_array)
+        feeds = _feeds(2)
+        loss, pexe = _fresh_world(2)
+        for f in feeds:
+            pexe.run(feed=f, fetch_list=[loss])
+        elastic.save_train_state(str(tmp_path), executor=pexe, step=2)
+        snap = elastic.latest_snapshot(str(tmp_path))
+        meta = elastic.read_meta(snap)
+
+        loss, pexe4 = _fresh_world(4)
+        prepared = pexe4.prepare_program()
+        ckpt = ShardedCheckpoint(snap)
+        plan = reshard.plan_restore(ckpt, meta, prepared, pexe4)
+        assert reshard.validate_schedule(plan) == []
+        # pick a ZeRO-1 sharded accumulator (its coverage is split) and
+        # a replicated parameter
+        shard_var = next(n for n, v in plan.variables.items()
+                         if v.old_factors and v.old_factors[0] == 2)
+        for name in [shard_var]:
+            ckpt2 = ShardedCheckpoint(snap)
+            sharding = pexe4.state_sharding(prepared, name)
+            restore_array(ckpt2, name, sharding)
+            loaded = {(f, k) for f, k in ckpt2._cache}
+            planned = {(f, k) for f, k, _ in plan.variables[name].reads}
+            assert loaded == planned, (name, loaded, planned)
+
+
+VOCAB_R, T_R, D_R, HEADS_R, LAYERS_R = 32, 4, 16, 2, 2
+
+
+def _tfm_build():
+    from paddle_tpu.models import transformer
+    loss, _ = transformer.transformer_lm(
+        vocab=VOCAB_R, max_len=T_R, d_model=D_R, d_inner=2 * D_R,
+        num_heads=HEADS_R, num_layers=LAYERS_R, mean_loss=True)
+    pt.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    return loss
+
+
+def _tfm_feeds(n=6, bs=8):
+    rng = np.random.RandomState(5)
+    return [{
+        "tokens": rng.randint(0, VOCAB_R, (bs, T_R)).astype("int64"),
+        "tokens@SEQLEN": np.full((bs,), T_R, dtype="int32"),
+        "targets": rng.randint(0, VOCAB_R, (bs, T_R)).astype("int64")}
+        for _ in range(n)]
+
+
+def _tfm_world(axes, annotate=False, stages=0, micro=0, quant=""):
+    """Fresh transformer training world on a named-axes mesh — the
+    builder every side of a mesh-to-mesh resize shares (identical var
+    names via the unique_name guard, identical random_seed)."""
+    from paddle_tpu.parallel import annotate_tp
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    with pt.core.unique_name.guard():
+        loss = _tfm_build()
+    if annotate:
+        assert annotate_tp()
+    n = int(np.prod(list(axes.values())))
+    bst = BuildStrategy(**(dict(pipeline_stages=stages,
+                                num_microbatches=micro) if stages
+                           else {}))
+    bst.reduce_strategy = ReduceStrategy.ReduceScatter
+    if quant:
+        bst.quant_comm = quant
+        bst.comm_error_feedback = True
+    pexe = ParallelExecutor(loss_name=loss.name, build_strategy=bst,
+                            mesh=DeviceMesh(jax.devices()[:n], axes))
+    pt.Executor().run(pt.default_startup_program())
+    return loss, pexe
+
+
+class TestMeshResize:
+    """The acceptance bar: three DISTINCT mesh-to-mesh re-layouts
+    restore with fixed-seed loss parity <= 1e-5 vs the uninterrupted
+    run — dp-grow (TestDeterministicResume.test_dp_resize_2_to_4),
+    dp×tp → dp, and dp×pp → dp×tp — with the emitted redistribution
+    schedule's wire bytes matching the costs.py prediction exactly
+    (validate_schedule is enforced inside restore; asserted again here
+    via the returned summary)."""
+
+    @pytest.fixture(autouse=True)
+    def _f32_matmuls(self):
+        """Parity runs compare f32-exact: splitting a bf16 contraction
+        over tp changes rounding — precision noise, not a resize bug."""
+        from paddle_tpu.core import flags
+        old = flags.get_flag("use_bf16_matmul")
+        flags.set_flag("use_bf16_matmul", False)
+        yield
+        flags.set_flag("use_bf16_matmul", old)
+
+    def _run_and_snapshot(self, root, axes, feeds, at_step, **kw):
+        """Train the full trajectory on one world, snapshotting at
+        `at_step` without perturbing it: the uninterrupted reference and
+        the donor snapshot in one run."""
+        loss, pexe = _tfm_world(axes, **kw)
+        ref = []
+        for i, f in enumerate(feeds):
+            ref.append(float(pexe.run(feed=f, fetch_list=[loss])[0]))
+            if i + 1 == at_step:
+                elastic.save_train_state(root, executor=pexe,
+                                         step=at_step)
+        return ref
+
+    def test_dp_tp_to_dp_resize(self, tmp_path):
+        """dp2×tp2 → dp4: the tp axis disappears — every tp-sharded
+        parameter/accumulator must all-gather (wire bytes > 0, matching
+        the costs prediction exactly); ZeRO-1 dp slices re-partition
+        2→4."""
+        feeds = _tfm_feeds()
+        ref = self._run_and_snapshot(str(tmp_path),
+                                     {"dp": 2, "tp": 2}, feeds,
+                                     at_step=3, annotate=True)
+        loss, pexe4 = _tfm_world({"dp": 4})
+        meta = elastic.restore_train_state(str(tmp_path),
+                                           executor=pexe4)
+        assert meta["world"] == {"dp": 2, "tp": 2}
+        rs = meta["reshard"]
+        assert rs["new_world"] == {"dp": 4}
+        assert rs["wire_bytes"] > 0          # tp state really moved
+        assert rs["steps"].get("all-gather", 0) > 0
+        got = [float(pexe4.run(feed=f, fetch_list=[loss])[0])
+               for f in feeds[3:]]
+        worst = max(abs(a - b) for a, b in zip(ref[3:], got))
+        assert worst <= 1e-5, f"dp2x tp2 -> dp4 parity {worst}"
+
+    def test_dp_pp_to_dp_tp_resize(self, tmp_path):
+        """dp2×pp2 → dp2×tp2: the pipeline axis disappears and a tensor
+        axis appears — replicated params SLICE onto tp (zero wire: the
+        re-layout is pure refinement), and the restored program is the
+        tp-rewritten one (r10/r13-verified before the first step)."""
+        feeds = _tfm_feeds()
+        ref = self._run_and_snapshot(str(tmp_path),
+                                     {"dp": 2, "pp": 2}, feeds,
+                                     at_step=3, stages=2, micro=2)
+        loss, pexe_tp = _tfm_world({"dp": 2, "tp": 2}, annotate=True)
+        meta = elastic.restore_train_state(str(tmp_path),
+                                           executor=pexe_tp)
+        assert meta["world"] == {"dp": 2, "pp": 2}
+        rs = meta["reshard"]
+        assert rs["new_world"] == {"dp": 2, "tp": 2}
+        # replicated -> sharded is dynamic-slice only: nothing on the
+        # wire, exactly as costs.reshard_wire_bytes predicts
+        assert rs["wire_bytes"] == 0.0
+        assert rs["steps"].get("all-gather", 0) == 0
+        got = [float(pexe_tp.run(feed=f, fetch_list=[loss])[0])
+               for f in feeds[3:]]
+        worst = max(abs(a - b) for a, b in zip(ref[3:], got))
+        assert worst <= 1e-5, f"dp2x pp2 -> dp2x tp2 parity {worst}"
+
+    def test_ef_state_round_trips_across_tp_change(self, tmp_path):
+        """Error-feedback residuals re-map through the GLOBAL gradient
+        space across a tp change: dp2×tp2 (int8 + EF) → dp4 → dp2×tp2.
+        Params and accumulators return bit-exact; inside the EF state,
+        tp-SHARDED gradient segments re-slice bit-exact (pad-then-fold
+        dp identity at a power-of-two ratio), and tp-replicated segments
+        come back as the MEAN of their per-shard rows — per-shard
+        residuals legitimately differ (quant scale blocks span
+        neighboring tp-local bucket segments), so the mean is the
+        documented mass-preserving semantic, and it round-trips exactly
+        once collapsed."""
+        from paddle_tpu.sharded_checkpoint import ShardedCheckpoint
+        feeds = _tfm_feeds(4)
+        root_a = str(tmp_path / "a")
+        root_b = str(tmp_path / "b")
+        loss, pexe = _tfm_world({"dp": 2, "tp": 2}, annotate=True,
+                                quant="int8")
+        for f in feeds:
+            pexe.run(feed=f, fetch_list=[loss])
+        elastic.save_train_state(root_a, executor=pexe, step=4)
+        snap_a = elastic.latest_snapshot(root_a)
+        ckpt_a = ShardedCheckpoint(snap_a)
+        orig = {n: ckpt_a.read(n) for n in ckpt_a.names()}
+        ef_a = [n for n in orig if n.startswith("dp_comm_err")]
+        assert ef_a and any(np.abs(orig[n]).max() > 0 for n in ef_a), \
+            "test premise: non-trivial residuals exist"
+        meta_a = elastic.read_meta(snap_a)
+        layout = meta_a["ef_layout"]
+        assert layout["tp"] == 2
+        assert any(d is not None for t in layout["transfers"]
+                   for d in t["tp_dims"]), \
+            "test premise: tp-sharded gradient segments exist"
+
+        # dp2 x tp2 -> dp4: restore (tp disappears), snapshot again
+        loss, pexe4 = _tfm_world({"dp": 4}, quant="int8")
+        elastic.restore_train_state(root_a, executor=pexe4)
+        elastic.save_train_state(root_b, executor=pexe4, step=4)
+        meta_b = elastic.read_meta(root_b)
+        assert meta_b["ef_layout"]["tp"] == 1
+        assert meta_b["ef_layout"]["dp"] == 4
+
+        # dp4 -> dp2 x tp2: non-EF state bit-exact; EF per documented
+        # semantics
+        loss, pexe_back = _tfm_world({"dp": 2, "tp": 2}, annotate=True,
+                                     quant="int8")
+        elastic.restore_train_state(root_b, executor=pexe_back)
+        scope = pt.global_scope()
+        for name, want in orig.items():
+            if name.startswith("dp_comm_err"):
+                continue
+            got = np.asarray(scope.get(name))
+            np.testing.assert_array_equal(
+                got, want, err_msg=f"{name} did not round-trip across "
+                                   f"the tp resize")
+        tp, dp = layout["tp"], layout["dp"]
+        for t in layout["transfers"]:
+            old = orig[t["var"]].reshape(tp, dp, t["flat"])
+            got = np.asarray(scope.get(t["var"])) \
+                .reshape(tp, dp, t["flat"])
+            off = 0
+            for g, n, tp_dim in zip(t["grads"], t["numels"],
+                                    t["tp_dims"]):
+                want_seg = old[:, :, off:off + n]
+                got_seg = got[:, :, off:off + n]
+                if tp_dim is not None:
+                    np.testing.assert_array_equal(
+                        got_seg, want_seg,
+                        err_msg=f"tp-sharded segment {g} not bit-exact")
+                else:
+                    mean = want_seg.mean(axis=0)
+                    for ti in range(tp):
+                        np.testing.assert_array_equal(
+                            got_seg[ti], mean,
+                            err_msg=f"replicated segment {g} != tp-mean")
+                off += n
+
+
+# ---------------------------------------------------------------------------
 # trainer integration + supervisor
 # ---------------------------------------------------------------------------
 
@@ -506,6 +923,90 @@ class TestSupervisor:
                          sleep_fn=delays.append)
         assert sup.run() == 7
         assert sup.exit_codes == [7, 7]
+        assert sup.exhausted
+
+    def test_budget_exhaustion_raises_terminal_error(self):
+        """The satellite bar: a crash-looping child ends in a CLEAR
+        terminal error, not an exit code the caller may ignore."""
+        from paddle_tpu.trainer import (Supervisor,
+                                        SupervisorExhaustedError)
+        sup = Supervisor([sys.executable, "-c", "import sys; sys.exit(5)"],
+                         max_restarts=1, backoff_s=0.01,
+                         raise_on_exhaust=True, sleep_fn=lambda s: None)
+        with pytest.raises(SupervisorExhaustedError) as ei:
+            sup.run()
+        assert ei.value.exit_code == 5
+        assert ei.value.exit_codes == [5, 5]
+        assert "crash-looping" in str(ei.value)
+
+    def test_backoff_jitter_decorrelates_delays(self):
+        """Jittered backoff: each sleep is the exponential delay scaled
+        by a uniform factor in [1-j, 1+j] from the injected rng."""
+        from paddle_tpu.trainer import Supervisor
+
+        class _Rng:
+            def __init__(self):
+                self.calls = []
+
+            def uniform(self, a, b):
+                self.calls.append((a, b))
+                return 0.5 * (a + b) + 0.1   # deterministic: +0.1
+
+        rng = _Rng()
+        delays = []
+        sup = Supervisor([sys.executable, "-c", "import sys; sys.exit(3)"],
+                         max_restarts=2, backoff_s=0.1,
+                         backoff_factor=2.0, backoff_jitter=0.25,
+                         rng=rng, sleep_fn=delays.append)
+        assert sup.run() == 3
+        assert rng.calls == [(-0.25, 0.25), (-0.25, 0.25)]
+        np.testing.assert_allclose(delays, [0.1 * 1.1, 0.2 * 1.1])
+
+    def test_healthy_run_resets_backoff(self):
+        """A child that ran past healthy_run_s before dying restarts at
+        the BASE backoff (a preemption pattern), while instant deaths
+        keep compounding it (a crash loop)."""
+        from paddle_tpu.trainer import Supervisor
+        child = ("import sys,time\n"
+                 "time.sleep(0.25)\n"
+                 "sys.exit(9)\n")
+        delays = []
+        sup = Supervisor([sys.executable, "-c", child], max_restarts=2,
+                         backoff_s=0.05, backoff_factor=4.0,
+                         healthy_run_s=0.2, sleep_fn=delays.append)
+        assert sup.run() == 9
+        assert delays == [0.05, 0.05]   # reset each time, never 0.2
+        delays2 = []
+        sup2 = Supervisor([sys.executable, "-c", "import sys; sys.exit(9)"],
+                          max_restarts=2, backoff_s=0.05,
+                          backoff_factor=4.0, healthy_run_s=10.0,
+                          sleep_fn=delays2.append)
+        assert sup2.run() == 9
+        assert delays2 == [0.05, 0.2]   # compounding: not healthy
+
+    def test_world_gang_restarts_together(self, tmp_path):
+        """world_size > 1: one rank dying kills the rest of the gang and
+        the WHOLE world relaunches (the restart granularity the barrier
+        protocol assumes). Each rank sees its identity in the env."""
+        from paddle_tpu.trainer import Supervisor
+        marker = str(tmp_path / "rank1_died")
+        child = (
+            "import os, sys, time\n"
+            f"p = {marker!r}\n"
+            "rank = os.environ['PTPU_WORLD_RANK']\n"
+            "assert os.environ['PTPU_WORLD_SIZE'] == '3'\n"
+            "if rank == '1' and not os.path.exists(p):\n"
+            "    open(p, 'w').write('1')\n"
+            "    sys.exit(6)          # first incarnation: rank 1 dies\n"
+            "if not os.path.exists(p):\n"
+            "    time.sleep(30)       # others hang until terminated\n"
+            "sys.exit(0)\n")
+        sup = Supervisor([sys.executable, "-c", child], world_size=3,
+                         max_restarts=3, backoff_s=0.05,
+                         sleep_fn=lambda s: None)
+        assert sup.run() == 0
+        assert sup.restarts == 1
+        assert sup.exit_codes == [6, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -566,7 +1067,7 @@ class TestCrashMidSaveAtomicity:
         assert len(snaps) == 2
         marker = json.load(open(os.path.join(snaps[-1][1],
                                              elastic.COMMIT_MARKER)))
-        total = sum(marker["files"].values())
+        total = sum(e["size"] for e in marker["files"].values())
 
         rng = np.random.RandomState(20260804)
         offsets = sorted({0, total // 2, total, total + 1,

@@ -182,6 +182,8 @@ def _restore_diagnostics(prog, args):
     stanza's lint half). Emitted as error-severity diagnostics:
 
       restore-uncommitted     no committed snapshot / integrity failure
+      restore-digest-mismatch a file's content digest disagrees with the
+                              COMMIT record (silent corruption)
       restore-missing-var     program declares state the snapshot lacks
       restore-shape-mismatch  saved shape != declared shape
       restore-dp-indivisible  a ZeRO-1-sharded var cannot split over --dp
@@ -201,8 +203,12 @@ def _restore_diagnostics(prog, args):
     try:
         snap = elastic._resolve_snapshot_dir(args.restore_dir)
         elastic.validate_snapshot(snap)
+    except elastic.SnapshotDigestError as e:
+        return [Diagnostic("restore-digest-mismatch", args.restore_dir,
+                           str(e))]
     except EnforceError as e:
-        return [Diagnostic("restore-uncommitted", args.restore_dir, str(e))]
+        return [Diagnostic("restore-uncommitted", args.restore_dir,
+                           str(e))]
     meta = elastic.read_meta(snap)
     ckpt = ShardedCheckpoint(snap)
     saved = ckpt.vars

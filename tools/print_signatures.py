@@ -58,6 +58,8 @@ PUBLIC_MODULES = [
     "paddle_tpu.parallel.elastic",
     "paddle_tpu.parallel.grad_comm",
     "paddle_tpu.parallel.pipeline",
+    "paddle_tpu.parallel.process_world",
+    "paddle_tpu.parallel.reshard",
     "paddle_tpu.data",
     "paddle_tpu.fusion",
 ]

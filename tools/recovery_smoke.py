@@ -19,17 +19,43 @@ Orchestrates REAL process deaths through the elastic fault-injection hook
            restore falls back to the previous committed snapshot, and
            the relaunched run still reproduces the reference exactly.
 
-Child modes (also used by tests/test_elastic.py):
+`--world 4` runs the MULTI-RANK phases instead (the chief-commits
+barrier over a simulated 4-rank ProcessWorld, parallel/process_world.py):
+
+  phase D  SIGKILL a NON-CHIEF rank mid-barrier (crash_rank:2@ack at the
+           first snapshot attempt): the whole gang dies with nothing
+           committed, the restart re-trains and commits through the full
+           barrier, losses match the uninterrupted dp4 reference
+           BITWISE.
+  phase E  SIGKILL the CHIEF mid-COMMIT (crash_rank:0@commit: after the
+           directory rename, before the COMMIT marker): the restart
+           finds only an UNCOMMITTED snapshot dir, starts clean, and
+           still reproduces the reference exactly; the uncommitted
+           leftover stays on disk for the run_ci.sh lint negative check
+           (lint_program --restore_dir must exit 1 on it).
+
+Child modes (also used by tests/test_elastic.py /
+tests/test_process_world.py):
   --child          one training run: restore-if-possible, train to
-                   --steps, snapshot every --snap_every, append per-step
-                   losses to --out as JSON lines
+                   --steps, snapshot every --snap_every (through the
+                   barrier when --world > 1), append per-step losses to
+                   --out as JSON lines; --fault_once arms
+                   PTPU_FAULT_INJECT for exactly ONE attempt (a sentinel
+                   file marks the armed attempt)
   --atomic-child   no-mesh snapshot writer for the crash-mid-save
                    atomicity property test: commit generation 0, then
                    save generation 1 (which PTPU_FAULT_INJECT may kill
                    at any byte offset)
+  --world-atomic-child
+                   mesh-backed MULTI-RANK writer for the crash-anywhere
+                   property test: dp4 sharded + replicated state over a
+                   4-rank world; commit generation 0 through the
+                   barrier, then save generation 1 under the fault
+                   (crash_rank:<r>@<phase>[@<offset>])
 
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
         python tools/recovery_smoke.py
+    ... python tools/recovery_smoke.py --world 4
 """
 
 from __future__ import annotations
@@ -86,6 +112,16 @@ def run_child(args) -> int:
         # self-arming fault: only the FIRST attempt crashes, so one
         # Supervisor argv covers crash and recovery
         os.environ["PTPU_FAULT_INJECT"] = args.fault_if_fresh
+    if args.fault_once:
+        # arm for exactly ONE attempt, committed-or-not (a barrier kill
+        # commits nothing, so "fresh" would re-arm forever): a sentinel
+        # file marks that some attempt already ran armed
+        sentinel = os.path.join(args.root, ".fault_armed")
+        os.makedirs(args.root, exist_ok=True)
+        if not os.path.exists(sentinel):
+            with open(sentinel, "w") as f:
+                f.write(args.fault_once)
+            os.environ["PTPU_FAULT_INJECT"] = args.fault_once
 
     with pt.core.unique_name.guard():
         loss = _build_model()
@@ -95,6 +131,10 @@ def run_child(args) -> int:
     pexe = ParallelExecutor(loss_name=loss.name, build_strategy=bst,
                             mesh=mesh)
     pt.Executor().run(pt.default_startup_program())
+    world = None
+    if args.world > 1:
+        from paddle_tpu.parallel.process_world import ProcessWorld
+        world = ProcessWorld(args.world)
     start = 0
     if not fresh:
         meta = elastic.restore_train_state(args.root, executor=pexe)
@@ -107,8 +147,12 @@ def run_child(args) -> int:
             f.write(json.dumps({"step": i, "loss": val}) + "\n")
             f.flush()
             if (i + 1) % args.snap_every == 0:
-                elastic.save_train_state(args.root, executor=pexe,
-                                         step=i + 1)
+                path = elastic.save_train_state(args.root, executor=pexe,
+                                                step=i + 1, world=world,
+                                                barrier_deadline_s=30)
+                if world is not None and path is None:
+                    print(f"snapshot at step {i + 1} aborted at the "
+                          f"barrier; continuing", file=sys.stderr)
     return 0
 
 
@@ -150,6 +194,69 @@ def run_atomic_child(args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# child: multi-rank barrier writer (crash-anywhere property test)
+# ---------------------------------------------------------------------------
+
+def world_atomic_arrays(generation: int):
+    """The deterministic state both sides of the property test agree on:
+    one dp-sharded [8, 6] matrix (its rows spread across every rank's
+    devices, so EVERY rank stages real payload) plus one replicated
+    [4, 4] matrix (written once, by whichever rank owns its replica-0
+    device). Generation g adds g to every element."""
+    import numpy as np
+    rng = np.random.RandomState(11)
+    return {"sharded_w": rng.randn(8, 6).astype("f4") + generation,
+            "repl_w": rng.randn(4, 4).astype("f4") + generation}
+
+
+def run_world_atomic_child(args) -> int:
+    import jax
+    import numpy as np
+
+    from paddle_tpu.framework.program import Program, program_guard
+    from paddle_tpu.framework.scope import Scope
+    from paddle_tpu.parallel import elastic
+    from paddle_tpu.parallel.mesh import DeviceMesh
+    from paddle_tpu.parallel.process_world import ProcessWorld
+
+    n = args.world
+    mesh = DeviceMesh(jax.devices()[:n], {"dp": n})
+    world = ProcessWorld(n)
+
+    class _MeshOnly:
+        pass
+
+    exe = _MeshOnly()
+    exe.mesh = mesh
+
+    def _save(generation, fault_env=None):
+        arrays = world_atomic_arrays(generation)
+        prog, startup = Program(), Program()
+        scope = Scope()
+        with program_guard(prog, startup):
+            for name, val in arrays.items():
+                prog.global_block().create_var(
+                    name=name, shape=list(val.shape), dtype="float32",
+                    persistable=True)
+                sharding = (mesh.batch_sharding(val.ndim)
+                            if name.startswith("sharded")
+                            else mesh.replicated())
+                scope.set_var(name, jax.device_put(np.asarray(val),
+                                                   sharding))
+        if fault_env is not None:
+            os.environ["PTPU_FAULT_INJECT"] = fault_env
+        return elastic.save_train_state(args.root, program=prog,
+                                        scope=scope, executor=exe,
+                                        step=generation, world=world,
+                                        barrier_deadline_s=30)
+
+    p0 = _save(0)                                # generation 0: committed
+    assert p0 is not None, "generation 0 barrier must commit"
+    _save(1, fault_env=args.fault or "")         # gen 1: may die anywhere
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # orchestrator
 # ---------------------------------------------------------------------------
 
@@ -164,12 +271,16 @@ def _child_env(fault=None):
 
 
 def _child_argv(root, out, dp=2, steps=STEPS, snap_every=SNAP_EVERY,
-                fault_if_fresh=None):
+                fault_if_fresh=None, world=0, fault_once=None):
     argv = [sys.executable, os.path.abspath(__file__), "--child",
             "--root", root, "--out", out, "--dp", str(dp),
             "--steps", str(steps), "--snap_every", str(snap_every)]
     if fault_if_fresh:
         argv += ["--fault_if_fresh", fault_if_fresh]
+    if fault_once:
+        argv += ["--fault_once", fault_once]
+    if world:
+        argv += ["--world", str(world)]
     return argv
 
 
@@ -264,17 +375,131 @@ def orchestrate(args) -> int:
     return 0
 
 
+def orchestrate_world(args) -> int:
+    """The multi-rank phases (--world N): chief-commits barrier under
+    real SIGKILLs of a non-chief rank mid-barrier and the chief
+    mid-COMMIT, restart, fixed-seed loss parity vs the uninterrupted
+    run. Keeps (under --keep_root) root `d` with a committed barrier
+    snapshot and root `e` additionally holding the chief-kill's
+    UNCOMMITTED snapshot dir — the run_ci.sh lint stanza's positive and
+    negative --restore_dir targets."""
+    from paddle_tpu.parallel import elastic
+    n = args.world
+    dp = n
+    if args.keep_root:
+        work = args.keep_root
+        shutil.rmtree(work, ignore_errors=True)
+        os.makedirs(work)
+    else:
+        work = tempfile.mkdtemp(prefix="ptpu_recovery_world_")
+    steps = args.steps
+
+    print(f"== world reference run (uninterrupted, dp={dp}, "
+          f"{n}-rank barrier) ==")
+    ref_out = os.path.join(work, "ref.jsonl")
+    rc = subprocess.run(
+        _child_argv(os.path.join(work, "ref"), ref_out, dp=dp,
+                    steps=steps, world=n),
+        env=_child_env()).returncode
+    assert rc == 0, f"world reference run failed rc={rc}"
+    ref = _losses(ref_out)
+    assert sorted(ref) == list(range(steps)), ref
+    ref_snap = elastic.latest_snapshot(os.path.join(work, "ref"))
+    assert ref_snap is not None, "reference run committed no snapshot"
+    marker_path = os.path.join(ref_snap, elastic.COMMIT_MARKER)
+    marker = json.load(open(marker_path))
+    assert marker["manifests"] == n, \
+        f"barrier snapshot binds {marker['manifests']} manifests, " \
+        f"expected {n}"
+
+    def _kill_phase(tag, fault, expect_uncommitted):
+        """Warm up a root to a committed barrier snapshot (steps/2),
+        then run the full child armed with `fault` — it RESUMES from the
+        committed snapshot and the designated rank dies at the next
+        barrier — then restart unfaulted and demand bitwise parity."""
+        root = os.path.join(work, tag)
+        out = os.path.join(work, f"{tag}.jsonl")
+        half = steps // 2
+        rc = subprocess.run(
+            _child_argv(root, out, dp=dp, steps=half, world=n),
+            env=_child_env()).returncode
+        assert rc == 0, f"{tag}: warm-up run failed rc={rc}"
+        warm = elastic.latest_snapshot(root)
+        assert warm is not None and \
+            elastic.read_meta(warm)["step"] == half
+        rc = subprocess.run(
+            _child_argv(root, out, dp=dp, steps=steps, world=n,
+                        fault_once=fault),
+            env=_child_env()).returncode
+        assert rc == -9, f"{tag}: child exited {rc}, expected SIGKILL " \
+                         f"({fault})"
+        # the kill happened strictly before a COMMIT marker: the warm-up
+        # snapshot is still the latest committed one
+        latest = elastic.latest_snapshot(root)
+        assert latest is not None and \
+            elastic.read_meta(latest)["step"] == half, \
+            f"{tag}: a barrier killed pre-COMMIT must commit nothing new"
+        uncommitted = [p for _, p in elastic.list_snapshots(
+            root, committed_only=False) if not elastic.is_committed(p)]
+        if expect_uncommitted:
+            assert uncommitted, \
+                f"{tag}: chief killed between rename and COMMIT must " \
+                f"leave an uncommitted snapshot dir"
+        rc = subprocess.run(
+            _child_argv(root, out, dp=dp, steps=steps, world=n),
+            env=_child_env()).returncode
+        assert rc == 0, f"{tag}: restart failed rc={rc}"
+        got = _losses(out)
+        deltas = [abs(got[i] - ref[i]) for i in range(steps)]
+        assert max(deltas) == 0.0, \
+            f"{tag}: resumed losses not bitwise-equal: {deltas}"
+        elastic.validate_snapshot(elastic.latest_snapshot(root))
+        return [p for _, p in elastic.list_snapshots(
+            root, committed_only=False) if not elastic.is_committed(p)]
+
+    print("== phase D: SIGKILL non-chief rank 2 mid-barrier "
+          "(crash_rank:2@ack), resume, exact parity ==")
+    _kill_phase("d", "crash_rank:2@ack", expect_uncommitted=False)
+    print("   rank-2 kill committed nothing; resumed run exact")
+
+    print("== phase E: SIGKILL the CHIEF mid-COMMIT "
+          "(crash_rank:0@commit), resume, exact parity ==")
+    still = _kill_phase("e", "crash_rank:0@commit",
+                        expect_uncommitted=True)
+    assert still, "uncommitted leftover expected to remain on disk " \
+                  "(the run_ci lint negative target)"
+    print(f"   uncommitted leftover {still[0]} skipped; resume exact")
+
+    if args.keep_root:
+        print(f"work dir kept at {work} (committed: {work}/d, "
+              f"uncommitted leftover: {still[0]})")
+    else:
+        shutil.rmtree(work, ignore_errors=True)
+    print("world recovery smoke OK")
+    return 0
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--child", action="store_true")
     p.add_argument("--atomic-child", action="store_true",
                    dest="atomic_child")
+    p.add_argument("--world-atomic-child", action="store_true",
+                   dest="world_atomic_child")
     p.add_argument("--root", default="")
     p.add_argument("--out", default="")
     p.add_argument("--dp", type=int, default=2)
+    p.add_argument("--world", type=int, default=0,
+                   help="simulated ProcessWorld size: children snapshot "
+                        "through the chief-commits barrier; the "
+                        "orchestrator runs the multi-rank kill phases")
     p.add_argument("--steps", type=int, default=STEPS)
     p.add_argument("--snap_every", type=int, default=SNAP_EVERY)
     p.add_argument("--fault_if_fresh", default="")
+    p.add_argument("--fault_once", default="",
+                   help="arm PTPU_FAULT_INJECT for exactly one attempt "
+                        "(sentinel-file tracked; works for faults that "
+                        "commit nothing, unlike --fault_if_fresh)")
     p.add_argument("--fault", default="")
     p.add_argument("--keep_root", default="",
                    help="orchestrator work dir to keep (the CI stanza "
@@ -284,6 +509,11 @@ def main():
         sys.exit(run_child(args))
     if args.atomic_child:
         sys.exit(run_atomic_child(args))
+    if args.world_atomic_child:
+        args.world = args.world or 4
+        sys.exit(run_world_atomic_child(args))
+    if args.world:
+        sys.exit(orchestrate_world(args))
     sys.exit(orchestrate(args))
 
 

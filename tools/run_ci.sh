@@ -349,6 +349,33 @@ JAX_PLATFORMS=cpu python tools/lint_program.py --model mnist \
     --optimizer momentum --dp 4 --restore_dir /tmp/ptpu_recovery_ci/b
 rm -rf /tmp/ptpu_recovery_ci
 
+echo "== multi-rank recovery (chief-commits barrier, kill -9 mid-barrier) =="
+# the chief-commits multi-writer protocol end to end (parallel/elastic.py +
+# parallel/process_world.py): training dp=4 snapshots through a 4-rank
+# simulated world; a non-chief rank is SIGKILLed mid-barrier (nothing may
+# commit) and the chief is SIGKILLed mid-COMMIT (a VISIBLE but uncommitted
+# snapshot dir remains); both restarts resume from the last committed
+# barrier snapshot with BITWISE fixed-seed loss parity vs the uninterrupted
+# run. Then lint_program --restore_dir must ACCEPT every committed barrier
+# snapshot (exit 0) and REJECT the uncommitted leftover (exit 1).
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python tools/recovery_smoke.py --world 4 \
+    --keep_root /tmp/ptpu_recovery_world_ci
+JAX_PLATFORMS=cpu python tools/lint_program.py --model mnist \
+    --optimizer momentum --dp 4 \
+    --restore_dir /tmp/ptpu_recovery_world_ci/d
+JAX_PLATFORMS=cpu python tools/lint_program.py --model mnist \
+    --optimizer momentum --dp 4 \
+    --restore_dir /tmp/ptpu_recovery_world_ci/e
+uncommitted=$(ls -d /tmp/ptpu_recovery_world_ci/e/snapshot-* | while read d; do \
+    [ ! -f "$d/COMMIT" ] && echo "$d"; done | head -1)
+test -n "$uncommitted"
+if JAX_PLATFORMS=cpu python tools/lint_program.py --model mnist \
+    --optimizer momentum --restore_dir "$uncommitted"; then
+    echo "lint accepted an UNCOMMITTED snapshot dir"; exit 1
+fi
+rm -rf /tmp/ptpu_recovery_world_ci
+
 echo "== serving-engine smoke =="
 # continuous-batching engine end to end: submit through the RPC server,
 # decode over the slot cache, check a mid-batch join completes (fast:

@@ -580,18 +580,23 @@ class PipelinePartitionPass(Pass):
             hidden |= set(op.output_names())
 
         # --- splice pp_send/pp_recv at every cut -------------------------
+        # both sides of a cut share one correlation id: a merged
+        # cross-rank timeline (tools/trace_merge.py) pairs the sender's
+        # and receiver's spans by it, so "who waited on whom" reads off
+        # the matched corr_id lanes
         sends, recvs = [], []
         for c in range(K - 1):
+            corr = f"ppcut-{c}-s{c}to{c + 1}"
             buf = block.create_var(name=f"pp_cut{c}@PP", shape=None,
                                    dtype="float32", stop_gradient=True)
             sends.append(Operator(
                 block, "pp_send", inputs={"X": list(crossings[c])},
                 outputs={"Out": [buf.name]},
-                attrs={"cut": c, "op_role": "forward"}))
+                attrs={"cut": c, "corr_id": corr, "op_role": "forward"}))
             recvs.append(Operator(
                 block, "pp_recv", inputs={"X": [buf.name]},
                 outputs={"Out": list(crossings[c])},
-                attrs={"cut": c, "op_role": "forward"}))
+                attrs={"cut": c, "corr_id": corr, "op_role": "forward"}))
         ins_by_pos: Dict[int, list] = {}
         for c in range(K - 1):
             ins_by_pos.setdefault(stage_pos[c][-1] + 1, []).append(sends[c])

@@ -11,6 +11,10 @@ Three pieces, one story — see docs/observability.md:
 - `ledger`: joins `framework.costs.predict()` analytic cost reports with
   measured spans and HLO collective censuses into one
   predicted-vs-measured artifact per run (BENCH_OBS_*.json).
+- `flight_recorder` (r16): the distributed half — per-rank phase
+  beacons that survive SIGKILL, crash dossiers (spans + metrics + state
+  board) on enforce error/SIGTERM/rank death, and the Supervisor's
+  post-mortem synthesis (which rank died, in which barrier phase).
 
 The capability equivalent of the reference's platform/profiler +
 device_tracer + timeline stack, grown into the always-on,
@@ -18,9 +22,10 @@ prediction-reconciling form the auto-parallel planner (ROADMAP item 2)
 and the serving load harness (item 3) consume.
 """
 
-from . import ledger, metrics, tracing  # noqa: F401
+from . import flight_recorder, ledger, metrics, tracing  # noqa: F401
 from .ledger import CostLedger, LedgerRow  # noqa: F401
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
-                      MetricsRegistry, default_registry)
+                      MetricsRegistry, MultiRegistry, default_registry)
 from .tracing import (SPAN_KINDS, Span, aggregate,  # noqa: F401
-                      export_chrome_trace, span, spans)
+                      export_chrome_trace, rank_scope, record_span,
+                      scoped_tags, span, spans)

@@ -262,18 +262,73 @@ class MetricsRegistry:
     def expose(self) -> str:
         """Prometheus text exposition (0.0.4). Families sharing a name
         emit their HELP/TYPE header once, label variants consecutively."""
-        lines: List[str] = []
-        seen_headers = set()
-        for m in sorted(self.metrics(), key=lambda m: m.name):
-            if m.name not in seen_headers:
-                lines.extend(m.header_lines())
-                seen_headers.add(m.name)
-            lines.extend(m.sample_lines())
-        return "\n".join(lines) + "\n"
+        return _expose_metrics(self.metrics())
+
+
+def _expose_metrics(metrics: Sequence[_Metric]) -> str:
+    """The ONE exposition renderer (MetricsRegistry and MultiRegistry
+    both call it): sorted families, HELP/TYPE headers deduplicated,
+    label variants consecutive."""
+    lines: List[str] = []
+    seen_headers = set()
+    for m in sorted(metrics, key=lambda m: m.name):
+        if m.name not in seen_headers:
+            lines.extend(m.header_lines())
+            seen_headers.add(m.name)
+        lines.extend(m.sample_lines())
+    return "\n".join(lines) + "\n"
+
+
+class MultiRegistry:
+    """Read-only union of several registries with ONE text exposition —
+    what a single /metrics scrape serves when checkpoint/training series
+    live in the process-wide `default_registry()` while each serving
+    engine keeps its own registry (two engines in one process must not
+    collide on `ptpu_engine_*` names). Families sort and deduplicate
+    headers across the members exactly like one registry would."""
+
+    def __init__(self, registries: Sequence[MetricsRegistry]):
+        enforce(len(registries) >= 1,
+                "MultiRegistry needs at least one member registry",
+                exc=InvalidArgumentError)
+        self._registries = list(registries)
+
+    def metrics(self) -> List[_Metric]:
+        out: List[_Metric] = []
+        for r in self._registries:
+            out.extend(r.metrics())
+        return out
+
+    def get(self, name, labels=None) -> Optional[_Metric]:
+        for r in self._registries:
+            m = r.get(name, labels)
+            if m is not None:
+                return m
+        return None
+
+    def expose(self) -> str:
+        return _expose_metrics(self.metrics())
 
 
 _default_registry = MetricsRegistry()
 
 
 def default_registry() -> MetricsRegistry:
+    """The ONE process-wide registry: checkpoint (`ptpu_ckpt_*`,
+    parallel/elastic.py), training (`ptpu_train_*`, trainer.py), and any
+    other module-level series register here, so a single /metrics scrape
+    sees them all next to the scraped engine's own registry
+    (EngineServer exposes MultiRegistry([engine, default]))."""
     return _default_registry
+
+
+def get_or_create(registry: MetricsRegistry, kind: str, name: str,
+                  help: str = "", labels=None, **kw) -> _Metric:
+    """Idempotent registration: the existing metric when (name, labels)
+    is already registered, a fresh one otherwise — what module-level
+    metric sets use so re-initialization (tests, reloads) cannot trip
+    the duplicate-registration enforce."""
+    m = registry.get(name, labels)
+    if m is not None:
+        return m
+    return getattr(registry, kind)(name, help, labels=labels, **kw)

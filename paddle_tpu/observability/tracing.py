@@ -47,6 +47,8 @@ SPAN_KINDS = frozenset({
     "dp_comm",     # explicit gradient-comm rewrite planning
     "pass",        # any registered Pass application (provenance = name)
     "checkpoint",  # elastic snapshot/restore phases (parallel/elastic.py)
+    "request",     # one serving request's lifecycle phases (queue_wait/
+                   # prefill/decode/transport, serving_engine.py)
     "user",        # RecordEvent-style user annotation
 })
 
@@ -89,8 +91,52 @@ _ring_cap = 0
 _seq = itertools.count()
 _resize_lock = threading.Lock()
 
-# per-thread nesting stack: (name, depth)
+# per-thread nesting stack: (name, depth) — plus the thread's tag dict
+# (scoped_tags), merged into every span the thread records
 _tls = threading.local()
+
+
+class scoped_tags:
+    """Tag every span recorded by THIS thread while the scope is open:
+
+        with tracing.scoped_tags(world="w1", rank=2, world_size=4):
+            ...   # every span (and record_span) carries these attrs
+
+    Scopes nest (inner tags shadow outer ones of the same key, the rest
+    merge); a span's own attrs win over thread tags. This is how the
+    process-world rank threads stamp {world_id, rank, world_size} onto
+    every span they record without threading the identity through every
+    instrumented callsite."""
+
+    __slots__ = ("tags", "_prev")
+
+    def __init__(self, **tags):
+        self.tags = tags
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "tags", None)
+        merged = dict(self._prev) if self._prev else {}
+        merged.update(self.tags)
+        _tls.tags = merged
+        return self
+
+    def __exit__(self, *exc):
+        _tls.tags = self._prev
+        return False
+
+
+def rank_scope(world: str, rank: int, world_size: int) -> scoped_tags:
+    """The distributed-tracing tag triple: every span this thread records
+    is attributed to (world, rank) — tools/trace_merge.py turns the rank
+    into a Chrome-trace pid lane."""
+    return scoped_tags(world=str(world), rank=int(rank),
+                       world_size=int(world_size))
+
+
+def current_tags() -> Dict[str, Any]:
+    """This thread's active scoped_tags (empty dict outside any scope)."""
+    tags = getattr(_tls, "tags", None)
+    return dict(tags) if tags else {}
 
 # profiler interop: incremented while the legacy profiler context is
 # active (spans then record even with the trace flag down — the old
@@ -102,12 +148,22 @@ annotation_factory: Optional[Callable[[str], Any]] = None
 
 def _ensure_ring():
     global _ring, _ring_cap
-    cap = int(flags.get_flag("trace_ring"))
+    raw = flags.get_flag("trace_ring")
+    try:
+        cap = int(raw)
+    except (TypeError, ValueError):
+        raise InvalidArgumentError(
+            f"PTPU_TRACE_RING (flag trace_ring) must be a positive "
+            f"integer span-ring capacity, got {raw!r}") from None
+    if cap < 1:   # no eager f-string on the record hot path
+        raise InvalidArgumentError(
+            f"PTPU_TRACE_RING (flag trace_ring) must be >= 1 (the span "
+            f"ring needs at least one slot), got {cap}")
     if cap != _ring_cap:
         with _resize_lock:
             if cap != _ring_cap:
-                _ring = [None] * max(cap, 1)
-                _ring_cap = max(cap, 1)
+                _ring = [None] * cap
+                _ring_cap = cap
     return _ring
 
 
@@ -206,11 +262,36 @@ class span:
         stack = getattr(_tls, "stack", None)
         if stack and stack[-1][0] == self.name:
             stack.pop()
+        tags = getattr(_tls, "tags", None)
+        attrs = {**tags, **self.attrs} if tags else self.attrs
         _record(Span(self.kind, self.name, self._start, end,
                      threading.get_ident(), self._parent, self._depth,
-                     self.attrs, next(_seq)))
+                     attrs, next(_seq)))
         self._live = False
         return False
+
+
+def record_span(kind: str, name: str, start: float, end: float,
+                **attrs) -> Optional[Span]:
+    """Record a RETROACTIVE span from externally measured perf_counter
+    timestamps — phases whose boundaries were observed as plain floats
+    (a request's queue-wait between submit and slot assignment, a
+    barrier phase reconstructed from beacon notes) become first-class
+    spans on the same timeline the live `span` scopes draw on. Thread
+    tags (scoped_tags) merge in exactly like live spans; returns None
+    when tracing is disabled."""
+    if kind not in SPAN_KINDS:
+        raise InvalidArgumentError(
+            f"unknown span kind {kind!r}; known: {sorted(SPAN_KINDS)}")
+    if not (_TRACE_FLAG.value or _force_count):
+        return None
+    tags = getattr(_tls, "tags", None)
+    if tags:
+        attrs = {**tags, **attrs}
+    s = Span(kind, name, float(start), float(end),
+             threading.get_ident(), "", 0, attrs, next(_seq))
+    _record(s)
+    return s
 
 
 def clear():

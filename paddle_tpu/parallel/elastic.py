@@ -206,46 +206,61 @@ _reg_lock = threading.Lock()
 
 
 def metrics_registry():
-    """Module-level MetricsRegistry for checkpoint telemetry: save/restore
-    durations, bytes written, snapshots committed, pending async writes.
-    Scrapeable alongside any other registry (observability/metrics.py)."""
+    """The checkpoint telemetry series: save/restore durations, bytes
+    written, snapshots committed, barrier aborts, pending async writes.
+
+    Since r16 these register into `observability.metrics
+    .default_registry()` (idempotently) instead of a private registry,
+    so ONE /metrics scrape sees checkpoint, training, and serving series
+    together — this function now returns the default registry and is
+    kept for API compatibility (every `ptpu_ckpt_*` lookup through it
+    still resolves)."""
     global _registry
     with _reg_lock:
         if _registry is None:
             from ..observability import metrics as m
-            r = m.MetricsRegistry()
-            r.counter("ptpu_ckpt_saves_total",
-                      "Snapshots committed by this process.")
-            r.counter("ptpu_ckpt_save_bytes_total",
-                      "Payload bytes written across committed snapshots.")
-            r.counter("ptpu_ckpt_restores_total", "Snapshots restored.")
-            r.counter("ptpu_ckpt_barrier_aborts_total",
-                      "Multi-rank snapshot attempts aborted at the "
-                      "chief's barrier (straggler past the deadline or a "
-                      "dead rank); training continues, the snapshot is "
-                      "discarded.")
-            r.counter("ptpu_ckpt_skipped_foreign_total",
-                      "Snapshot dirs skipped during latest-snapshot "
-                      "selection because their COMMIT record was written "
-                      "by a newer protocol/world config than this "
-                      "process understands.")
-            r.counter("ptpu_ckpt_digest_failures_total",
-                      "Snapshot files whose content digest disagreed "
-                      "with the COMMIT integrity record (silent "
-                      "bit-flips caught at validate/restore).")
-            r.histogram("ptpu_ckpt_save_seconds",
-                        "Wall time of the write+commit phase.",
-                        buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
-                                 5.0, 10.0, 30.0))
-            r.histogram("ptpu_ckpt_restore_seconds",
-                        "Wall time of restore_train_state.",
-                        buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
-                                 5.0, 10.0, 30.0))
-            r.gauge("ptpu_ckpt_pending_async",
-                    "Async snapshot writes not yet committed.",
-                    fn=lambda: float(len(_PENDING)))
+            r = m.default_registry()
+            c = m.get_or_create
+            c(r, "counter", "ptpu_ckpt_saves_total",
+              "Snapshots committed by this process.")
+            c(r, "counter", "ptpu_ckpt_save_bytes_total",
+              "Payload bytes written across committed snapshots.")
+            c(r, "counter", "ptpu_ckpt_restores_total",
+              "Snapshots restored.")
+            c(r, "counter", "ptpu_ckpt_barrier_aborts_total",
+              "Multi-rank snapshot attempts aborted at the "
+              "chief's barrier (straggler past the deadline or a "
+              "dead rank); training continues, the snapshot is "
+              "discarded.")
+            c(r, "counter", "ptpu_ckpt_skipped_foreign_total",
+              "Snapshot dirs skipped during latest-snapshot "
+              "selection because their COMMIT record was written "
+              "by a newer protocol/world config than this "
+              "process understands.")
+            c(r, "counter", "ptpu_ckpt_digest_failures_total",
+              "Snapshot files whose content digest disagreed "
+              "with the COMMIT integrity record (silent "
+              "bit-flips caught at validate/restore).")
+            c(r, "histogram", "ptpu_ckpt_save_seconds",
+              "Wall time of the write+commit phase.",
+              buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                       5.0, 10.0, 30.0))
+            c(r, "histogram", "ptpu_ckpt_restore_seconds",
+              "Wall time of restore_train_state.",
+              buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                       5.0, 10.0, 30.0))
+            c(r, "gauge", "ptpu_ckpt_pending_async",
+              "Async snapshot writes not yet committed.",
+              fn=lambda: float(len(_PENDING)))
             _registry = r
     return _registry
+
+
+def pending_async_count() -> int:
+    """In-flight async snapshot writes not yet committed — the number
+    the serving /healthz endpoint reports as pending_checkpoints."""
+    with _pending_lock:
+        return len(_PENDING)
 
 
 def _metric(name):
@@ -945,16 +960,19 @@ def _stage_rank_files(world, root: str, serial: int, rank: int,
     The two fault points bracket exactly the states the crash matrix
     needs: died mid-write (possibly at a byte offset) vs staged-durable-
     but-ack-unsent."""
+    from ..observability import tracing as _tracing
     from ..sharded_checkpoint import write_chunks
 
     staging = _rank_staging_dir(root, serial, rank)
-    if os.path.isdir(staging):
-        shutil.rmtree(staging)
-    os.makedirs(staging)
-    write_chunks(staging, chunks, manifest, rank, fsync=True)
-    world.fault(rank, "stage", staging=staging)
-    digests = _stage_digests(staging)
-    world.fault(rank, "ack")
+    with _tracing.span("checkpoint", "barrier/stage", rank=rank,
+                       serial=serial):
+        if os.path.isdir(staging):
+            shutil.rmtree(staging)
+        os.makedirs(staging)
+        write_chunks(staging, chunks, manifest, rank, fsync=True)
+        world.fault(rank, "stage", staging=staging, serial=serial)
+        digests = _stage_digests(staging)
+    world.fault(rank, "ack", serial=serial)
     return digests
 
 
@@ -969,10 +987,12 @@ def _chief_commit(world, root: str, serial: int, own_files: Dict,
     rank's manifest. Any rank missing at the deadline aborts the
     snapshot (training continues; the attempt's staging is swept).
     Returns (committed path, payload bytes) — (None, 0) on abort."""
+    from ..observability import tracing as _tracing
     from ..sharded_checkpoint import _fsync_file
 
     chief = world.chief
     acks: Dict[int, Dict] = {chief: own_files}
+    t_wait = time.perf_counter()
     deadline = time.monotonic() + deadline_s
     while set(acks) < set(expected):
         remaining = deadline - time.monotonic()
@@ -984,6 +1004,11 @@ def _chief_commit(world, root: str, serial: int, own_files: Dict,
         if (msg.get("kind") == "ack"
                 and int(msg.get("serial", -1)) == serial):
             acks[int(msg["rank"])] = msg["files"]
+    # the chief's wait-for-acks window as a span: its duration IS the
+    # straggler gap a merged timeline shows the chief blocked on
+    _tracing.record_span("checkpoint", "barrier/collect_acks",
+                         t_wait, time.perf_counter(), rank=chief,
+                         serial=serial, acked=sorted(acks))
 
     missing = sorted(set(expected) - set(acks))
     if missing:
@@ -1004,7 +1029,8 @@ def _chief_commit(world, root: str, serial: int, own_files: Dict,
         return None, 0
 
     # every live rank's shards are durable on disk — the commit point
-    world.fault(chief, "barrier")
+    world.fault(chief, "barrier", serial=serial)
+    t_commit = time.perf_counter()
     assembly = os.path.join(
         root, f"{STAGING_PREFIX}{serial:08d}-world{os.getpid()}")
     if os.path.isdir(assembly):
@@ -1039,13 +1065,15 @@ def _chief_commit(world, root: str, serial: int, own_files: Dict,
         shutil.rmtree(final)
     os.replace(assembly, final)
     _fsync_file(root)
-    world.fault(chief, "commit")
+    world.fault(chief, "commit", serial=serial)
     n_manifests = len([n for n in files if n.startswith("manifest-")])
     _commit_marker_and_retain(
         root, final, files, n_manifests, step,
         {"world_size": world.world_size, "axes": meta.get("world", {})},
         max_snapshots)
-    world.fault(chief, "post")
+    world.fault(chief, "post", serial=serial)
+    _tracing.record_span("checkpoint", "barrier/commit", t_commit,
+                         time.perf_counter(), rank=chief, serial=serial)
 
     # sweep staging leftovers of EARLIER barrier rounds (aborted or
     # crashed attempts); rounds are serialized on world.barrier_lock, so
@@ -1069,6 +1097,7 @@ def _barrier_write_and_commit(world, root: str, serial: int,
     """Run the chief-commits barrier over the world: every rank stages
     and acks; the chief waits, binds, and commits. Returns the committed
     path, or None when the barrier aborted (straggler/dead rank)."""
+    from ..observability import flight_recorder as _fr
     from ..observability import tracing as _tracing
 
     fault = fault_injection_config()
@@ -1132,6 +1161,12 @@ def _barrier_write_and_commit(world, root: str, serial: int,
                                   ignore_errors=True)
                     return None
 
+    # the state board names the ACTIVE barrier round: a dossier dumped
+    # while this round runs (rank death, enforce error) records which
+    # serial/step was in flight
+    _fr.set_state("barrier", serial=serial, step=int(step),
+                  world_size=world.world_size, world=world.world_id,
+                  status="running")
     with _tracing.span("checkpoint", "elastic/barrier_commit",
                        step=int(step), world_size=world.world_size), \
             world.barrier_lock:
@@ -1141,6 +1176,8 @@ def _barrier_write_and_commit(world, root: str, serial: int,
         world.drain(world.chief)  # no stale acks from an aborted round
         results = world.run(rank_fn)
     path = results[world.chief]
+    _fr.set_state("barrier", serial=serial,
+                  status="committed" if path is not None else "aborted")
     if path is not None:
         dt = time.perf_counter() - t0
         nbytes = committed_bytes[0] if committed_bytes else 0

@@ -539,14 +539,26 @@ def run_pp_region(region_op, seg_indices, env, block, ctx):
 
     def _run_stage(k, env2, bin_by_name, ctx2):
         """Run stage k's spliced op list; returns crossing out values (or
-        None for the last stage)."""
+        None for the last stage). Boundary ops record "collective" spans
+        carrying their cut's corr_id (trace-time provenance: the spliced
+        send/recv pair shares the id, so a merged timeline pairs the
+        producing and consuming stage lanes)."""
+        from ..observability import tracing as _tracing
         out_vals = None
         for op in stage_ops[k]:
             if op.type == "pp_recv":
-                for n in op.outputs["Out"]:
-                    env2[n] = bin_by_name[n]
+                with _tracing.span(
+                        "collective", f"pp_recv/{op.attrs['cut']}",
+                        stage=k, cut=op.attrs["cut"],
+                        corr_id=op.attrs.get("corr_id", "")):
+                    for n in op.outputs["Out"]:
+                        env2[n] = bin_by_name[n]
             elif op.type == "pp_send":
-                out_vals = [env2[n] for n in op.inputs["X"]]
+                with _tracing.span(
+                        "collective", f"pp_send/{op.attrs['cut']}",
+                        stage=k, cut=op.attrs["cut"],
+                        corr_id=op.attrs.get("corr_id", "")):
+                    out_vals = [env2[n] for n in op.inputs["X"]]
             else:
                 run_op(op, env2, block, ctx2)
         return out_vals

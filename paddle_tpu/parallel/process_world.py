@@ -171,6 +171,10 @@ class ProcessWorld:
     snapshot attempt) proceeds without them, exactly like a real gang
     missing one process."""
 
+    #: monotone world ids within a process (two worlds in one test must
+    #: not merge their trace lanes)
+    _ID_SEQ = __import__("itertools").count(1)
+
     def __init__(self, world_size: int, chief: int = 0):
         enforce(world_size >= 1, "world_size must be >= 1",
                 exc=InvalidArgumentError)
@@ -179,6 +183,10 @@ class ProcessWorld:
                 exc=InvalidArgumentError)
         self.world_size = world_size
         self.chief = chief
+        #: stable identity stamped (with rank/world_size) onto every
+        #: span a rank thread records — the {world_id, rank, world_size}
+        #: triple tools/trace_merge.py lanes the merged timeline by
+        self.world_id = f"pw{os.getpid()}-{next(self._ID_SEQ)}"
         #: serializes barrier rounds over this world (elastic.py): two
         #: concurrent rounds would steal each other's acks off the
         #: chief's inbox
@@ -227,10 +235,17 @@ class ProcessWorld:
 
     # -- fault injection --------------------------------------------------
     def fault(self, rank: int, phase: str,
-              staging: Optional[str] = None):
+              staging: Optional[str] = None,
+              serial: Optional[int] = None):
         """The per-rank fault-injection point; protocol code calls this
         at every phase boundary. Reads PTPU_FAULT_INJECT fresh per call
-        (tests flip it between runs)."""
+        (tests flip it between runs). Every call is ALSO a flight-
+        recorder beacon point: the phase note (rank, phase, serial) is
+        durable before any directive fires, so a SIGKILL here leaves a
+        beacon naming exactly the dead rank and phase
+        (observability/flight_recorder.py)."""
+        from ..observability import flight_recorder as _fr
+        _fr.note_phase("barrier", phase, rank=rank, serial=serial)
         plan = world_fault_plan()
         hit = plan["straggle"].get(rank)
         if hit and hit[0] == phase:
@@ -241,6 +256,8 @@ class ProcessWorld:
         if hit and hit[0] == phase:
             flags.vlog(0, "fault injection: rank %d dropped at %s",
                        rank, phase)
+            _fr.note_phase("barrier", phase, rank=rank, serial=serial,
+                           dropped=True)
             raise RankDead(rank, phase)
         hit = plan["crash"].get(rank)
         if hit and hit[0] == phase:
@@ -249,6 +266,8 @@ class ProcessWorld:
                 _truncate_payload_at(staging, int(offset))
             flags.vlog(0, "fault injection: SIGKILL at rank %d phase %s",
                        rank, phase)
+            _fr.note_phase("barrier", phase, rank=rank, serial=serial,
+                           crashing=True)
             _sigkill_self()  # pragma: no cover
 
     # -- execution --------------------------------------------------------
@@ -261,16 +280,27 @@ class ProcessWorld:
         `self.failures` and re-raised from run() after every thread
         joined — a protocol bug must fail the caller, not vanish into a
         thread."""
+        from ..observability import flight_recorder as _fr
+        from ..observability import tracing as _tracing
         results: List[Any] = [None] * self.world_size
         self.failures = {}
 
         def _guard(r: int):
-            try:
-                results[r] = fn(r)
-            except RankDead:
-                self.dead.add(r)
-            except BaseException as e:  # noqa: BLE001 - re-raised below
-                self.failures[r] = e
+            # every span this rank's thread records carries the
+            # {world_id, rank, world_size} triple — the per-rank span
+            # stream the merged timeline lanes by
+            with _tracing.rank_scope(self.world_id, r, self.world_size):
+                try:
+                    results[r] = fn(r)
+                except RankDead as e:
+                    self.dead.add(r)
+                    _fr.dump_dossier(
+                        f"rank {r} dropped at phase {e.phase!r}",
+                        rank=r, exc=e)
+                except BaseException as e:  # noqa: BLE001 - re-raised below
+                    self.failures[r] = e
+                    _fr.dump_dossier(f"rank {r} protocol failure",
+                                     rank=r, exc=e)
 
         threads = [threading.Thread(target=_guard, args=(r,),
                                     name=f"world-rank-{r}", daemon=True)

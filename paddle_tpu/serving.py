@@ -167,52 +167,86 @@ class _BatchingWriter:
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
+    @staticmethod
+    def _fire_sent(callbacks):
+        """Run the batch's on_sent callbacks with ONE timestamp — the
+        instant the vectored send returned, i.e. when the frames left
+        the process (the transport-span boundary serving_engine's
+        per-request latency decomposition records)."""
+        if not callbacks:
+            return
+        import time as _time
+        now = _time.perf_counter()
+        for cb in callbacks:
+            try:
+                cb(now)
+            except Exception:   # telemetry must not kill the writer
+                pass
+
     def _loop(self):
+        callbacks = []
         try:
             while True:
                 item = self._q.get()
                 if item is _WRITER_EOF:
                     return
-                parts = list(item)
+                parts, cb = item
+                parts = list(parts)
+                callbacks = [cb] if cb is not None else []
                 try:
                     while True:   # batch whatever else is ready NOW
                         nxt = self._q.get_nowait()
                         if nxt is _WRITER_EOF:
                             _sendall_vec(self._conn, parts)
+                            self._fire_sent(callbacks)
+                            callbacks = []
                             return
-                        parts.extend(nxt)
+                        parts.extend(nxt[0])
+                        if nxt[1] is not None:
+                            callbacks.append(nxt[1])
                 except _queue.Empty:
                     pass
                 _sendall_vec(self._conn, parts)
+                self._fire_sent(callbacks)
+                callbacks = []
         except (ConnectionError, OSError):
             pass
         finally:
             self.dead.set()
-            try:   # unblock producers stuck in put()
+            try:   # unblock producers stuck in put(); collect their
+                # callbacks — these frames will never go out
                 while True:
-                    self._q.get_nowait()
+                    item = self._q.get_nowait()
+                    if item is not _WRITER_EOF and item[1] is not None:
+                        callbacks.append(item[1])
             except _queue.Empty:
                 pass
+            # close out EVERY un-fired on_sent (the in-flight batch a
+            # ConnectionError interrupted + the drained queue): a dead
+            # connection must not leave telemetry series lagging forever
+            # — the callback gets the death instant as its timestamp
+            self._fire_sent(callbacks)
 
-    def respond(self, parts) -> bool:
+    def respond(self, parts, on_sent=None) -> bool:
         """Blocking enqueue with backpressure; False once the writer is
-        gone."""
+        gone. `on_sent(perf_counter_ts)` fires after the frame's
+        vectored send returned."""
         while not self.dead.is_set():
             try:
-                self._q.put(parts, timeout=0.2)
+                self._q.put((parts, on_sent), timeout=0.2)
                 return True
             except _queue.Full:
                 continue
         return False
 
-    def offer(self, parts) -> bool:
+    def offer(self, parts, on_sent=None) -> bool:
         """Non-blocking enqueue. A full queue means the peer stopped
         reading ~maxsize frames ago: the connection is killed (the peer
         sees a disconnect, never a silent gap) and False returned."""
         if self.dead.is_set():
             return False
         try:
-            self._q.put_nowait(parts)
+            self._q.put_nowait((parts, on_sent))
             return True
         except _queue.Full:
             self.dead.set()

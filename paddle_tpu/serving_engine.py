@@ -90,14 +90,38 @@ class SlotAllocator:
 
 
 class GenRequest:
-    """One generation request moving through the engine."""
+    """One generation request moving through the engine.
 
-    __slots__ = ("rid", "prompt", "max_new", "eos_id", "tokens", "slot",
-                 "fed", "next_tok", "submitted_at", "first_token_at",
-                 "done_at", "on_done", "_event")
+    Besides the wall-clock fields (`submitted_at`/`first_token_at`/
+    `done_at`, kept for API compatibility), every lifecycle boundary is
+    also stamped on the perf_counter clock — the monotonic timeline the
+    trace ring uses — so the request's latency DECOMPOSES conservatively:
 
-    def __init__(self, rid, prompt, max_new, eos_id=None, on_done=None):
+        queue_wait = admitted - submitted       (waiting for a slot)
+        prefill    = first_token - admitted     (prompt ticks, TTFT part)
+        decode     = done - first_token         (sampled-token ticks)
+        transport  = sent - done                (completion frame on the
+                                                 wire; 0 without a server)
+
+    The four phases partition [submitted, sent] exactly — their sum IS
+    the end-to-end latency (BENCH_REQTRACE's 5% acceptance bar is float
+    noise headroom, not slack in the definition). `request_id` threads
+    from EngineClient through admission, every tick's span attrs, and
+    the completion frame."""
+
+    __slots__ = ("rid", "request_id", "prompt", "max_new", "eos_id",
+                 "tokens", "slot", "fed", "next_tok", "submitted_at",
+                 "first_token_at", "done_at", "on_done", "_event",
+                 "submitted_pc", "admitted_at", "admitted_pc",
+                 "first_token_pc", "done_pc", "sent_at", "sent_pc",
+                 "defer_transport")
+
+    def __init__(self, rid, prompt, max_new, eos_id=None, on_done=None,
+                 request_id: Optional[str] = None,
+                 defer_transport: bool = False):
         self.rid = rid
+        self.request_id = str(request_id) if request_id is not None \
+            else f"req-{rid}"
         self.prompt = [int(t) for t in prompt]
         self.max_new = int(max_new)
         self.eos_id = eos_id
@@ -106,9 +130,21 @@ class GenRequest:
         self.fed = 0                       # positions consumed so far
         self.next_tok = self.prompt[0]     # token the next tick feeds
         self.submitted_at = time.time()
+        self.submitted_pc = time.perf_counter()
+        self.admitted_at: Optional[float] = None
+        self.admitted_pc: Optional[float] = None
         self.first_token_at: Optional[float] = None
+        self.first_token_pc: Optional[float] = None
         self.done_at: Optional[float] = None
+        self.done_pc: Optional[float] = None
+        self.sent_at: Optional[float] = None
+        self.sent_pc: Optional[float] = None
         self.on_done = on_done
+        #: True when a server OWNS the transport phase (it will call
+        #: engine.report_sent once the completion frame is on the wire
+        #: — or immediately if the frame cannot be delivered); False =
+        #: no wire, transport/e2e close at completion
+        self.defer_transport = bool(defer_transport)
         self._event = threading.Event()
 
     @property
@@ -119,6 +155,31 @@ class GenRequest:
     def latency_s(self) -> Optional[float]:
         return (self.done_at - self.submitted_at) if self.done else None
 
+    def phases(self) -> Optional[Dict[str, float]]:
+        """{queue_wait, prefill, decode, transport} seconds (transport 0
+        until/unless a server reports the completion frame sent); None
+        before completion."""
+        if self.done_pc is None:
+            return None
+        first = self.first_token_pc if self.first_token_pc is not None \
+            else self.done_pc
+        return {
+            "queue_wait": self.admitted_pc - self.submitted_pc,
+            "prefill": first - self.admitted_pc,
+            "decode": self.done_pc - first,
+            "transport": ((self.sent_pc - self.done_pc)
+                          if self.sent_pc is not None else 0.0),
+        }
+
+    def e2e_s(self) -> Optional[float]:
+        """Measured end-to-end latency on the perf_counter clock:
+        submit → completion frame sent (→ completion when no server is
+        involved). The number the phase decomposition must sum to."""
+        if self.done_pc is None:
+            return None
+        end = self.sent_pc if self.sent_pc is not None else self.done_pc
+        return end - self.submitted_pc
+
     def wait(self, timeout: Optional[float] = None) -> List[int]:
         if not self._event.wait(timeout):
             raise TimeoutError(f"request {self.rid} not done in {timeout}s")
@@ -126,6 +187,7 @@ class GenRequest:
 
     def _complete(self):
         self.done_at = time.time()
+        self.done_pc = time.perf_counter()
         if self.on_done is not None:
             self.on_done(self)
         self._event.set()
@@ -192,6 +254,12 @@ class ContinuousBatchingEngine:
         self.total_slot_ticks = 0
         self.tokens_out = 0
         self._started_at = time.time()
+        #: wall time of the last executed decode tick (None before the
+        #: first) — /healthz reports its age as the liveness signal
+        self.last_tick_at: Optional[float] = None
+        #: completed requests, newest last (bounded) — the per-request
+        #: latency decomposition record tools/bench_reqtrace.py reads
+        self.completed_log: "deque[GenRequest]" = deque(maxlen=512)
         self._init_metrics()
 
     def _init_metrics(self):
@@ -231,6 +299,23 @@ class ContinuousBatchingEngine:
                     f"{name} decode-tick latency (histogram estimate).",
                     fn=(lambda q=q:
                         self._m_tick_latency.quantile(q) or 0.0))
+        # per-request latency decomposition: one labeled histogram
+        # family, phase=queue_wait|prefill|decode|transport, plus the
+        # end-to-end series the phases must sum to (BENCH_REQTRACE)
+        req_buckets = (1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2,
+                       2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                       10.0, 30.0)
+        self._m_req_phase = {
+            phase: r.histogram(
+                "ptpu_request_latency_seconds",
+                "Per-request latency decomposition by lifecycle phase.",
+                labels={"phase": phase}, buckets=req_buckets)
+            for phase in ("queue_wait", "prefill", "decode", "transport")}
+        self._m_req_e2e = r.histogram(
+            "ptpu_request_e2e_seconds",
+            "End-to-end request latency (submit -> completion frame "
+            "sent; -> completion when no server is attached).",
+            buckets=req_buckets)
 
     def _kv_cache_bytes(self) -> int:
         total = 0
@@ -256,10 +341,14 @@ class ContinuousBatchingEngine:
     # -- request intake ---------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new: int,
                eos_id: Optional[int] = "engine",
-               on_done: Optional[Callable] = None) -> GenRequest:
+               on_done: Optional[Callable] = None,
+               request_id: Optional[str] = None,
+               defer_transport: bool = False) -> GenRequest:
         """Queue a generation request; returns the GenRequest handle
         (wait() for completion, or pass on_done — called on the ENGINE
-        thread, keep it cheap)."""
+        thread, keep it cheap). `request_id` is the caller's correlation
+        id (EngineClient threads it through the RPC frame); it rides
+        every span and the completion frame — auto-minted when absent."""
         enforce(len(prompt) >= 1, "prompt must not be empty",
                 exc=InvalidArgumentError)
         enforce(len(prompt) + int(max_new) <= self.max_len,
@@ -270,12 +359,14 @@ class ContinuousBatchingEngine:
             self._rid += 1
             req = GenRequest(self._rid, prompt, max_new,
                              self.eos_id if eos_id == "engine" else eos_id,
-                             on_done)
+                             on_done, request_id=request_id,
+                             defer_transport=defer_transport)
             self._pending.append(req)
         return req
 
     # -- scheduler --------------------------------------------------------
     def _admit(self):
+        admitted = []
         with _tracing.span("admission", "engine/admit",
                            pending=len(self._pending)), self._lock:
             if self.policy == "static" and (self._active
@@ -291,7 +382,19 @@ class ContinuousBatchingEngine:
                 slot = self._slots.alloc()
                 req = self._pending.popleft()
                 req.slot = slot
+                req.admitted_at = time.time()
+                req.admitted_pc = time.perf_counter()
                 self._active[slot] = req
+                admitted.append(req)
+        for req in admitted:
+            # the queue-wait phase becomes a first-class span the moment
+            # it ends (slot assignment) — retroactive, exact boundaries
+            _tracing.record_span(
+                "request", "request/queue_wait", req.submitted_pc,
+                req.admitted_pc, request_id=req.request_id,
+                slot=req.slot)
+            self._m_req_phase["queue_wait"].observe(
+                req.admitted_pc - req.submitted_pc)
 
     @property
     def n_active(self) -> int:
@@ -314,7 +417,13 @@ class ContinuousBatchingEngine:
         if not active:
             return []
         t0 = time.perf_counter()
-        with _tracing.span("tick", "engine/tick", active=len(active)):
+        # the rid list is trace provenance only — don't build it per
+        # tick when tracing is off (the decode loop is the hot path)
+        span_attrs = {"active": len(active)}
+        if _tracing.enabled():
+            span_attrs["request_ids"] = [r.request_id
+                                         for r in active.values()]
+        with _tracing.span("tick", "engine/tick", **span_attrs):
             tok, pos = self._tok, self._pos
             tok[:] = 0
             pos[:] = 0.0
@@ -327,6 +436,7 @@ class ContinuousBatchingEngine:
         self._m_tick_latency.observe(time.perf_counter() - t0)
         self._m_ticks.inc()
         self.n_ticks += 1
+        self.last_tick_at = time.time()
         self.busy_slot_ticks += len(active)
         self.total_slot_ticks += self.n_slots
         finished = []
@@ -339,6 +449,7 @@ class ContinuousBatchingEngine:
             t = int(ids[slot, 0])                    # sampled next token
             if req.first_token_at is None:
                 req.first_token_at = time.time()
+                req.first_token_pc = time.perf_counter()
             req.tokens.append(t)
             self.tokens_out += 1
             self._m_tokens.inc()
@@ -360,7 +471,45 @@ class ContinuousBatchingEngine:
                     del self._active[req.slot]
                     self._slots.free(req.slot)
             self._m_completed.inc(len(finished))
+            for req in finished:
+                self._finalize_request(req)
         return finished
+
+    def _finalize_request(self, req: GenRequest):
+        """Completion-side telemetry: the prefill/decode phase spans and
+        histograms from the request's perf_counter stamps. The transport
+        phase + end-to-end series land in `report_sent` when a server
+        reports the completion frame on the wire; for a direct engine
+        caller (no server → no wire) they are closed here with
+        transport = 0, so the phase sums always match the e2e series."""
+        first = req.first_token_pc if req.first_token_pc is not None \
+            else req.done_pc
+        _tracing.record_span("request", "request/prefill",
+                             req.admitted_pc, first,
+                             request_id=req.request_id, slot=req.slot,
+                             prompt_len=len(req.prompt))
+        _tracing.record_span("request", "request/decode", first,
+                             req.done_pc, request_id=req.request_id,
+                             slot=req.slot, new_tokens=len(req.tokens))
+        ph = req.phases()
+        self._m_req_phase["prefill"].observe(ph["prefill"])
+        self._m_req_phase["decode"].observe(ph["decode"])
+        self.completed_log.append(req)
+        if not req.defer_transport:
+            self._m_req_phase["transport"].observe(0.0)
+            self._m_req_e2e.observe(req.e2e_s())
+
+    def report_sent(self, req: GenRequest, sent_pc: float):
+        """Server-side hook: the request's completion frame left the
+        process at perf_counter time `sent_pc` (the _BatchingWriter
+        on_sent callback). Closes the transport phase and the e2e
+        series, and records the transport span."""
+        req.sent_pc = float(sent_pc)
+        req.sent_at = time.time()
+        _tracing.record_span("request", "request/transport", req.done_pc,
+                             req.sent_pc, request_id=req.request_id)
+        self._m_req_phase["transport"].observe(req.sent_pc - req.done_pc)
+        self._m_req_e2e.observe(req.e2e_s())
 
     def run_until_idle(self, max_ticks: Optional[int] = None
                        ) -> List[GenRequest]:
@@ -384,6 +533,23 @@ class ContinuousBatchingEngine:
         return (self.busy_slot_ticks / self.total_slot_ticks
                 if self.total_slot_ticks else 0.0)
 
+    def stats(self) -> Dict:
+        """Instantaneous engine state for /healthz: slot/queue shape,
+        tick liveness, token throughput."""
+        now = time.time()
+        return {
+            "n_slots": self.n_slots,
+            "active": self.n_active,
+            "pending": self.n_pending,
+            "ticks": self.n_ticks,
+            "tokens_out": self.tokens_out,
+            "occupancy": self.occupancy(),
+            "last_tick_age_s": ((now - self.last_tick_at)
+                                if self.last_tick_at is not None
+                                else None),
+            "uptime_s": now - self._started_at,
+        }
+
 
 def _decode_tick_builder(n_slots, vocab, max_len, d_model, d_inner,
                          num_heads, num_layers, dropout, packed,
@@ -396,26 +562,41 @@ def _decode_tick_builder(n_slots, vocab, max_len, d_model, d_inner,
 
 
 # ---------------------------------------------------------------------------
-# Prometheus /metrics exposition
+# Prometheus /metrics exposition + /healthz
 # ---------------------------------------------------------------------------
 
 
 class _MetricsHTTPServer:
-    """Minimal threading HTTP listener serving GET /metrics as Prometheus
-    text exposition (0.0.4) from one MetricsRegistry."""
+    """Minimal threading HTTP listener serving GET /metrics (Prometheus
+    text exposition 0.0.4 from one registry — Multi or plain) and, when
+    a `health_fn` is given, GET /healthz as structured JSON (the control
+    loop's signal: engine serving/draining state, last-tick age, pending
+    checkpoints, supervisor restart count)."""
 
-    def __init__(self, addr, registry):
+    def __init__(self, addr, registry, health_fn=None):
         import http.server
+        import json as _json
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server contract)
-                if self.path.split("?", 1)[0] != "/metrics":
-                    self.send_error(404, "only /metrics is served here")
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = registry.expose().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                    code = 200
+                elif path == "/healthz" and health_fn is not None:
+                    health = health_fn()
+                    body = _json.dumps(health, default=str).encode()
+                    ctype = "application/json"
+                    # draining surfaces as 503: a load balancer must stop
+                    # routing to a replica that stopped admitting
+                    code = 200 if health.get("status") == "serving" \
+                        else 503
+                else:
+                    self.send_error(404, "serving /metrics and /healthz")
                     return
-                body = registry.expose().encode()
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -445,6 +626,23 @@ def scrape_metrics(host: str, port: int, timeout: float = 5.0) -> str:
     with urllib.request.urlopen(
             f"http://{host}:{port}/metrics", timeout=timeout) as resp:
         return resp.read().decode()
+
+
+def scrape_healthz(host: str, port: int, timeout: float = 5.0) -> Dict:
+    """One GET /healthz (same listener as /metrics): the parsed JSON
+    health document. A draining server answers 503 but still carries the
+    body — this helper returns it either way."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+    try:
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/healthz", timeout=timeout) as resp:
+            return _json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        if e.code == 503:   # draining: the body IS the health document
+            return _json.loads(e.read().decode())
+        raise
 
 
 # ---------------------------------------------------------------------------
@@ -487,18 +685,53 @@ class EngineServer:
         self._writers: List = []
         self._lock = threading.Lock()
         self._prev_sigterm = None
-        # Prometheus exposition: a small HTTP listener serving GET
-        # /metrics from the engine's registry. A SEPARATE socket from the
+        # Prometheus exposition + health: a small HTTP listener serving
+        # GET /metrics and GET /healthz. A SEPARATE socket from the
         # generation RPC (that one speaks the serving.py frame protocol;
-        # an HTTP GET on it would misparse as a frame header).
+        # an HTTP GET on it would misparse as a frame header). The
+        # scraped registry is the UNION of the engine's own registry and
+        # the process-wide default registry, so one scrape sees serving,
+        # checkpoint (ptpu_ckpt_*), and training (ptpu_train_*) series.
         # metrics_port=None disables; 0 picks an ephemeral port
         # (self.metrics_address after construction).
         self._http = None
         self.metrics_address = None
         if metrics_port is not None:
-            self._http = _MetricsHTTPServer((host, metrics_port),
-                                            engine.metrics_registry)
+            # materialize the process-wide series before the first
+            # scrape: ptpu_ckpt_* and ptpu_train_* register lazily, and
+            # a scrape must see the families (at zero) even before the
+            # first save/step touches them
+            from .parallel import elastic as _elastic
+            from .trainer import training_metrics as _training_metrics
+            _elastic.metrics_registry()
+            _training_metrics()
+            self._http = _MetricsHTTPServer(
+                (host, metrics_port),
+                _obs_metrics.MultiRegistry(
+                    [engine.metrics_registry,
+                     _obs_metrics.default_registry()]),
+                health_fn=self.health)
             self.metrics_address = self._http.server_address
+
+    def health(self) -> Dict:
+        """The /healthz document — the control-loop signal (ROADMAP
+        3(d)): admission state (serving vs draining after SIGTERM),
+        engine tick liveness, pending async checkpoint commits, and the
+        supervising process's restart count (PTPU_SUPERVISOR_RESTARTS,
+        set by trainer.Supervisor for its children)."""
+        from .parallel import elastic as _elastic
+        restarts = os.environ.get("PTPU_SUPERVISOR_RESTARTS")
+        return {
+            "status": ("draining" if self._draining.is_set()
+                       else "serving"),
+            "engine": self.engine.stats(),
+            "checkpoints": {
+                "pending_async": _elastic.pending_async_count()},
+            "supervisor": {
+                "restarts": int(restarts) if restarts else 0},
+            "pid": os.getpid(),
+            "ts": time.time(),
+        }
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "EngineServer":
@@ -671,9 +904,24 @@ class EngineServer:
             self._writers.append(writer)
 
         def on_done(req, tag):
-            writer.offer(_encode_msg({"done": {
+            ph = req.phases() or {}
+            frame = _encode_msg({"done": {
                 "tag": tag, "tokens": req.tokens,
-                "latency_ms": round(req.latency_s * 1e3, 3)}}))
+                "request_id": req.request_id,
+                "latency_ms": round(req.latency_s * 1e3, 3),
+                "phases_ms": {k: round(v * 1e3, 3)
+                              for k, v in ph.items()
+                              if k != "transport"}}})
+            # on_sent closes the transport phase: the writer thread
+            # reports the perf_counter instant the vectored send
+            # returned, and the engine observes transport + e2e. A
+            # failed offer (dead writer / slow-consumer eviction) means
+            # the frame will NEVER go out — close the series here so the
+            # e2e count cannot lag the phase counts
+            ok = writer.offer(frame, on_sent=(
+                lambda ts, req=req: self.engine.report_sent(req, ts)))
+            if not ok:
+                self.engine.report_sent(req, time.perf_counter())
 
         try:
             while not self._stop.is_set():
@@ -699,7 +947,9 @@ class EngineServer:
                             self.engine.submit(
                                 g["prompt"], g.get("max_new", 16),
                                 on_done=(lambda req, tag=tag:
-                                         on_done(req, tag)))
+                                         on_done(req, tag)),
+                                request_id=g.get("request_id"),
+                                defer_transport=True)
                             admitted = True
                         except Exception as e:
                             err = f"{type(e).__name__}: {e}"
@@ -737,14 +987,20 @@ class EngineClient:
         self._tag = 0
 
     def send_gen(self, prompt: Sequence[int], max_new: int = 16,
-                 tag=None):
+                 tag=None, request_id: Optional[str] = None):
+        """`request_id` is the client's correlation id: it threads
+        through admission, every decode tick's span attrs, the
+        per-request latency decomposition, and comes back on the done
+        frame — the end-to-end trace key across client/server/engine."""
         from .serving import _send_msg
         with self._lock:
             self._tag += 1
             tag = self._tag if tag is None else tag
-            _send_msg(self._sock, {"gen": {
-                "prompt": [int(t) for t in prompt],
-                "max_new": int(max_new), "tag": tag}})
+            msg = {"gen": {"prompt": [int(t) for t in prompt],
+                           "max_new": int(max_new), "tag": tag}}
+            if request_id is not None:
+                msg["gen"]["request_id"] = str(request_id)
+            _send_msg(self._sock, msg)
         return tag
 
     def recv_done(self):

@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+from time import perf_counter as _perf_counter
 from typing import Callable, List, Optional, Sequence
 
 from . import io as _io
@@ -94,6 +95,34 @@ class CheckpointConfig:
 CHECKPOINT_PREFIX = "checkpoint"
 TRAINER_ARGS_FILE = "trainer_args.json"
 SUCCESS_MARKER = "_SUCCESS"
+
+_train_metrics = None
+
+
+def training_metrics():
+    """The trainer-side operational series, registered (idempotently)
+    into `observability.metrics.default_registry()` — one /metrics
+    scrape sees training throughput next to the `ptpu_ckpt_*`
+    checkpoint counters and the engine's serving series."""
+    global _train_metrics
+    if _train_metrics is None:
+        from .observability import metrics as m
+        r = m.default_registry()
+        _train_metrics = {
+            "steps": m.get_or_create(
+                r, "counter", "ptpu_train_steps_total",
+                "Training steps executed by Trainer.train."),
+            "epochs": m.get_or_create(
+                r, "counter", "ptpu_train_epochs_total",
+                "Training epochs completed by Trainer.train."),
+            "step_seconds": m.get_or_create(
+                r, "histogram", "ptpu_train_step_seconds",
+                "Wall time of one training step (feed + dispatch + "
+                "fetch).",
+                buckets=(1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2, 0.1,
+                         0.25, 0.5, 1.0, 2.5, 5.0, 10.0)),
+        }
+    return _train_metrics
 
 
 def _serial_dir(root: str, serial: int) -> str:
@@ -337,18 +366,23 @@ class Trainer:
                 fetch = [m.name for m in self.metrics] \
                     if begin.fetch_metrics else []
                 feed = feeder.feed(batch)
+                t_step = _perf_counter()
                 if self._pe is not None:
                     metrics = self._pe.run(feed=feed, fetch_list=fetch)
                 else:
                     metrics = self.exe.run(self.train_program, feed=feed,
                                            fetch_list=fetch,
                                            scope=self.scope)
+                tm = training_metrics()
+                tm["steps"].inc()
+                tm["step_seconds"].observe(_perf_counter() - t_step)
                 event_handler(EndStepEvent(epoch_id, step_id, metrics))
                 if (self.checkpoint_cfg and
                         (step_id + 1) % self.checkpoint_cfg.step_interval
                         == 0):
                     self._save_checkpoint(epoch_id, step_id + 1)
             event_handler(EndEpochEvent(epoch_id))
+            training_metrics()["epochs"].inc()
             if (self.checkpoint_cfg and
                     (epoch_id + 1) % self.checkpoint_cfg.epoch_interval == 0):
                 self._save_checkpoint(epoch_id + 1, 0)
@@ -461,6 +495,15 @@ class Supervisor:
     gang members cannot form a jax process world (jaxlib 0.4.x), so
     multi-rank children run the simulated ProcessWorld internally.
 
+    `dossier_dir` arms the flight recorder across restarts
+    (observability/flight_recorder.py): children inherit
+    PTPU_DOSSIER_DIR (their barrier phase beacons and crash dossiers
+    land there) plus PTPU_SUPERVISOR_RESTARTS (surfaced on /healthz),
+    and after every incarnation that DIES the supervisor folds the
+    beacons + dossiers into `post_mortem-<k>.json` — which rank died,
+    in which barrier phase, with the per-rank straggler timeline —
+    before restarting the gang. Paths collect in `self.post_mortems`.
+
     Fault injection (PTPU_FAULT_INJECT, parallel/elastic.py +
     parallel/process_world.py) makes the crash side testable:
     tests/test_elastic.py and tools/recovery_smoke.py supervise children
@@ -477,6 +520,7 @@ class Supervisor:
                  world_size: int = 1,
                  raise_on_exhaust: bool = False,
                  env: Optional[dict] = None,
+                 dossier_dir: Optional[str] = None,
                  sleep_fn: Optional[Callable[[float], None]] = None,
                  rng=None):
         enforce(len(argv) >= 1, "Supervisor needs a command",
@@ -503,6 +547,9 @@ class Supervisor:
         self.world_size = world_size
         self.raise_on_exhaust = raise_on_exhaust
         self.env = env
+        self.dossier_dir = dossier_dir
+        if dossier_dir:
+            os.makedirs(dossier_dir, exist_ok=True)
         self._sleep = sleep_fn or __import__("time").sleep
         self._rng = rng or __import__("random").Random()
         #: restarts performed by the last run()
@@ -512,6 +559,52 @@ class Supervisor:
         #: exit codes observed, in order (negative = killed by signal);
         #: for a gang, the FIRST nonzero code of each incarnation
         self.exit_codes: List[int] = []
+        #: post_mortem-<k>.json paths written by the last run()
+        self.post_mortems: List[str] = []
+
+    def _child_env(self, rank: Optional[int] = None) -> dict:
+        env = dict(self.env if self.env is not None else os.environ)
+        if self.dossier_dir:
+            env["PTPU_DOSSIER_DIR"] = self.dossier_dir
+        env["PTPU_SUPERVISOR_RESTARTS"] = str(self.restarts)
+        if rank is not None:
+            env["PTPU_WORLD_RANK"] = str(rank)
+            env["PTPU_WORLD_SIZE"] = str(self.world_size)
+        return env
+
+    def _write_post_mortem(self):
+        """After an incarnation died: fold the dossier dir's beacons +
+        dossiers into post_mortem-<incarnation>.json, then ARCHIVE them
+        into an incarnation-<k>/ subdir — the next incarnation's
+        beacons start from a clean top level, so a stale crash marker
+        from a previous death can never win the next post-mortem's
+        verdict (and the fold stays bounded on long-running jobs). The
+        children are already dead here, so no writer holds the moved
+        files open. Best-effort — a post-mortem failure must never
+        break supervision itself."""
+        if not self.dossier_dir:
+            return
+        from .core import flags
+        from .observability import flight_recorder as _fr
+        try:
+            k = len(self.exit_codes)
+            path = _fr.write_post_mortem(
+                self.dossier_dir, incarnation=k,
+                extra={"exit_code": self.exit_codes[-1],
+                       "restarts": self.restarts,
+                       "argv": self.argv})
+            self.post_mortems.append(path)
+            archive = os.path.join(self.dossier_dir, f"incarnation-{k}")
+            os.makedirs(archive, exist_ok=True)
+            for name in os.listdir(self.dossier_dir):
+                if name.startswith((_fr.BEACON_PREFIX,
+                                    _fr.DOSSIER_PREFIX)):
+                    os.replace(os.path.join(self.dossier_dir, name),
+                               os.path.join(archive, name))
+            flags.vlog(0, "Supervisor: post-mortem %s", path)
+        except Exception as e:  # noqa: BLE001 - best effort
+            flags.vlog(0, "Supervisor: post-mortem failed: %s: %s",
+                       type(e).__name__, e)
 
     def _launch_gang(self):
         """One incarnation: world_size children with rank identities in
@@ -521,13 +614,12 @@ class Supervisor:
         whole-world restarts)."""
         import subprocess
         if self.world_size == 1:
-            return subprocess.run(self.argv, env=self.env).returncode
+            return subprocess.run(self.argv,
+                                  env=self._child_env()).returncode
         procs = []
         for r in range(self.world_size):
-            env = dict(self.env if self.env is not None else os.environ)
-            env["PTPU_WORLD_RANK"] = str(r)
-            env["PTPU_WORLD_SIZE"] = str(self.world_size)
-            procs.append(subprocess.Popen(self.argv, env=env))
+            procs.append(subprocess.Popen(self.argv,
+                                          env=self._child_env(r)))
         import time as _time
         rc = 0
         kill_deadline = None
@@ -571,6 +663,7 @@ class Supervisor:
         self.restarts = 0
         self.exhausted = False
         self.exit_codes = []
+        self.post_mortems = []
         delay = self.backoff_s
         while True:
             t0 = _time.monotonic()
@@ -579,6 +672,10 @@ class Supervisor:
             self.exit_codes.append(rc)
             if rc == 0:
                 return 0
+            # the incarnation died: synthesize its post-mortem from the
+            # flight-recorder beacons/dossiers BEFORE restarting (a
+            # restarted gang appends new beacon lines)
+            self._write_post_mortem()
             if self.restarts >= self.max_restarts:
                 self.exhausted = True
                 msg = (f"Supervisor: restart budget ({self.max_restarts})"

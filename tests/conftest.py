@@ -34,14 +34,16 @@ def fresh_state():
     tracing.clear() empties the span ring."""
     import paddle_tpu as pt
     from paddle_tpu.core import unique_name
-    from paddle_tpu.observability import tracing
+    from paddle_tpu.observability import flight_recorder, tracing
     pt.reset_default_programs()
     pt.reset_global_scope()
     pt.profiler.reset()
     tracing.clear()
+    flight_recorder.reset()
     with unique_name.guard():
         yield
     pt.profiler.reset()
+    flight_recorder.reset()
 
 
 @pytest.fixture

@@ -143,6 +143,164 @@ class TestTracing:
                    and s.attrs["microbatches"] == 4 for s in ss)
 
 
+class TestTraceRingEnv:
+    """ISSUE 12 satellite: a bad PTPU_TRACE_RING value must surface as a
+    clear enforce error naming the variable and the accepted range, not
+    a bare ValueError deep in _ensure_ring — one test per branch."""
+
+    def test_non_integer_rejected_with_clear_error(self):
+        old = flags.get_flag("trace_ring")
+        flags.set_flag("trace_ring", "not-a-number")
+        try:
+            with pytest.raises(Exception) as ei:
+                tracing.mark()
+            assert "PTPU_TRACE_RING" in str(ei.value)
+            assert "positive integer" in str(ei.value)
+        finally:
+            flags.set_flag("trace_ring", old)
+
+    def test_zero_rejected(self):
+        old = flags.get_flag("trace_ring")
+        flags.set_flag("trace_ring", 0)
+        try:
+            with pytest.raises(Exception) as ei:
+                with tracing.span("user", "x"):
+                    pass
+            assert "PTPU_TRACE_RING" in str(ei.value)
+            assert ">= 1" in str(ei.value)
+        finally:
+            flags.set_flag("trace_ring", old)
+
+    def test_negative_rejected(self):
+        old = flags.get_flag("trace_ring")
+        flags.set_flag("trace_ring", -8)
+        try:
+            with pytest.raises(Exception, match="PTPU_TRACE_RING"):
+                tracing.mark()
+        finally:
+            flags.set_flag("trace_ring", old)
+
+    def test_valid_string_value_accepted(self):
+        """set_flag with a numeric string (the env-var shape) works."""
+        old = flags.get_flag("trace_ring")
+        flags.set_flag("trace_ring", "16")
+        tracing.clear()
+        try:
+            with tracing.span("user", "ok"):
+                pass
+            assert [s.name for s in tracing.spans()] == ["ok"]
+        finally:
+            flags.set_flag("trace_ring", old)
+            tracing.clear()
+
+
+class TestDistributedTracing:
+    """r16 tentpole (a): rank-tagged span streams + the merged
+    cross-rank timeline."""
+
+    def test_rank_scope_tags_every_span(self):
+        with tracing.rank_scope("w7", 3, 8):
+            with tracing.span("user", "inner"):
+                pass
+        s = [x for x in tracing.spans() if x.name == "inner"][0]
+        assert s.attrs == {"world": "w7", "rank": 3, "world_size": 8}
+
+    def test_span_attrs_win_over_thread_tags_and_scopes_nest(self):
+        with tracing.scoped_tags(rank=1, color="red"):
+            with tracing.scoped_tags(rank=2):
+                with tracing.span("user", "a", color="blue"):
+                    pass
+            with tracing.span("user", "b"):
+                pass
+        by = {s.name: s.attrs for s in tracing.spans()}
+        assert by["a"] == {"rank": 2, "color": "blue"}
+        assert by["b"] == {"rank": 1, "color": "red"}
+        assert tracing.current_tags() == {}
+
+    def test_record_span_retroactive(self):
+        s = tracing.record_span("request", "retro", 10.0, 10.5, rid="r1")
+        assert s.duration_ms == pytest.approx(500.0)
+        got = [x for x in tracing.spans() if x.name == "retro"][0]
+        assert got.attrs == {"rid": "r1"}
+        with pytest.raises(Exception, match="unknown span kind"):
+            tracing.record_span("nope", "x", 0.0, 1.0)
+
+    def test_record_span_disabled_returns_none(self):
+        old = flags.get_flag("trace")
+        flags.set_flag("trace", False)
+        try:
+            assert tracing.record_span("user", "ghost", 0.0, 1.0) is None
+        finally:
+            flags.set_flag("trace", old)
+
+    def test_ring_wrap_under_concurrent_rank_writers(self):
+        """ISSUE 12 satellite: N rank threads recording through a wrap
+        must keep per-rank attribution intact — every surviving span's
+        rank tag matches the identity encoded in its name."""
+        import threading
+        old = flags.get_flag("trace_ring")
+        flags.set_flag("trace_ring", 32)
+        tracing.clear()
+        try:
+            n_ranks, per_rank = 4, 50   # 200 spans >> 32 slots: wraps
+
+            def writer(r):
+                with tracing.rank_scope("wrap", r, n_ranks):
+                    for i in range(per_rank):
+                        with tracing.span("user", f"r{r}-i{i}"):
+                            pass
+
+            ts = [threading.Thread(target=writer, args=(r,))
+                  for r in range(n_ranks)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            survivors = tracing.spans()
+            assert 0 < len(survivors) <= 32
+            for s in survivors:
+                want_rank = int(s.name[1:s.name.index("-")])
+                assert s.attrs["rank"] == want_rank, (s.name, s.attrs)
+                assert s.attrs["world"] == "wrap"
+                assert s.attrs["world_size"] == n_ranks
+        finally:
+            flags.set_flag("trace_ring", old)
+            tracing.clear()
+
+    def test_trace_merge_rank_lanes_and_alignment(self, tmp_path):
+        """tools/trace_merge.py: rank-tagged spans land on rank pids
+        with process_name metadata; phase-family spans get named tid
+        lanes; per-input clocks align on the --align-span event."""
+        import trace_merge
+
+        for r in (0, 1):
+            with tracing.rank_scope("wm", r, 2):
+                tracing.record_span("checkpoint", "barrier/stage",
+                                    1.0 + r, 1.2 + r, serial=5)
+                tracing.record_span("checkpoint", "barrier/ack",
+                                    1.2 + r, 1.3 + r, serial=5)
+        with tracing.span("user", "host_side"):
+            pass
+        path = str(tmp_path / "t.json")
+        tracing.export_chrome_trace(path)
+        merged = trace_merge.merge([path])
+        evs = [e for e in merged["traceEvents"] if e.get("ph") != "M"]
+        meta = [e for e in merged["traceEvents"] if e.get("ph") == "M"]
+        assert {e["pid"] for e in evs
+                if str(e["name"]).startswith("barrier/")} == {0, 1}
+        pnames = {e["pid"]: e["args"]["name"] for e in meta
+                  if e["name"] == "process_name"}
+        assert pnames[0].startswith("rank 0")
+        assert pnames[1].startswith("rank 1")
+        assert 999 in pnames            # untagged host lane
+        tnames = {e["args"]["name"] for e in meta
+                  if e["name"] == "thread_name"}
+        assert "barrier/stage" in tnames and "barrier/ack" in tnames
+        # alignment: each input shifts its first barrier/stage to t=0
+        stage_ts = [e["ts"] for e in evs if e["name"] == "barrier/stage"]
+        assert min(stage_ts) == pytest.approx(0.0)
+
+
 class TestOverheadBudget:
     """ISSUE 7 acceptance: tracing overhead <= 3% of step time with
     PTPU_TRACE=1 and <= 0.5% with it off. Overhead = measured per-span
@@ -179,6 +337,28 @@ class TestOverheadBudget:
         flags.set_flag("trace", False)
         try:
             off_cost = tracing.span_overhead_s()
+        finally:
+            flags.set_flag("trace", old)
+        frac_off = off_cost * spans_per_step / step_s
+        assert frac_off <= 0.005, (frac_off, off_cost, spans_per_step,
+                                   step_s)
+
+    def test_overhead_budget_holds_with_rank_tagging_on(self, rng):
+        """r16 acceptance: the budget must still hold with the
+        distributed-tracing tag merge on the record path — measure the
+        per-span cost INSIDE a rank scope (every span pays the
+        {world, rank, world_size} dict merge) against the same step."""
+        step_s, spans_per_step = self._step_time_and_spans(rng)
+        with tracing.rank_scope("budget", 0, 4):
+            tagged_cost = tracing.span_overhead_s()
+        frac_on = tagged_cost * spans_per_step / step_s
+        assert frac_on <= 0.03, (frac_on, tagged_cost, spans_per_step,
+                                 step_s)
+        old = flags.get_flag("trace")
+        flags.set_flag("trace", False)
+        try:
+            with tracing.rank_scope("budget", 0, 4):
+                off_cost = tracing.span_overhead_s()
         finally:
             flags.set_flag("trace", old)
         frac_off = off_cost * spans_per_step / step_s
@@ -323,6 +503,306 @@ class TestEngineMetricsEndpoint:
         eng.run_until_idle()
         kinds = {s.kind for s in tracing.spans_since(m)}
         assert "tick" in kinds and "admission" in kinds
+
+
+@pytest.mark.quick
+class TestRequestDecomposition:
+    """r16 tentpole (c): a request_id threads submit → admission → every
+    tick it rides → completion, with a queue/prefill/decode/transport
+    decomposition that partitions the measured e2e latency exactly."""
+
+    def _engine(self, n_slots=1):
+        from paddle_tpu.serving_engine import ContinuousBatchingEngine
+        return ContinuousBatchingEngine(
+            n_slots=n_slots, vocab=50, max_len=8, d_model=16,
+            d_inner=32, num_heads=2, num_layers=1)
+
+    def test_request_id_threads_through_spans_and_ticks(self):
+        eng = self._engine()
+        m = tracing.mark()
+        eng.submit([1, 2], max_new=2, request_id="rid-42")
+        eng.run_until_idle()
+        ss = tracing.spans_since(m)
+        names = {s.name for s in ss
+                 if s.attrs.get("request_id") == "rid-42"}
+        assert {"request/queue_wait", "request/prefill",
+                "request/decode"} <= names, names
+        ticks = [s for s in ss if s.name == "engine/tick"]
+        assert ticks and all("rid-42" in s.attrs["request_ids"]
+                             for s in ticks)
+
+    def test_phases_partition_e2e_direct_engine(self):
+        """No server: transport is 0 and the three engine-side phases
+        sum to done-submitted exactly (same clock, shared boundaries)."""
+        eng = self._engine(n_slots=1)
+        # second request MUST queue behind the first on the single slot
+        r1 = eng.submit([1, 2, 3], max_new=3)
+        r2 = eng.submit([4], max_new=2)
+        eng.run_until_idle()
+        for req in (r1, r2):
+            ph = req.phases()
+            assert set(ph) == {"queue_wait", "prefill", "decode",
+                               "transport"}
+            assert ph["transport"] == 0.0
+            assert sum(ph.values()) == pytest.approx(req.e2e_s(),
+                                                     rel=1e-9)
+        assert r2.phases()["queue_wait"] > r1.phases()["queue_wait"]
+        assert list(eng.completed_log)[-2:] == [r1, r2] or \
+            list(eng.completed_log)[-2:] == [r2, r1]
+
+    def test_latency_histograms_labeled_per_phase(self):
+        eng = self._engine()
+        done = []
+        # a direct caller WITH on_done (no server): transport/e2e must
+        # still close at completion — only a server that will report
+        # the frame sent (defer_transport=True) defers them
+        eng.submit([1], max_new=2, on_done=done.append)
+        eng.run_until_idle()
+        assert done
+        r = eng.metrics_registry
+        for phase in ("queue_wait", "prefill", "decode", "transport"):
+            h = r.get("ptpu_request_latency_seconds", {"phase": phase})
+            assert h is not None and h.count >= 1, phase
+        e2e = r.get("ptpu_request_e2e_seconds")
+        assert e2e.count >= 1
+        # conservation at the histogram level too: sums of the phase
+        # series equal the e2e series sum (transport included)
+        total = sum(
+            r.get("ptpu_request_latency_seconds", {"phase": p}).sum
+            for p in ("queue_wait", "prefill", "decode", "transport"))
+        assert total == pytest.approx(e2e.sum, rel=1e-6)
+
+    def test_server_transport_closes_the_decomposition(self):
+        """Through the RPC server the transport phase is real (writer
+        on_sent) and the four phases still sum to e2e within the 5%
+        acceptance band (exact up to callback scheduling)."""
+        import time as _time
+        from paddle_tpu.serving_engine import (EngineClient, EngineServer)
+        eng = self._engine(n_slots=2)
+        with EngineServer(eng) as srv:
+            host, port = srv.address
+            with EngineClient(host, port) as c:
+                c.send_gen([3], max_new=3, request_id="srv-req")
+                c.recv_done()
+            deadline = _time.time() + 5
+            while _time.time() < deadline and (
+                    not eng.completed_log
+                    or eng.completed_log[-1].sent_pc is None):
+                _time.sleep(0.01)
+        req = list(eng.completed_log)[-1]
+        assert req.request_id == "srv-req" and req.sent_pc is not None
+        ph, e2e = req.phases(), req.e2e_s()
+        assert ph["transport"] > 0.0
+        assert abs(sum(ph.values()) - e2e) / e2e <= 0.05, (ph, e2e)
+
+
+@pytest.mark.quick
+class TestHealthz:
+    """r16 tentpole (d): the structured /healthz surface on the metrics
+    listener — the autoscaling control loop's signal."""
+
+    def test_healthz_document_and_drain_503(self, monkeypatch):
+        import urllib.request
+        from paddle_tpu.serving_engine import (ContinuousBatchingEngine,
+                                               EngineServer,
+                                               scrape_healthz)
+        monkeypatch.setenv("PTPU_SUPERVISOR_RESTARTS", "3")
+        eng = ContinuousBatchingEngine(n_slots=2, vocab=50, max_len=8,
+                                       d_model=16, d_inner=32,
+                                       num_heads=2, num_layers=1)
+        eng.submit([1], max_new=2)
+        eng.run_until_idle()
+        with EngineServer(eng) as srv:
+            mh, mp = srv.metrics_address
+            h = scrape_healthz(mh, mp)
+            assert h["status"] == "serving"
+            assert h["engine"]["n_slots"] == 2
+            assert h["engine"]["ticks"] >= 2
+            assert h["engine"]["last_tick_age_s"] >= 0
+            assert h["checkpoints"]["pending_async"] == 0
+            assert h["supervisor"]["restarts"] == 3
+            # plain /metrics still served from the same listener
+            with urllib.request.urlopen(
+                    f"http://{mh}:{mp}/metrics", timeout=5) as resp:
+                assert resp.status == 200
+            # draining flips the status and the HTTP code to 503 (the
+            # load balancer's stop-routing signal); scrape_healthz
+            # still returns the body
+            srv._draining.set()
+            h2 = scrape_healthz(mh, mp)
+            assert h2["status"] == "draining"
+            import urllib.error
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(f"http://{mh}:{mp}/healthz",
+                                       timeout=5)
+
+    def test_single_scrape_sees_ckpt_train_and_engine_series(self):
+        """ISSUE 12 satellite: ONE /metrics scrape carries checkpoint
+        (ptpu_ckpt_*), training (ptpu_train_*), and serving
+        (ptpu_engine_*) series — the per-module registries are joined
+        through default_registry()."""
+        from paddle_tpu import trainer as _trainer
+        from paddle_tpu.parallel import elastic
+        from paddle_tpu.serving_engine import (ContinuousBatchingEngine,
+                                               EngineServer,
+                                               scrape_metrics)
+        assert elastic.metrics_registry() is obs_metrics.default_registry()
+        tm = _trainer.training_metrics()
+        assert (obs_metrics.default_registry()
+                .get("ptpu_train_steps_total") is tm["steps"])
+        eng = ContinuousBatchingEngine(n_slots=2, vocab=50, max_len=8,
+                                       d_model=16, d_inner=32,
+                                       num_heads=2, num_layers=1)
+        with EngineServer(eng) as srv:
+            text = scrape_metrics(*srv.metrics_address)
+        assert "ptpu_engine_ticks_total" in text
+        assert "ptpu_ckpt_saves_total" in text
+        assert "ptpu_ckpt_barrier_aborts_total" in text
+        assert "ptpu_train_steps_total" in text
+
+    def test_multiregistry_union_and_lookup(self):
+        a, b = obs_metrics.MetricsRegistry(), obs_metrics.MetricsRegistry()
+        a.counter("ptpu_a_total").inc(2)
+        b.gauge("ptpu_b").set(5)
+        multi = obs_metrics.MultiRegistry([a, b])
+        assert multi.get("ptpu_a_total").value == 2
+        assert multi.get("ptpu_b").value == 5
+        text = multi.expose()
+        assert "ptpu_a_total 2" in text and "ptpu_b 5" in text
+
+
+class TestFlightRecorder:
+    """r16 tentpole (b), unit level: beacons, dossiers, post-mortems.
+    (The real-SIGKILL integration lives in tests/test_process_world.py.)"""
+
+    def test_disabled_by_default_and_state_board(self):
+        from paddle_tpu.observability import flight_recorder as fr
+        assert not fr.enabled()
+        assert fr.dump_dossier("nothing to write") is None
+        fr.set_state("engine", draining=False, ticks=3)
+        fr.set_state("engine", ticks=4)
+        assert fr.state_board()["engine"] == {"draining": False,
+                                              "ticks": 4}
+        fr.clear_state("engine")
+        assert "engine" not in fr.state_board()
+
+    def test_dossier_carries_spans_metrics_and_state(self, tmp_path):
+        from paddle_tpu.observability import flight_recorder as fr
+        fr.configure(str(tmp_path), world_id="wd")
+        with tracing.span("user", "before_death"):
+            pass
+        fr.set_state("barrier", serial=9, phase="stage")
+        path = fr.dump_dossier("unit test", rank=1,
+                               exc=ValueError("boom"))
+        doc = json.load(open(path))
+        assert doc["reason"] == "unit test" and doc["rank"] == 1
+        assert doc["exception"] == "ValueError: boom"
+        assert doc["state"]["barrier"]["serial"] == 9
+        assert any(s["name"] == "before_death" for s in doc["spans"])
+        assert "default" in doc["metrics"]
+        assert fr.collect_dossiers(str(tmp_path))[0]["reason"] == \
+            "unit test"
+
+    def test_beacons_survive_and_name_the_crashing_rank(self, tmp_path):
+        from paddle_tpu.observability import flight_recorder as fr
+        fr.configure(str(tmp_path), world_id="wb")
+        for r in range(3):
+            fr.note_phase("barrier", "stage", rank=r, serial=4)
+        fr.note_phase("barrier", "ack", rank=0, serial=4)
+        fr.note_phase("barrier", "ack", rank=2, serial=4)
+        fr.note_phase("barrier", "ack", rank=1, serial=4,
+                      crashing=True)
+        verdict = fr.analyze(str(tmp_path))
+        assert verdict["dead_rank"] == 1
+        assert verdict["dead_phase"] == "ack"
+        assert verdict["serial"] == 4
+        assert verdict["cause"] == "crash_rank SIGKILL"
+        assert set(verdict["timeline"]) == {"0", "1", "2"}
+        pm = fr.write_post_mortem(str(tmp_path), incarnation=2)
+        doc = json.load(open(pm))
+        assert doc["incarnation"] == 2 and doc["dead_rank"] == 1
+
+    def test_least_advanced_heuristic_without_markers(self, tmp_path):
+        """Unplanned death (no fault directive announced itself): the
+        rank that stopped beaconing first is named, with the heuristic
+        cause spelled out."""
+        import time as _time
+        from paddle_tpu.observability import flight_recorder as fr
+        fr.configure(str(tmp_path))
+        fr.note_phase("barrier", "stage", rank=0, serial=1)
+        fr.note_phase("barrier", "stage", rank=1, serial=1)
+        _time.sleep(0.01)
+        fr.note_phase("barrier", "ack", rank=0, serial=1)
+        verdict = fr.analyze(str(tmp_path))
+        assert verdict["dead_rank"] == 1
+        assert verdict["dead_phase"] == "stage"
+        assert "heuristic" in verdict["cause"]
+        assert verdict["straggler_order"][0] == 1
+
+    def test_configure_none_pins_disabled_despite_env(
+            self, tmp_path, monkeypatch):
+        """configure(None) means OFF — no silent re-enable through a
+        leaked PTPU_DOSSIER_DIR; only a never-configured process (a
+        supervised child) inherits the env var."""
+        from paddle_tpu.observability import flight_recorder as fr
+        monkeypatch.setenv("PTPU_DOSSIER_DIR", str(tmp_path))
+        fr.configure(None)
+        assert not fr.enabled()
+        fr.note_phase("barrier", "stage", rank=0)
+        assert not any(n.startswith(fr.BEACON_PREFIX)
+                       for n in os.listdir(tmp_path))
+        # the pristine (never-configured) state DOES inherit the env
+        fr._configured = False
+        assert fr.dossier_dir() == str(tmp_path)
+
+    def test_dead_writer_still_closes_transport(self, tmp_path):
+        """A client that disconnects before reading its completion must
+        not leave the transport/e2e series lagging: the writer fires
+        pending on_sent callbacks on its death path."""
+        import time as _time
+        from paddle_tpu.serving_engine import (ContinuousBatchingEngine,
+                                               EngineClient, EngineServer)
+        eng = ContinuousBatchingEngine(n_slots=1, vocab=50, max_len=8,
+                                       d_model=16, d_inner=32,
+                                       num_heads=2, num_layers=1)
+        with EngineServer(eng) as srv:
+            host, port = srv.address
+            c = EngineClient(host, port)
+            c.send_gen([3], max_new=2, request_id="goner")
+            c.close()                       # gone before the done frame
+            deadline = _time.time() + 10
+            while _time.time() < deadline and not any(
+                    r.request_id == "goner" and r.sent_pc is not None
+                    for r in eng.completed_log):
+                _time.sleep(0.02)
+        req = [r for r in eng.completed_log
+               if r.request_id == "goner"][0]
+        assert req.sent_pc is not None      # closed: sent or died trying
+        e2e = eng.metrics_registry.get("ptpu_request_e2e_seconds")
+        tr = eng.metrics_registry.get("ptpu_request_latency_seconds",
+                                      {"phase": "transport"})
+        assert e2e.count == 1 and tr.count == 1
+
+    def test_rank_drop_dumps_a_dossier(self, tmp_path, monkeypatch):
+        """A simulated rank death (drop_rank) is a death the process CAN
+        see: ProcessWorld.run dumps a dossier naming the rank+phase."""
+        from paddle_tpu.observability import flight_recorder as fr
+        from paddle_tpu.parallel.process_world import ProcessWorld
+        fr.configure(str(tmp_path))
+        monkeypatch.setenv("PTPU_FAULT_INJECT", "drop_rank:1@ack")
+        world = ProcessWorld(2)
+
+        def fn(rank):
+            world.fault(rank, "ack")
+            return rank
+
+        out = world.run(fn)
+        assert out == [0, None] and world.dead == {1}
+        dossiers = fr.collect_dossiers(str(tmp_path))
+        assert any("rank 1 dropped" in d["reason"] for d in dossiers)
+        verdict = fr.analyze(str(tmp_path))
+        assert verdict["dead_rank"] == 1 and verdict["dead_phase"] == "ack"
+        assert verdict["cause"] == "drop_rank simulated death"
 
 
 # ---------------------------------------------------------------------------

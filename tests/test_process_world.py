@@ -388,12 +388,28 @@ class TestCrashAnywhereProperty:
             ("crash_rank:0@commit", {0}),
             ("crash_rank:0@post", {0, 1}),
         ]
+        from paddle_tpu.observability import flight_recorder
         for fault, committed in matrix:
             root = tmp_path / fault.replace(":", "_").replace("@", "_")
             rc = _run_world_child(root, fault=fault)
             assert rc == -9, f"{fault}: child exited {rc}, expected " \
                              f"SIGKILL"
             self._check_surviving_state(root, committed)
+            # r16 acceptance: every surviving world carries a dossier
+            # trail whose post-mortem names EXACTLY the dead rank and
+            # barrier phase of the injected fault — the beacons are
+            # written before the SIGKILL fires, so kill -9 cannot
+            # outrun them
+            spec = fault.split(":", 1)[1].split("@")
+            want_rank, want_phase = int(spec[0]), spec[1]
+            verdict = flight_recorder.analyze(str(root / "dossiers"))
+            assert verdict["cause"] == "crash_rank SIGKILL", \
+                (fault, verdict)
+            assert verdict["dead_rank"] == want_rank, (fault, verdict)
+            assert verdict["dead_phase"] == want_phase, (fault, verdict)
+            assert verdict["serial"] is not None
+            # the timeline covers every rank that got to beacon at all
+            assert str(want_rank) in verdict["timeline"]
         # kill between rename and COMMIT must leave the generation-1 dir
         # VISIBLE but uncommitted (the dichotomy's interesting corner)
         root = tmp_path / "crash_rank_0_commit"
@@ -402,6 +418,41 @@ class TestCrashAnywhereProperty:
             if not elastic.is_committed(p)]
         assert uncommitted, "chief@commit: renamed dir should be " \
                             "visible and uncommitted"
+
+
+class TestSupervisorPostMortem:
+    def test_gang_death_writes_post_mortem_naming_rank_and_phase(
+            self, tmp_path):
+        """The Supervisor side of the flight recorder: a supervised
+        world-atomic child is SIGKILLed mid-barrier; the supervisor
+        hands its children the dossier dir through the env, and after
+        the incarnation dies it folds the beacons into
+        post_mortem-1.json naming the dead rank and phase."""
+        from paddle_tpu.trainer import Supervisor
+        dossiers = str(tmp_path / "dossiers")
+        sup = Supervisor(
+            [sys.executable, RECOVERY_SMOKE, "--world-atomic-child",
+             "--world", "4", "--root", str(tmp_path / "root")],
+            max_restarts=0, backoff_s=0.0,
+            env=_child_env(fault="crash_rank:3@stage"),
+            dossier_dir=dossiers)
+        rc = sup.run()
+        assert rc == -9 and sup.exhausted
+        assert len(sup.post_mortems) == 1
+        doc = json.load(open(sup.post_mortems[0]))
+        assert doc["dead_rank"] == 3
+        assert doc["dead_phase"] == "stage"
+        assert doc["cause"] == "crash_rank SIGKILL"
+        assert doc["incarnation"] == 1 and doc["exit_code"] == -9
+        # straggler timeline: every rank beaconed at least its stage
+        assert set(doc["timeline"]) >= {"3"}
+        # beacons/dossiers are ARCHIVED per incarnation after the
+        # verdict: the next incarnation's fold starts clean, so a stale
+        # crash marker can never win a later post-mortem
+        top = os.listdir(dossiers)
+        assert not any(n.startswith("flight-") for n in top), top
+        archived = os.listdir(os.path.join(dossiers, "incarnation-1"))
+        assert any(n.startswith("flight-") for n in archived)
 
 
 if __name__ == "__main__":

@@ -215,6 +215,7 @@ def run_world_atomic_child(args) -> int:
 
     from paddle_tpu.framework.program import Program, program_guard
     from paddle_tpu.framework.scope import Scope
+    from paddle_tpu.observability import flight_recorder
     from paddle_tpu.parallel import elastic
     from paddle_tpu.parallel.mesh import DeviceMesh
     from paddle_tpu.parallel.process_world import ProcessWorld
@@ -222,6 +223,13 @@ def run_world_atomic_child(args) -> int:
     n = args.world
     mesh = DeviceMesh(jax.devices()[:n], {"dp": n})
     world = ProcessWorld(n)
+    # arm the flight recorder: every rank's barrier phase transitions
+    # beacon into <root>/dossiers (PTPU_DOSSIER_DIR overrides), so a
+    # SIGKILL anywhere in the sweep leaves a dossier trail naming the
+    # dead rank and phase — what the post-mortem asserts on
+    flight_recorder.install(
+        os.environ.get("PTPU_DOSSIER_DIR")
+        or os.path.join(args.root, "dossiers"))
 
     class _MeshOnly:
         pass

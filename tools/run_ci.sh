@@ -319,20 +319,62 @@ assert chk["ok"], chk                     # predicted == census, exactly
 
 from paddle_tpu.serving_engine import (ContinuousBatchingEngine,
                                        EngineClient, EngineServer,
-                                       scrape_metrics)
+                                       scrape_healthz, scrape_metrics)
 eng = ContinuousBatchingEngine(n_slots=2, vocab=100, max_len=16,
                                d_model=32, d_inner=64, num_heads=4,
                                num_layers=2)
 with EngineServer(eng) as srv:
     host, port = srv.address
     with EngineClient(host, port) as c:
-        c.send_gen([3], max_new=2)
+        c.send_gen([3], max_new=2, request_id="ci-req")
         c.recv_done()
     text = scrape_metrics(*srv.metrics_address)
+    health = scrape_healthz(*srv.metrics_address)
 assert "ptpu_engine_tokens_total 2" in text, text[:400]
 assert "ptpu_engine_tick_latency_seconds_count" in text
+# r16: the per-request latency decomposition series are on the scrape,
+# for all four phases, and one scrape carries the checkpoint + training
+# series too (unified registries)
+for phase in ("queue_wait", "prefill", "decode", "transport"):
+    assert f'ptpu_request_latency_seconds_count{{phase="{phase}"}}' \
+        in text, phase
+assert "ptpu_request_e2e_seconds_count" in text
+assert "ptpu_ckpt_saves_total" in text and "ptpu_train_steps_total" in text
+# r16: /healthz is live on the same listener
+assert health["status"] == "serving", health
+assert health["engine"]["last_tick_age_s"] is not None
+assert health["checkpoints"]["pending_async"] == 0
 print("observability smoke OK")
 PY
+
+echo "== flight-recorder smoke (SIGKILL mid-barrier -> dossier + post-mortem) =="
+# the distributed flight recorder end to end (observability/
+# flight_recorder.py, docs/fault_tolerance.md): a 4-rank world-atomic
+# child is SIGKILLed at a NON-CHIEF rank's ack phase via the existing
+# PTPU_FAULT_INJECT crash_rank hook; the beacons written before the kill
+# must name exactly that rank and phase, and the post-mortem synthesis
+# must commit the verdict. (The merged-timeline path, trace_merge.py, is
+# pinned by tests/test_observability.py.)
+rm -rf /tmp/ptpu_flightrec_ci
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+PTPU_FAULT_INJECT=crash_rank:2@ack \
+    python tools/recovery_smoke.py --world-atomic-child --world 4 \
+    --root /tmp/ptpu_flightrec_ci && { \
+    echo "child survived a crash_rank directive"; exit 1; } || true
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+from paddle_tpu.observability import flight_recorder as fr
+d = "/tmp/ptpu_flightrec_ci/dossiers"
+verdict = fr.analyze(d)
+assert verdict["dead_rank"] == 2, verdict
+assert verdict["dead_phase"] == "ack", verdict
+assert verdict["cause"] == "crash_rank SIGKILL", verdict
+pm = fr.write_post_mortem(d, incarnation=1)
+doc = json.load(open(pm))
+assert doc["dead_rank"] == 2 and doc["dead_phase"] == "ack"
+print(f"flight-recorder smoke OK: {pm} names rank 2 @ ack")
+PY
+rm -rf /tmp/ptpu_flightrec_ci
 
 echo "== recovery smoke (kill -9 mid-run, dp resize, fixed-seed parity) =="
 # the elastic fault-tolerance runtime end to end (parallel/elastic.py,

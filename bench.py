@@ -321,7 +321,7 @@ def _uint8_link_mbps(batch: int, streams: int = 4, reps: int = 12) -> float:
 
     The dev tunnel is RTT/window-bound, not bandwidth-capped: measured
     12 MB/s single-stream vs 24+ MB/s at 3-4 concurrent streams
-    (tools/probe_prefetch2.py). A single-stream denominator would
+    (tools/probe_prefetch.py --exp streams). A single-stream denominator would
     understate the achievable link and let utilization exceed 1; matching
     the pipeline's concurrency makes the ratio honest."""
     import jax

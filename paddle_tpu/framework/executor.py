@@ -239,6 +239,50 @@ class Executor:
         idempotent — both _compile and run_steps call it."""
         return program
 
+    def _stash_flops_estimate(self, compiled: _CompiledStep, program,
+                              feed=None):
+        """Cache the analytic per-STEP model flops on the compiled step
+        for the `ptpu_mfu` gauge — a Python op walk, negligible next to
+        the XLA compile. Batch dims resolve to the fed batch when a feed
+        signature is at hand (self._feed_shapes is stashed by run()
+        callers before compiling)."""
+        shapes = (dict(getattr(self, "_feed_shapes", {}) or {}) if feed
+                  is None else {n: np.shape(v) for n, v in feed.items()})
+        batch = max((s[0] for s in shapes.values() if len(s) >= 1),
+                    default=8)
+        from .costs import program_flops_bytes
+        try:
+            compiled.flops_estimate = program_flops_bytes(
+                program, nominal_batch=int(batch))["flops"]
+        except Exception:
+            compiled.flops_estimate = 0.0
+
+    def _note_run_memory(self, compiled: _CompiledStep, step_s: float,
+                         steps: int = 1):
+        """Per-run memory/utilization sample: the device-state watermark
+        (per-device bytes censused once per compiled step) and the
+        `ptpu_mfu` gauge — predicted PER-DEVICE model flops (whole-step
+        flops over the device count) over the dispatch-window wall time.
+        Under donated-state backpressure successive dispatches track
+        true step time; tools/benchmark.py rows carry the
+        blocked-measured figure. O(1) per run."""
+        from ..observability import memory as _memory
+        sb = getattr(compiled, "census_state_bytes", None)
+        if sb is not None:
+            _memory.update_watermark("device_state_bytes", sb)
+        flops = getattr(compiled, "flops_estimate", 0.0)
+        # the dispatch window only tracks true step time when donated
+        # rw state backpressures successive dispatches — an rw-less
+        # (inference) step returns in dispatch time and would publish a
+        # meaningless (even >1) utilization. Likewise skip the FIRST
+        # window per compiled step: it reads warm-up, not steady state.
+        if flops and step_s > 0 and compiled.rw_names:
+            if getattr(compiled, "_mfu_warm", False):
+                ndev = max(1, int(getattr(self, "device_count", 1)))
+                _memory.note_mfu(flops * steps / ndev, step_s)
+            else:
+                compiled._mfu_warm = True
+
     def _compile(self, program: Program, scope: Scope, feed_names, fetch_names,
                  in_shardings=None, out_shardings=None, analysis=None):
         program = self._prepare_program(program, scope)
@@ -261,6 +305,7 @@ class Executor:
         fn = jax.jit(step, **jit_kwargs)
         compiled = _CompiledStep(fn, ro, rw, feed_names, fetch_names)
         compiled.state_out_names = state_out_names
+        self._stash_flops_estimate(compiled, program)
         return compiled
 
     def _scan_shardings(self, program, feed_names, fetch_names, ro, rw,
@@ -346,6 +391,10 @@ class Executor:
                _fusion_flags_key())
         compiled = self._cache.get(key)
         if compiled is None:
+            # feed shapes inform the flops estimate's batch resolution
+            # (and ParallelExecutor's feed shardings, which stash the
+            # same dict in run()); keep them current for this compile
+            self._feed_shapes = {n: np.shape(v) for n, v in feed.items()}
             from ..observability import tracing as _tracing
             with _tracing.span("compile", "executor/trace_and_compile",
                                program_version=program._version,
@@ -381,6 +430,13 @@ class Executor:
                               for n in compiled.feed_names)
             ro_vals = tuple(scope.get(n) for n in compiled.ro_names)
             rw_vals = tuple(scope.get(n) for n in compiled.rw_names)
+        if getattr(compiled, "census_state_bytes", None) is None:
+            # state shapes/placements are pinned by the compile: census
+            # the per-device bytes ONCE, before the rw buffers are
+            # donated, so the per-run watermark update is O(1)
+            from ..observability.memory import per_device_bytes
+            compiled.census_state_bytes = sum(
+                per_device_bytes(v) for v in ro_vals + rw_vals)
         self._run_counter += 1
         seed = np.uint32((program.random_seed * 1000003 + self._run_counter)
                          % (2 ** 31))
@@ -409,6 +465,7 @@ class Executor:
                            n_state=len(compiled.state_out_names)):
             for name, val in zip(compiled.state_out_names, new_state):
                 scope.set_var(name, val)
+        self._note_run_memory(compiled, time.time() - t0)
         if flags.get_flag("benchmark"):
             jax.block_until_ready(fetches)
             print(f"[benchmark] program run took {time.time() - t0:.4f}s")
@@ -499,6 +556,8 @@ class Executor:
             compiled = _CompiledStep(fn, ro, rw,
                                      list(feed_list[0].keys()), fetch_names)
             compiled.state_out_names = state_out_names
+            self._stash_flops_estimate(compiled, program,
+                                       feed=feed_list[0])
             self._cache[key] = compiled
 
         feed_stacks = tuple(
@@ -511,7 +570,12 @@ class Executor:
         seed = np.uint32((program.random_seed * 1000003
                           + self._run_counter + 1) % (2 ** 31))
         self._run_counter += k
+        if getattr(compiled, "census_state_bytes", None) is None:
+            from ..observability.memory import per_device_bytes
+            compiled.census_state_bytes = sum(
+                per_device_bytes(v) for v in ro_vals + rw_vals)
         from ..observability import tracing as _tracing
+        t0 = time.time()
         with _tracing.span("step", "executor/run_steps", steps=k):
             fetches, final_state = compiled.fn(feed_stacks, ro_vals, rw_vals,
                                                seed)
@@ -526,6 +590,7 @@ class Executor:
                 "with PTPU_CHECK_NAN_INF=1 to localize")
         for name, val in zip(compiled.state_out_names, final_state):
             scope.set_var(name, val)
+        self._note_run_memory(compiled, time.time() - t0, steps=k)
         if return_numpy:
             return [as_numpy(f) for f in fetches]
         return list(fetches)
@@ -557,6 +622,25 @@ class Executor:
         return PreparedStep(compiled, scope, self, program.random_seed,
                             injected)
 
+    def _aot_compiled(self, compiled: _CompiledStep, feed, scope):
+        """The AOT `lower().compile()` twin of a cached step, memoized on
+        it: the object that exposes XLA's cost_analysis / memory_analysis
+        / as_text. The AOT path bypasses the jit executable cache, so
+        without the memo every analysis call would pay a full XLA
+        compile. Feed names absent from `feed` fall back to scope values
+        (the bench tools' convention)."""
+        aot = getattr(compiled, "aot_cache", None)
+        if aot is None:
+            feed_vals = tuple(
+                jnp.asarray(feed[n]) if n in feed else scope.get(n)
+                for n in compiled.feed_names)
+            ro_vals = tuple(scope.get(n) for n in compiled.ro_names)
+            rw_vals = tuple(scope.get(n) for n in compiled.rw_names)
+            aot = compiled.fn.lower(feed_vals, ro_vals, rw_vals,
+                                    np.uint32(0)).compile()
+            compiled.aot_cache = aot
+        return aot
+
     def cost_analysis(self, program=None, feed=None, fetch_list=None,
                       scope=None):
         """XLA cost analysis (flops, bytes accessed) of the compiled step for
@@ -571,18 +655,44 @@ class Executor:
         compiled = self._lookup_or_compile(program, feed, fetch_names, scope)
         ca = getattr(compiled, "cost_analysis_cache", None)
         if ca is None:
-            feed_vals = tuple(jnp.asarray(feed[n])
-                              for n in compiled.feed_names)
-            ro_vals = tuple(scope.get(n) for n in compiled.ro_names)
-            rw_vals = tuple(scope.get(n) for n in compiled.rw_names)
-            # the AOT lower().compile() path bypasses the jit executable
-            # cache, so memoize on the cached step — the repeated-call cost
-            # would otherwise be a full XLA compile each time
-            ca = compiled.fn.lower(feed_vals, ro_vals, rw_vals,
-                                   np.uint32(0)).compile().cost_analysis()
+            ca = self._aot_compiled(compiled, feed, scope).cost_analysis()
             ca = ca[0] if isinstance(ca, (list, tuple)) else ca
             compiled.cost_analysis_cache = ca
         return ca
+
+    def memory_analysis(self, program=None, feed=None, fetch_list=None,
+                        scope=None):
+        """Measured per-device memory of the compiled step from the XLA
+        executable's buffer assignment: argument / output / temp / alias
+        bytes (`observability.memory.executable_memory`, with the
+        documented HLO liveness-walk fallback when the backend reports a
+        zero temp figure). Compiles (AOT, memoized) if needed; updates
+        the `executor_temp_bytes` watermark with what it measured."""
+        program = program or default_main_program()
+        feed = dict(feed or {})
+        scope = scope or global_scope()
+        fetch_names = [f.name if isinstance(f, Variable) else f
+                       for f in (fetch_list or [])]
+        compiled = self._lookup_or_compile(program, feed, fetch_names, scope)
+        from ..observability import memory as _memory
+        stats = _memory.executable_memory(
+            self._aot_compiled(compiled, feed, scope))
+        _memory.update_watermark("executor_temp_bytes",
+                                 stats["temp_bytes"])
+        return stats
+
+    def memory_census(self, feed=None, program=None, scope=None,
+                      kv_names=()):
+        """The full measured memory census of the LAST compiled step
+        (`observability.memory.device_memory_census`): per-device state
+        bytes by category from the actual scope arrays, feed bytes, the
+        XLA executable's argument/output/temp/alias figures, and a
+        process-wide live-array sweep. Run the step once first."""
+        from ..observability import memory as _memory
+        return _memory.device_memory_census(
+            self, dict(feed or {}), scope or global_scope(),
+            program=program, dp=int(getattr(self, "_dp", 1)),
+            kv_names=kv_names)
 
     def close(self):
         """≙ Executor::Close (reference executor.cc:48) — drop caches."""

@@ -15,6 +15,12 @@ Three pieces, one story — see docs/observability.md:
   beacons that survive SIGKILL, crash dossiers (spans + metrics + state
   board) on enforce error/SIGTERM/rank death, and the Supervisor's
   post-mortem synthesis (which rank died, in which barrier phase).
+- `memory` (r17): the measured memory + utilization half — the
+  device-memory census (XLA buffer-assignment figures + live-state
+  walk), per-channel watermarks behind the `ptpu_memory_*` gauges and
+  the `memory` trace channel, and the `ptpu_mfu` utilization gauge; the
+  ledger reconciles the census against `costs.predict()["memory"]`
+  with a committed accounting identity (`check_memory_identity`).
 
 The capability equivalent of the reference's platform/profiler +
 device_tracer + timeline stack, grown into the always-on,
@@ -22,10 +28,10 @@ prediction-reconciling form the auto-parallel planner (ROADMAP item 2)
 and the serving load harness (item 3) consume.
 """
 
-from . import flight_recorder, ledger, metrics, tracing  # noqa: F401
+from . import flight_recorder, ledger, memory, metrics, tracing  # noqa: F401
 from .ledger import CostLedger, LedgerRow  # noqa: F401
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
                       MetricsRegistry, MultiRegistry, default_registry)
 from .tracing import (SPAN_KINDS, Span, aggregate,  # noqa: F401
-                      export_chrome_trace, rank_scope, record_span,
-                      scoped_tags, span, spans)
+                      export_chrome_trace, rank_scope, record_counter,
+                      record_span, scoped_tags, span, spans)

@@ -206,6 +206,16 @@ def dump_dossier(reason: str, rank: int = 0, exc: Optional[BaseException]
         spans = [s.to_dict() for s in _tracing.spans()[-DOSSIER_SPANS:]]
     except Exception:
         spans = []
+    try:
+        # the memory board: current + high-water bytes per channel and
+        # the last MFU reading — an OOM-shaped death is attributable
+        # from the dossier alone (was the KV cache or the checkpoint
+        # staging holding the bytes?). SAME shape as /healthz's
+        # "memory" field, so one post-mortem tool reads both.
+        from . import memory as _memory
+        mem_board = _memory.watermark_board()
+    except Exception:
+        mem_board = {}
     with _lock:
         _dossier_seq += 1
         seq = _dossier_seq
@@ -219,6 +229,7 @@ def dump_dossier(reason: str, rank: int = 0, exc: Optional[BaseException]
         "exception": (f"{type(exc).__name__}: {exc}"
                       if exc is not None else None),
         "state": state_board(),
+        "memory": mem_board,
         "spans": spans,
         "metrics": _metrics_snapshot(),
         "extra": dict(extra or {}),

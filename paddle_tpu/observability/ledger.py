@@ -78,6 +78,14 @@ class LedgerRow:
         self.measured.update(fields)
         return self
 
+    def set_memory_census(self, census: Dict):
+        """Attach a measured memory census
+        (observability.memory.device_memory_census output — per-device
+        state categories from the actual arrays, feed bytes, and the XLA
+        executable's argument/output/temp/alias figures)."""
+        self.measured["memory"] = census
+        return self
+
     # -- reconciliation ---------------------------------------------------
     def _check(self, what, predicted, measured, tolerance, ok):
         rec = {"what": what, "predicted": predicted, "measured": measured,
@@ -130,6 +138,96 @@ class LedgerRow:
         ok = abs(pred - measured_fraction) <= band
         return self._check("bubble_fraction", pred, measured_fraction,
                            f"abs<={band}", ok)
+
+    #: categories whose per-device bytes are EXACTLY predictable from
+    #: declared shapes + placement markers (costs.memory_categories) —
+    #: any drift is a placement/accounting bug, not noise
+    MEMORY_EXACT_CATEGORIES = ("params", "optimizer_state", "ef_residual",
+                               "other_state", "feeds")
+
+    def check_memory_identity(self, residual_frac: float = 0.10) -> Dict:
+        """The r17 memory accounting identity: every MEASURED per-device
+        byte of the step's footprint is attributed to a predicted
+        category or lands in an explicitly NAMED residual bucket, and
+        the named residual stays bounded. Three disciplines in one
+        check set (requires set_prediction — with the memory.per_device
+        section — and set_memory_census first):
+
+        1. `memory_<cat>` per category in MEMORY_EXACT_CATEGORIES:
+           measured == predicted EXACTLY (declared shapes + placement
+           markers fully determine these; `unrealized:<cat>` /
+           `unattributed:<cat>` buckets name any drift).
+        2. `memory_args_balance`: the category walk must re-derive the
+           XLA executable's own argument figure —
+           state_total + feeds + seed == argument_bytes within 64 bytes
+           (scalar-seed/alignment slack). Catches a category the walk
+           missed entirely.
+        3. `memory_residual_bound`: unattributed measured bytes (the
+           sum of every `unattributed:<cat>` bucket, dominated by
+           measured temp exceeding the static transient estimate)
+           <= residual_frac of the measured peak footprint.
+
+        The identity itself — sum(attributed) + sum(unattributed) ==
+        measured total — holds by construction and is recorded in the
+        check's `buckets` field for the artifact."""
+        enforce(self.predicted is not None
+                and isinstance(self.predicted.get("memory"), dict)
+                and "per_device" in self.predicted["memory"]
+                and "memory" in self.measured,
+                f"ledger row {self.name!r}: need a prediction carrying "
+                f"memory.per_device (costs.predict) AND a memory census "
+                f"(set_memory_census) before check_memory_identity",
+                exc=InvalidArgumentError)
+        pred = self.predicted["memory"]["per_device"]
+        mem = self.measured["memory"]
+        mcats = mem["state"]["categories"]
+        measured = {
+            "params": mcats["params"],
+            "optimizer_state": mcats["optimizer_state"],
+            "ef_residual": mcats["ef_residual"],
+            # kv_cache is the census's refinement of other_state (slot
+            # caches are plain persistables to the static walk, which
+            # prices them under other_state) — attribute them together
+            # so a serving census with kv_names set reconciles instead
+            # of pushing every KV byte into unattributed
+            "other_state": mcats["other_state"] + mcats["kv_cache"],
+            "feeds": mem["feeds"]["per_device_bytes"],
+            "seed": mem["seed_bytes"],
+            "transient_peak": mem["xla"]["temp_bytes"],
+        }
+        predicted = {c: float(pred.get(c, 0)) for c in measured}
+        attributed, buckets = {}, {}
+        for c, mv in measured.items():
+            pv = predicted[c]
+            attributed[c] = min(mv, pv)
+            if mv > pv + 0.5:
+                buckets[f"unattributed:{c}"] = mv - pv
+            elif pv > mv + 0.5:
+                buckets[f"unrealized:{c}"] = pv - mv
+        for c in self.MEMORY_EXACT_CATEGORIES:
+            self._check(f"memory_{c}", predicted[c], measured[c],
+                        "exact", abs(predicted[c] - measured[c]) < 0.5)
+        args_lhs = (mcats["state_total"]
+                    + mem["feeds"]["per_device_bytes"]
+                    + mem["seed_bytes"])
+        args_rhs = mem["xla"]["argument_bytes"]
+        self._check("memory_args_balance", round(args_lhs), args_rhs,
+                    "abs<=64", abs(args_lhs - args_rhs) <= 64)
+        unattributed = sum(v for k, v in buckets.items()
+                           if k.startswith("unattributed:"))
+        peak = float(mem["peak_bytes"])
+        rec = self._check(
+            "memory_residual_bound", round(residual_frac * peak),
+            round(unattributed), f"unattributed<={residual_frac}*peak",
+            unattributed <= residual_frac * peak)
+        rec["buckets"] = {k: round(v) for k, v in buckets.items()}
+        rec["attributed_total"] = round(sum(attributed.values()))
+        rec["measured_total"] = round(sum(measured.values()))
+        rec["peak_bytes"] = round(peak)
+        # the identity proper: attribution is a partition of measured
+        assert abs((sum(attributed.values()) + unattributed)
+                   - sum(measured.values())) < 1.0
+        return rec
 
     def check(self, what: str, predicted, measured, rel: float) -> Dict:
         """Generic relative-tolerance comparison."""

@@ -49,6 +49,8 @@ SPAN_KINDS = frozenset({
     "checkpoint",  # elastic snapshot/restore phases (parallel/elastic.py)
     "request",     # one serving request's lifecycle phases (queue_wait/
                    # prefill/decode/transport, serving_engine.py)
+    "memory",      # memory watermark sample (record_counter; rendered as
+                   # a Chrome COUNTER track, observability/memory.py)
     "user",        # RecordEvent-style user annotation
 })
 
@@ -294,6 +296,27 @@ def record_span(kind: str, name: str, start: float, end: float,
     return s
 
 
+def record_counter(name: str, value: float, **attrs) -> Optional[Span]:
+    """Record one SAMPLE on the `memory` channel: a zero-duration span
+    whose `value` attr is the sampled level (a watermark's current bytes,
+    an MFU reading). Samples ride the same ring as interval spans — one
+    counter draw, no lock — and `chrome_trace_events` renders them as
+    Chrome COUNTER events (`ph: "C"`), i.e. a plotted track per sample
+    name, so memory levels read as a line under the span lanes. Thread
+    tags (scoped_tags / rank_scope) merge in exactly like live spans;
+    returns None when tracing is disabled."""
+    if not (_TRACE_FLAG.value or _force_count):
+        return None
+    now = time.perf_counter()
+    tags = getattr(_tls, "tags", None)
+    attrs = ({**tags, "value": float(value), **attrs} if tags
+             else {"value": float(value), **attrs})
+    s = Span("memory", name, now, now, threading.get_ident(), "", 0,
+             attrs, next(_seq))
+    _record(s)
+    return s
+
+
 def clear():
     """Drop every recorded span (test isolation; profiler.reset)."""
     global _ring, _seq
@@ -347,6 +370,17 @@ def chrome_trace_events(span_list: Optional[List[Span]] = None,
     the overlapping ts/dur intervals per thread lane."""
     evs = []
     for s in (spans() if span_list is None else span_list):
+        if s.kind == "memory":
+            # counter sample -> Chrome COUNTER event: args values are
+            # plotted as a track named after the sample. Non-numeric
+            # attrs (rank tags) ride along for trace_merge's lane
+            # assignment and are ignored by the counter renderer.
+            evs.append({
+                "name": s.name, "cat": s.kind, "ph": "C",
+                "ts": s.start * 1e6, "pid": pid, "tid": s.thread_id,
+                "args": dict(s.attrs),
+            })
+            continue
         evs.append({
             "name": s.name, "cat": s.kind, "ph": "X",
             "ts": s.start * 1e6, "dur": (s.end - s.start) * 1e6,

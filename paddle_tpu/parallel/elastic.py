@@ -554,6 +554,28 @@ _pending_lock = threading.Lock()
 _serial_lock = threading.Lock()
 _last_serial = -1
 
+# host bytes currently staged for in-flight snapshot writes (the d2h
+# copies a background writer still holds) — the host_staging_bytes
+# memory watermark (observability/memory.py). Concurrent async saves
+# sum; the watermark's peak records the worst co-residency.
+_staged_bytes = 0.0
+
+
+def _note_staging(delta: float):
+    global _staged_bytes
+    from ..observability import memory as _obs_memory
+    with _pending_lock:
+        _staged_bytes = max(0.0, _staged_bytes + delta)
+        # publish under the SAME lock that computed the total: two
+        # writers finishing together must publish in total order, or
+        # the channel's "current" can stick at a stale nonzero value
+        _obs_memory.update_watermark("host_staging_bytes",
+                                     _staged_bytes)
+
+
+def _chunk_nbytes(chunks) -> float:
+    return float(sum(getattr(a, "nbytes", 0) for a in chunks.values()))
+
 
 def _alloc_serial(root: str) -> int:
     """Monotone snapshot serial: max(disk, in-process counter) under a
@@ -736,12 +758,20 @@ def save_train_state(root: str,
                            n_vars=len(arrays), step=int(step),
                            world_size=world.world_size):
             rank_payloads = _collect_rank_chunks(world, arrays, mesh)
+        staged = sum(_chunk_nbytes(c) for c, _ in rank_payloads.values())
         os.makedirs(root, exist_ok=True)
         serial = _alloc_serial(root)
+        # note the staged bytes only once every step that can raise
+        # OUTSIDE a try/finally is behind us (an unwritable root must
+        # not leave the watermark permanently inflated)
+        _note_staging(staged)
         if block:
-            return _barrier_write_and_commit(
-                world, root, serial, rank_payloads, meta, max_snapshots,
-                step, barrier_deadline_s)
+            try:
+                return _barrier_write_and_commit(
+                    world, root, serial, rank_payloads, meta,
+                    max_snapshots, step, barrier_deadline_s)
+            finally:
+                _note_staging(-staged)
         handle = AsyncSnapshot(serial)
         with _pending_lock:
             _PENDING.append(handle)
@@ -754,6 +784,8 @@ def save_train_state(root: str,
                 handle._finish(path=path)
             except BaseException as e:  # noqa: BLE001 - via result()
                 handle._finish(exc=e)
+            finally:
+                _note_staging(-staged)
 
         t = threading.Thread(target=_bwriter,
                              name=f"ckpt-barrier-{serial}", daemon=True)
@@ -763,17 +795,24 @@ def save_train_state(root: str,
     with _tracing.span("checkpoint", "elastic/snapshot_d2h",
                        n_vars=len(arrays), step=int(step)):
         chunks, manifest, pid = collect_chunks(arrays)
+    staged = _chunk_nbytes(chunks)
 
     os.makedirs(root, exist_ok=True)
     serial = _alloc_serial(root)
     final = os.path.join(root, f"{SNAPSHOT_PREFIX}{serial:08d}")
     staging = os.path.join(root,
                            f"{STAGING_PREFIX}{serial:08d}-{os.getpid()}")
+    # see the barrier path: only note once the can-raise setup is done,
+    # so the compensating decrement in the finally always runs
+    _note_staging(staged)
 
     if block:
-        return _write_and_commit(staging, final, chunks, manifest, pid,
-                                 meta, root, max_snapshots, step,
-                                 serial)
+        try:
+            return _write_and_commit(staging, final, chunks, manifest,
+                                     pid, meta, root, max_snapshots,
+                                     step, serial)
+        finally:
+            _note_staging(-staged)
     handle = AsyncSnapshot(serial)
     with _pending_lock:
         _PENDING.append(handle)
@@ -786,6 +825,8 @@ def save_train_state(root: str,
             handle._finish(path=path)
         except BaseException as e:  # noqa: BLE001 - surfaced via result()
             handle._finish(exc=e)
+        finally:
+            _note_staging(-staged)
 
     t = threading.Thread(target=_writer, name=f"ckpt-writer-{serial}",
                          daemon=True)

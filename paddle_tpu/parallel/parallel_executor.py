@@ -846,6 +846,29 @@ class ParallelExecutor(Executor):
                               tp=self.mesh.axis_size(MODEL_AXIS),
                               nominal_batch=nominal_batch)
 
+    def memory_report(self, feed, program: Optional[Program] = None,
+                      scope: Optional[Scope] = None,
+                      nominal_batch: int = 8) -> Dict:
+        """Predicted + measured memory for the program AS RUN, in one
+        dict — the memory half of the r17 sensor pair (ROADMAP items 1
+        and 2 read both sides):
+
+          predicted  cost_report()["memory"] — the static estimate plus
+                     the per-device category buckets
+                     (costs.memory_categories at this mesh's dp/tp)
+          measured   Executor.memory_census() — actual scope arrays, the
+                     XLA executable's buffer-assignment figures, the
+                     live-array sweep
+
+        Run the step once first (the census measures the executable the
+        runs actually use); observability/ledger.py
+        check_memory_identity reconciles the two sides with the
+        accounting identity."""
+        report = self.cost_report(program=program, scope=scope,
+                                  nominal_batch=nominal_batch)
+        census = self.memory_census(feed, program=program, scope=scope)
+        return {"predicted": report["memory"], "measured": census}
+
     @property
     def device_count(self) -> int:
         return self.mesh.num_devices

@@ -49,6 +49,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from .core.enforce import InvalidArgumentError, enforce
+from .observability import memory as _obs_memory
 from .observability import metrics as _obs_metrics
 from .observability import tracing as _tracing
 
@@ -261,6 +262,15 @@ class ContinuousBatchingEngine:
         #: latency decomposition record tools/bench_reqtrace.py reads
         self.completed_log: "deque[GenRequest]" = deque(maxlen=512)
         self._init_metrics()
+        # the slot KV caches are persistable fixed-shape state: their
+        # byte census is pinned at construction. Seed the process-wide
+        # kv watermark (ptpu_memory_kv_cache_bytes) now so a scrape or a
+        # dossier taken before the first tick already carries it; ticks
+        # re-stamp it (two engines in one process: last writer wins the
+        # `current`, the peak ratchets over both)
+        self._kv_bytes_static = self._kv_cache_bytes()
+        _obs_memory.update_watermark("kv_cache_bytes",
+                                     self._kv_bytes_static)
 
     def _init_metrics(self):
         """Per-engine MetricsRegistry (observability/metrics.py) — the
@@ -437,6 +447,11 @@ class ContinuousBatchingEngine:
         self._m_ticks.inc()
         self.n_ticks += 1
         self.last_tick_at = time.time()
+        # re-stamp the kv watermark from the pinned construction-time
+        # census (slot caches are fixed-shape; O(1) per tick) so the
+        # live `current` reflects the ENGINE that is actually ticking
+        _obs_memory.update_watermark("kv_cache_bytes",
+                                     self._kv_bytes_static)
         self.busy_slot_ticks += len(active)
         self.total_slot_ticks += self.n_slots
         finished = []
@@ -705,6 +720,7 @@ class EngineServer:
             from .trainer import training_metrics as _training_metrics
             _elastic.metrics_registry()
             _training_metrics()
+            _obs_memory.memory_metrics()   # ptpu_memory_* + ptpu_mfu
             self._http = _MetricsHTTPServer(
                 (host, metrics_port),
                 _obs_metrics.MultiRegistry(
@@ -729,6 +745,11 @@ class EngineServer:
                 "pending_async": _elastic.pending_async_count()},
             "supervisor": {
                 "restarts": int(restarts) if restarts else 0},
+            # the memory board (r17): per-channel current + high-water
+            # bytes and the last MFU reading — the same board every
+            # flight-recorder dossier embeds, so live probing and
+            # post-mortems read one vocabulary
+            "memory": _obs_memory.watermark_board(),
             "pid": os.getpid(),
             "ts": time.time(),
         }

@@ -333,7 +333,12 @@ class Trainer:
         """Saved trainer args are the NEXT work item (resume_epoch,
         resume_step): a resumed run skips everything already trained —
         including the whole run when it had completed."""
+        from .observability import memory as _memory
         from .parallel import elastic as _elastic
+        # materialize the ptpu_memory_*/ptpu_mfu families up front: a
+        # scrape or crash dossier taken before the first step must see
+        # them (the executor stamps the values per run)
+        _memory.memory_metrics()
         feeder = DataFeeder(feed_list=[
             self.train_program.global_block().var(n) for n in feed_order])
         start_epoch = (self.checkpoint_cfg.epoch_id

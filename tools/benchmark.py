@@ -443,7 +443,6 @@ def main():
 
     import numpy as np
     import jax
-    import jax.numpy as jnp
     import paddle_tpu as pt
 
     if args.no_bf16:
@@ -619,17 +618,45 @@ def main():
         if not args.no_census:
             from probe_common import census_wire_bytes, collective_census
             cs = list(runner._cache.values())[-1]
-            feed_vals = tuple(jnp.asarray(feed[n]) if n in feed else
-                              scope.get(n) for n in cs.feed_names)
-            ro = tuple(scope.get(n) for n in cs.ro_names)
-            rw = tuple(scope.get(n) for n in cs.rw_names)
-            hlo = cs.fn.lower(feed_vals, ro, rw,
-                              np.uint32(0)).compile().as_text()
+            # one memoized AOT compile serves the wire census AND the
+            # memory census below (Executor._aot_compiled)
+            hlo = runner._aot_compiled(cs, feed, scope).as_text()
             census = collective_census(hlo)
             comm_fields["wire_bytes_census"] = int(census_wire_bytes(
                 census, dp, min_bytes=8))
             comm_fields["census_collectives"] = {
                 k: len(v) for k, v in census.items()}
+
+    # memory + utilization columns (r17): the blocked-measured MFU (the
+    # timed window above block_until_ready's, so dt is true step time)
+    # and — unless --no_census — the measured memory census of the
+    # executable the loop actually ran, next to the static prediction
+    from paddle_tpu.framework import costs as _costs
+    flops = _costs.program_flops_bytes(
+        pt.default_main_program(), nominal_batch=args.batch_size)["flops"]
+    ndev = max(1, int(getattr(runner, "device_count", 1)))
+    mem_fields = {
+        "model_flops_per_step": round(flops),
+        "mfu": round(_costs.mfu(flops / ndev, dt / args.iters), 8),
+    }
+    if not args.no_census:
+        census = runner.memory_census(feed=feed)
+        pred_mem = _costs.predict(
+            runner._prepare_program(pt.default_main_program(),
+                                    pt.global_scope())
+            if args.update_method == "collective"
+            else pt.default_main_program(),
+            dp=getattr(runner, "_dp", 1),
+            nominal_batch=args.batch_size)["memory"]
+        mem_fields.update({
+            "mem_state_bytes": round(
+                census["state"]["categories"]["state_total"]),
+            "mem_temp_bytes": census["xla"]["temp_bytes"],
+            "mem_temp_source": census["xla"]["temp_source"],
+            "mem_peak_bytes": round(census["peak_bytes"]),
+            "mem_predicted_peak_total_bytes":
+                pred_mem["peak_total_bytes"],
+        })
 
     unit = ("tokens/sec" if args.model in
             ("transformer", "machine_translation") else "examples/sec")
@@ -645,6 +672,7 @@ def main():
         "throughput": round(units_per_step * args.iters / dt, 2),
         "unit": unit,
         "device": jax.devices()[0].platform,
+        **mem_fields,
         **comm_fields,
     }))
 

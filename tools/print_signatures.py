@@ -54,6 +54,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.observability.metrics",
     "paddle_tpu.observability.ledger",
     "paddle_tpu.observability.flight_recorder",
+    "paddle_tpu.observability.memory",
     "paddle_tpu.parallel",
     "paddle_tpu.parallel.collective",
     "paddle_tpu.parallel.elastic",

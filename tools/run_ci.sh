@@ -340,12 +340,88 @@ for phase in ("queue_wait", "prefill", "decode", "transport"):
         in text, phase
 assert "ptpu_request_e2e_seconds_count" in text
 assert "ptpu_ckpt_saves_total" in text and "ptpu_train_steps_total" in text
+# r17: ONE scrape also carries the memory board + the MFU sensor
+for series in ("ptpu_mfu", "ptpu_memory_device_state_bytes",
+               "ptpu_memory_kv_cache_bytes",
+               "ptpu_memory_watermark_bytes"):
+    assert series in text, series
 # r16: /healthz is live on the same listener
 assert health["status"] == "serving", health
 assert health["engine"]["last_tick_age_s"] is not None
 assert health["checkpoints"]["pending_async"] == 0
+# r17: /healthz embeds the same memory board the dossiers carry
+assert health["memory"]["kv_cache_bytes"]["current"] > 0, health
 print("observability smoke OK")
 PY
+
+echo "== memory-observability smoke (census + ledger identity + MFU) =="
+# the r17 memory sensor end to end (docs/observability.md): a traced
+# mnist dp2 step must reconcile its measured memory census against
+# costs.predict's per-device categories under the accounting identity
+# (state/feed categories EXACT, unattributed residual <= 10% of the
+# measured peak), stamp the ptpu_memory_* watermarks + ptpu_mfu, and
+# emit memory COUNTER events into the Chrome trace export. Then the
+# BENCH_MEM artifact generator must run clean on the same cell.
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+python - <<'PY'
+import json, numpy as np, jax
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.observability import memory as obs_memory
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.observability import tracing
+from paddle_tpu.observability.ledger import CostLedger
+from paddle_tpu.parallel import ParallelExecutor
+from paddle_tpu.parallel.mesh import DeviceMesh
+from paddle_tpu.parallel.strategy import BuildStrategy, ReduceStrategy
+
+pt.reset_default_programs(); pt.reset_global_scope()
+with pt.core.unique_name.guard():
+    x = layers.data("x", shape=[64])
+    label = layers.data("label", shape=[1], dtype="int64")
+    h = layers.fc(x, size=128, act="relu")
+    loss = layers.mean(layers.softmax_with_cross_entropy(
+        layers.fc(h, size=10), label))
+    pt.optimizer.MomentumOptimizer(0.1, momentum=0.9).minimize(loss)
+bst = BuildStrategy(); bst.reduce_strategy = ReduceStrategy.ReduceScatter
+exe = ParallelExecutor(loss_name=loss.name, build_strategy=bst,
+                       mesh=DeviceMesh(jax.devices()[:2], {"dp": 2}))
+pt.Executor().run(pt.default_startup_program())
+rng = np.random.RandomState(0)
+feed = {"x": rng.rand(16, 64).astype("float32"),
+        "label": rng.randint(0, 10, (16, 1)).astype("int64")}
+for _ in range(3):   # traced steps (first is the MFU warm-up window)
+    exe.run(feed=feed, fetch_list=[loss])
+
+row = CostLedger("ci").row("mnist_dp2_mem")
+row.set_prediction(exe.cost_report(nominal_batch=16))
+row.set_memory_census(exe.memory_census(feed=feed))
+rec = row.check_memory_identity()
+assert row.ok, [c for c in row.checks if not c["ok"]]
+
+text = obs_metrics.default_registry().expose()
+assert "ptpu_memory_device_state_bytes" in text
+assert "ptpu_memory_executor_temp_bytes" in text
+mfu = [l for l in text.splitlines() if l.startswith("ptpu_mfu ")][0]
+assert float(mfu.split()[-1]) > 0, mfu
+
+tracing.export_chrome_trace("/tmp/ptpu_mem_trace_ci.json")
+evs = json.load(open("/tmp/ptpu_mem_trace_ci.json"))["traceEvents"]
+counters = {e["name"] for e in evs if e.get("ph") == "C"}
+assert any(n.startswith("memory/") for n in counters), counters
+print("memory-observability smoke OK:", json.dumps(rec["buckets"]))
+PY
+rm -f /tmp/ptpu_mem_trace_ci.json /tmp/bench_mem_ci.json
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python tools/bench_mem.py --out /tmp/bench_mem_ci.json --iters 2 \
+    --cells mnist:dp2 --skip_live
+python - <<'PY'
+import json
+doc = json.load(open("/tmp/bench_mem_ci.json"))
+assert doc["ok"] and len(doc["rows"]) == 1, doc["ok"]
+print("bench_mem smoke OK")
+PY
+rm -f /tmp/bench_mem_ci.json
 
 echo "== flight-recorder smoke (SIGKILL mid-barrier -> dossier + post-mortem) =="
 # the distributed flight recorder end to end (observability/

@@ -40,9 +40,11 @@ import sys
 from typing import Dict, List, Optional, Tuple
 
 #: name prefixes whose spans collapse onto one named thread lane per
-#: (rank, phase family) — the "phase → tid" naming of the merged view
+#: (rank, phase family) — the "phase → tid" naming of the merged view.
+#: "memory/" carries the r17 watermark COUNTER events (ph "C"): each
+#: rank's memory levels plot on one lane under its span lanes.
 PHASE_FAMILIES = ("barrier/", "request/", "pp_send/", "pp_recv/",
-                  "elastic/", "engine/")
+                  "elastic/", "engine/", "memory/")
 
 
 def _load_events(path: str) -> List[dict]:

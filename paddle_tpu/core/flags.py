@@ -146,6 +146,17 @@ define_bool("tp_shard", True,
             "the old enforce gate — tp-sharded programs are then rejected "
             "by the manual modes instead of rewritten. Part of the "
             "executor's compile cache key.")
+define_bool("memory_plan", True,
+            "Allow the static memory planner (framework/memory_plan.py) "
+            "when the BuildStrategy requests it (memory_plan=True) or a "
+            "caller applies memory_plan_pass: liveness-minimizing op "
+            "scheduling, interference-graph buffer-slot coloring (verified "
+            "race-free by the r13 buffer-reuse detectors on every apply), "
+            "and the remat-vs-stash search that segments the backward "
+            "region under jax.checkpoint. Kill switch: PTPU_MEMORY_PLAN=0 "
+            "runs every program unplanned — the escape hatch if a plan "
+            "ever misbehaves in production. Part of the executor's "
+            "compile cache key (framework/executor.py _fusion_flags_key).")
 define_bool("quant_comm", True,
             "Allow quantized gradient collectives when the BuildStrategy "
             "requests them (quant_comm='int8'/'bf16'). Kill switch: "

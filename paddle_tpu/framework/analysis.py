@@ -935,14 +935,9 @@ def peak_live_bytes(program: Program, nominal_batch: int = 8) -> Dict:
 
     def nbytes(block, name):
         # only vars DECLARED in this block: parent vars are the parent
-        # sweep's to count (persistables/feeds are block 0's)
-        v = block.vars.get(name)
-        if v is None or v.shape is None:
-            return 0
-        numel = 1
-        for d in _subst(v.shape, nominal_batch):
-            numel *= d
-        return numel * np.dtype(v.dtype).itemsize
+        # sweep's to count (persistables/feeds are block 0's); ONE
+        # pricing rule shared with the memory planner
+        return _dataflow.declared_var_bytes(block, name, nominal_batch)
 
     block0 = program.global_block()
     persistent, feed = 0, 0

@@ -628,6 +628,24 @@ def predict(program, strategy=None, *, dp: int = 1, tp: int = 0,
                                             nominal_batch=nominal_batch),
         },
     }
+    if getattr(program, "_memory_plan_applied", False):
+        # the static memory plan's decision record rides the prediction:
+        # the ledger's conservative transient estimate stays UNPLANNED
+        # (so a planned cell's measured reduction surfaces in the NAMED
+        # unrealized:transient_peak bucket, never the residual), and this
+        # section says what the plan predicted it bought and how
+        plan = dict(getattr(program, "_memory_plan_report", {}) or {})
+        report["memory"]["plan"] = {
+            "predicted_peak_before": plan.get("predicted_peak_before"),
+            "predicted_peak_after": plan.get("predicted_peak_after"),
+            "predicted_reduction_bytes":
+                plan.get("predicted_reduction_bytes"),
+            "n_slots": plan.get("n_slots"),
+            "shared_vars": plan.get("shared_vars"),
+            "remat": plan.get("remat"),
+            "pp_stages": plan.get("pp_stages"),
+            "schedule": plan.get("schedule"),
+        }
     if dp > 1:
         report["dp_comm"] = (_gc.analytic_wire_bytes(program, dp)
                              or _gc.spmd_allreduce_wire_bytes(program, dp))

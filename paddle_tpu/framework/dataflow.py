@@ -184,6 +184,24 @@ def var_lifetimes(block: Block,
     return {name: (w, last_r.get(name, w)) for name, w in first_w.items()}
 
 
+def declared_var_bytes(block: Block, name: str,
+                       nominal_batch: int = 8) -> int:
+    """Declared-shape bytes of one var (-1 dims priced at
+    `nominal_batch`) — the ONE pricing rule the lifetime walks
+    (analysis.peak_live_bytes) and the memory planner
+    (framework/memory_plan.py) share, so slot-table and stash estimates
+    can never drift from the peak estimate they are compared against.
+    0 for undeclared/shapeless names."""
+    import numpy as np
+    v = block.vars.get(name)
+    if v is None or v.shape is None:
+        return 0
+    numel = 1
+    for d in v.shape:
+        numel *= (nominal_batch if d == -1 else int(d))
+    return numel * np.dtype(v.dtype).itemsize
+
+
 def interference_graph(block: Block,
                        lifetimes: Optional[Dict[str, Tuple[int, int]]] = None
                        ) -> Dict[str, Set[str]]:
@@ -700,6 +718,78 @@ def _check_replica_divergence(program, env, diags):
 # ---------------------------------------------------------------------------
 
 
+def _check_cross_block_slots(program, groups, diags):
+    """Slot groups that CROSS a block boundary (r18 planner satellite):
+    the per-block scan above compares live intervals inside one op list,
+    so a planner slot shared between a parent-block var and a var inside
+    a bound sub-block (while/cond/static_rnn body — or any region a
+    binder op executes) was never verified. The sub-block var's effective
+    live window in an ancestor block is its BINDER op's index — the
+    binder (re-)executes the whole sub-block, possibly per iteration, so
+    the var is live whenever the binder is. Walk each member's binder
+    chain to the deepest common ancestor and report overlap there as the
+    same `buffer-reuse-race` the in-block scan raises. Sibling sub-blocks
+    of ONE binder (cond/switch branches) are mutually exclusive and
+    sanctioned."""
+    cross = {s: ms for s, ms in groups.items()
+             if len({b.idx for b, _ in ms}) > 1}
+    if not cross:
+        return
+    binders = _sub_block_map(program)
+    lifetimes_cache: Dict[int, Dict] = {}
+
+    def lifetimes(block):
+        lt = lifetimes_cache.get(block.idx)
+        if lt is None:
+            lt = lifetimes_cache[block.idx] = var_lifetimes(block)
+        return lt
+
+    def spans(block, name):
+        """{ancestor block idx: (start, end)} — the var's own lifetime in
+        its block, then its binder op's point interval per ancestor."""
+        iv = lifetimes(block).get(name)
+        if iv is None:
+            return None                   # never written: nothing to race
+        out = {block.idx: iv}
+        b = block
+        seen = set()
+        while b.idx in binders and b.idx not in seen:
+            seen.add(b.idx)
+            pb, pidx, _pop = binders[b.idx]
+            out[pb.idx] = (pidx, pidx)
+            b = pb
+        return out
+
+    for slot, members in sorted(cross.items(), key=lambda kv: repr(kv[0])):
+        for i in range(len(members)):
+            for j in range(i + 1, len(members)):
+                (b1, n1), (b2, n2) = members[i], members[j]
+                if b1.idx == b2.idx:
+                    continue              # the per-block scan owns these
+                s1, s2 = spans(b1, n1), spans(b2, n2)
+                if s1 is None or s2 is None:
+                    continue
+                common = set(s1) & set(s2)
+                if not common:
+                    continue
+                cb = max(common)          # deepest common ancestor
+                (a1, e1), (a2, e2) = s1[cb], s2[cb]
+                if cb not in (b1.idx, b2.idx) and (a1, e1) == (a2, e2):
+                    continue    # sibling branches of one binder: exclusive
+                if a1 <= e2 and a2 <= e1:
+                    block = program.blocks[cb]
+                    bidx = a1 if cb != b1.idx else a2
+                    diags.append(Diagnostic(
+                        "buffer-reuse-race",
+                        op_loc(block, bidx, block.ops[bidx]),
+                        f"buffer slot {slot!r}: {n1!r} (block {b1.idx}) "
+                        f"and {n2!r} (block {b2.idx}) overlap in ancestor "
+                        f"block {cb} — a sub-block var is live whenever "
+                        f"its region binder executes, so a slot crossing "
+                        f"the boundary must not overlap the binder's "
+                        f"live window"))
+
+
 def _check_buffer_reuse(program, diags):
     """The safety gate for liveness-driven buffer reuse (ROADMAP item 4):
     vars the planner assigns one buffer (`Variable.buffer_slot`) must not
@@ -710,12 +800,14 @@ def _check_buffer_reuse(program, diags):
     aliases from effect rules get the same WAR treatment. Programs with
     no annotations (everything today outside the planner and its tests)
     short-circuit to zero cost."""
+    all_groups: Dict[Any, List[Tuple[Any, str]]] = {}
     for block in program.blocks:
         groups: Dict[Any, List[str]] = {}
         for name, v in block.vars.items():
             slot = getattr(v, "buffer_slot", None)
             if slot is not None:
                 groups.setdefault(slot, []).append(name)
+                all_groups.setdefault(slot, []).append((block, name))
         # cross-name in-place aliases can only come from a REGISTERED
         # effect rule (the slot-derived default is same-name only), so the
         # scan touches just the ops that have one — everything else keeps
@@ -781,6 +873,7 @@ def _check_buffer_reuse(program, diags):
                     f"in-place alias {rin!r} -> {rout!r}: op#{j} "
                     f"{block.ops[j].type!r} still reads {rin!r} after "
                     f"the aliasing write overwrote its buffer"))
+    _check_cross_block_slots(program, all_groups, diags)
 
 
 # ---------------------------------------------------------------------------

@@ -41,7 +41,8 @@ def _fusion_flags_key():
             flags.get_flag("fuse_decode_attention"),
             flags.get_flag("quant_comm"),
             flags.get_flag("pipeline"),
-            flags.get_flag("tp_shard"))
+            flags.get_flag("tp_shard"),
+            flags.get_flag("memory_plan"))
 
 
 def _feed_signature(feed: Dict[str, Any]):
@@ -546,7 +547,15 @@ class Executor:
                 final_state = tuple(by_name[n] for n in state_out_names)
                 return fetches, final_state
 
-            jit_kwargs: Dict[str, Any] = {"donate_argnums": (2,)}
+            # donation/aliasing hints: rw state is always donated; a
+            # memory-PLANNED program additionally donates the stacked
+            # feeds — _place_feed_stack materializes a fresh stack every
+            # call (jnp.stack / device_put of host values), so XLA may
+            # fold the feed buffers into its temp arena for the planned
+            # step without invalidating anything the caller holds
+            jit_kwargs: Dict[str, Any] = {
+                "donate_argnums": ((0, 2) if getattr(
+                    program, "_memory_plan_applied", False) else (2,))}
             scan_sh = self._scan_shardings(program, feed_names, fetch_names,
                                            ro, rw, state_out_names)
             if scan_sh is not None:

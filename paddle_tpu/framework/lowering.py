@@ -187,12 +187,128 @@ def _find_sparse_embedding_specs(seg_ops, target_names, env, block, ctx):
     return specs
 
 
+def remat_boundaries(seg_op_lists, out_need: Set[str]):
+    """Per-segment carried-out name lists for a segmented-remat region:
+    segment i's boundary = names produced at/before segment i that a
+    LATER segment reads, or that the region must publish (`out_need` —
+    the narrowed live-out set plus the loss). Everything else a segment
+    produces is recomputed from its boundary input during the backward
+    (jax.checkpoint per segment). The ONE copy shared by the executing
+    runner below and the planner's predicted-peak model
+    (framework/memory_plan.py) — prediction and execution cannot drift."""
+    reads_after = []
+    acc: Set[str] = set()
+    for ops in reversed(seg_op_lists):
+        reads_after.insert(0, set(acc))
+        for op in ops:
+            acc |= set(op.input_names())
+    boundaries = []
+    avail: Set[str] = set()
+    for i, ops in enumerate(seg_op_lists):
+        for op in ops:
+            avail |= set(op.output_names())
+        boundaries.append(sorted((reads_after[i] | out_need) & avail))
+    return boundaries
+
+
+def _run_vjp_region_segmented(region_op, seg_indices, env, block, ctx,
+                              segments):
+    """Segmented-remat execution of a vjp_region (attrs set by the memory
+    planner, framework/memory_plan.py): the forward runs as a chain of
+    jax.checkpoint'd segment functions, so the backward of segment i
+    recomputes ONLY segment i's activations from its carried boundary —
+    the executable form of the remat-vs-stash plan (Checkmate-style
+    segmentation; the pipeline engine's stage-granular checkpointing is
+    the same idea at stage boundaries). attrs consulted:
+      remat_segments     list of block-op-index lists partitioning fwd_ops
+      remat_policy       optional jax.checkpoint_policies name per segment
+      remat_prevent_cse  default True (real recompute); False lets XLA CSE
+                         recomputation back into the forward where that
+                         wins wall-clock (documented tradeoff)
+    """
+    attrs = region_op.attrs
+    target_names: List[str] = attrs["targets"]
+    loss_name: str = attrs["loss"]
+    seg_ops_all = [block.ops[i] for i in seg_indices]
+    produced: List[str] = []
+    for op in seg_ops_all:
+        for n in op.output_names():
+            if n not in produced:
+                produced.append(n)
+    live_out = attrs.get("live_out")
+    if live_out is not None:
+        live = set(live_out) | set(ctx.extras.get("fetch_names", ()))
+        produced = [n for n in produced if n in live]
+    base_env = {k: v for k, v in env.items()}
+    dense_names = list(target_names)
+    seg_op_lists = [[block.ops[i] for i in seg] for seg in segments]
+    # boundaries computed at TRACE time (not plan time) so run-specific
+    # fetch targets are carried out of their producing segment
+    boundaries = remat_boundaries(seg_op_lists,
+                                  set(produced) | {loss_name})
+    policy_name = attrs.get("remat_policy")
+    policy = (getattr(jax.checkpoint_policies, policy_name)
+              if policy_name else None)
+    prevent_cse = bool(attrs.get("remat_prevent_cse", True))
+
+    def fwd(dense_vals, perturb_vals):
+        carried_names: List[str] = []
+        carried_vals = ()
+        for i, ops in enumerate(seg_op_lists):
+            bn = boundaries[i]
+
+            def seg_fn(dv, cv, _ops=ops, _cn=list(carried_names), _bn=bn):
+                e = dict(base_env)
+                e.update(zip(dense_names, dv))
+                e.update(zip(_cn, cv))
+                for op in _ops:
+                    run_op(op, e, block, ctx)
+                return tuple(e[n] for n in _bn)
+
+            seg_fn = jax.checkpoint(seg_fn, policy=policy,
+                                    prevent_cse=prevent_cse)
+            carried_vals = seg_fn(dense_vals, carried_vals)
+            carried_names = bn
+        e = dict(zip(carried_names, carried_vals))
+        loss = e[loss_name]
+        aux = tuple(e[n] for n in produced)
+        return loss, aux
+
+    missing = [n for n in dense_names if n not in env]
+    if missing:
+        raise NotFoundError(
+            f"vjp_region differentiates wrt {missing} which are not "
+            f"initialized — run the startup program or feed them")
+    dense_vals = tuple(env[n] for n in dense_names)
+    loss_val, vjp_fn, aux = jax.vjp(fwd, dense_vals, (), has_aux=True)
+    seed = jnp.ones_like(loss_val)
+    dgrads, _ = vjp_fn(seed)
+    env.update(zip(produced, aux))
+    env[grad_var_name(loss_name)] = seed
+    for name, g in zip(dense_names, dgrads):
+        env[grad_var_name(name)] = g
+
+
 def run_vjp_region(region_op: Operator, seg_indices: Sequence[int],
                    env: Dict[str, Any], block: Block, ctx: LowerCtx):
     """Execute a forward segment under jax.vjp, producing forward vars AND
     gradients (≙ append_backward's emitted grad-op chain, reference
     backward.py:315-469, executed by the compiler instead)."""
     attrs = region_op.attrs
+    segments = attrs.get("remat_segments")
+    if segments:
+        # the planner refuses to segment regions with sparse-capable
+        # embedding lookups (the perturbation trick below needs the
+        # un-segmented trace); re-check here so a hand-set attr degrades
+        # to the plain path instead of mis-training
+        sparse_free = not any(
+            block.ops[i].type == "lookup_table"
+            and block.ops[i].attrs.get("is_sparse")
+            for i in seg_indices)
+        if sparse_free and sorted(i for s in segments for i in s) == \
+                sorted(seg_indices):
+            return _run_vjp_region_segmented(region_op, seg_indices, env,
+                                             block, ctx, segments)
     target_names: List[str] = attrs["targets"]        # vars to differentiate wrt
     loss_name: str = attrs["loss"]
     seg_ops = [block.ops[i] for i in seg_indices]

@@ -72,7 +72,17 @@ def register_pass(name: str):
     return deco
 
 
+# passes registered by modules this package does not import eagerly (the
+# module's import cost stays off the common path); get_pass resolves them
+# on first use — the same "import registers" contract every caller-side
+# `from ..framework import sharding  # registers` comment documents
+_LAZY_PASS_MODULES = {"memory_plan_pass": "memory_plan"}
+
+
 def get_pass(name: str, **attrs) -> Pass:
+    if name not in _REGISTRY and name in _LAZY_PASS_MODULES:
+        import importlib
+        importlib.import_module("." + _LAZY_PASS_MODULES[name], __package__)
     if name not in _REGISTRY:
         raise NotFoundError(
             f"no pass named {name!r}; known: {sorted(_REGISTRY)}")

@@ -229,6 +229,72 @@ class LedgerRow:
                    - sum(measured.values())) < 1.0
         return rec
 
+    def check_plan_reduction(self, unplanned, *, min_reduction: float = 0.0,
+                             time_band: float = 0.02) -> Dict:
+        """Reconcile a memory-PLANNED cell against its unplanned twin
+        (the r18 acceptance shape — bench_mem --plan): `unplanned` is the
+        twin's row-shaped dict {"memory": census, "step_ms": float}.
+
+        1. `plan_state_feeds_invariant`: the plan may only move TRANSIENT
+           bytes — state/feed/seed categories must match the unplanned
+           census exactly (a plan that changed resident state re-placed
+           something it had no business touching).
+        2. `plan_reduction_named`: the measured peak reduction must be
+           fully explained by the transient/temp category — the named
+           side of the r17 identity — not by drift in the residual
+           (|Δpeak − Δtemp| bounded by the output-alias slack).
+        3. `plan_step_time_band`: planned step time within `time_band`
+           of unplanned.
+        4. `plan_reduction_floor`: peak reduction >= `min_reduction`
+           (fraction; 0 records the measured value without gating).
+        """
+        enforce("memory" in self.measured
+                and isinstance(unplanned, dict)
+                and "memory" in unplanned,
+                f"ledger row {self.name!r}: need a memory census on both "
+                f"the planned row and the unplanned twin",
+                exc=InvalidArgumentError)
+        mem_p, mem_u = self.measured["memory"], unplanned["memory"]
+        sp = dict(mem_p["state"]["categories"],
+                  feeds=mem_p["feeds"]["per_device_bytes"])
+        su = dict(mem_u["state"]["categories"],
+                  feeds=mem_u["feeds"]["per_device_bytes"])
+        cats = ("params", "optimizer_state", "ef_residual", "kv_cache",
+                "other_state", "feeds")
+        same_state = all(abs(sp[c] - su[c]) < 0.5 for c in cats)
+        # record every compared category so a failing artifact row shows
+        # WHICH one the plan perturbed
+        self._check("plan_state_feeds_invariant",
+                    {c: round(su[c]) for c in cats},
+                    {c: round(sp[c]) for c in cats},
+                    "exact", same_state)
+        d_peak = float(mem_u["peak_bytes"]) - float(mem_p["peak_bytes"])
+        d_temp = float(mem_u["xla"]["temp_bytes"]) \
+            - float(mem_p["xla"]["temp_bytes"])
+        slack = 64 + abs(
+            (mem_u["xla"]["output_bytes"] - mem_u["xla"]["alias_bytes"])
+            - (mem_p["xla"]["output_bytes"] - mem_p["xla"]["alias_bytes"]))
+        self._check("plan_reduction_named", round(d_temp), round(d_peak),
+                    "Δpeak == Δtemp (named transient category)",
+                    abs(d_peak - d_temp) <= slack)
+        t_p = self.measured.get("step_ms")
+        t_u = unplanned.get("step_ms")
+        if t_p is not None and t_u is not None and t_u > 0:
+            # one-sided: the plan must not SLOW the step past the band;
+            # a faster planned step is a win, never a violation
+            rel = t_p / t_u - 1.0
+            self._check("plan_step_time_band", f"<= +{time_band:.0%}",
+                        round(rel, 4), f"rel<={time_band}",
+                        rel <= time_band)
+        frac = d_peak / max(float(mem_u["peak_bytes"]), 1.0)
+        rec = self._check("plan_reduction_floor", min_reduction,
+                          round(frac, 4), f">={min_reduction}",
+                          frac >= min_reduction)
+        rec["planned_peak_bytes"] = round(float(mem_p["peak_bytes"]))
+        rec["unplanned_peak_bytes"] = round(float(mem_u["peak_bytes"]))
+        rec["reduction_bytes"] = round(d_peak)
+        return rec
+
     def check(self, what: str, predicted, measured, rel: float) -> Dict:
         """Generic relative-tolerance comparison."""
         denom = max(abs(measured), 1e-12)

@@ -297,7 +297,7 @@ class ParallelExecutor(Executor):
         return rewritten
 
     def _prepare_program(self, program: Program, scope: Scope) -> Program:
-        """BuildStrategy-driven program rewrite, three ordered passes, each
+        """BuildStrategy-driven program rewrite, four ordered passes, each
         cached per (program, version, resolved config) and idempotent (the
         base Executor calls this again inside _compile):
 
@@ -312,7 +312,52 @@ class ParallelExecutor(Executor):
            passes.pipeline_partition_pass on the (possibly comm-rewritten)
            program — the pp_pipeline_region leaves gradients as LOCAL dp
            partials when dp_grad_comm owns the dp reduction, and pmeans
-           them itself otherwise."""
+           them itself otherwise;
+        4. static memory plan (memory_plan=True, PTPU_MEMORY_PLAN=1):
+           framework/memory_plan.py memory_plan_pass over the program AS
+           REWRITTEN — scheduling/coloring/remat decisions are made
+           against the ops the step actually runs, and the sanitized
+           apply re-verifies the colored program with the r13
+           buffer-reuse detectors."""
+        return self._apply_memory_plan(
+            self._prepare_parallel(program, scope))
+
+    def _apply_memory_plan(self, program: Program) -> Program:
+        from ..core import flags
+        if (not getattr(self.build_strategy, "memory_plan", False)
+                or not flags.get_flag("memory_plan")
+                or getattr(program, "_memory_plan_applied", False)):
+            return program
+        cache = getattr(self, "_plan_cache", None)
+        if cache is None:
+            cache = self._plan_cache = {}
+        batch = max((s[0] for s in (self._feed_shapes or {}).values()
+                     if len(s) >= 1), default=8)
+        budget_s = float(getattr(self.build_strategy,
+                                 "memory_plan_time_budget_s", 0.0) or 0.0)
+        prevent_cse = bool(getattr(self.build_strategy,
+                                   "memory_plan_prevent_cse", False))
+        time_frac = float(getattr(self.build_strategy,
+                                  "memory_plan_time_frac", 0.02))
+        # every strategy field the plan reads is in the key: BuildStrategy
+        # is a mutable dataclass, and a knob flipped between runs must
+        # re-plan instead of silently serving the stale plan
+        key = (id(program), program._version, int(batch), budget_s,
+               prevent_cse, time_frac)
+        planned = cache.get(key)
+        if planned is None:
+            from ..framework.passes import get_pass
+            planned = get_pass(
+                "memory_plan_pass",
+                nominal_batch=int(batch),
+                time_budget_s=(budget_s or None),
+                time_budget_frac=time_frac,
+                remat_prevent_cse=prevent_cse,
+            )(program)
+            cache[key] = planned
+        return planned
+
+    def _prepare_parallel(self, program: Program, scope: Scope) -> Program:
         if getattr(program, "_pp_applied", False):
             return program
         cfg = _grad_comm.explicit_comm_config(self.build_strategy)
@@ -700,6 +745,9 @@ class ParallelExecutor(Executor):
         addressable shards."""
         program = program or self.main_program or default_main_program()
         scope = scope or self.scope
+        if feed_list and feed_list[0]:
+            self._feed_shapes = {n: np.shape(v)
+                                 for n, v in feed_list[0].items()}
         # rewrite for the explicit gradient pipeline BEFORE any placement
         # decision: _globalize_state/_place_feed_stack consult the
         # rewritten program's markers (sharded accumulators, error state,
@@ -793,6 +841,10 @@ class ParallelExecutor(Executor):
         Argument order follows the reference (fetch_list first)."""
         program = program or self.main_program or default_main_program()
         scope = scope or self.scope
+        # provisional feed shapes BEFORE the rewrite: the memory planner's
+        # nominal batch reads them (padded shapes re-stash below)
+        if feed:
+            self._feed_shapes = {n: np.shape(v) for n, v in feed.items()}
         # see run_steps: placement below must read the REWRITTEN program
         program = self._prepare_program(program, scope)
         feed, real_b, padded_b = self._pad_for_dp(program, dict(feed or {}))

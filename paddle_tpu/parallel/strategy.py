@@ -54,10 +54,42 @@ class BuildStrategy:
     # global-batch mean makes it unnecessary); One is the only implemented
     # mode.
     gradient_scale_strategy: GradientScaleStrategy = GradientScaleStrategy.One
-    # RESERVED (accepted, not yet consumed): debug program dumps and
-    # remat-based memory optimization land with the observability layer.
+    # RESERVED (accepted, not yet consumed): debug program dumps.
     debug_graphviz_path: str = ""
+    # Legacy remat knob (transpiler.memory_optimize); superseded by the
+    # static memory planner below — kept accepted for API parity.
     memory_optimize: bool = False
+    # --- static memory planner (framework/memory_plan.py) ---------------
+    # Apply memory_plan_pass to the program AS RUN (after the tp/dp-comm/
+    # pipeline rewrites): liveness-minimizing op scheduling, interference-
+    # graph buffer-slot coloring (proven race-free by the r13
+    # buffer-reuse detectors on every sanitized apply), and the
+    # remat-vs-stash search that segments the backward region under
+    # jax.checkpoint when the predicted memory return fits the time
+    # budget. Runtime kill switch: PTPU_MEMORY_PLAN=0 (in the executor's
+    # compile cache key, so a flip recompiles unplanned).
+    memory_plan: bool = False
+    # Mandate the remat recompute (jax.checkpoint prevent_cse=True): the
+    # searched plan's segments are really recomputed in the backward and
+    # the time budget below GATES candidates by their roofline recompute
+    # seconds. Default False = CSE-able mode: the recompute is a
+    # liveness hint XLA may fold back wherever it would cost wall-clock
+    # (measured time-neutral; the budget then only documents the upper
+    # bound — no candidate is rejected on time).
+    memory_plan_prevent_cse: bool = False
+    # The mandated-recompute search's step-time budget: predicted
+    # recompute seconds must stay within this fraction of the reference
+    # step time (the program's roofline step by default; benches pass
+    # the measured step via memory_plan_time_budget_s for CPU-mesh runs
+    # where dispatch dominates the roofline).
+    memory_plan_time_frac: float = 0.02
+    # Optional MEASURED step-time budget in seconds (0 = derive from the
+    # roofline via memory_plan_time_frac). On a CPU mesh the roofline
+    # underestimates the step by orders of magnitude (dispatch
+    # dominates), so a strict roofline budget rejects every remat plan;
+    # benches measure the unplanned step once and pass
+    # memory_plan_time_frac x measured seconds here.
+    memory_plan_time_budget_s: float = 0.0
     enable_sequence_parallel: bool = False
     # --- communication-optimized gradient pipeline (parallel/grad_comm.py) --
     # Wire dtype for gradient collectives: "" = fp32 (off), "int8" =

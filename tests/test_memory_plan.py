@@ -1,0 +1,407 @@
+"""Static memory planner tests (framework/memory_plan.py).
+
+Five disciplines, mirroring ISSUE 14's acceptance bars:
+1. coloring respects interference — property test over every
+   MODEL_BUILDER x {plain, dp2, pp2, tp2}: every planned program passes
+   verify_program with ZERO new diagnostics (the r13 buffer-reuse/WAR
+   detectors are the soundness proof of the coloring), and every slot
+   group is pairwise non-interfering against the SAME lifetime model the
+   detector uses;
+2. the schedule is a valid topological order of the def-use partial
+   order (plus the ordered-chain contracts: collectives/rng keep their
+   relative order, region segments precede their region);
+3. fixed-seed loss parity planned-vs-unplanned (the segmented-remat
+   execution recomputes the identical forward);
+4. mutation tests — forcing two INTERFERING vars into one slot fires
+   `buffer-reuse-race` BY NAME, and a slot crossing a region binder
+   (sub-block var vs parent var live across the binder) fires the
+   cross-block extension of the same code;
+5. the PTPU_MEMORY_PLAN kill switch runs the strategy-requested plan
+   unplanned (and sits in the executor's compile cache key).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.core.enforce import EnforceError, InvalidArgumentError
+from paddle_tpu.framework import analysis, dataflow, memory_plan
+from paddle_tpu.framework.passes import get_pass
+from paddle_tpu.parallel.grad_comm import comm_optimize_pass
+
+import test_static_analysis as _tsa  # pytest puts tests/ on sys.path
+
+_DP_CFG = {"shard_update": True, "quant": "", "block": 512,
+           "error_feedback": False, "bucket_bytes": 1 << 20}
+
+
+def _errors(diags):
+    return [d for d in diags if d.severity == "error"]
+
+
+def _codes(diags):
+    return {d.code for d in _errors(diags)}
+
+
+def _mlp_program(batch_cols=64):
+    x = layers.data("x", shape=[batch_cols])
+    label = layers.data("label", shape=[1], dtype="int64")
+    h = layers.fc(x, size=128, act="relu")
+    h2 = layers.fc(h, size=64, act="relu")
+    loss = layers.mean(layers.softmax_with_cross_entropy(
+        layers.fc(h2, size=10), label))
+    pt.optimizer.MomentumOptimizer(0.1, momentum=0.9).minimize(loss)
+    return pt.default_main_program(), loss
+
+
+# ---------------------------------------------------------------------------
+# 1. coloring respects interference: the builder x config property sweep
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", sorted(_tsa.MODEL_BUILDERS))
+def test_planned_programs_verify_clean(name):
+    """Every model builder, under every parallelism rewrite its gates
+    admit, planned: zero error diagnostics (the sanitized apply already
+    re-verified — this asserts the END state too), and every slot group
+    is pairwise non-interfering under dataflow.interference_graph."""
+    loss = _tsa.MODEL_BUILDERS[name]()
+    if loss is not None:
+        pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    prog = pt.default_main_program()
+    configs = {"plain": lambda p: p}
+    if loss is not None:
+        configs["dp2"] = lambda p: comm_optimize_pass(p, 2, dict(_DP_CFG))
+        configs["pp2"] = get_pass("pipeline_partition_pass", num_stages=2,
+                                  num_microbatches=4, schedule="1f1b")
+        from paddle_tpu.framework import sharding as _sharding
+        if _sharding.has_tp_annotations(prog):
+            configs["tp2"] = get_pass("tp_shard_pass", tp=2)
+    for cname, apply in configs.items():
+        try:
+            rewritten = apply(prog)
+        except (EnforceError, analysis.ProgramAnalysisError):
+            continue                 # gate-rejected: config does not apply
+        planned = get_pass("memory_plan_pass", time_budget_s=1.0)(rewritten)
+        assert getattr(planned, "_memory_plan_applied", False)
+        errs = _errors(analysis.verify_program(planned))
+        assert not errs, (name, cname,
+                          "\n".join(str(d) for d in errs))
+        for block in planned.blocks:
+            graph = dataflow.interference_graph(block)
+            groups = {}
+            for vn, v in block.vars.items():
+                slot = getattr(v, "buffer_slot", None)
+                if slot is not None:
+                    groups.setdefault(slot, []).append(vn)
+            for slot, members in groups.items():
+                for i, a in enumerate(members):
+                    for b in members[i + 1:]:
+                        assert b not in graph.get(a, set()), (
+                            name, cname, slot, a, b,
+                            "slot members interfere")
+
+
+# ---------------------------------------------------------------------------
+# 2. the schedule is a valid topological order
+# ---------------------------------------------------------------------------
+
+
+def test_schedule_is_valid_topological_order():
+    prog, _ = _mlp_program()
+    block = prog.global_block()
+    order = memory_plan.schedule_block(block, nominal_batch=8)
+    if order is None:                # already optimal is a legal outcome
+        order = list(range(len(block.ops)))
+    assert sorted(order) == list(range(len(block.ops)))
+    # RAW: every reader lands after its writer in the new order
+    pos = {old: new for new, old in enumerate(order)}
+    writers = {}
+    for i, op in enumerate(block.ops):
+        for nm in op.input_names():
+            if nm in writers:
+                assert pos[writers[nm]] < pos[i], (nm, writers[nm], i)
+        for nm in op.output_names():
+            writers[nm] = i
+    # region segments all precede their region op
+    for ridx, op in enumerate(block.ops):
+        if op.type in dataflow.REGION_OPS:
+            for i in op.attrs["fwd_ops"]:
+                assert pos[i] < pos[ridx]
+
+
+def test_schedule_never_regresses_predicted_peak():
+    prog, loss = _mlp_program()
+    before = analysis.peak_live_bytes(prog, nominal_batch=8)
+    planned = get_pass("memory_plan_pass", remat=False)(prog)
+    after = analysis.peak_live_bytes(planned, nominal_batch=8)
+    assert after["peak_transient_bytes"] <= before["peak_transient_bytes"]
+
+
+def test_scheduler_keeps_collective_relative_order():
+    """dp_grad_comm and the other chained ops must keep their relative
+    order (the r13 collective-order contract) — pinned by planning a
+    dp-rewritten program and re-verifying."""
+    prog, loss = _mlp_program()
+    rewritten = comm_optimize_pass(prog, 2, dict(_DP_CFG))
+    planned = get_pass("memory_plan_pass", time_budget_s=1.0)(rewritten)
+    assert not _errors(analysis.verify_program(planned))
+    # the comm op still sits between the region and every consumer
+    block = planned.global_block()
+    ridx = next(i for i, op in enumerate(block.ops)
+                if op.type == "vjp_region")
+    cidx = next(i for i, op in enumerate(block.ops)
+                if op.type == "dp_grad_comm")
+    assert ridx < cidx
+
+
+# ---------------------------------------------------------------------------
+# 3. fixed-seed loss parity planned vs unplanned
+# ---------------------------------------------------------------------------
+
+
+def _transformer_program():
+    from paddle_tpu.models import transformer
+    loss, _ = transformer.transformer_lm(
+        vocab=64, max_len=8, d_model=32, d_inner=64, num_heads=4,
+        num_layers=2, dropout=0.0, mean_loss=True)
+    pt.optimizer.AdamOptimizer(1e-3).minimize(loss)
+    return pt.default_main_program(), loss
+
+
+def _train_losses(planned: bool, steps: int = 3):
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    rng = np.random.RandomState(11)
+    with pt.core.unique_name.guard():
+        prog, loss = _transformer_program()
+    if planned:
+        prog = get_pass("memory_plan_pass", nominal_batch=8,
+                        time_budget_s=1.0)(prog)
+        rep = memory_plan.plan_report(prog)
+        assert rep["remat"]["chosen"] == "remat", rep["remat"]
+    exe = pt.Executor()
+    pt.Executor().run(pt.default_startup_program())
+    feed = {"tokens": rng.randint(0, 64, (8, 8)).astype("int64"),
+            "tokens@SEQLEN": np.full((8,), 8, "int32"),
+            "targets": rng.randint(0, 64, (8, 8)).astype("int64")}
+    out = []
+    for _ in range(steps):
+        out.append(float(np.asarray(exe.run(
+            program=prog, feed=feed, fetch_list=[loss],
+            return_numpy=False)[0])))
+    return out
+
+
+def test_fixed_seed_loss_parity_planned_vs_unplanned():
+    base = _train_losses(False)
+    planned = _train_losses(True)
+    assert np.allclose(base, planned, rtol=0, atol=1e-6), (base, planned)
+
+
+def test_segmented_remat_executes_when_searched():
+    """The chosen remat plan actually lands on the region (the parity
+    test above then executes it): attrs present, a true partition of
+    fwd_ops, live_out narrowed."""
+    pt.reset_default_programs()
+    with pt.core.unique_name.guard():
+        prog, loss = _transformer_program()
+    planned = get_pass("memory_plan_pass", time_budget_s=1.0)(prog)
+    rop = next(op for op in planned.global_block().ops
+               if op.type == "vjp_region")
+    segs = rop.attrs.get("remat_segments")
+    assert segs and sorted(i for s in segs for i in s) == \
+        sorted(rop.attrs["fwd_ops"])
+    assert rop.attrs.get("live_out") is not None
+
+
+# ---------------------------------------------------------------------------
+# 4. mutation tests: the detectors catch a bad plan BY NAME
+# ---------------------------------------------------------------------------
+
+
+def test_forcing_interfering_vars_into_one_slot_fires_by_name():
+    """Two vars whose live intervals overlap, hand-forced into one slot:
+    exactly `buffer-reuse-race` (the coloring's soundness gate — only
+    this detector stands between a bad plan and silent corruption)."""
+    x = layers.data("x", shape=[8])
+    a = layers.fc(x, size=8)
+    b = layers.fc(x, size=8)          # a still live (read below)
+    layers.elementwise_add(a, b)
+    prog = pt.default_main_program()
+    blk = prog.global_block()
+    blk.vars[a.name].buffer_slot = "forced#0"
+    blk.vars[b.name].buffer_slot = "forced#0"
+    assert _codes(analysis.verify_program(prog)) == {"buffer-reuse-race"}
+
+
+def test_slot_across_region_binder_fires_by_name():
+    """Satellite: a planner slot CROSSING a region binder — a sub-block
+    var sharing a slot with a parent var that is live across the binder
+    op — is verified through the binder chain and reports the exact
+    `buffer-reuse-race` code (per-block scans cannot see this pair)."""
+    x = layers.data("x", shape=[16])
+    i = layers.fill_constant([1], "int64", 0)
+    n = layers.fill_constant([1], "int64", 2)
+    cond = layers.less_than(i, n)
+    acc = layers.fc(x, size=16)        # parent transient, live across While
+    w = layers.While(cond)
+    with w.block():
+        inner = layers.fc(acc, size=16)    # sub-block transient
+        layers.increment(i, value=1.0, in_place=True)
+        layers.less_than(i, n, cond=cond)
+        inner_name = inner.name
+    after = layers.fc(acc, size=4)     # keeps acc live PAST the binder
+    prog = pt.default_main_program()
+    blk0 = prog.global_block()
+    sub = prog.blocks[1]
+    blk0.vars[acc.name].buffer_slot = "xb#0"
+    sub.vars[inner_name].buffer_slot = "xb#0"
+    diags = analysis.verify_program(prog)
+    assert _codes(diags) == {"buffer-reuse-race"}, diags
+    msg = "\n".join(d.message for d in _errors(diags))
+    assert "binder" in msg and inner_name in msg
+
+
+def test_slot_in_sibling_branches_is_sanctioned():
+    """Two sub-blocks of ONE binder (cond branches) are mutually
+    exclusive — sharing a slot across them is legal."""
+    from paddle_tpu.layers.control_flow import cond
+    x = layers.data("x", shape=[8])
+    flag = layers.fill_constant([1], "bool", True)
+    names = []
+
+    def _branch():
+        t = layers.fc(x, size=8)
+        names.append((pt.default_main_program()._current_block_idx,
+                      t.name))
+        return t
+
+    cond(flag, _branch, _branch)
+    prog = pt.default_main_program()
+    (b1, n1), (b2, n2) = names
+    assert b1 != b2
+    prog.blocks[b1].vars[n1].buffer_slot = "sib#0"
+    prog.blocks[b2].vars[n2].buffer_slot = "sib#0"
+    assert not _errors(analysis.verify_program(prog))
+
+
+def test_planner_slots_survive_clone():
+    pt.reset_default_programs()
+    with pt.core.unique_name.guard():
+        prog, _ = _mlp_program()
+    planned = get_pass("memory_plan_pass", time_budget_s=1.0)(prog)
+    clone = planned.clone()
+    slots = {n for b in planned.blocks for n, v in b.vars.items()
+             if getattr(v, "buffer_slot", None) is not None}
+    slots_c = {n for b in clone.blocks for n, v in b.vars.items()
+               if getattr(v, "buffer_slot", None) is not None}
+    assert slots == slots_c
+
+
+# ---------------------------------------------------------------------------
+# 5. kill switch + strategy plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_kill_switch_runs_unplanned():
+    from paddle_tpu.parallel import ParallelExecutor
+    from paddle_tpu.parallel.strategy import BuildStrategy
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    with pt.core.unique_name.guard():
+        prog, loss = _mlp_program()
+    bst = BuildStrategy()
+    bst.memory_plan = True
+    bst.memory_plan_time_budget_s = 1.0
+    exe = ParallelExecutor(loss_name=loss.name, build_strategy=bst)
+    try:
+        _flags.set_flag("memory_plan", False)
+        unplanned = exe.prepare_program(prog)
+        assert not getattr(unplanned, "_memory_plan_applied", False)
+    finally:
+        _flags.set_flag("memory_plan", True)
+    planned = exe.prepare_program(prog)
+    assert getattr(planned, "_memory_plan_applied", False)
+    rep = memory_plan.plan_report(planned)
+    assert rep["predicted_peak_after"] <= rep["predicted_peak_before"]
+
+
+def test_kill_switch_is_in_compile_cache_key():
+    from paddle_tpu.framework.executor import _fusion_flags_key
+    on = _fusion_flags_key()
+    try:
+        _flags.set_flag("memory_plan", False)
+        off = _fusion_flags_key()
+    finally:
+        _flags.set_flag("memory_plan", True)
+    assert on != off
+
+
+def test_plan_report_requires_a_planned_program():
+    pt.reset_default_programs()
+    with pt.core.unique_name.guard():
+        prog, _ = _mlp_program()
+    with pytest.raises(InvalidArgumentError):
+        memory_plan.plan_report(prog)
+
+
+def test_plan_is_idempotent_and_never_mutates_the_input():
+    pt.reset_default_programs()
+    with pt.core.unique_name.guard():
+        prog, _ = _mlp_program()
+    v_before = prog._version
+    ops_before = [op.type for op in prog.global_block().ops]
+    planned = get_pass("memory_plan_pass", time_budget_s=1.0)(prog)
+    assert prog._version == v_before
+    assert [op.type for op in prog.global_block().ops] == ops_before
+    assert not any(getattr(v, "buffer_slot", None) is not None
+                   for b in prog.blocks for v in b.vars.values())
+    again = get_pass("memory_plan_pass", time_budget_s=1.0)(planned)
+    assert again is planned
+
+
+def test_multi_region_programs_report_every_region():
+    """Two losses over one trunk (two vjp_regions — lowering.build_plan
+    supports them): the plan searches BOTH and the report carries every
+    region's decision instead of silently keeping the last."""
+    x = layers.data("x", shape=[32])
+    label = layers.data("label", shape=[1], dtype="int64")
+    h = layers.fc(x, size=64, act="relu")
+    loss_a = layers.mean(layers.softmax_with_cross_entropy(
+        layers.fc(h, size=10), label))
+    loss_b = layers.mean(layers.fc(h, size=1))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss_a)
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss_b)
+    prog = pt.default_main_program()
+    n_regions = sum(1 for op in prog.global_block().ops
+                    if op.type == "vjp_region")
+    assert n_regions == 2
+    planned = get_pass("memory_plan_pass", time_budget_s=1.0)(prog)
+    rep = memory_plan.plan_report(planned)
+    assert rep["remat"] is None
+    assert len(rep["remat_regions"]) == 2
+    regions = {r["region"] for r in rep["remat_regions"]}
+    assert len(regions) == 2
+
+
+def test_sparse_embedding_regions_are_not_segmented():
+    """A region with an is_sparse lookup keeps the un-segmented trace
+    (selected-rows grads need it): the search must refuse."""
+    ids = layers.data("ids", shape=[1], dtype="int64")
+    label = layers.data("label", shape=[1], dtype="int64")
+    emb = layers.embedding(ids, size=[100, 16], is_sparse=True)
+    loss = layers.mean(layers.softmax_with_cross_entropy(
+        layers.fc(emb, size=10), label))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    planned = get_pass("memory_plan_pass",
+                       time_budget_s=1.0)(pt.default_main_program())
+    rop = next(op for op in planned.global_block().ops
+               if op.type == "vjp_region")
+    assert "remat_segments" not in rop.attrs
+    rep = memory_plan.plan_report(planned)
+    assert "sparse" in (rep["remat"].get("skipped") or "")

@@ -60,20 +60,24 @@ def _build_mnist_mlp(rng, batch):
     return loss, feed
 
 
-def _build_transformer_lm(rng, batch, tp=0):
+def _build_transformer_lm(rng, batch, tp=0, big=False):
     import paddle_tpu as pt
     from paddle_tpu.models import transformer
-    T = 8
+    # `big`: the r18 memory-plan cells — activation-stash-dominated
+    # shapes (T=64, d=64) where the remat-vs-stash curve has room; the
+    # r17 identity cells keep the original tiny config
+    T, vocab, d_model, d_inner = (64, 128, 64, 128) if big \
+        else (8, 64, 32, 64)
     loss, _ = transformer.transformer_lm(
-        vocab=64, max_len=T, d_model=32, d_inner=64, num_heads=4,
-        num_layers=2, dropout=0.0, mean_loss=True)
+        vocab=vocab, max_len=T, d_model=d_model, d_inner=d_inner,
+        num_heads=4, num_layers=2, dropout=0.0, mean_loss=True)
     if tp > 1:
         from paddle_tpu.parallel import annotate_tp
         assert annotate_tp(), "annotate_tp matched nothing"
     pt.optimizer.AdamOptimizer(1e-3).minimize(loss)
-    feed = {"tokens": rng.randint(0, 64, (batch, T)).astype("int64"),
+    feed = {"tokens": rng.randint(0, vocab, (batch, T)).astype("int64"),
             "tokens@SEQLEN": np.full((batch,), T, "int32"),
-            "targets": rng.randint(0, 64, (batch, T)).astype("int64")}
+            "targets": rng.randint(0, vocab, (batch, T)).astype("int64")}
     return loss, feed
 
 
@@ -90,8 +94,26 @@ CELLS = [
     ("transformer_lm", "tp2"),
 ]
 
+#: the --plan matrix (BENCH_MEMPLAN_r18.json): every cell runs its
+#: planned twin (memory_plan_pass / BuildStrategy.memory_plan) next to
+#: the unplanned baseline and commits the MEASURED census delta +
+#: step-time ratio + the r17 identity on the planned cell.
+#: transformer_lm_big is the activation-dominated shape (T=64, d=64,
+#: batch below) where the remat-vs-stash search has real room; the
+#: r17-config cells pin that planning tiny programs stays safe/neutral.
+PLAN_CELLS = [
+    ("mnist", "plain"),
+    ("mnist", "dp2"),
+    ("transformer_lm", "plain"),
+    ("transformer_lm", "dp2"),
+    ("transformer_lm", "tp2"),
+    ("transformer_lm_big", "plain"),
+    ("transformer_lm_big", "dp2"),
+]
+PLAN_BATCH = {"transformer_lm_big": 64}
 
-def run_cell(led, model, mode, batch, iters):
+
+def run_cell(led, model, mode, batch, iters, plan=False, time_frac=0.02):
     import jax
     import paddle_tpu as pt
     from paddle_tpu.core import flags as _flags
@@ -109,7 +131,8 @@ def run_cell(led, model, mode, batch, iters):
         if model == "mnist":
             loss, feed = _build_mnist_mlp(rng, batch)
         else:
-            loss, feed = _build_transformer_lm(rng, batch, tp=tp)
+            loss, feed = _build_transformer_lm(
+                rng, batch, tp=tp, big=model == "transformer_lm_big")
 
     bst = BuildStrategy()
     if mode != "pp2":   # a pp-only mesh has no dp axis for explicit comm
@@ -156,6 +179,55 @@ def run_cell(led, model, mode, batch, iters):
     jax.block_until_ready(out)
     step_s = (time.time() - t0) / iters
 
+    run2 = None
+    if plan:
+        # the planned twin: same model/mode, the memory planner applied
+        # to the program AS RUN. The measured-step budget is recorded on
+        # the plan (and would GATE candidates under the mandated-recompute
+        # mode, memory_plan_prevent_cse=True); the default CSE-able plan
+        # is time-safe by construction — the band check below is what
+        # holds its measured step to the bar
+        budget_s = time_frac * step_s
+        if mode == "plain":
+            from paddle_tpu.framework.passes import get_pass
+            planned_prog = get_pass(
+                "memory_plan_pass", nominal_batch=batch,
+                time_budget_s=budget_s)(pt.default_main_program())
+            exe2 = pt.Executor()
+            run2 = lambda: exe2.run(  # noqa: E731
+                program=planned_prog, feed=feed, fetch_list=[loss],
+                return_numpy=False)
+        else:
+            import dataclasses
+            bst2 = dataclasses.replace(
+                bst, memory_plan=True, memory_plan_time_budget_s=budget_s)
+            exe2 = ParallelExecutor(loss_name=loss.name,
+                                    build_strategy=bst2, mesh=mesh)
+            run2 = lambda: exe2.run(  # noqa: E731
+                feed=feed, fetch_list=[loss], return_numpy=False)
+        jax.block_until_ready(run2())             # compile + warm
+        # interleaved timing: planned and unplanned share every noise
+        # source (load, caches), the ratio is what the band checks.
+        # Sub-millisecond cells need many samples before a 2% band means
+        # anything — scale the pair count to ~1s of total timing
+        iters = min(400, max(iters, int(1.0 / max(2 * step_s, 2.5e-3))))
+        ts_u, ts_p = [], []
+        for _ in range(iters):
+            a = time.perf_counter()
+            jax.block_until_ready(run())
+            ts_u.append(time.perf_counter() - a)
+            a = time.perf_counter()
+            jax.block_until_ready(run2())
+            ts_p.append(time.perf_counter() - a)
+        step_s = sorted(ts_u)[len(ts_u) // 2]
+        step2_s = sorted(ts_p)[len(ts_p) // 2]
+        # the band's noise floor: a hard 2% gate on a millisecond CPU
+        # step is flakier than the thing it measures — use the UNPLANNED
+        # side's own relative IQR as the floor and record it
+        q1, q3 = np.percentile(ts_u, [25, 75])
+        noise_rel = float((q3 - q1) / max(step_s, 1e-9))
+        time_band = max(0.02, noise_rel)
+
     if mode == "plain":
         predicted = _costs.predict(pt.default_main_program(), dp=1,
                                    nominal_batch=batch)
@@ -177,6 +249,46 @@ def run_cell(led, model, mode, batch, iters):
     rec = row.check_memory_identity(residual_frac=0.10)
     row._check("mfu_positive", ">0", round(cell_mfu, 10), ">0",
                cell_mfu > 0)
+
+    if plan:
+        if mode == "plain":
+            census2 = exe2.memory_census(feed=feed, program=planned_prog)
+            predicted2 = _costs.predict(planned_prog, dp=1,
+                                        nominal_batch=batch)
+        else:
+            census2 = exe2.memory_census(feed=feed)
+            predicted2 = exe2.cost_report(nominal_batch=batch)
+        reduction = 1.0 - (census2["peak_bytes"]
+                           / max(census["peak_bytes"], 1.0))
+        # the satellite columns on the BASE row: planned peak + reduction
+        row.set_measured(
+            mem_planned_peak_bytes=round(census2["peak_bytes"]),
+            mem_plan_reduction=round(reduction, 4),
+            step_ms_planned=round(step2_s * 1e3, 3))
+        prow = led.row(f"{model}_{mode}_planned", model=model, mode=mode,
+                       batch_size=batch, devices=ndev, dp=dp,
+                       memory_plan=True)
+        prow.set_prediction(predicted2)
+        prow.set_memory_census(census2)
+        prow.set_measured(
+            step_ms=round(step2_s * 1e3, 3), iters=iters,
+            temp_source=census2["xla"]["temp_source"],
+            mem_planned_peak_bytes=round(census2["peak_bytes"]),
+            mem_plan_reduction=round(reduction, 4))
+        # the r17 identity must STILL hold on the planned cell, and the
+        # reduction must land in the named transient category at a
+        # planned step within the band
+        prow.set_measured(step_time_noise_iqr_rel=round(noise_rel, 4))
+        prow.check_memory_identity(residual_frac=0.10)
+        prow.check_plan_reduction(
+            {"memory": census, "step_ms": round(step_s * 1e3, 3)},
+            min_reduction=0.0, time_band=time_band)
+        print(json.dumps({"cell": prow.name,
+                          "reduction": round(reduction, 4),
+                          "time_ratio": round(step2_s / step_s, 4),
+                          "ok": prow.ok}), flush=True)
+        assert prow.ok, [c for c in prow.checks if not c["ok"]]
+
     print(json.dumps({"cell": row.name, "residual": rec, "ok": row.ok}),
           flush=True)
     assert row.ok, [c for c in row.checks if not c["ok"]]
@@ -251,6 +363,19 @@ def main():
     p.add_argument("--cells", default="",
                    help="comma-separated model:mode subset (CI smoke "
                         "uses mnist:dp2); default = all cells")
+    p.add_argument("--plan", action="store_true",
+                   help="the r18 memory-plan matrix (PLAN_CELLS): run "
+                        "every cell's memory-planned twin next to the "
+                        "unplanned baseline, commit the measured census "
+                        "delta + step-time ratio + identity on the "
+                        "planned cell (BENCH_MEMPLAN_r18.json)")
+    p.add_argument("--time_frac", type=float, default=0.02,
+                   help="--plan: the step-time budget recorded on each "
+                        "plan, as a fraction of the MEASURED unplanned "
+                        "step (gates candidates only under the "
+                        "mandated-recompute mode; the default CSE-able "
+                        "plans are held to the bar by the measured "
+                        "plan_step_time_band check instead)")
     p.add_argument("--skip_live", action="store_true",
                    help="skip the serving-engine live-surface smoke")
     p.add_argument("--trace_out", default="/tmp/bench_mem_trace.json")
@@ -259,25 +384,35 @@ def main():
     import jax
     from paddle_tpu.observability.ledger import CostLedger
 
-    cells = CELLS
+    table = PLAN_CELLS if args.plan else CELLS
+    cells = table
     if args.cells:
         want = {tuple(c.split(":")) for c in args.cells.split(",")}
-        cells = [c for c in CELLS if c in want]
-        assert cells, f"no cell matches {args.cells!r} (known: {CELLS})"
+        cells = [c for c in table if c in want]
+        assert cells, f"no cell matches {args.cells!r} (known: {table})"
 
-    led = CostLedger("r17", meta={
+    led = CostLedger("r18-memplan" if args.plan else "r17", meta={
         "mesh": "virtual CPU x8 (byte/category checks are exact "
                 "properties of the compiled executable and transfer to "
                 "TPU unchanged; ms/MFU numbers are CPU-mesh)",
         "identity": "every measured per-device byte attributed to a "
                     "predicted category or a NAMED residual bucket; "
                     "exact on state/feed categories, unattributed "
-                    "<= 10% of measured peak",
+                    "<= 10% of measured peak"
+                    + ("; planned cells additionally reconcile their "
+                       "census against the unplanned twin "
+                       "(check_plan_reduction: state/feeds invariant, "
+                       "reduction fully in the named transient "
+                       "category, step within the band)"
+                       if args.plan else ""),
         "devices": [str(d) for d in jax.devices()[:2]],
     })
     for model, mode in cells:
-        run_cell(led, model, mode, batch=16, iters=args.iters)
-    if not args.skip_live:
+        run_cell(led, model, mode,
+                 batch=PLAN_BATCH.get(model, 16),
+                 iters=(max(args.iters, 20) if args.plan else args.iters),
+                 plan=args.plan, time_frac=args.time_frac)
+    if not args.skip_live and not args.plan:
         live_surface_smoke(led, args.trace_out)
     path = led.write(args.out)
     print(json.dumps({"artifact": path, "ok": led.ok,

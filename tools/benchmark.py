@@ -426,6 +426,14 @@ def main():
     p.add_argument("--no_census", action="store_true",
                    help="skip the HLO comm census fields (saves one AOT "
                         "compile on big models)")
+    p.add_argument("--memory_plan", action="store_true",
+                   help="also compile the memory-PLANNED twin "
+                        "(framework/memory_plan.py, budget 2%% of the "
+                        "measured step) and fill the "
+                        "mem_planned_peak_bytes / mem_plan_reduction "
+                        "columns from its MEASURED census (one extra "
+                        "compile; needs the census, i.e. not "
+                        "--no_census)")
     p.add_argument("--no_bf16", action="store_true")
     p.add_argument("--profile", action="store_true")
     p.add_argument("--trace_dir", default=None,
@@ -656,6 +664,46 @@ def main():
             "mem_peak_bytes": round(census["peak_bytes"]),
             "mem_predicted_peak_total_bytes":
                 pred_mem["peak_total_bytes"],
+            "mem_planned_peak_bytes": None,
+            "mem_plan_reduction": None,
+        })
+    if args.memory_plan and not args.no_census:
+        # the r18 planned twin: one extra compile of the memory-planned
+        # program, censused with the same formula — the MEASURED
+        # columns, not the prediction. The measured-step budget is
+        # recorded on the plan (it gates candidates only under the
+        # mandated-recompute mode; the default CSE-able plan is
+        # time-safe by construction)
+        from paddle_tpu.framework.passes import get_pass
+        budget_s = 0.02 * dt / args.iters
+        if args.update_method == "collective":
+            import dataclasses
+            bst2 = dataclasses.replace(
+                runner.build_strategy, memory_plan=True,
+                memory_plan_time_budget_s=budget_s)
+            from paddle_tpu.parallel import ParallelExecutor
+            twin = ParallelExecutor(loss_name=loss.name,
+                                    build_strategy=bst2,
+                                    mesh=runner.mesh)
+            jax.block_until_ready(twin.run(feed=feed, fetch_list=[loss],
+                                           return_numpy=False))
+            census2 = twin.memory_census(feed=feed)
+            planned_peak = census2["peak_bytes"]
+        else:
+            planned_prog = get_pass(
+                "memory_plan_pass", nominal_batch=args.batch_size,
+                time_budget_s=budget_s)(pt.default_main_program())
+            twin = pt.Executor()
+            jax.block_until_ready(twin.run(
+                program=planned_prog, feed=feed, fetch_list=[loss],
+                return_numpy=False))
+            census2 = twin.memory_census(feed=feed,
+                                         program=planned_prog)
+            planned_peak = census2["peak_bytes"]
+        mem_fields.update({
+            "mem_planned_peak_bytes": round(planned_peak),
+            "mem_plan_reduction": round(
+                1.0 - planned_peak / max(census["peak_bytes"], 1.0), 4),
         })
 
     unit = ("tokens/sec" if args.model in

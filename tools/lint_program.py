@@ -132,6 +132,8 @@ def _config_desc(args):
     if args.pipeline_stages >= 2:
         cfg["pipeline_stages"] = args.pipeline_stages
         cfg["num_microbatches"] = args.num_microbatches
+    if args.memory_plan:
+        cfg["memory_plan"] = True
     return cfg
 
 
@@ -173,6 +175,19 @@ def _apply_config(prog, name, args):
                 reduce_dp=False)(prog)
         except EnforceError as e:
             return prog, f"pipeline_partition_pass: {e}"
+    if args.memory_plan:
+        from paddle_tpu.framework import memory_plan  # noqa: F401  (registers)
+        try:
+            # a generous budget so lint always analyzes a NON-trivial
+            # plan: the budget gates candidates only under the
+            # mandated-recompute mode, but keeping it wide here means a
+            # future mode flip still lints the fullest plan the search
+            # can choose
+            prog = get_pass("memory_plan_pass",
+                            nominal_batch=args.batch_size,
+                            time_budget_s=1.0)(prog)
+        except (EnforceError, analysis.ProgramAnalysisError) as e:
+            return prog, f"memory_plan_pass: {e}"
     return prog, None
 
 
@@ -304,6 +319,10 @@ def lint_one(name, build, args):
             prog, tp_size=args.tp if args.tp >= 2 else None)
         diags += shard_res.diagnostics
     mem = analysis.peak_live_bytes(prog, nominal_batch=args.batch_size)
+    plan = None
+    if args.memory_plan and getattr(prog, "_memory_plan_applied", False):
+        from paddle_tpu.framework.memory_plan import plan_report
+        plan = plan_report(prog)
     analyze_s = time.time() - t1
 
     n_ops = sum(len(b.ops) for b in prog.blocks)
@@ -320,6 +339,24 @@ def lint_one(name, build, args):
         "memory": {k: v for k, v in mem.items() if k != "peak_at"},
         "peak_at": mem["peak_at"],
     })
+    if plan is not None:
+        def _remat_summary(rm):
+            return {k: rm.get(k) for k in
+                    ("chosen", "segments", "policy", "stash_freed_bytes")}
+        # multi-loss programs carry one decision PER region
+        # (plan_report: remat=None, remat_regions=[...])
+        rms = ([plan["remat"]] if plan.get("remat")
+               else plan.get("remat_regions") or [])
+        report["memory_plan"] = {
+            "predicted_peak_before": plan["predicted_peak_before"],
+            "predicted_peak_after": plan["predicted_peak_after"],
+            "n_slots": plan["n_slots"],
+            "shared_vars": plan["shared_vars"],
+            "remat": _remat_summary(rms[0]) if len(rms) == 1 else None,
+            "remat_regions": ([_remat_summary(r) for r in rms]
+                              if len(rms) > 1 else None),
+            "pp_stages": plan.get("pp_stages"),
+        }
 
     if args.json:
         return report
@@ -357,6 +394,26 @@ def lint_one(name, build, args):
                       f"{local}")
             if len(rows) > args.max_shard_rows:
                 print(f"    ... {len(rows) - args.max_shard_rows} more")
+    if plan is not None:
+        rms = ([plan["remat"]] if plan.get("remat")
+               else plan.get("remat_regions") or [])
+        remat_txt = ", ".join(
+            (f"{rm.get('chosen', '-')}"
+             + (f" ({rm['segments']} segments, "
+                f"policy={rm.get('policy') or 'full'})"
+                if rm.get("chosen") == "remat" else ""))
+            for rm in rms) or "-"
+        print(f"  memory plan (batch={args.batch_size}): predicted peak "
+              f"{_human(plan['predicted_peak_before'])} -> "
+              f"{_human(plan['predicted_peak_after'])}, "
+              f"{plan['n_slots']} shared slot(s) over "
+              f"{plan['shared_vars']} var(s), remat={remat_txt}")
+        for row in plan["slots"][:args.max_shard_rows]:
+            print(f"    slot {row['slot']}: {row['reuses']} reuse(s) of "
+                  f"{_human(row['bytes'])}  <- {row['vars']}")
+        if len(plan["slots"]) > args.max_shard_rows:
+            print(f"    ... {len(plan['slots']) - args.max_shard_rows} "
+                  f"more slot(s)")
     sub = mem.get("sub_block_peaks") or {}
     sub_txt = (f" (+{len(sub)} sub-block(s), "
                f"{_human(sum(sub.values()))} at their binders)"
@@ -415,6 +472,14 @@ def main():
                         "(grad_comm.comm_optimize_pass) and lint the "
                         "rewritten program")
     p.add_argument("--comm_bucket_bytes", type=int, default=1 << 20)
+    p.add_argument("--memory_plan", action="store_true",
+                   help="apply the static memory planner "
+                        "(framework/memory_plan.py memory_plan_pass) "
+                        "after the parallelism rewrites and lint the "
+                        "PLANNED program: prints the buffer-slot table "
+                        "and the predicted peak before/after; any "
+                        "error-severity diagnostic the plan introduces "
+                        "(the r13 buffer-reuse detectors) exits 1")
     p.add_argument("--tp", type=int, default=0,
                    help="tensor-parallel degree: apply tp_shard_pass to a "
                         "tp-annotated program (e.g. --model "

@@ -48,6 +48,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.framework.analysis",
     "paddle_tpu.framework.costs",
     "paddle_tpu.framework.dataflow",
+    "paddle_tpu.framework.memory_plan",
     "paddle_tpu.framework.sharding",
     "paddle_tpu.observability",
     "paddle_tpu.observability.tracing",

@@ -46,10 +46,16 @@ if [ "$TIER" != "quick" ]; then
     # code/severity/op_loc rows; documented exit codes in
     # tools/lint_program.py) — no table scraping. Pass gates rejecting a
     # (model, config) pair are expected sweep noise (--allow_gate_rejects).
+    # the r18 planned variants ride the same sweep: every (model, config)
+    # pair is ALSO linted through memory_plan_pass — the planner's
+    # scheduling/coloring/remat must introduce zero error diagnostics on
+    # every program the detectors accept unplanned
     rm -f /tmp/lint_sweep_*.json
     i=0
     for flags in "" "--dp 2" "--pipeline_stages 2 --num_microbatches 4" \
-                 "--tp 2"; do
+                 "--tp 2" "--memory_plan" "--dp 2 --memory_plan" \
+                 "--pipeline_stages 2 --num_microbatches 4 --memory_plan" \
+                 "--tp 2 --memory_plan"; do
         # don't let set -e kill the sweep on a lint exit(1): the Python
         # aggregator below owns the gating AND prints which model/config/
         # code failed (a hard crash leaves truncated JSON, which the
@@ -422,6 +428,100 @@ assert doc["ok"] and len(doc["rows"]) == 1, doc["ok"]
 print("bench_mem smoke OK")
 PY
 rm -f /tmp/bench_mem_ci.json
+
+echo "== memory-plan smoke (planner + detectors + measured reduction) =="
+# the r18 static memory planner end to end (docs/static_analysis.md):
+# (1) plan mnist dp2 through BuildStrategy.memory_plan — the sanitized
+#     memory_plan_pass apply must stay lint-clean (the r13 buffer-reuse
+#     detectors are the soundness gate) and the r17 ledger identity must
+#     still hold on the planned cell; the mnist plan is a no-op by
+#     SEARCH (nothing to free on the mlp) and its census must not
+#     regress;
+# (2) the activation-heavy transformer cell: the searched remat plan's
+#     memory_census peak must land STRICTLY below the unplanned twin
+#     (the measured matrix with step-time bands is BENCH_MEMPLAN_r18.json).
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python - <<'PY'
+import numpy as np, jax
+import paddle_tpu as pt
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.framework import analysis, costs as _costs
+from paddle_tpu.framework.passes import get_pass
+from paddle_tpu.observability.ledger import CostLedger
+from paddle_tpu.parallel import ParallelExecutor
+from paddle_tpu.parallel.mesh import DeviceMesh
+from paddle_tpu.parallel.strategy import BuildStrategy, ReduceStrategy
+_flags.set_flag("use_bf16_matmul", False)
+led = CostLedger("ci-memplan")
+
+# (1) mnist dp2 behind BuildStrategy.memory_plan
+rng = np.random.RandomState(7)
+from paddle_tpu import layers
+x = layers.data("x", shape=[64]); label = layers.data("label", shape=[1], dtype="int64")
+h = layers.fc(x, size=128, act="relu")
+loss = layers.mean(layers.softmax_with_cross_entropy(layers.fc(h, size=10), label))
+pt.optimizer.MomentumOptimizer(0.1, momentum=0.9).minimize(loss)
+bst = BuildStrategy(); bst.reduce_strategy = ReduceStrategy.ReduceScatter
+bst.memory_plan = True; bst.memory_plan_time_budget_s = 1.0
+exe = ParallelExecutor(loss_name=loss.name, build_strategy=bst,
+                       mesh=DeviceMesh(jax.devices()[:2], {"dp": 2}))
+pt.Executor().run(pt.default_startup_program())
+feed = {"x": rng.rand(16, 64).astype("float32"),
+        "label": rng.randint(0, 10, (16, 1)).astype("int64")}
+jax.block_until_ready(exe.run(feed=feed, fetch_list=[loss], return_numpy=False))
+planned = exe.prepare_program()
+assert getattr(planned, "_memory_plan_applied", False)
+errs = [d for d in analysis.verify_program(planned) if d.severity == "error"]
+assert not errs, errs
+row = led.row("mnist_dp2_planned")
+row.set_prediction(exe.cost_report(nominal_batch=16))
+row.set_memory_census(exe.memory_census(feed=feed))
+rec = row.check_memory_identity(residual_frac=0.10)
+assert row.ok, [c for c in row.checks if not c["ok"]]
+
+# (2) transformer: planned census peak strictly below unplanned
+def build():
+    pt.reset_default_programs(); pt.reset_global_scope()
+    with pt.core.unique_name.guard():
+        from paddle_tpu.models import transformer
+        loss, _ = transformer.transformer_lm(
+            vocab=128, max_len=32, d_model=64, d_inner=128, num_heads=4,
+            num_layers=2, dropout=0.0, mean_loss=True)
+        pt.optimizer.AdamOptimizer(1e-3).minimize(loss)
+    r = np.random.RandomState(7)
+    feed = {"tokens": r.randint(0, 128, (32, 32)).astype("int64"),
+            "tokens@SEQLEN": np.full((32,), 32, "int32"),
+            "targets": r.randint(0, 128, (32, 32)).astype("int64")}
+    return loss, feed
+
+def peak(prog, loss, feed):
+    e = pt.Executor()
+    pt.Executor().run(pt.default_startup_program())
+    jax.block_until_ready(e.run(program=prog, feed=feed,
+                                fetch_list=[loss], return_numpy=False))
+    c = e.memory_census(feed=feed, program=prog)
+    return c["peak_bytes"], c
+
+loss, feed = build()
+p_base, _ = peak(pt.default_main_program(), loss, feed)
+loss, feed = build()
+prog = get_pass("memory_plan_pass", nominal_batch=32,
+                time_budget_s=1.0)(pt.default_main_program())
+assert not [d for d in analysis.verify_program(prog)
+            if d.severity == "error"]
+p_plan, census = peak(prog, loss, feed)
+assert p_plan < p_base, (p_plan, p_base)
+prow = led.row("transformer_planned")
+prow.set_prediction(_costs.predict(prog, dp=1, nominal_batch=32))
+prow.set_memory_census(census)
+prow.check_memory_identity(residual_frac=0.10)
+assert prow.ok, [c for c in prow.checks if not c["ok"]]
+import json
+print("memory-plan smoke OK:", json.dumps({
+    "transformer_peak_unplanned": round(p_base),
+    "transformer_peak_planned": round(p_plan),
+    "reduction": round(1 - p_plan / p_base, 4)}))
+PY
 
 echo "== flight-recorder smoke (SIGKILL mid-barrier -> dossier + post-mortem) =="
 # the distributed flight recorder end to end (observability/

@@ -157,6 +157,17 @@ define_bool("memory_plan", True,
             "runs every program unplanned — the escape hatch if a plan "
             "ever misbehaves in production. Part of the executor's "
             "compile cache key (framework/executor.py _fusion_flags_key).")
+define_bool("auto_parallel", True,
+            "Allow the auto-parallel planner (framework/auto_parallel.py) "
+            "when the BuildStrategy requests it (auto_parallel=True): "
+            "cost-model-guided search over the dp x pp x tp strategy "
+            "space that chooses the executor's BuildStrategy knobs and "
+            "mesh factorization, and re-plans on elastic restore to a "
+            "changed world size. Kill switch: PTPU_AUTO_PARALLEL=0 runs "
+            "the user's strategy and mesh untouched — the escape hatch "
+            "if a plan ever misbehaves in production. Part of the "
+            "executor's compile cache key (framework/executor.py "
+            "_fusion_flags_key).")
 define_bool("quant_comm", True,
             "Allow quantized gradient collectives when the BuildStrategy "
             "requests them (quant_comm='int8'/'bf16'). Kill switch: "

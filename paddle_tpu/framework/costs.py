@@ -36,6 +36,12 @@ from typing import Dict, Optional, Tuple
 
 V5E_PEAK_TFLOPS = 197e12
 V5E_HBM_BPS = 819e9
+# per-chip HBM capacity and per-device ICI (inter-chip interconnect)
+# bandwidth — the auto-parallel planner's budget and wire-time constants
+# (framework/auto_parallel.py). 45 GB/s is the one-direction per-link v5e
+# figure the ring models' per-device byte counts divide through.
+V5E_HBM_BYTES = 16 * (1 << 30)
+V5E_ICI_BPS = 45e9
 
 # dtype byte widths for parsing XLA shape strings — the ONE copy shared by
 # the probes (probe_caps) and the comm-structure tests. Covers every XLA
@@ -646,9 +652,48 @@ def predict(program, strategy=None, *, dp: int = 1, tp: int = 0,
             "pp_stages": plan.get("pp_stages"),
             "schedule": plan.get("schedule"),
         }
+        if strategy is not None and getattr(strategy, "memory_plan", False):
+            # PLAN-AWARE memory pricing (the auto-parallel planner's
+            # view): the ledger's conservative estimates above stay
+            # UNPLANNED on purpose — a planned cell's measured reduction
+            # must keep landing in the NAMED unrealized:transient_peak
+            # bucket, so the identity checks never change — and the
+            # planned expectation rides in NEW keys instead. The plan's
+            # peak_before/after ratio is scale-invariant, so it applies
+            # to the per-device transient (priced at the local batch)
+            # as well as the whole-program peak; the dp-comm/pipeline
+            # working sets are schedule state the plan cannot touch.
+            before = float(plan.get("predicted_peak_before") or 0)
+            after = float(plan.get("predicted_peak_after") or 0)
+            if before > 0:
+                frac = min(max(after / before, 0.0), 1.0)
+                per_dev = report["memory"]["per_device"]
+                fixed_ws = (per_dev.get("dp_comm_working_set", 0)
+                            + per_dev.get("pp_working_set", 0))
+                base = max(0, per_dev["transient_peak"] - fixed_ws)
+                per_dev["transient_peak_planned"] = int(base * frac
+                                                        + fixed_ws)
+                mem = report["memory"]
+                mem["planned_peak_total_bytes"] = int(
+                    mem["persistent_bytes"] + mem["feed_bytes"]
+                    + mem["peak_transient_bytes"] * frac)
     if dp > 1:
+        spmd_model = _gc.spmd_allreduce_wire_bytes
+        try:
+            from ..parallel.strategy import ReduceStrategy
+            if (strategy is not None
+                    and getattr(strategy, "reduce_strategy", None)
+                    == ReduceStrategy.Reduce):
+                # the ZeRO-1 SPMD mode costs MORE wire than plain
+                # allreduce on this backend (grad allreduce + sharded-
+                # update param all-gather, census-measured); an
+                # allreduce-priced Reduce point would win planner
+                # comparisons unfairly
+                spmd_model = _gc.spmd_zero1_wire_bytes
+        except Exception:
+            pass
         report["dp_comm"] = (_gc.analytic_wire_bytes(program, dp)
-                             or _gc.spmd_allreduce_wire_bytes(program, dp))
+                             or spmd_model(program, dp))
         report["dp_comm"]["explicit"] = bool(
             getattr(program, "_dp_comm_applied", False))
     if getattr(program, "_tp_applied", False):
@@ -725,3 +770,395 @@ def predicted_wire_bytes(report: Dict) -> float:
     if pipe:
         total += pipe.get("grad_psum_wire_bytes", 0)
     return total
+
+
+# ---------------------------------------------------------------------------
+# planner-facing scalarization: one CostReport -> predicted seconds/bytes.
+# The auto-parallel planner (framework/auto_parallel.py) minimizes
+# predicted_step_seconds subject to predicted_device_bytes <= HBM; both
+# read ONLY the report, so prediction and search can never disagree on
+# what a strategy costs.
+# ---------------------------------------------------------------------------
+
+
+def predicted_device_bytes(report: Dict, planned: bool = True) -> int:
+    """Predicted per-device footprint of one step from a predict()
+    report: the per-device state/feed/seed categories plus the transient
+    peak — the memory-PLANNED transient (`transient_peak_planned`,
+    priced by predict() when the strategy set memory_plan) when present
+    and `planned` is True, the unplanned estimate otherwise."""
+    per_dev = report["memory"]["per_device"]
+    total = sum(int(per_dev.get(c, 0))
+                for c in ("params", "optimizer_state", "ef_residual",
+                          "other_state", "feeds", "seed"))
+    transient = per_dev["transient_peak"]
+    if planned and "transient_peak_planned" in per_dev:
+        transient = per_dev["transient_peak_planned"]
+    return int(total + transient)
+
+
+def predicted_step_seconds(report: Dict, *, mesh_axes: Optional[Dict] = None,
+                           strategy=None,
+                           ici_bps: float = V5E_ICI_BPS,
+                           hbm_bps: float = V5E_HBM_BPS,
+                           coll_launch_s: float = 2e-6) -> Dict:
+    """Scalarize one predict() report into predicted step seconds on the
+    v5e constants — the auto-parallel planner's objective. A RELATIVE
+    model (like the pipeline partitioner's balance signal): it only has
+    to rank strategies, not to forecast wall-clock on any particular
+    host. Terms:
+
+      compute_s   roofline seconds of the whole program divided over
+                  dp*tp*K (dp splits the batch, tp the sharded matmuls,
+                  pipeline stages run concurrently)
+      bubble_s    the schedule's fill/drain overhead on that compute:
+                  compute * ((M+K-1)/M - 1), the executed-table bubble
+      dp_comm_s / tp_comm_s / pp_comm_s
+                  per-device wire bytes / ici_bps (ring models; the pp
+                  term adds the boundary permutes — 2 per tick — and the
+                  pp-axis gradient psum)
+      quant_s     the quantized pipeline's quantize -> f32 dequant-sum
+                  -> requantize working-set passes (~3x the flat f32
+                  gradient bytes at HBM speed) — what makes int8 wire a
+                  LOSS for models whose gradients are small enough that
+                  the saved wire never amortizes it (the measured r08
+                  CPU-mesh attribution, priced instead of ignored)
+      launch_s    per-collective launch overhead x the plan's launch
+                  count — what makes comm_bucket_bytes a searched knob
+                  (fewer, larger transfers) instead of a free one
+    """
+    axes = dict(mesh_axes or {})
+    dp = int(axes.get("dp", report.get("dp", 1)) or 1)
+    # credit the tp split ONLY when the tp rewrite actually ran (the
+    # report carries a tp_comm section): a tp mesh axis over a program
+    # without executable sharding runs REPLICATED — charging tp-divided
+    # compute for it would make wasted devices look free
+    tp = int(axes.get("tp", 1) or 1) if report.get("tp_comm") else 1
+    pipe = report.get("pipeline")
+    k = int(pipe["num_stages"]) if pipe else 1
+    compute = report["compute"]["roofline_s"] / max(dp * tp * max(k, 1), 1)
+    bubble = 0.0
+    if pipe:
+        m = int(pipe["num_microbatches"])
+        bubble = compute * ((m + k - 1) / m - 1.0)
+    dp_comm_s = tp_comm_s = pp_comm_s = quant_s = 0.0
+    launches = 0
+    dpc = report.get("dp_comm")
+    if dpc:
+        dp_comm_s = dpc.get("wire_bytes", 0) / ici_bps
+        launches += int(dpc.get("n_transfers", 0))
+        if (strategy is not None and getattr(strategy, "quant_comm", "")
+                and dpc.get("explicit")):
+            quant_s = 3.0 * dpc.get("grad_f32_bytes", 0) / hbm_bps
+    tpc = report.get("tp_comm")
+    if tpc:
+        tp_comm_s = tpc.get("tp_wire_bytes", 0) / ici_bps
+        launches += int(sum((tpc.get("tp_op_counts") or {}).values()))
+    if pipe:
+        pp_comm_s = pipe.get("grad_psum_wire_bytes", 0) / ici_bps
+        boundary = pipe.get("boundary") or {}
+        pp_comm_s += boundary.get("pp_boundary_bytes", 0) / ici_bps
+        launches += 2 * int(boundary.get("ticks_per_step", 0)) + 1
+    launch_s = coll_launch_s * launches
+    total = (compute + bubble + dp_comm_s + tp_comm_s + pp_comm_s
+             + quant_s + launch_s)
+    return {"compute_s": compute, "bubble_s": bubble,
+            "dp_comm_s": dp_comm_s, "tp_comm_s": tp_comm_s,
+            "pp_comm_s": pp_comm_s, "quant_s": quant_s,
+            "launch_s": launch_s, "n_collective_launches": launches,
+            "total_s": total}
+
+
+# ---------------------------------------------------------------------------
+# compile-free strategy feasibility: the SAME gates the executor/pass
+# stack raises at run time, surfaced statically with NAMED reasons — the
+# auto-parallel planner's pruning predicate and the lint_program
+# --strategy surface.
+# ---------------------------------------------------------------------------
+
+
+class Feasibility:
+    """Result of strategy_is_feasible: `ok`, the named `reasons`
+    ([{code, message}]) when not, and — for a feasible deep check — the
+    `program` AS THE EXECUTOR WOULD RUN IT (tp/dp-comm/pipeline/
+    memory-plan rewrites applied), ready for costs.predict."""
+
+    def __init__(self, ok: bool, reasons, program=None):
+        self.ok = bool(ok)
+        self.reasons = list(reasons)
+        self.program = program
+
+    def reason_codes(self):
+        return sorted({r["code"] for r in self.reasons})
+
+    def __repr__(self):
+        return (f"Feasibility(ok={self.ok}, "
+                f"reasons={self.reason_codes()})")
+
+    def __bool__(self):
+        return self.ok
+
+
+def _reason(code: str, message: str) -> Dict:
+    return {"code": code, "message": message}
+
+
+def strategy_is_feasible(program, strategy, *, mesh_axes: Dict,
+                         nominal_batch: int = 8,
+                         deep: bool = True) -> Feasibility:
+    """Would `(strategy, mesh_axes)` execute this program? The checks are
+    the executor/pass gates themselves, run statically (no XLA compile)
+    and mapped to NAMED rejection codes — a config this function accepts
+    cannot be rejected by ParallelExecutor at run time, and one it
+    rejects names the same condition the run-time enforce would raise:
+
+      quant-invalid          quant_comm outside {'', 'int8', 'bf16'}
+      gradient-scale-unsupported  CoeffNumDevice (executor __init__)
+      mesh-mismatch          pipeline_stages vs pp axis size, explicit
+                             comm without a dp axis, schedule unknown
+      batch-indivisible      batch % dp (explicit comm) or % (dp*M)
+                             (pipeline) != 0 (_pad_for_dp)
+      batch-norm             whole-batch statistics ops under a manual
+                             mode (grad_comm/pipeline _BATCH_GLOBAL_OPS)
+      non-mean-loss          manual modes need a MEAN-reduced loss
+      sp-manual-conflict     enable_sequence_parallel + manual mode
+      non-tp-sharded-param   parameter sharded over a live non-tp axis
+                             (_gate_manual_mode)
+      multi-region           pipeline needs exactly one vjp_region
+      pp-too-few-ops         fewer forward ops than stages
+      tp-unannotated         manual tp>1 on a program with no sharding
+                             annotations
+      tp-indivisible         an annotated dim does not divide by tp
+      tp-spec-conflict       sharding propagation conflict diagnostics
+      narrow-cut             pipeline_partition_pass boundary validation
+                             (wide cut / persistable / non-float / sink)
+      tp-gate / dp-gate / pp-gate / memory-plan-gate
+                             any remaining pass enforce, verbatim
+
+    With `deep=True` (default) the surviving config is pushed through
+    the ACTUAL rewrite passes in executor order (tp -> dp-comm ->
+    pipeline -> memory plan) so pass-internal gates — narrow-cut
+    validity above all — run for real, and the rewritten program rides
+    back on the result for costs.predict. `deep=False` stops after the
+    cheap structural checks (the planner's first pruning sweep)."""
+    from ..core.enforce import EnforceError
+    from ..parallel.grad_comm import _BATCH_GLOBAL_OPS, _MEAN_LOSS_OPS
+    from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, PIPELINE_AXIS
+    from ..parallel.pipeline import PIPELINE_SCHEDULES
+    from ..parallel.strategy import GradientScaleStrategy, ReduceStrategy
+    from . import sharding as _sharding
+    from .analysis import ProgramAnalysisError
+
+    axes = dict(mesh_axes or {})
+    dp = int(axes.get(DATA_AXIS, 1) or 1)
+    pp = int(axes.get(PIPELINE_AXIS, 1) or 1)
+    tp = int(axes.get(MODEL_AXIS, 1) or 1)
+    reasons = []
+
+    quant = getattr(strategy, "quant_comm", "") or ""
+    if quant not in ("", "int8", "bf16"):
+        reasons.append(_reason(
+            "quant-invalid",
+            f"BuildStrategy.quant_comm must be '', 'int8' or 'bf16', "
+            f"got {quant!r}"))
+        quant = ""
+    if (getattr(strategy, "gradient_scale_strategy",
+                GradientScaleStrategy.One)
+            == GradientScaleStrategy.CoeffNumDevice):
+        reasons.append(_reason(
+            "gradient-scale-unsupported",
+            "GradientScaleStrategy.CoeffNumDevice is not implemented "
+            "(the SPMD global-batch mean already scales the loss)"))
+
+    stages = int(getattr(strategy, "pipeline_stages", 0) or 0)
+    m = int(getattr(strategy, "num_microbatches", 1) or 1)
+    schedule = getattr(strategy, "pipeline_schedule", "1f1b")
+    explicit = (getattr(strategy, "reduce_strategy", None)
+                == ReduceStrategy.ReduceScatter) or bool(quant)
+    manual = explicit or stages >= 2
+
+    if stages >= 2 and pp != stages:
+        reasons.append(_reason(
+            "mesh-mismatch",
+            f"pipeline_stages={stages} needs a pp mesh axis of exactly "
+            f"that size; mesh axes are {axes}"))
+    if stages < 2 and pp > 1:
+        reasons.append(_reason(
+            "mesh-mismatch",
+            f"mesh carries a pp axis of size {pp} but the strategy asks "
+            f"for no pipeline (pipeline_stages={stages})"))
+    if stages >= 2 and schedule not in PIPELINE_SCHEDULES:
+        reasons.append(_reason(
+            "mesh-mismatch",
+            f"pipeline_schedule must be one of {PIPELINE_SCHEDULES}, "
+            f"got {schedule!r}"))
+    if explicit and DATA_AXIS not in axes:
+        reasons.append(_reason(
+            "mesh-mismatch",
+            f"the explicit gradient pipeline (ReduceScatter/quant_comm) "
+            f"needs a {DATA_AXIS!r} axis in the mesh, got {axes}"))
+
+    if explicit and nominal_batch % max(dp, 1) != 0:
+        reasons.append(_reason(
+            "batch-indivisible",
+            f"batch {nominal_batch} is not divisible by dp={dp}: the "
+            f"explicit gradient pipeline derives the global-mean "
+            f"gradient from EQUAL per-shard batches"))
+    if stages >= 2 and nominal_batch % max(dp * m, 1) != 0:
+        reasons.append(_reason(
+            "batch-indivisible",
+            f"batch {nominal_batch} is not divisible by dp * "
+            f"num_microbatches = {dp} * {m}: the pipeline schedule "
+            f"derives the global-mean loss from EQUAL microbatches"))
+
+    if manual and getattr(strategy, "enable_sequence_parallel", False):
+        reasons.append(_reason(
+            "sp-manual-conflict",
+            "sequence-parallel feed splitting cannot compose with the "
+            "manual execution modes (whole per-shard sequences)"))
+
+    block0 = program.global_block()
+    if manual:
+        bad = sorted({op.type for op in block0.ops
+                      if op.type in _BATCH_GLOBAL_OPS})
+        if bad:
+            reasons.append(_reason(
+                "batch-norm",
+                f"ops {bad} fold statistics over the WHOLE batch and "
+                f"would silently compute per-shard statistics under a "
+                f"manual mode"))
+        live = {a for a, s in axes.items() if int(s or 1) > 1}
+        for b in program.blocks:
+            for v in b.vars.values():
+                spec = getattr(v, "sharding_spec", None)
+                if not v.persistable or spec is None:
+                    continue
+                names = set()
+                for s in spec:
+                    if isinstance(s, (tuple, list)):
+                        names.update(s)
+                    elif s is not None:
+                        names.add(s)
+                non_tp = sorted((names & live) - {MODEL_AXIS})
+                if non_tp:
+                    reasons.append(_reason(
+                        "non-tp-sharded-param",
+                        f"parameter {v.name!r} is sharded over mesh "
+                        f"axes {non_tp}; only the tp axis has a manual-"
+                        f"mode rewrite pass"))
+
+    regions = [op for op in block0.ops if op.type == "vjp_region"]
+    if manual:
+        for rop in regions:
+            loss_name = rop.attrs["loss"]
+            producer = next(
+                (o for o in reversed(block0.ops)
+                 if loss_name in o.output_names()
+                 and o.type != "vjp_region"), None)
+            if producer is None or producer.type not in _MEAN_LOSS_OPS:
+                reasons.append(_reason(
+                    "non-mean-loss",
+                    f"loss {loss_name!r} is produced by "
+                    f"{producer.type if producer else '<nothing>'}; the "
+                    f"manual modes require a MEAN-reduced loss "
+                    f"(layers.mean / reduce_mean)"))
+    if stages >= 2:
+        if len(regions) != 1:
+            reasons.append(_reason(
+                "multi-region",
+                f"pipeline partitioning supports exactly one backward "
+                f"region (vjp_region), found {len(regions)}"))
+        elif len(list(regions[0].attrs["fwd_ops"])) < stages:
+            reasons.append(_reason(
+                "pp-too-few-ops",
+                f"cannot cut {len(list(regions[0].attrs['fwd_ops']))} "
+                f"forward ops into {stages} non-empty stages"))
+
+    if tp > 1 and manual:
+        if not _sharding.has_tp_annotations(program):
+            reasons.append(_reason(
+                "tp-unannotated",
+                f"mesh carries a tp axis of size {tp} but the program "
+                f"has no tp sharding annotations "
+                f"(ParamAttr(sharding_spec=...) / annotate_tp)"))
+        else:
+            res = _sharding.propagate_sharding(program, tp_size=tp)
+            for d in res.diagnostics:
+                if d.severity != "error":
+                    continue
+                code = ("tp-indivisible"
+                        if d.code == "shard-divisibility"
+                        else "tp-spec-conflict")
+                reasons.append(_reason(code, f"{d.loc}: {d.message}"))
+
+    if reasons:
+        return Feasibility(False, reasons)
+    if not deep:
+        return Feasibility(True, [])
+
+    # -- deep check: the actual rewrite passes, executor order ------------
+    from ..parallel import grad_comm as _gc
+    from ..parallel import pipeline as _pipeline
+    from .passes import get_pass
+
+    rewritten = program
+    try:
+        if (tp > 1 and manual
+                and _sharding.has_tp_annotations(rewritten)
+                and not getattr(rewritten, "_tp_applied", False)):
+            rewritten = get_pass("tp_shard_pass", tp=tp)(rewritten)
+    except (EnforceError, ProgramAnalysisError) as e:
+        return Feasibility(False, [_reason("tp-gate", str(e))])
+    cfg = _gc.explicit_comm_config(strategy)
+    if cfg is not None and not getattr(rewritten, "_dp_comm_applied",
+                                       False):
+        try:
+            rewritten = _gc.comm_optimize_pass(rewritten, dp, cfg)
+        except (EnforceError, ProgramAnalysisError) as e:
+            return Feasibility(False, [_reason("dp-gate", str(e))])
+    pcfg = _pipeline.pipeline_config(strategy)
+    if pcfg is not None and not getattr(rewritten, "_pp_applied", False):
+        try:
+            rewritten = get_pass(
+                "pipeline_partition_pass",
+                num_stages=pcfg["stages"],
+                num_microbatches=pcfg["microbatches"],
+                schedule=pcfg["schedule"],
+                nominal_batch=nominal_batch,
+                dp_axis="dp" if "dp" in axes else "",
+                reduce_dp=("dp" in axes
+                           and not getattr(rewritten, "_dp_comm_applied",
+                                           False)),
+            )(rewritten)
+        except (EnforceError, ProgramAnalysisError) as e:
+            msg = str(e)
+            code = ("narrow-cut"
+                    if ("narrow activation cut" in msg
+                        or "carries no activation" in msg
+                        or "may cross a stage cut" in msg
+                        or "cannot cross a pipeline cut" in msg
+                        or "cannot be pruned" in msg)
+                    else "pp-too-few-ops" if "cannot cut" in msg
+                    else "pp-gate")
+            return Feasibility(False, [_reason(code, msg)])
+    if getattr(strategy, "memory_plan", False) \
+            and not getattr(rewritten, "_memory_plan_applied", False):
+        from . import memory_plan as _memory_plan  # noqa: F401 (registers)
+        try:
+            budget = float(getattr(strategy, "memory_plan_time_budget_s",
+                                   0.0) or 0.0)
+            rewritten = get_pass(
+                "memory_plan_pass",
+                nominal_batch=nominal_batch,
+                time_budget_s=(budget or None),
+                time_budget_frac=float(getattr(strategy,
+                                               "memory_plan_time_frac",
+                                               0.02)),
+                remat_prevent_cse=bool(getattr(strategy,
+                                               "memory_plan_prevent_cse",
+                                               False)),
+            )(rewritten)
+        except (EnforceError, ProgramAnalysisError) as e:
+            return Feasibility(False, [_reason("memory-plan-gate",
+                                               str(e))])
+    return Feasibility(True, [], rewritten)

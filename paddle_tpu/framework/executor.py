@@ -42,7 +42,8 @@ def _fusion_flags_key():
             flags.get_flag("quant_comm"),
             flags.get_flag("pipeline"),
             flags.get_flag("tp_shard"),
-            flags.get_flag("memory_plan"))
+            flags.get_flag("memory_plan"),
+            flags.get_flag("auto_parallel"))
 
 
 def _feed_signature(feed: Dict[str, Any]):

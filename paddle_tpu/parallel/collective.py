@@ -167,6 +167,27 @@ def _decompress(payload, scales):
     return dequantize_blocks(payload, scales)
 
 
+def _pin_wire(payload, scales):
+    """Best-effort pin of the COMPRESSED dtype on the wire. The bf16
+    path is an exact round-trip (the f32 -> bf16 -> f32 widening loses
+    nothing the narrowing didn't already drop), so a simplifier may
+    legally commute the widening convert across the collective; the
+    optimization barriers keep each convert on its own side of the
+    transfer on backends whose collectives carry bf16 natively (TPU).
+    KNOWN LIMIT, census-measured (r19 planner bench): this container's
+    jaxlib-0.4.x CPU backend promotes the bf16 collective payload to
+    f32 REGARDLESS (it inserts its own converts and elides the
+    barriers), so on the CPU mesh the bf16 wire census reads exactly 2x
+    the analytic model — which is why the auto-parallel planner's
+    DEFAULT space searches int8 but not bf16 (auto_parallel.
+    SearchSpace); the bf16 claim stays a TPU re-measure item. int8
+    needs no pin: its dequant multiplies by per-block scales, which
+    nothing can hoist."""
+    if scales is None:
+        payload = jax.lax.optimization_barrier(payload)
+    return payload
+
+
 def compressed_size_ratio(wire_dtype: str, block: int = QUANT_BLOCK) -> float:
     """Analytic bytes-on-wire ratio vs f32 for one compressed transfer."""
     if wire_dtype == "int8":
@@ -197,9 +218,11 @@ def quantized_reduce_scatter_flat(flat, axis_name: str, *,
     payload, scales = _compress(xb.reshape(-1), wire_dtype, block)
     # all_to_all the per-destination compressed chunks: shard i ends up
     # holding every peer's compressed version of chunk i
+    payload = _pin_wire(payload, scales)
     payload = payload.reshape(n, -1, *payload.shape[1:])
     payload = jax.lax.all_to_all(payload, axis_name, split_axis=0,
                                  concat_axis=0, tiled=True)
+    payload = _pin_wire(payload, scales)
     if scales is not None:
         scales = scales.reshape(n, -1, *scales.shape[1:])
         scales = jax.lax.all_to_all(scales, axis_name, split_axis=0,
@@ -237,7 +260,9 @@ def quantized_all_gather_flat(chunk, axis_name: str, *,
     cpad = -(-c // block) * block
     padded = jnp.pad(chunk, (0, cpad - c))
     payload, scales = _compress(padded, wire_dtype, block)
+    payload = _pin_wire(payload, scales)
     payload = jax.lax.all_gather(payload, axis_name, axis=0, tiled=True)
+    payload = _pin_wire(payload, scales)
     if scales is not None:
         scales = jax.lax.all_gather(scales, axis_name, axis=0, tiled=True)
     full = _decompress(payload, scales).reshape(n, cpad)[:, :c]

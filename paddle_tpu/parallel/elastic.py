@@ -1448,7 +1448,8 @@ def verify_restored_placement(executor, program, scope,
 def restore_train_state(path: str,
                         program=None, scope=None, executor=None,
                         strict: bool = True,
-                        verify: bool = True) -> Dict[str, Any]:
+                        verify: bool = True,
+                        replan: Optional[bool] = None) -> Dict[str, Any]:
     """Restore the latest committed snapshot under `path` (or `path`
     itself when it is a snapshot dir) into `scope`, re-placing every
     array onto the CURRENT executor's mesh — which may be an ARBITRARILY
@@ -1474,6 +1475,17 @@ def restore_train_state(path: str,
     strict=True errors on persistables the checkpoint lacks; False
     leaves them at their startup values (warm-starting a grown model).
 
+    replan: when the snapshot's world differs from the executor's, run
+    the auto-parallel planner over the NEW world and adopt its choice
+    onto the executor BEFORE re-placing any state — the planner prices
+    keeping the restored strategy vs re-planning (predicted step seconds
+    plus each side's one-time redistribution wire bytes, validated
+    against `costs.reshard_wire_bytes`) and adopts the re-plan only when
+    it wins (framework/auto_parallel.py replan_on_restore; the decision
+    record returns as meta["replan"]). Default None follows the
+    executor's `BuildStrategy.auto_parallel` (and the PTPU_AUTO_PARALLEL
+    kill switch); True/False force it either way.
+
     Returns the snapshot metadata (step, extra, world, strategy...)."""
     import time as _time
 
@@ -1491,10 +1503,27 @@ def restore_train_state(path: str,
     with open(os.path.join(dirname, META_FILE)) as f:
         meta = json.load(f)
 
+    # re-plan BEFORE the prepared view is computed: the planner may
+    # adopt a different strategy + mesh factorization onto the executor,
+    # and everything below (rewritten view, EF layout, placement,
+    # reshard schedule) must follow the ADOPTED configuration
+    mesh = getattr(executor, "mesh", None)
+    want_replan = (replan if replan is not None else bool(
+        executor is not None
+        and getattr(getattr(executor, "build_strategy", None),
+                    "auto_parallel", False)
+        and flags.get_flag("auto_parallel")))
+    old_world = dict(meta.get("world", {}) or {})
+    if (want_replan and executor is not None and mesh is not None
+            and old_world != dict(getattr(mesh, "axes", {}) or {})):
+        from ..framework import auto_parallel as _auto
+        meta["replan"] = _auto.replan_on_restore(
+            executor, program, scope, meta, dirname)
+        mesh = executor.mesh
+
     prepared = _prepared_view(executor, program, scope)
     new_ef = _ef_layout(prepared)
     old_ef = meta.get("ef_layout")
-    mesh = getattr(executor, "mesh", None)
     new_dp = int(mesh.axis_size("dp")) if mesh is not None else 1
 
     with _tracing.span("checkpoint", "elastic/restore",

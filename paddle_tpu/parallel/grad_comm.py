@@ -449,14 +449,21 @@ def analytic_wire_bytes(program: Program, dp: int) -> Optional[Dict]:
     comm = next((op for op in block0.ops if op.type == "dp_grad_comm"), None)
     if comm is None:
         return {"grad_wire_bytes": 0, "param_allgather_wire_bytes": 0,
-                "wire_bytes": 0}
+                "wire_bytes": 0, "grad_f32_bytes": 0, "n_transfers": 0}
     quant = comm.attrs["quant"]
     qblock = comm.attrs["block"]
     kinds, numels = comm.attrs["kinds"], comm.attrs["numels"]
     grad = 0.0
+    # launch-count + uncompressed-size side channel for the time model
+    # (framework/costs.predicted_step_seconds): how many collective
+    # launches the plan issues per step, and the f32 gradient bytes the
+    # quantized path must quantize/dequant-sum/requantize
+    n_transfers = 0
+    grad_f32 = 4 * sum(numels)
     for i, kind in enumerate(kinds):
         if kind != "sharded":
             continue
+        n_transfers += 1
         if quant:
             out = _compressed_transfer_bytes(numels[i], dp, quant, qblock)
             grad += out * (dp - 1) / dp            # all_to_all
@@ -465,6 +472,7 @@ def analytic_wire_bytes(program: Program, dp: int) -> Optional[Dict]:
     for idxs in comm.attrs["buckets"]:
         flat = sum(numels[i] for i in idxs)
         npad = -(-flat // dp) * dp
+        n_transfers += 2                           # reduce + gather phase
         if quant:
             out = _compressed_transfer_bytes(npad, dp, quant, qblock)
             grad += 2 * out * (dp - 1) / dp        # a2a + all_gather
@@ -477,6 +485,7 @@ def analytic_wire_bytes(program: Program, dp: int) -> Optional[Dict]:
     for op in block0.ops:
         if op.type != "dp_shard_all_gather":
             continue
+        n_transfers += 1
         v = block0.var(op.outputs["Out"][0])
         shape = list(v.shape)
         if tp > 1 and getattr(v, "tp_spec", None):
@@ -488,13 +497,48 @@ def analytic_wire_bytes(program: Program, dp: int) -> Optional[Dict]:
         param_ag += (n * 4) * (dp - 1) / dp
     return {"grad_wire_bytes": int(grad),
             "param_allgather_wire_bytes": int(param_ag),
-            "wire_bytes": int(grad + param_ag)}
+            "wire_bytes": int(grad + param_ag),
+            "grad_f32_bytes": int(grad_f32),
+            "n_transfers": int(n_transfers)}
+
+
+def spmd_zero1_wire_bytes(program: Program, dp: int) -> Dict:
+    """Analytic model of the SPMD `ReduceStrategy.Reduce` (ZeRO-1 via
+    sharded accumulators) mode: XLA keeps the full gradient all-reduce
+    AND all-gathers every parameter whose optimizer state it sharded
+    (census-measured on this backend: exactly the allreduce model plus
+    the dim0-divisible params' all-gather). APPROXIMATE, unlike the
+    explicit-pipeline model: the partitioner owns this lowering, so the
+    planner prices it but the ledger never asserts it exact — the
+    auto-parallel planner also prefers census-exact modes inside the
+    measured noise band for exactly this reason."""
+    base = spmd_allreduce_wire_bytes(program, dp)
+    ag = 0.0
+    n_ag = 0
+    for b in program.blocks:
+        for v in b.vars.values():
+            if not (getattr(v, "trainable", False) and v.persistable):
+                continue
+            shape = list(v.shape or ())
+            if not shape or shape[0] < dp or shape[0] % dp:
+                continue
+            n = 4
+            for d in shape:
+                n *= d
+            ag += n * (dp - 1) / dp
+            n_ag += 1
+    return {**base,
+            "param_allgather_wire_bytes": int(ag),
+            "wire_bytes": int(base["grad_wire_bytes"] + ag),
+            "n_transfers": base["n_transfers"] + n_ag,
+            "exact": False}
 
 
 def spmd_allreduce_wire_bytes(program: Program, dp: int) -> Dict:
     """The default SPMD pipeline's analytic equivalent: every trainable
     parameter's gradient rides one f32 all-reduce (ring: 2n(dp-1)/dp)."""
     total = 0
+    n_grads = 0
     for b in program.blocks:
         for v in b.vars.values():
             if getattr(v, "trainable", False) and v.persistable:
@@ -502,10 +546,13 @@ def spmd_allreduce_wire_bytes(program: Program, dp: int) -> Dict:
                 for d in v.shape:
                     n *= d
                 total += n * 4
+                n_grads += 1
     grad = 2.0 * total * (dp - 1) / dp
     return {"grad_wire_bytes": int(grad),
             "param_allgather_wire_bytes": 0,
-            "wire_bytes": int(grad)}
+            "wire_bytes": int(grad),
+            "grad_f32_bytes": int(total),
+            "n_transfers": int(n_grads)}
 
 
 # ---------------------------------------------------------------------------

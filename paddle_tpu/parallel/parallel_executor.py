@@ -95,13 +95,26 @@ class ParallelExecutor(Executor):
     def _state_sharding(self, program: Program, name: str) -> NamedSharding:
         v = self._find_var(program, name)
         spec = getattr(v, "sharding_spec", None) if v is not None else None
-        if spec is not None:
+        manual = (getattr(program, "_dp_comm_applied", False)
+                  or getattr(program, "_pp_applied", False))
+        if spec is not None and not (
+                manual and v is not None
+                and (getattr(v, "dp_shard_update", False)
+                     or getattr(v, "dp_replica_state", False)
+                     or getattr(v, "tp_spec", None))):
             # explicit TP/EP placement from ParamAttr(sharding_spec=...) or
             # parallel.auto_shard annotation; mesh.sharding drops axis names
-            # not present in this mesh (replicated there).
+            # not present in this mesh (replicated there). In the MANUAL
+            # modes a var the rewrite passes marked is placed by its
+            # markers below instead: optimizer.py copies the param's
+            # sharding_spec onto same-shaped accumulators, and an
+            # annotation-only placement would drop the ZeRO dim-0/dp
+            # component a dp_shard_update accumulator needs (caught by
+            # the r19 planner sweep: tp-annotated transformer + Adam +
+            # sharded update crashed the per-shard optimizer math on a
+            # tp-only moment slice).
             return self.mesh.sharding(*spec)
-        if (getattr(program, "_dp_comm_applied", False)
-                or getattr(program, "_pp_applied", False)):
+        if manual:
             # manual (explicit-comm and/or pipeline) modes: placement
             # follows the rewrite passes' markers — tp_shard_pass marks
             # tensor-parallel state with `tp_spec` (lives split over tp);
@@ -296,6 +309,76 @@ class ParallelExecutor(Executor):
             self._tp_cache[key] = rewritten
         return rewritten
 
+    def _maybe_auto_plan(self, program: Program):
+        """BuildStrategy.auto_parallel: run the cost-model-guided planner
+        (framework/auto_parallel.py) once per (program version, device
+        count, batch) and ADOPT its choice — the chosen BuildStrategy
+        knobs and the chosen mesh factorization over this executor's own
+        devices. Planning always starts from the USER's base strategy
+        (knobs that change numerics — quant_comm, error feedback — are
+        pinned to it), so repeated prepares converge instead of
+        compounding. Kill switch PTPU_AUTO_PARALLEL=0 (in the compile
+        cache key) reverts to the user's own strategy/mesh, so a runtime
+        flip recompiles the un-planned configuration."""
+        from ..core import flags
+        if not getattr(self.build_strategy, "auto_parallel", False):
+            return
+        if getattr(self, "_auto_plan_suspended", False):
+            # replan_on_restore prices the KEPT side through
+            # prepare_program; planning here would adopt mid-pricing
+            return
+        if not flags.get_flag("auto_parallel"):
+            orig = getattr(self, "_auto_orig", None)
+            if orig is not None and getattr(self, "_auto_adopted", False):
+                self.build_strategy, self.mesh = orig
+                self._dp = self.mesh.axis_size(DATA_AXIS)
+                self._auto_adopted = False
+                # forget the plan: flipping the switch back on must
+                # RE-plan and re-adopt, and auto_plan_report() must not
+                # keep describing a strategy that is no longer executing
+                self._auto_plan = None
+                self._auto_plan_keys = set()
+            return
+        if (getattr(program, "_dp_comm_applied", False)
+                or getattr(program, "_pp_applied", False)
+                or getattr(program, "_memory_plan_applied", False)):
+            return   # already-rewritten view: the decision was made
+        batch = max((s[0] for s in (self._feed_shapes or {}).values()
+                     if len(s) >= 1), default=8)
+        key = (id(program), program._version, self.mesh.num_devices,
+               int(batch))
+        done = getattr(self, "_auto_plan_keys", None)
+        if done is None:
+            done = self._auto_plan_keys = set()
+        # batch None = an elastic-restore decision covering ANY batch
+        # (auto_parallel.replan_on_restore priced it against the
+        # one-time reshard cost; re-planning here would override it
+        # without that price)
+        if key in done or key[:3] + (None,) in done:
+            return
+        from ..framework import auto_parallel as _auto
+        if not hasattr(self, "_auto_orig"):
+            self._auto_orig = (self.build_strategy, self.mesh)
+        base = self._auto_orig[0]
+        result = _auto.plan(
+            program, self.mesh.num_devices, nominal_batch=int(batch),
+            strategy_base=base,
+            space=_auto.numerics_preserving_space(base))
+        done.add(key)
+        self._auto_plan = result
+        self.build_strategy = result.strategy
+        if dict(result.mesh_axes) != dict(self.mesh.axes):
+            devices = list(self.mesh.jax_mesh.devices.flat)
+            self.mesh = DeviceMesh(devices, result.mesh_axes)
+        self._dp = self.mesh.axis_size(DATA_AXIS)
+        self._auto_adopted = True
+
+    def auto_plan_report(self):
+        """The adopted PlanResult of the auto-parallel planner — None
+        until a prepare ran with BuildStrategy.auto_parallel=True (and
+        the PTPU_AUTO_PARALLEL kill switch up)."""
+        return getattr(self, "_auto_plan", None)
+
     def _prepare_program(self, program: Program, scope: Scope) -> Program:
         """BuildStrategy-driven program rewrite, four ordered passes, each
         cached per (program, version, resolved config) and idempotent (the
@@ -318,7 +401,12 @@ class ParallelExecutor(Executor):
            REWRITTEN — scheduling/coloring/remat decisions are made
            against the ops the step actually runs, and the sanitized
            apply re-verifies the colored program with the r13
-           buffer-reuse detectors."""
+           buffer-reuse detectors.
+
+        Step 0, before any of them: the auto-parallel planner
+        (BuildStrategy.auto_parallel) may first REPLACE the strategy and
+        mesh this executor rewrites FOR (framework/auto_parallel.py)."""
+        self._maybe_auto_plan(program)
         return self._apply_memory_plan(
             self._prepare_parallel(program, scope))
 

@@ -125,6 +125,22 @@ class BuildStrategy:
     # with M) or '1f1b' (warmup / 1-forward-1-backward steady state /
     # drain — stash bounded at <= K in-flight microbatches; the default).
     pipeline_schedule: str = "1f1b"
+    # --- auto-parallel planner (framework/auto_parallel.py) --------------
+    # Let the framework CHOOSE the parallelism: on first prepare the
+    # executor runs the cost-model-guided search over the dp x pp x tp
+    # strategy space (mesh factorization, reduce mode, pipeline
+    # schedule/microbatches, comm buckets, memory plan) and adopts the
+    # chosen knobs + mesh. The fields above then serve as the BASE the
+    # planner overwrites; knobs that change training numerics
+    # (quant_comm, comm_error_feedback) are never flipped implicitly —
+    # they stay exactly as set here (auto_parallel.
+    # numerics_preserving_space). On elastic restore to a CHANGED world
+    # size the planner re-plans and adopts the re-plan only when its
+    # predicted step time beats keeping the restored strategy
+    # (parallel/elastic.py restore_train_state). Runtime kill switch:
+    # PTPU_AUTO_PARALLEL=0 (in the executor's compile cache key) runs
+    # the strategy/mesh exactly as constructed.
+    auto_parallel: bool = False
 
 
 @dataclass

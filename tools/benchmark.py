@@ -423,6 +423,17 @@ def main():
                         "framework/sharding.py tp_shard_pass rewrite). "
                         "Composes with --pipeline_stages on a "
                         "dp x pp x tp mesh")
+    p.add_argument("--auto", action="store_true",
+                   help="let the auto-parallel planner "
+                        "(framework/auto_parallel.py) choose the whole "
+                        "strategy — mesh factorization over ALL visible "
+                        "devices, reduce mode, quantized wire, buckets, "
+                        "pipeline schedule/microbatches, memory plan — "
+                        "instead of the flags below; forces "
+                        "--update_method collective and emits "
+                        "plan_predicted_ms / plan_rank / plan_search_s "
+                        "columns. Mutually exclusive with --reduce_mode/"
+                        "--pipeline_stages/--tp")
     p.add_argument("--no_census", action="store_true",
                    help="skip the HLO comm census fields (saves one AOT "
                         "compile on big models)")
@@ -444,6 +455,12 @@ def main():
         p.error("--iters must be >= 1")
     if args.warmup < 0:
         p.error("--warmup must be >= 0")
+    if args.auto:
+        if (args.reduce_mode != "allreduce" or args.pipeline_stages
+                or args.tp or args.update_method == "multiproc"):
+            p.error("--auto owns the strategy; do not combine it with "
+                    "--reduce_mode/--pipeline_stages/--tp/multiproc")
+        args.update_method = "collective"
 
     if args.update_method == "multiproc":
         _drive_multiproc(args)
@@ -474,7 +491,34 @@ def main():
 
     exe = pt.Executor()
     exe.run(pt.default_startup_program())
-    if args.update_method == "collective":
+    plan_fields = {}
+    if args.auto:
+        # planner-chosen strategy over every visible device: annotate tp
+        # first (transformer-family models pick up the Megatron recipe;
+        # models nothing matches keep tp pruned with a named reason),
+        # then search from the default BuildStrategy base
+        from paddle_tpu.framework import auto_parallel as _auto
+        from paddle_tpu.parallel import ParallelExecutor, annotate_tp
+        from paddle_tpu.parallel.mesh import DeviceMesh
+        annotate_tp()
+        plan_res = _auto.plan(pt.default_main_program(),
+                              len(jax.devices()),
+                              nominal_batch=args.batch_size)
+        runner = ParallelExecutor(
+            loss_name=loss.name, build_strategy=plan_res.strategy,
+            mesh=DeviceMesh(jax.devices(), plan_res.mesh_axes))
+        plan_fields = {
+            "auto": True,
+            "plan_point": plan_res.point.describe(),
+            "plan_mesh_axes": dict(plan_res.mesh_axes),
+            "plan_predicted_ms":
+                round(plan_res.predicted_step_s * 1e3, 6),
+            "plan_rank": plan_res.rank_of(plan_res.point),
+            "plan_search_s": round(plan_res.search_s, 3),
+            "plan_n_feasible": plan_res.n_feasible,
+            "plan_rejections": dict(plan_res.rejections),
+        }
+    elif args.update_method == "collective":
         from paddle_tpu.parallel import ParallelExecutor
         from paddle_tpu.parallel.strategy import (BuildStrategy,
                                                   ReduceStrategy)
@@ -567,13 +611,23 @@ def main():
         # (the census == analytic balance is asserted exactly in
         # tests/test_zero_comm.py)
         from paddle_tpu.parallel import grad_comm as _gc
+        from paddle_tpu.parallel.strategy import ReduceStrategy as _RS
         prog, scope = pt.default_main_program(), pt.global_scope()
         dp = runner._dp
         rewritten = runner._prepare_program(prog, scope)
+        # same model selection as costs.predict: the SPMD ZeRO-1 mode
+        # costs the sharded-update param all-gather on top of the grad
+        # all-reduce (census-measured) — an allreduce-priced fallback
+        # would under-report the --auto rows whenever the planner picks
+        # reduce mode
+        spmd_model = (_gc.spmd_zero1_wire_bytes
+                      if runner.build_strategy.reduce_strategy == _RS.Reduce
+                      else _gc.spmd_allreduce_wire_bytes)
         analytic = (_gc.analytic_wire_bytes(rewritten, dp)
-                    or _gc.spmd_allreduce_wire_bytes(prog, dp))
+                    or spmd_model(prog, dp))
         comm_fields = {
-            "reduce_mode": args.reduce_mode,
+            "reduce_mode": (plan_fields["plan_point"] if args.auto
+                            else args.reduce_mode),
             "total_devices": runner.device_count,
             "grad_bytes_on_wire": analytic["grad_wire_bytes"],
             "param_allgather_bytes_on_wire":
@@ -722,6 +776,7 @@ def main():
         "device": jax.devices()[0].platform,
         **mem_fields,
         **comm_fields,
+        **plan_fields,
     }))
 
 

@@ -134,7 +134,46 @@ def _config_desc(args):
         cfg["num_microbatches"] = args.num_microbatches
     if args.memory_plan:
         cfg["memory_plan"] = True
+    if args.strategy:
+        cfg["strategy"] = args.strategy
     return cfg
+
+
+_STRATEGY_KEYS = ("dp", "pp", "tp", "microbatches", "schedule", "reduce",
+                  "quant", "bucket_bytes", "memory_plan")
+
+
+def _parse_strategy(text):
+    """--strategy JSON -> a StrategyPoint (auto_parallel's point type).
+    Unknown keys raise with the accepted key list."""
+    from paddle_tpu.framework.auto_parallel import StrategyPoint
+    cfg = json.loads(text)
+    bad = sorted(set(cfg) - set(_STRATEGY_KEYS))
+    if bad:
+        raise SystemExit(
+            f"--strategy: unknown key(s) {bad}; accepted keys are "
+            f"{list(_STRATEGY_KEYS)}")
+    return StrategyPoint(**cfg)
+
+
+def _apply_strategy(prog, point, args):
+    """--strategy: the SAME compile-free feasibility check the
+    auto-parallel planner prunes with (costs.strategy_is_feasible) over
+    a user-supplied joint config — named rejection reasons statically
+    instead of executor enforce raises at run time. Returns
+    (program-as-the-executor-would-run-it, feasibility dict,
+    gate_reason)."""
+    from paddle_tpu.framework import costs as _costs
+    feas = _costs.strategy_is_feasible(
+        prog, point.to_build_strategy(), mesh_axes=point.mesh_axes(),
+        nominal_batch=args.batch_size)
+    record = {"point": point.describe(), "ok": feas.ok,
+              "reasons": feas.reasons}
+    if not feas.ok:
+        gate = "; ".join(f"[{r['code']}] {r['message']}"
+                         for r in feas.reasons)
+        return prog, record, f"strategy infeasible: {gate}"
+    return feas.program, record, None
 
 
 def _apply_config(prog, name, args):
@@ -296,8 +335,20 @@ def lint_one(name, build, args):
               "gate_rejected": None, "errors": 0, "warnings": 0,
               "diagnostics": []}
 
-    if loss is None and (args.tp >= 2 or args.dp >= 2
-                         or args.pipeline_stages >= 2):
+    strat_cfg = None
+    if args.strategy:
+        point = _parse_strategy(args.strategy)
+        if loss is None and (point.dp > 1 or point.pp > 1 or point.tp > 1
+                             or point.explicit or point.memory_plan):
+            report["gate_rejected"] = (
+                "inference/serving programs lint in the plain config "
+                "only (no backward region to rewrite)")
+        else:
+            prog, strat_cfg, gate = _apply_strategy(prog, point, args)
+            report["strategy_feasible"] = strat_cfg
+            report["gate_rejected"] = gate
+    elif loss is None and (args.tp >= 2 or args.dp >= 2
+                           or args.pipeline_stages >= 2):
         report["gate_rejected"] = (
             "inference/serving programs lint in the plain config only "
             "(no backward region to rewrite)")
@@ -364,6 +415,9 @@ def lint_one(name, build, args):
     print(f"\n== {name} ==")
     print(f"  ops={n_ops} blocks={len(prog.blocks)} "
           f"build={build_s:.2f}s analyze={analyze_s:.2f}s")
+    if strat_cfg is not None:
+        print(f"  strategy: {strat_cfg['point']} FEASIBLE "
+              f"(linting the program as the executor would run it)")
     print(f"  inference: {res.n_inferred}/{res.n_ops} ops inferred, "
           f"{res.n_skipped} skipped (waived/unknown inputs)")
     if shard_res is not None:
@@ -486,6 +540,19 @@ def main():
                         "transformer_lm_tp) and lint the spliced program; "
                         "the propagated sharding-spec table prints per "
                         "sharded var")
+    p.add_argument("--strategy", default="",
+                   help="JSON joint-strategy config, e.g. "
+                        "'{\"dp\": 2, \"pp\": 2, \"microbatches\": 4, "
+                        "\"reduce\": \"reduce_scatter\"}' (keys: dp, pp, "
+                        "tp, microbatches, schedule, reduce, quant, "
+                        "bucket_bytes, memory_plan): run the SAME "
+                        "compile-free feasibility check the auto-parallel "
+                        "planner prunes with (costs.strategy_is_feasible) "
+                        "and lint the rewritten program when feasible; an "
+                        "infeasible config reports its NAMED rejection "
+                        "reasons and exits 2 (the gate-reject contract). "
+                        "Mutually exclusive with --dp/--tp/"
+                        "--pipeline_stages/--memory_plan")
     p.add_argument("--restore_dir", default="",
                    help="elastic snapshot dir (or root of snapshot-* "
                         "dirs, parallel/elastic.py): statically verify "
@@ -497,6 +564,12 @@ def main():
     p.add_argument("--max_shard_rows", type=int, default=24)
     p.add_argument("--max_diags", type=int, default=40)
     args = p.parse_args()
+    if args.strategy and (args.dp >= 2 or args.tp >= 2
+                          or args.pipeline_stages >= 2
+                          or args.memory_plan):
+        p.error("--strategy carries the whole joint config; do not "
+                "combine it with --dp/--tp/--pipeline_stages/"
+                "--memory_plan")
 
     names = sorted(builders) if args.all else [args.model]
     reports = [lint_one(name, builders[name], args) for name in names]

@@ -46,6 +46,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.transpiler",
     "paddle_tpu.distributed",
     "paddle_tpu.framework.analysis",
+    "paddle_tpu.framework.auto_parallel",
     "paddle_tpu.framework.costs",
     "paddle_tpu.framework.dataflow",
     "paddle_tpu.framework.memory_plan",

@@ -523,6 +523,79 @@ print("memory-plan smoke OK:", json.dumps({
     "reduction": round(1 - p_plan / p_base, 4)}))
 PY
 
+echo "== auto-parallel smoke (planner choice: feasible + lint-clean + exact wire) =="
+# the r19 auto-parallel planner end to end (docs/auto_parallel.md): plan
+# mnist over a 4-device mesh; the chosen strategy must (1) be in the
+# feasible set per the SAME compile-free gates the executor raises
+# (costs.strategy_is_feasible), (2) leave the rewritten program
+# analyzer-clean, and (3) balance its predicted per-step wire bytes
+# against the executed HLO census EXACTLY (the r12 ledger discipline on
+# a strategy the framework picked for itself). Then the lint surface:
+# a feasible --strategy lints clean, an infeasible one exits 2 naming
+# the reason.
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+python - <<'PY'
+import numpy as np, jax
+import jax.numpy as jnp
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework import analysis, auto_parallel, costs
+from paddle_tpu.observability.ledger import CostLedger
+from paddle_tpu.parallel import ParallelExecutor
+from paddle_tpu.parallel.mesh import DeviceMesh
+
+pt.reset_default_programs(); pt.reset_global_scope()
+with pt.core.unique_name.guard():
+    x = layers.data("x", shape=[64])
+    label = layers.data("label", shape=[1], dtype="int64")
+    h = layers.fc(x, size=128, act="relu")
+    loss = layers.mean(layers.softmax_with_cross_entropy(
+        layers.fc(h, size=10), label))
+    pt.optimizer.MomentumOptimizer(0.1, momentum=0.9).minimize(loss)
+prog = pt.default_main_program()
+result = auto_parallel.plan(prog, 4, nominal_batch=16)
+feas = costs.strategy_is_feasible(prog, result.strategy,
+                                  mesh_axes=result.mesh_axes,
+                                  nominal_batch=16)
+assert feas.ok, feas.reasons                       # (1) feasible
+errs = [d for d in analysis.verify_program(feas.program)
+        if d.severity == "error"]
+assert not errs, errs                              # (2) lint-clean
+
+exe = ParallelExecutor(loss_name=loss.name, build_strategy=result.strategy,
+                       mesh=DeviceMesh(jax.devices()[:4],
+                                       result.mesh_axes))
+pt.Executor().run(pt.default_startup_program())
+rng = np.random.RandomState(0)
+feed = {"x": rng.rand(16, 64).astype("float32"),
+        "label": rng.randint(0, 10, (16, 1)).astype("int64")}
+l0 = float(exe.run(feed=feed, fetch_list=[loss])[0])
+l1 = float(exe.run(feed=feed, fetch_list=[loss])[0])
+assert l1 < l0, (l0, l1)                           # it actually trains
+cs = list(exe._cache.values())[-1]
+scope = pt.global_scope()
+hlo = cs.fn.lower(tuple(jnp.asarray(feed[n]) for n in cs.feed_names),
+                  tuple(scope.get(n) for n in cs.ro_names),
+                  tuple(scope.get(n) for n in cs.rw_names),
+                  np.uint32(0)).compile().as_text()
+row = CostLedger("ci").row("auto_parallel_choice")
+row.set_prediction(exe.cost_report(nominal_batch=16))
+row.set_census(costs.collective_census(hlo),
+               exe.mesh.axis_size("dp"), min_bytes=8)
+chk = row.check_wire_bytes_exact()
+assert chk["ok"], chk                              # (3) exact balance
+import json
+print("auto-parallel smoke OK:", json.dumps({
+    "chosen": result.point.describe(),
+    "predicted_wire": chk["predicted"], "census": chk["measured"]}))
+PY
+JAX_PLATFORMS=cpu python tools/lint_program.py --model mnist \
+    --strategy '{"dp": 2, "pp": 2, "microbatches": 4, "reduce": "reduce_scatter"}'
+if JAX_PLATFORMS=cpu python tools/lint_program.py --model mnist \
+    --strategy '{"dp": 2, "tp": 2, "reduce": "reduce_scatter"}'; then
+    echo "lint accepted an INFEASIBLE strategy"; exit 1
+fi
+
 echo "== flight-recorder smoke (SIGKILL mid-barrier -> dossier + post-mortem) =="
 # the distributed flight recorder end to end (observability/
 # flight_recorder.py, docs/fault_tolerance.md): a 4-rank world-atomic

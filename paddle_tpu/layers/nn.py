@@ -946,6 +946,27 @@ def cache_write(cache, new, pos, axis, batch_axis=None, out=None, name=None):
     return out
 
 
+def paged_cache_write(pool, new, block_ids, offsets, out=None, name=None):
+    """Scatter one new KV row per tick slot into the paged block pool —
+    the block-granular counterpart of `cache_write` (serving/kv_pager.py).
+    `pool` is [n_blocks, nh, block_size, dh]; `new` is [S, nh, dh];
+    `block_ids`/`offsets` give each slot's physical target
+    (pool[block_ids[s], :, offsets[s], :]). Pass the pool variable as
+    `out` to round-trip the persistable pool through the executor's
+    donated-state path, same as `cache_write(out=...)`."""
+    helper = LayerHelper("paged_cache_write", name=name)
+    if out is None:
+        out = helper.create_tmp_variable(dtype=dtype_name(pool.dtype),
+                                         shape=pool.shape,
+                                         stop_gradient=True)
+    helper.append_op(type="paged_cache_write",
+                     inputs={"Cache": [pool], "New": [new],
+                             "BlockIds": [block_ids],
+                             "Offsets": [offsets]},
+                     outputs={"Out": [out]})
+    return out
+
+
 def lrn(input, n=5, k=2.0, alpha=1e-4, beta=0.75, name=None):
     helper = LayerHelper("lrn", name=name)
     out = helper.create_tmp_variable(dtype=dtype_name(input.dtype),

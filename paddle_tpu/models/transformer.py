@@ -580,6 +580,114 @@ def transformer_lm_decode_tick(n_slots, vocab=32000, max_len=64,
     return next_ids, cache_names
 
 
+def transformer_lm_paged_decode_tick(n_slots, n_blocks, block_size,
+                                     blocks_per_req, vocab=32000,
+                                     d_model=512, d_inner=2048, num_heads=8,
+                                     num_layers=6, dropout=0.0, packed=False,
+                                     cache_prefix="pgd", topk_k=0):
+    """ONE decode tick over a PAGED KV cache — the block-table read/write
+    variant of `transformer_lm_decode_tick` (serving/kv_pager.py).
+
+    The slot tick owns a full [S,1,nh,max_len,dh] row per slot; here the
+    KV state is one device-resident POOL per layer per k/v —
+    [n_blocks, nh, block_size, dh] persistable variables — and each slot
+    sees the cache through its BLOCK TABLE (`tick_btab` [S, NLB] int64,
+    NLB = blocks_per_req): logical block j of slot s lives in physical
+    block tick_btab[s, j]. The read path is gather(pool, btab) →
+    transpose → reshape, reconstructing the exact [S,1,nh,T,dh] view the
+    slot tick attends over (T = NLB*block_size), so the downstream
+    q·K/softmax/·V chain is IDENTICAL and fuse_decode_attention_pass
+    matches it unchanged. The write path is `paged_cache_write`: slot
+    s's new k/v row lands at pool[tick_wblock[s], :, tick_woff[s], :] —
+    block-granular, one XLA scatter.
+
+    Physical block 0 is the pool's reserved NULL block: idle slots are
+    steered to write there (tok/pos zeroed, btab all-zero) so one
+    fixed-shape compiled tick serves any live/idle mix; a live block
+    table never maps block 0, and the positional mask hides every view
+    position beyond a slot's own `tick_pos`, so null-block garbage is
+    never attended. Prefix sharing needs no graph support at all: a
+    shared prefix simply means two rows of `tick_btab` carry the SAME
+    physical block id — the gather reads the same bytes twice.
+
+    Weights are shared BY NAME with transformer_lm (tok_emb, l{i}_attn_*,
+    l{i}_ln*, l{i}_ffn_*, lm_head) — same contract as the slot tick;
+    pass the SAME dropout/packed the train graph used.
+
+    Inputs (fed per tick): `tick_tok` [S,1] int64, `tick_pos` [S,1,1]
+    float32 (the LOGICAL position being written), `tick_btab` [S,NLB]
+    int64, `tick_wblock` [S] int64, `tick_woff` [S] int64.
+
+    Returns (next_ids [S,1] int64, cache_names); with topk_k > 0 also
+    the per-slot top-k of the tick's log-probs — (topk_logp [S,1,k],
+    topk_ids [S,1,k]) — the host-side scoring surface `paged_beam_search`
+    ranks hypotheses with."""
+    S, NB, BS, NLB = n_slots, n_blocks, block_size, blocks_per_req
+    T = NLB * BS                      # the per-request logical span
+    d_head = d_model // num_heads
+    tok = layers.data(name="tick_tok", shape=[S, 1], dtype="int64",
+                      append_batch_size=False)
+    pos = layers.data(name="tick_pos", shape=[S, 1, 1], dtype="float32",
+                      append_batch_size=False)
+    btab = layers.data(name="tick_btab", shape=[S, NLB], dtype="int64",
+                       append_batch_size=False)
+    wblock = layers.data(name="tick_wblock", shape=[S], dtype="int64",
+                         append_batch_size=False)
+    woff = layers.data(name="tick_woff", shape=[S], dtype="int64",
+                       append_batch_size=False)
+    attn_dropout = 0.0 if packed else dropout
+
+    pools = {}
+    for i in range(num_layers):
+        for s in ("k", "v"):
+            pools[f"{s}{i}"] = _slot_cache_var(
+                f"{cache_prefix}_{s}{i}", [NB, num_heads, BS, d_head])
+
+    pe_table = positional_encoding_table(T, d_model).astype("float32")
+    arange = np.arange(T, dtype="float32").reshape(1, 1, T)
+    x = _gen_embed_step(tok, pos, "tok_emb", vocab, d_model, pe_table,
+                        dropout)
+    bias = _step_mask_bias(pos, arange)       # per-slot: pos broadcasts
+    H = d_model
+    for i in range(num_layers):
+        q = layers.fc(x, size=H, num_flatten_dims=2, bias_attr=False,
+                      use_bf16=True, name=f"l{i}_attn_q")
+        kn = layers.fc(x, size=H, num_flatten_dims=2, bias_attr=False,
+                       use_bf16=True, name=f"l{i}_attn_k")
+        vn = layers.fc(x, size=H, num_flatten_dims=2, bias_attr=False,
+                       use_bf16=True, name=f"l{i}_attn_v")
+        views = []
+        for sname, new in (("k", kn), ("v", vn)):
+            pool = pools[f"{sname}{i}"]
+            # write this tick's row into each slot's current block (the
+            # pool var round-trips through donated state, as in the
+            # slot tick), THEN read the table view from the written pool
+            # so the new row is attendable within the same tick
+            written = layers.paged_cache_write(
+                pool, layers.reshape(new, shape=[0, num_heads, d_head]),
+                wblock, woff, out=pool)
+            g = layers.gather(written, btab)     # [S,NLB,nh,BS,dh]
+            g = layers.transpose(g, perm=[0, 2, 1, 3, 4])
+            g = layers.reshape(g, shape=[0, num_heads, T, d_head])
+            views.append(layers.unsqueeze(g, axes=[1]))  # [S,1,nh,T,dh]
+        ctx = _attend_cached(q, views[0], views[1], bias, 1, num_heads,
+                             d_head, attn_dropout)
+        attn = layers.fc(ctx, size=H, num_flatten_dims=2, bias_attr=False,
+                         use_bf16=True, name=f"l{i}_attn_o")
+        x = _add_norm(attn, x, dropout, True, name=f"l{i}_ln1")
+        f = ffn(x, d_model, d_inner, dropout, True, name=f"l{i}_ffn")
+        x = _add_norm(f, x, dropout, True, name=f"l{i}_ln2")
+    logits = layers.fc(x, size=vocab, num_flatten_dims=2, use_bf16=True,
+                       name="lm_head")
+    next_ids = layers.argmax(logits, axis=2)            # [S,1] int64
+    cache_names = [v.name for v in pools.values()]
+    if topk_k:
+        logp = layers.log_softmax(logits)
+        topk_vals, topk_ids = layers.topk(logp, k=topk_k)
+        return next_ids, cache_names, topk_vals, topk_ids
+    return next_ids, cache_names
+
+
 def transformer_lm(tokens=None, label=None, vocab=32000, max_len=128,
                    d_model=512, d_inner=2048, num_heads=8, num_layers=6,
                    dropout=0.0, is_test=False, packed=False,

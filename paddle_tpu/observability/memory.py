@@ -51,7 +51,8 @@ from ..core.enforce import InvalidArgumentError, enforce
 #: the watermark channels (fixed set: a typo'd channel raises instead of
 #: minting a gauge no scrape ever finds)
 CHANNELS = ("device_state_bytes", "executor_temp_bytes",
-            "kv_cache_bytes", "host_staging_bytes")
+            "kv_cache_bytes", "kv_cache_used_bytes",
+            "host_staging_bytes")
 
 _lock = threading.Lock()
 _marks: Dict[str, Dict[str, float]] = {

@@ -213,6 +213,35 @@ def _cache_write(ctx, ins, attrs):
     return {"Out": [out]}
 
 
+@register_op("paged_cache_write", stop_gradient=True)
+def _paged_cache_write(ctx, ins, attrs):
+    """Block-granular KV write for the paged cache (serving/kv_pager.py):
+    scatter one new token row per slot into a device-resident block POOL
+    instead of a per-slot cache row. `Cache` is the pool
+    [n_blocks, nh, block_size, dh]; `New` is [S, nh, dh] (one row per
+    tick slot); `BlockIds`/`Offsets` are [S] — slot s lands at
+    pool[BlockIds[s], :, Offsets[s], :]. Inactive slots are steered at
+    the reserved null block 0 (never mapped by a live block table), so
+    one fixed-shape compiled tick serves any mix of live/idle slots —
+    the same trick the slot tick plays with its zeroed feeds. Duplicate
+    (block, offset) targets are only ever the null block, where any
+    write order is acceptable. Lowers to one XLA scatter; inside the
+    executor's donated-state path the pool updates in place."""
+    pool = ins["Cache"][0]
+    new = ins["New"][0].astype(pool.dtype)
+    blocks = ins["BlockIds"][0].reshape(-1).astype(jnp.int32)
+    offs = ins["Offsets"][0].reshape(-1).astype(jnp.int32)
+    if new.ndim != pool.ndim - 1:
+        raise ValueError(
+            f"paged_cache_write: New must drop exactly the pool's "
+            f"block-size axis (pool {pool.shape}, New {new.shape})")
+    if blocks.shape != offs.shape:
+        raise ValueError(
+            f"paged_cache_write: BlockIds {blocks.shape} and Offsets "
+            f"{offs.shape} must agree")
+    return {"Out": [pool.at[blocks, :, offs, :].set(new)]}
+
+
 @register_op("one_hot", stop_gradient=True)
 def _one_hot(ctx, ins, attrs):
     x = ins["X"][0]

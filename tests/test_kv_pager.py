@@ -1,0 +1,308 @@
+"""Paged KV-cache subsystem: block pool + radix prefix index + paged
+engine (ISSUE r20 tentpole).
+
+Covers the paging contract end to end:
+- BlockPool refcount/free-list invariants (null block reserved, alloc
+  exhaustion, share/release, `check()` exactness);
+- RadixPrefixIndex register/match/LRU-evict semantics incl. the
+  missing-ancestor no-op and index-owned refs;
+- `paged_cache_write` op parity against a per-row numpy reference;
+- greedy decode identity: paged engine token-identical to the slot
+  engine AND to a paged engine with prefix sharing disabled — shared
+  prefixes change WHERE the KV bytes live, never the tokens;
+- prefix-cache hits on a second wave over a warm index;
+- CoW at the divergence block, pinned by a mutation test (writing the
+  fork's copy must not alter the parent's physical block);
+- beam search over forked tables: shared-vs-unshared identity;
+- leak-free release/evict/reuse: after run_until_idle the only live
+  blocks are the index's cached prefixes, and evict_all returns the
+  pool to empty — twice;
+- pool-capacity admission keeps requests PENDING (head-of-line) until
+  blocks free, while submission-side limits raise with the block-table
+  span named;
+- census/watermark reconciliation: kv_cache category == pool bytes,
+  used watermark == used blocks x per-block bytes;
+- the paged tick compiles through the r06 fused decode path.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core.enforce import InvalidArgumentError
+from paddle_tpu.serving import (BlockPool, ContinuousBatchingEngine,
+                                KVPager, PagedKVEngine,
+                                RadixPrefixIndex, paged_beam_search)
+
+pytestmark = pytest.mark.quick
+
+_DIMS = dict(vocab=50, max_len=16, d_model=32, d_inner=64, num_heads=4,
+             num_layers=2)
+_PREFIX = [2, 7, 1, 9, 4, 8, 5, 6]          # two full 4-token blocks
+
+
+@pytest.fixture(scope="module")
+def engines():
+    """slot + paged + paged-without-sharing on ONE scope (same weights:
+    identity tests compare token streams across all three)."""
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    scope = pt.global_scope()
+    slot = ContinuousBatchingEngine(n_slots=3, scope=scope, **_DIMS)
+    paged = PagedKVEngine(n_slots=3, block_size=4, topk_k=3,
+                          scope=scope, **_DIMS)
+    unshared = PagedKVEngine(n_slots=3, block_size=4, topk_k=3,
+                             prefix_sharing=False, scope=scope, **_DIMS)
+    return slot, paged, unshared
+
+
+def _gen(eng, prompts, max_new=6):
+    reqs = [eng.submit(list(p), max_new=max_new) for p in prompts]
+    eng.run_until_idle()
+    assert all(r.done for r in reqs)
+    return [list(r.tokens) for r in reqs]
+
+
+class TestBlockPool:
+    def test_refcount_free_list_invariants(self):
+        p = BlockPool(5, 2)                  # 4 data blocks + null
+        bs = [p.alloc() for _ in range(4)]
+        assert 0 not in bs and None not in bs
+        assert p.alloc() is None             # exhausted
+        assert p.n_used == 4 and p.n_free == 0
+        p.share(bs[0])
+        assert p.refcount(bs[0]) == 2
+        assert p.release(bs[0]) is False     # still held
+        assert p.release(bs[0]) is True      # now freed
+        for b in bs[1:]:
+            assert p.release(b) is True
+        p.check()
+        assert p.n_used == 0 and p.n_free == 4
+        b = p.alloc()                        # freed blocks are reusable
+        assert b in bs
+        p.release(b)
+
+    def test_null_block_protected(self):
+        p = BlockPool(3, 2)
+        with pytest.raises(InvalidArgumentError):
+            p.release(0)
+        with pytest.raises(InvalidArgumentError):
+            p.share(0)
+        b = p.alloc()
+        p.release(b)
+        with pytest.raises(InvalidArgumentError):
+            p.release(b)                     # double free
+
+
+class TestRadixPrefixIndex:
+    def test_register_match_evict(self):
+        pool = BlockPool(10, 4)
+        idx = RadixPrefixIndex(4)
+        prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+        assert idx.match(prompt) == []
+        b0, b1 = pool.alloc(), pool.alloc()
+        assert idx.register(prompt, 0, b0, pool)
+        assert idx.register(prompt, 1, b1, pool)
+        m = idx.match(prompt + [9, 9])       # longer prompt, same lead
+        assert [n.block for n in m] == [b0, b1]
+        assert idx.match([1, 2, 3, 4, 0, 0, 0, 0]) \
+            and idx.match([1, 2, 3, 4, 0, 0, 0, 0])[0].block == b0
+        # drop the caller's refs: the index's own refs keep them live
+        pool.release(b0)
+        pool.release(b1)
+        assert pool.n_used == 2
+        assert idx.evict_one(pool)           # LRU leaf first: b1
+        assert pool.n_used == 1 and pool.refcount(b0) == 1
+        assert idx.evict_all(pool) == 1
+        assert pool.n_used == 0
+        pool.check()
+
+    def test_missing_ancestor_is_noop(self):
+        pool = BlockPool(10, 4)
+        idx = RadixPrefixIndex(4)
+        b = pool.alloc()
+        assert not idx.register([1, 2, 3, 4, 5, 6, 7, 8], 1, b, pool)
+        assert pool.refcount(b) == 1         # no index ref taken
+        pool.release(b)
+        pool.check()
+
+
+class TestPagedCacheWriteOp:
+    def test_parity_vs_numpy(self, rng):
+        NB, nh, bs, dh = 6, 2, 4, 3
+        pool = rng.randn(NB, nh, bs, dh).astype("float32")
+        new = rng.randn(2, nh, dh).astype("float32")
+        blocks = np.array([2, 5], "int64")
+        offs = np.array([1, 3], "int64")
+        c = layers.data(name="pc", shape=[NB, nh, bs, dh],
+                        dtype="float32", append_batch_size=False)
+        n = layers.data(name="pn", shape=[2, nh, dh], dtype="float32",
+                        append_batch_size=False)
+        b = layers.data(name="pb", shape=[2], dtype="int64",
+                        append_batch_size=False)
+        o = layers.data(name="po", shape=[2], dtype="int64",
+                        append_batch_size=False)
+        out = layers.paged_cache_write(c, n, b, o)
+        got = pt.Executor().run(
+            feed={"pc": pool, "pn": new, "pb": blocks, "po": offs},
+            fetch_list=[out])[0]
+        ref = pool.copy()
+        for i in range(2):
+            ref[blocks[i], :, offs[i], :] = new[i]
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+class TestDecodeIdentity:
+    PROMPTS = [[7, 8, 9], [7, 8, 9], [1, 2, 3, 4, 5, 6],
+               _PREFIX + [3], _PREFIX + [11, 12]]
+
+    def test_paged_matches_slot_engine(self, engines):
+        slot, paged, _ = engines
+        assert _gen(paged, self.PROMPTS) == _gen(slot, self.PROMPTS)
+
+    def test_shared_prefix_wave_token_identical_and_hits(self, engines):
+        _, paged, unshared = engines
+        # wave 1 fills + registers the prefix blocks; wave 2 must HIT
+        wave1 = [_PREFIX + [3]]
+        wave2 = [_PREFIX + [11], _PREFIX + [12, 13], _PREFIX + [3, 14]]
+        _gen(paged, wave1)
+        hits0 = paged.pager.prefix_hits
+        got = _gen(paged, wave2)
+        assert paged.pager.prefix_hits >= hits0 + len(wave2)
+        _gen(unshared, wave1)
+        assert got == _gen(unshared, wave2)
+        assert unshared.pager.prefix_hits == 0
+        paged.pager.pool.check()
+        unshared.pager.pool.check()
+
+
+class TestCopyOnWrite:
+    def test_fork_shares_full_blocks_and_copies_divergence(self,
+                                                           engines):
+        _, _, eng = engines                  # unshared: empty index
+        pager = eng.pager
+        t1 = pager.try_admit(list(range(1, 9)), 12)   # 3 blocks
+        assert t1 is not None and len(t1.blocks) == 3
+        name = eng.cache_names[0]
+        a = np.array(eng.scope.get(name))
+        a[t1.blocks[1]] = 7.0                # sentinel in the partial
+        eng.scope.set_var(name, a)
+        t2 = pager.fork(t1, 6, eng._copy_block)   # 1 full + 2 in part
+        assert t2.blocks[0] == t1.blocks[0]       # full block SHARED
+        assert pager.pool.refcount(t1.blocks[0]) == 2
+        assert t2.blocks[1] != t1.blocks[1]       # divergence COPIED
+        assert t2.blocks[2] != t1.blocks[2]       # unwritten: fresh
+        a = np.array(eng.scope.get(name))
+        np.testing.assert_array_equal(a[t2.blocks[1]],
+                                      a[t1.blocks[1]])
+        # the mutation test: writing the fork's copy must not reach
+        # the parent's physical block (and vice versa)
+        a[t2.blocks[1]] = -3.0
+        eng.scope.set_var(name, a)
+        a = np.array(eng.scope.get(name))
+        assert float(a[t1.blocks[1]].min()) == 7.0
+        assert float(a[t2.blocks[1]].max()) == -3.0
+        pager.release(t1)
+        pager.release(t2)
+        pager.pool.check()
+        assert pager.cow_copies >= 1
+
+
+class TestPagedBeamSearch:
+    def test_shared_vs_unshared_identical(self, engines):
+        _, paged, unshared = engines
+        prompt = list(_PREFIX)
+        a = paged_beam_search(paged, prompt, max_new=5, beam_size=3)
+        b = paged_beam_search(unshared, prompt, max_new=5, beam_size=3)
+        assert a == b
+        assert len(a) == 3 and a[0][1] >= a[-1][1]   # sorted best-first
+        assert paged.pager.cow_copies > 0
+        paged.pager.pool.check()
+        unshared.pager.pool.check()
+
+
+class TestLeakFree:
+    def test_release_evict_reuse_cycles(self, engines):
+        _, paged, _ = engines
+        pager = paged.pager
+        for _ in range(2):
+            _gen(paged, [_PREFIX + [11], _PREFIX + [12, 13],
+                         [9, 9, 9, 9, 9]])
+            pager.pool.check()
+            # idle: the ONLY live blocks are the index's cached
+            # prefixes — every request ref was dropped
+            assert pager.pool.n_used == pager.stats()["blocks_cached"]
+        pager.index.evict_all(pager.pool)
+        assert pager.pool.n_used == 0
+        pager.pool.check()
+        # pool drained to empty is immediately reusable
+        _gen(paged, [_PREFIX + [11]])
+        pager.pool.check()
+
+
+class TestCapacityAdmission:
+    def test_head_of_line_waits_for_blocks(self):
+        eng = PagedKVEngine(n_slots=2, max_len=8, block_size=4,
+                            n_blocks=3, prefix_sharing=False, vocab=50,
+                            d_model=32, d_inner=64, num_heads=4,
+                            num_layers=2)
+        r1 = eng.submit([1, 2, 3, 4], max_new=4)      # pins both blocks
+        r2 = eng.submit([5, 6, 7, 8], max_new=4)
+        eng.step()
+        # a slot is free but the POOL is not: r2 must stay pending
+        assert eng.n_active == 1 and eng.n_pending == 1
+        eng.run_until_idle()
+        assert r1.done and r2.done
+        assert len(r1.tokens) == 4 and len(r2.tokens) == 4
+        eng.pager.pool.check()
+        assert eng.pager.pool.n_used == 0
+
+    def test_submit_error_names_block_table_span(self):
+        eng = PagedKVEngine(n_slots=2, max_len=8, block_size=4,
+                            n_blocks=3, prefix_sharing=False, vocab=50,
+                            d_model=32, d_inner=64, num_heads=4,
+                            num_layers=2)
+        with pytest.raises(InvalidArgumentError,
+                           match="block-table span"):
+            eng.submit(list(range(1, 8)), max_new=4)
+        with pytest.raises(InvalidArgumentError, match="ADMISSION"):
+            eng.submit(list(range(1, 8)), max_new=4)
+
+
+class TestCensusReconciliation:
+    def test_kv_category_and_watermarks_match_pool(self, engines):
+        from paddle_tpu.observability.memory import (state_census,
+                                                     watermark_board)
+        _, paged, _ = engines
+        c = state_census(paged.scope, paged._program, paged.cache_names,
+                         kv_names=paged.cache_names)
+        assert c["categories"]["kv_cache"] == pytest.approx(
+            paged._kv_bytes_static)
+        paged._stamp_kv_watermarks({})
+        board = watermark_board()
+        assert board["kv_cache_bytes"]["current"] == pytest.approx(
+            paged._kv_bytes_static)
+        per_block = paged._kv_bytes_static / paged.n_blocks
+        assert board["kv_cache_used_bytes"]["current"] == pytest.approx(
+            paged.pager.pool.n_used * per_block)
+        # reserved covers used: the paging invariant in byte terms
+        assert (board["kv_cache_used_bytes"]["current"]
+                <= board["kv_cache_bytes"]["current"])
+
+
+class TestFusedDecodeStructure:
+    def test_paged_tick_fuses_attention(self):
+        from paddle_tpu.framework.passes import FuseDecodeAttentionPass
+        from paddle_tpu.models.transformer import \
+            transformer_lm_paged_decode_tick
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            transformer_lm_paged_decode_tick(
+                n_slots=2, n_blocks=5, block_size=4, blocks_per_req=2,
+                vocab=50, d_model=32, d_inner=64, num_heads=4,
+                num_layers=2, cache_prefix="tstpgd")
+        FuseDecodeAttentionPass().apply(main)
+        fused = [op for op in main.blocks[0].ops
+                 if op.type == "fused_decode_attention"]
+        assert len(fused) == 2               # one per layer

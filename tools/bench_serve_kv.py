@@ -1,0 +1,311 @@
+"""Trace-driven serving load harness: slot vs paged KV cache (ISSUE r20).
+
+The capacity claim, measured end to end: at FIXED usable KV pool bytes,
+on a long-tail + shared-prefix trace, the paged engine sustains >= 1.5x
+the slot engine's admitted concurrency (equivalently <= 0.6x KV bytes
+pinned per request) with decode output TOKEN-IDENTICAL per request, and
+the pool accounting reconciles exactly (used + free == usable blocks)
+after every run.
+
+Both sides get the same KV token capacity:
+
+- slot:  n_slots=4 rows x max_len=64         -> 256 reservable tokens
+- paged: 32 data blocks x block_size=8 (+1 null block) -> 256 tokens,
+         but 16 tick slots — a request pins ceil(L/8) blocks instead of
+         a whole 64-token row, and shared prompt prefixes pin their
+         blocks ONCE across the fan-out.
+
+Traces (all committed): Poisson arrivals with a long-tail length mix;
+a BURSTY trace — fan-out groups landing within a short burst window,
+every member sharing one of a few long system prompts (the realistic
+shape for the prefix cache: one agent template, N concurrent calls);
+and a SATURATED trace (everything offered at t=0) that measures the
+pool-limited admitted-concurrency ceiling directly. The engines run
+the identical weights (one shared scope), greedy argmax, so the
+per-request token streams must match bit-exact between engines — the
+harness asserts it (the paged read path is the SAME attention chain
+through a gather, fused by the same pass; tests/test_kv_pager.py pins
+the program structure).
+
+    JAX_PLATFORMS=cpu python tools/bench_serve_kv.py           # full, writes
+                                                  BENCH_SERVE_KV_r20.json
+    JAX_PLATFORMS=cpu python tools/bench_serve_kv.py --smoke   # CI stanza
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_DIMS = dict(vocab=1000, d_model=64, d_inner=128, num_heads=4,
+             num_layers=2)
+_MAX_LEN = 64
+_BLOCK_SIZE = 8
+_SLOT_SLOTS = 4                       # 4 x 64 = 256 reservable tokens
+_PAGED_SLOTS = 16                     # tick width; blocks are the capacity
+_PAGED_BLOCKS = _SLOT_SLOTS * _MAX_LEN // _BLOCK_SIZE + 1   # +1 null
+
+
+def _trace(rng, n_requests, mean_interarrival_s, mode):
+    """[(arrival_offset_s, prompt, max_new)]. Long-tail lengths; ~60%
+    of requests extend one of 3 shared 16-token system prompts.
+    Modes: "poisson" (exponential interarrivals), "bursty" (fan-out
+    groups over one shared prefix, members spread across a short burst
+    window — the prefix cache's target shape), "saturated" (the whole
+    trace offered at t=0 — measures admitted-concurrency CAPACITY:
+    with the backlog never empty, mean admitted concurrency is the
+    engine's pool-limited ceiling, not the offered load)."""
+    vocab = _DIMS["vocab"]
+    prefixes = [rng.randint(0, vocab, 16).tolist() for _ in range(3)]
+    out, t, i = [], 0.0, 0
+    while i < n_requests:
+        if mode == "bursty":
+            t += float(rng.exponential(mean_interarrival_s * 5))
+            fan = int(rng.randint(3, 7))
+            pre = prefixes[rng.randint(len(prefixes))]
+            group = [(pre, True)] * min(fan, n_requests - i)
+        else:
+            if mode == "poisson":
+                t += float(rng.exponential(mean_interarrival_s))
+            shared = bool(rng.rand() < 0.6)
+            pre = prefixes[rng.randint(len(prefixes))] if shared else None
+            group = [(pre, shared)]
+        for j, (pre, shared) in enumerate(group):
+            # burst members land ~20ms apart (a burst window, not one
+            # instant) so the leader's prefill can seed the prefix
+            # cache for its followers
+            t_j = t + j * 0.02 if mode == "bursty" else t
+            if shared:
+                tail = rng.randint(0, vocab,
+                                   int(rng.randint(2, 8))).tolist()
+                prompt = list(pre) + tail
+            else:
+                plen = int(rng.choice([3, 4, 6, 8, 12, 20],
+                                      p=[.2, .25, .2, .15, .1, .1]))
+                prompt = rng.randint(0, vocab, plen).tolist()
+            max_new = int(rng.choice([4, 6, 8, 16, 24],
+                                     p=[.3, .25, .2, .15, .1]))
+            max_new = min(max_new, _MAX_LEN - len(prompt))
+            out.append((t_j, prompt, max_new))
+            i += 1
+    return out, prefixes
+
+
+def _run_trace(kind, trace, prefixes, scope):
+    """Replay one arrival trace (feeder thread, real clock) against a
+    fresh engine; tick-level sampling of admitted concurrency."""
+    from paddle_tpu.serving import ContinuousBatchingEngine, PagedKVEngine
+
+    if kind == "slot":
+        eng = ContinuousBatchingEngine(n_slots=_SLOT_SLOTS,
+                                       max_len=_MAX_LEN, scope=scope,
+                                       **_DIMS)
+    else:
+        eng = PagedKVEngine(n_slots=_PAGED_SLOTS, max_len=_MAX_LEN,
+                            block_size=_BLOCK_SIZE,
+                            n_blocks=_PAGED_BLOCKS, scope=scope, **_DIMS)
+    # warm the compile, and seed the prefix cache with the system
+    # prompts (both engines run the same warm-up for fairness; only
+    # the paged engine's radix index retains anything from it)
+    warm = [eng.submit([1], max_new=1)]
+    warm += [eng.submit(list(p), max_new=1) for p in prefixes]
+    eng.run_until_idle()
+    assert all(r.done for r in warm)
+    eng.n_ticks = eng.busy_slot_ticks = eng.total_slot_ticks = 0
+    eng.tokens_out = 0
+    if kind == "paged":
+        eng.pager.n_admitted = eng.pager.prefix_hits = 0
+        eng.pager.shared_blocks_total = 0
+        eng.pager.blocks_allocated_total = 0
+        eng.pager.evictions = eng.pager.cow_copies = 0
+
+    order = []
+    t0 = time.time()
+
+    def feeder():
+        for off, prompt, max_new in trace:
+            delay = t0 + off - time.time()
+            if delay > 0:
+                time.sleep(delay)
+            order.append(eng.submit(prompt, max_new))
+
+    f = threading.Thread(target=feeder)
+    f.start()
+    done, active_curve, backlog_curve = [], [], []
+    while f.is_alive() or eng.n_active or eng.n_pending:
+        backlogged = eng.n_pending > 0     # admission ceiling binds
+        finished = eng.step()
+        done.extend(finished)
+        n = eng.n_active
+        if n:
+            active_curve.append(n)
+            if backlogged:
+                backlog_curve.append(n)
+        elif not eng.n_pending:
+            time.sleep(0.001)
+    f.join()
+    makespan = time.time() - t0
+    lats = sorted(r.latency_s for r in done)
+
+    def pct(p):
+        return lats[min(int(np.ceil(p * len(lats))) - 1, len(lats) - 1)]
+
+    # KV bytes a request PINS: slot = the whole row, always; paged =
+    # its privately allocated blocks (shared prefix blocks are the
+    # saving — they are pinned once for the whole fan-out)
+    kv_per_tok = eng._kv_bytes_static / (
+        eng.n_slots * eng.max_len if kind == "slot"
+        else eng.n_blocks * _BLOCK_SIZE)
+    if kind == "slot":
+        kv_bytes_per_req = eng.max_len * kv_per_tok
+        pager_stats = None
+        reconciles = True
+    else:
+        s = eng.pager.stats()
+        kv_bytes_per_req = (s["blocks_per_request"] * _BLOCK_SIZE
+                            * kv_per_tok)
+        pager_stats = s
+        eng.pager.pool.check()               # exact: used + free == N-1
+        reconciles = (s["blocks_used"] + s["blocks_free"]
+                      == eng.n_blocks - 1)
+    curve = np.asarray(active_curve, np.float64)
+    ds = max(1, len(curve) // 64)
+    row = {
+        "engine": kind,
+        "n_requests": len(done),
+        "tokens_per_sec": round(sum(len(r.tokens) for r in done)
+                                / makespan, 1),
+        "makespan_s": round(makespan, 3),
+        "p50_latency_ms": round(pct(0.50) * 1e3, 1),
+        "p95_latency_ms": round(pct(0.95) * 1e3, 1),
+        "p99_latency_ms": round(pct(0.99) * 1e3, 1),
+        "admitted_concurrency_mean": round(float(curve.mean()), 2),
+        # mean over only the ticks where requests were WAITING — the
+        # ticks where the admission ceiling (slots / pool blocks)
+        # actually bound; the capacity ratio is computed on this
+        "admitted_concurrency_under_backlog": round(
+            float(np.mean(backlog_curve)), 2) if backlog_curve
+            else round(float(curve.mean()), 2),
+        "backlogged_ticks": len(backlog_curve),
+        "admitted_concurrency_peak": int(curve.max()),
+        "admitted_concurrency_curve": [round(float(x), 1) for x in
+                                       curve[::ds][:64]],
+        "kv_bytes_per_request": round(kv_bytes_per_req, 1),
+        "kv_reserved_bytes": int(eng._kv_bytes_static),
+        "occupancy": round(eng.occupancy(), 3),
+        "census_reconciles": bool(reconciles),
+    }
+    if pager_stats is not None:
+        row["pager"] = pager_stats
+    tokens = [r.tokens for r in order]
+    return row, tokens
+
+
+def bench(n_requests=48, mean_interarrival_s=0.002, smoke=False):
+    import paddle_tpu as pt
+
+    if smoke:
+        n_requests, mean_interarrival_s = 12, 0.001
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    scope = pt.global_scope()          # both engines share one weight set
+    rng = np.random.RandomState(20)
+    runs = {}
+    identical = True
+    for tname, mode in (("poisson_longtail", "poisson"),
+                        ("bursty_shared_prefix", "bursty"),
+                        ("saturated_overload", "saturated")):
+        trace, prefixes = _trace(rng, n_requests, mean_interarrival_s,
+                                 mode)
+        slot_row, slot_tokens = _run_trace("slot", trace, prefixes,
+                                           scope)
+        paged_row, paged_tokens = _run_trace("paged", trace, prefixes,
+                                             scope)
+        identical = identical and (slot_tokens == paged_tokens)
+        conc = (paged_row["admitted_concurrency_under_backlog"]
+                / max(slot_row["admitted_concurrency_under_backlog"],
+                      1e-9))
+        kvb = (paged_row["kv_bytes_per_request"]
+               / max(slot_row["kv_bytes_per_request"], 1e-9))
+        runs[tname] = {
+            "slot": slot_row, "paged": paged_row,
+            "decode_token_identical": bool(slot_tokens == paged_tokens),
+            "paged_over_slot_admitted_concurrency": round(conc, 2),
+            "paged_over_slot_kv_bytes_per_request": round(kvb, 3),
+        }
+    # the concurrency CAPACITY claim is anchored on the saturated
+    # trace — on open-loop traces the paged engine often drains shared
+    # -prefix bursts faster than they queue (prefill skipped), so its
+    # sustained concurrency is bounded by offered load, not capacity
+    cap_conc = runs["saturated_overload"][
+        "paged_over_slot_admitted_concurrency"]
+    worst_kvb = max(r["paged_over_slot_kv_bytes_per_request"]
+                    for r in runs.values())
+    out = {
+        "bench": "serve_kv", "round": 20, "smoke": bool(smoke),
+        "model": dict(_DIMS, max_len=_MAX_LEN),
+        "fixed_pool": {
+            "kv_token_capacity_both": _SLOT_SLOTS * _MAX_LEN,
+            "slot": {"n_slots": _SLOT_SLOTS, "max_len": _MAX_LEN},
+            "paged": {"n_tick_slots": _PAGED_SLOTS,
+                      "block_size": _BLOCK_SIZE,
+                      "n_blocks": _PAGED_BLOCKS,
+                      "note": "n_blocks includes the reserved null "
+                              "block (idle-slot write target); usable "
+                              "data blocks = n_blocks - 1 = the slot "
+                              "engine's exact token capacity"},
+        },
+        "n_requests_per_trace": n_requests,
+        "runs": runs,
+        "claims": {
+            "decode_token_identical_all_traces": bool(identical),
+            "paged_admitted_concurrency_ge_1p5x_at_saturation":
+                bool(cap_conc >= 1.5),
+            "paged_kv_bytes_per_request_le_0p6x_all_traces":
+                bool(worst_kvb <= 0.6),
+            "census_reconciles_used_plus_free_eq_reserved": bool(all(
+                r["paged"]["census_reconciles"] for r in runs.values())),
+        },
+        "notes": "CPU-mesh measured. Admitted concurrency is sampled "
+                 "per executed tick (mean over busy ticks). KV bytes "
+                 "per request = bytes PINNED per admitted request: the "
+                 "slot engine always pins one full max_len row; the "
+                 "paged engine pins its privately allocated blocks "
+                 "(shared prefix blocks pinned once per fan-out are "
+                 "the saving). Token identity is asserted per request "
+                 "across engines on identical weights (greedy argmax, "
+                 "deterministic compute).",
+    }
+    return out
+
+
+def main():
+    smoke = "--smoke" in sys.argv
+    out = bench(smoke=smoke)
+    doc = json.dumps(out, indent=1)
+    print(doc, flush=True)
+    if not smoke:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(repo, "BENCH_SERVE_KV_r20.json"),
+                  "w") as f:
+            f.write(doc + "\n")
+    ok = out["claims"]
+    assert ok["decode_token_identical_all_traces"], \
+        "paged decode diverged from the slot engine"
+    assert ok["census_reconciles_used_plus_free_eq_reserved"], \
+        "pool accounting did not reconcile"
+    assert (ok["paged_admitted_concurrency_ge_1p5x_at_saturation"]
+            or ok["paged_kv_bytes_per_request_le_0p6x_all_traces"]), \
+        "paged engine met neither capacity bar"
+
+
+if __name__ == "__main__":
+    main()

@@ -68,6 +68,15 @@ def _builders():
             num_heads=4, num_layers=2)
         return None
 
+    def paged_decode_tick():
+        # the paged engine's compiled step (serving/kv_pager.py builds
+        # exactly this shape: block-table gather + paged_cache_write)
+        models.transformer.transformer_lm_paged_decode_tick(
+            n_slots=4, n_blocks=17, block_size=8, blocks_per_req=4,
+            vocab=1000, d_model=64, d_inner=128, num_heads=4,
+            num_layers=2)
+        return None
+
     def prefill():
         # the teacher-forced prefill + greedy/beam generation program the
         # engine's prompt phase shares weights with
@@ -98,6 +107,7 @@ def _builders():
             num_layers=2)[0],
         "transformer_lm_tp": _tp_transformer,
         "transformer_lm_decode_tick": decode_tick,
+        "transformer_lm_paged_decode_tick": paged_decode_tick,
         "transformer_lm_prefill": prefill,
         "machine_translation": mt,
     }

@@ -40,6 +40,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.trainer",
     "paddle_tpu.inferencer",
     "paddle_tpu.serving",
+    "paddle_tpu.serving.kv_pager",
     "paddle_tpu.serving_engine",
     "paddle_tpu.nets",
     "paddle_tpu.concurrency",

@@ -31,6 +31,8 @@ JAX_PLATFORMS=cpu python tools/lint_program.py --model transformer_lm
 # generate program must be analyzer-clean too (docs/serving.md)
 JAX_PLATFORMS=cpu python tools/lint_program.py \
     --model transformer_lm_decode_tick
+JAX_PLATFORMS=cpu python tools/lint_program.py \
+    --model transformer_lm_paged_decode_tick
 JAX_PLATFORMS=cpu python tools/lint_program.py --model transformer_lm_prefill
 # tp lint: tp-annotated transformer through tp_shard_pass at tp=2; prints
 # the propagated sharding-spec table and fails on any propagation conflict
@@ -687,5 +689,46 @@ with EngineServer(eng) as srv:
         assert len(done[long_tag]) == 8 and len(done[short_tag]) == 2
 print("serving-engine smoke OK")
 PY
+
+echo "== paged-serving smoke (r20: block-table KV + prefix sharing) =="
+# slot vs paged decode identity on a shared-prefix mix (same scope =
+# same weights), prefix-cache hits on the second wave, and the census
+# used-vs-reserved reconciliation (used + free == reserved, exactly)
+JAX_PLATFORMS=cpu python - <<'PY'
+import paddle_tpu as pt
+from paddle_tpu.observability.memory import watermark_board
+from paddle_tpu.serving import ContinuousBatchingEngine, PagedKVEngine
+DIMS = dict(vocab=100, max_len=16, d_model=32, d_inner=64, num_heads=4,
+            num_layers=2)
+scope = pt.global_scope()
+slot = ContinuousBatchingEngine(n_slots=3, scope=scope, **DIMS)
+paged = PagedKVEngine(n_slots=3, block_size=4, scope=scope, **DIMS)
+pre = [2, 7, 1, 9, 4, 8, 5, 6]
+waves = [[pre + [3]], [pre + [11], pre + [12, 13], [6, 5, 4]]]
+for wave in waves:
+    a = [slot.submit(p, max_new=5) for p in wave]
+    slot.run_until_idle()
+    b = [paged.submit(p, max_new=5) for p in wave]
+    paged.run_until_idle()
+    assert [r.tokens for r in a] == [r.tokens for r in b], \
+        "paged decode diverged from slot engine"
+assert paged.pager.prefix_hits >= 2, paged.pager.stats()
+pool = paged.pager.pool
+pool.check()
+assert pool.n_used + pool.n_free == paged.n_blocks - 1
+paged._stamp_kv_watermarks({})
+board = watermark_board()
+per_block = paged._kv_bytes_static / paged.n_blocks
+assert board["kv_cache_bytes"]["current"] == paged._kv_bytes_static
+assert board["kv_cache_used_bytes"]["current"] == pool.n_used * per_block
+print("paged-serving smoke OK")
+PY
+
+echo "== bench_serve_kv smoke (slot-vs-paged capacity harness) =="
+# the r20 load harness end to end in --smoke shape: asserts decode
+# identity, pool reconciliation, and at least one capacity bar inside
+# main() (BENCH_SERVE_KV_r20.json is the committed full-shape run)
+JAX_PLATFORMS=cpu python tools/bench_serve_kv.py --smoke > /dev/null
+echo "bench_serve_kv smoke OK"
 
 echo "CI OK"

@@ -175,6 +175,16 @@ define_bool("quant_comm", True,
             "while keeping the explicit reduce-scatter pipeline — the "
             "escape hatch if quantization ever hurts a model's "
             "convergence in production (parallel/grad_comm.py).")
+define_bool("quant_params", True,
+            "Allow weight-only quantized serving when an engine requests it "
+            "(quant='int8'/'int4'): quantize_params_pass rewrites a serving "
+            "program's persistable f32 weights into block-scaled (payload, "
+            "scales) pairs consumed by qmatmul/qlookup (framework/passes.py, "
+            "parallel/collective.py quantize_blocks_2d). Kill switch: "
+            "PTPU_QUANT_PARAMS=0 serves full f32 weights — the escape hatch "
+            "if quantization ever hurts decode quality in production. Part "
+            "of the executor's compile cache key (framework/executor.py "
+            "_fusion_flags_key).")
 define_bool("trace", True,
             "Structured step tracing (observability/tracing.py): typed "
             "nested spans (compile/step/tick/pass/dp_comm/pp_tick/"

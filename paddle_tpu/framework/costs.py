@@ -427,6 +427,11 @@ def state_category(v, name: str) -> str:
     if v is not None and (getattr(v, "is_optimizer_state", False)
                           or getattr(v, "accumulator_of", None)):
         return "optimizer_state"
+    if name.endswith("@qparam") or name.endswith("@qscale"):
+        # quantize_params_pass payload/scale pairs: classified by NAME
+        # suffix (the pass's census contract) because Program.clone() only
+        # preserves whitelisted extra var attrs
+        return "params_quantized"
     if v is not None and getattr(v, "trainable", False):
         return "params"
     return "other_state"
@@ -463,6 +468,8 @@ def memory_categories(program, *, dp: int = 1, tp: int = 0,
 
       params           trainable persistable state (replicated; tp-local
                        when the tp pass marked a `tp_spec`)
+      params_quantized block-scaled weight payload+scale pairs left by
+                       quantize_params_pass (`@qparam`/`@qscale` suffix)
       optimizer_state  accumulators (`is_optimizer_state`/`accumulator_of`);
                        dim 0 / dp when `dp_shard_update` (ZeRO-1)
       ef_residual      per-replica error-feedback state
@@ -480,8 +487,8 @@ def memory_categories(program, *, dp: int = 1, tp: int = 0,
     Placement rules mirror ParallelExecutor._state_sharding exactly; the
     SPMD Reduce heuristic (un-marked accumulator sharding) is NOT
     modeled — predict for the manual/explicit modes or dp=1."""
-    cats = {"params": 0, "optimizer_state": 0, "ef_residual": 0,
-            "other_state": 0, "feeds": 0, "seed": 4}
+    cats = {"params": 0, "params_quantized": 0, "optimizer_state": 0,
+            "ef_residual": 0, "other_state": 0, "feeds": 0, "seed": 4}
     if tp <= 1 and getattr(program, "_tp_applied", False):
         tp = int(getattr(program, "_tp_size", 0) or 0)
     seen = set()

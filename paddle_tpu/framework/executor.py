@@ -16,6 +16,7 @@ state are donated to XLA so parameter updates are in-place on device.
 
 from __future__ import annotations
 
+import operator
 import time
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -40,6 +41,7 @@ def _fusion_flags_key():
     return (flags.get_flag("fuse_recurrent_cells"),
             flags.get_flag("fuse_decode_attention"),
             flags.get_flag("quant_comm"),
+            flags.get_flag("quant_params"),
             flags.get_flag("pipeline"),
             flags.get_flag("tp_shard"),
             flags.get_flag("memory_plan"),
@@ -101,7 +103,9 @@ class PreparedStep:
     (the reserved @batch_row_mask) are re-injected per call."""
 
     __slots__ = ("_compiled", "_scope", "_owner", "_random_seed",
-                 "_injected")
+                 "_injected", "_b_feed_vals", "_b_ro_vals", "_b_rw_vals",
+                 "_b_rw_pick", "_b_state_names", "_b_scope_vars",
+                 "_b_seed_base")
 
     def __init__(self, compiled, scope, owner, random_seed, injected):
         self._compiled = compiled
@@ -109,6 +113,7 @@ class PreparedStep:
         self._owner = owner
         self._random_seed = random_seed
         self._injected = injected      # name -> constant value (batch mask)
+        self._b_rw_vals = None         # set by bind(): zero-dispatch state
 
     @property
     def fetch_names(self):
@@ -133,9 +138,72 @@ class PreparedStep:
         fetches, new_state = compiled.fn(feed_vals, ro_vals, rw_vals, seed)
         for name, val in zip(compiled.state_out_names, new_state):
             scope.set_var(name, val)
+        if self._b_rw_vals is not None:
+            # a bound tick coexists with plain runs (paged_beam_search
+            # drives the same compiled step through run()): the donated rw
+            # buffers the binding held are dead now, so re-point it at the
+            # state this call just produced
+            self._b_rw_vals = self._b_rw_pick(new_state)
         if return_numpy:
             return [as_numpy(f) for f in fetches]
         return list(fetches)
+
+    def bind(self, feed):
+        """One-time setup of the zero-dispatch tick: capture the caller's
+        feed buffers (the serving engine mutates them in place between
+        ticks), pin the read-only state straight out of the scope, and
+        precompute everything run() recomputes per call — the argument
+        tuples, the rw<-new_state selection, and the seed stream base.
+        After bind(), run_bound() is the hot path: no dict probes, no
+        per-name scope lookups, no tuple-comprehension rebuilds.
+
+        Contract: `feed` must hold the EXACT arrays fed forever after
+        (mutate them in place; rebinding is required if they are
+        replaced), and read-only persistables are pinned at bind time —
+        swap weights in the scope -> bind() again."""
+        compiled = self._compiled
+        injected = self._injected
+        scope = self._scope
+        self._b_feed_vals = tuple(
+            feed[n] if n in feed else injected[n]
+            for n in compiled.feed_names)
+        self._b_ro_vals = tuple(scope.get(n) for n in compiled.ro_names)
+        self._b_rw_vals = tuple(scope.get(n) for n in compiled.rw_names)
+        self._b_state_names = tuple(compiled.state_out_names)
+        idx = tuple(compiled.state_out_names.index(n)
+                    for n in compiled.rw_names)
+        if len(idx) == 1:
+            i0 = idx[0]
+            self._b_rw_pick = lambda s, _i=i0: (s[_i],)
+        elif idx:
+            self._b_rw_pick = operator.itemgetter(*idx)
+        else:
+            self._b_rw_pick = lambda s: ()
+        # Scope.set_var is a bare dict store; write the same dict directly
+        # so the per-tick write-back is one store per state var, no method
+        # dispatch (shadowing semantics identical to set_var)
+        self._b_scope_vars = scope._vars
+        self._b_seed_base = self._random_seed * 1000003
+        return self
+
+    def run_bound(self):
+        """The zero-dispatch steady-state tick over the buffers captured by
+        bind(): donated rw state threads call-to-call through a precomputed
+        selector, feeds are the caller's in-place-mutated arrays, and the
+        scope write-back is a raw dict store per state var. Returns the
+        fetch tuple (jax arrays)."""
+        compiled = self._compiled
+        owner = self._owner
+        owner._run_counter += 1
+        seed = np.uint32((self._b_seed_base + owner._run_counter)
+                         % 2147483648)
+        fetches, new_state = compiled.fn(self._b_feed_vals, self._b_ro_vals,
+                                         self._b_rw_vals, seed)
+        self._b_rw_vals = self._b_rw_pick(new_state)
+        sv = self._b_scope_vars
+        for name, val in zip(self._b_state_names, new_state):
+            sv[name] = val
+        return fetches
 
 
 class Executor:

@@ -359,6 +359,138 @@ class FuseDecodeAttentionPass(Pass):
         return len(matches)
 
 
+@register_pass("quantize_params_pass")
+class QuantizeParamsPass(Pass):
+    """Weight-only serving quantization: rewrite a serving program's
+    persistable f32 weights into block-scaled (payload, scales) pairs and
+    their consumer ops into the quantized kernels — `mul` -> `qmatmul`,
+    `lookup_table` -> `qlookup` (whose lowerings dequantize per-tile inside
+    the kernel; ops/nn_ops.py, ops/tensor_ops.py). attrs: bits (8 or 4),
+    block (tile edge, parallel/collective.py QUANT_BLOCK_2D).
+
+    Contract: MUTATES `program` and `scope` in place — the f32 weight array
+    is dropped from the scope and its var from the block (its HBM is the
+    freed headroom the serving engine hands to the KV pool), replaced by
+    `<w>@qparam` (int8; nibble-packed columns at bits=4) and `<w>@qscale`
+    (f32 tile grid). The name suffixes are the census contract:
+    costs.state_category classifies them as `params_quantized` — suffixes,
+    not var attrs, because Program.clone() only preserves whitelisted extra
+    attrs. A weight is only quantized when NO op writes it and EVERY
+    consumer reads it through a rewritable slot (mul.Y with
+    y_num_col_dims=1 / lookup_table.W) — anything else keeps f32. The
+    rewrite is 1:1 in the op list, so op indices stay valid."""
+
+    allowed_attrs = ("bits", "block")
+
+    def apply(self, program, scope=None):
+        import numpy as np
+
+        from ..parallel.collective import (QUANT_BLOCK_2D,
+                                           quantize_blocks_2d)
+        from .program import Operator
+
+        scope = scope or global_scope()
+        bits = int(self.attrs.get("bits", 8))
+        tile = int(self.attrs.get("block", QUANT_BLOCK_2D))
+        if bits not in (8, 4):
+            raise InvalidArgumentError(
+                f"quantize_params_pass supports bits in (8, 4), got {bits}")
+
+        written, consumers = set(), {}
+        for blk in program.blocks:
+            for op in blk.ops:
+                written.update(op.output_names())
+                for name in op.input_names():
+                    consumers.setdefault(name, []).append(op)
+
+        def weight_slot(op):
+            if op.type == "mul" and op.attrs.get("y_num_col_dims", 1) == 1:
+                return "Y"
+            if op.type == "lookup_table":
+                return "W"
+            return None
+
+        chosen = {}
+        for blk in program.blocks:
+            for name, var in blk.vars.items():
+                if (not var.persistable or name in written
+                        or var.shape is None or len(var.shape) != 2
+                        or -1 in var.shape or str(var.dtype) != "float32"
+                        or not scope.has_var(name)):
+                    continue
+                if bits == 4 and var.shape[1] % 2:
+                    continue     # nibble packing needs even columns
+                ops = consumers.get(name, [])
+                if not ops:
+                    continue
+                ok = True
+                for op in ops:
+                    slot = weight_slot(op)
+                    if slot is None or op.inputs.get(slot) != [name]:
+                        ok = False
+                        break
+                    if any(name in vs for s, vs in op.inputs.items()
+                           if s != slot):
+                        ok = False
+                        break
+                if ok:
+                    chosen[name] = blk
+        if not chosen:
+            return program
+
+        for name, blk in chosen.items():
+            w = np.asarray(scope.get(name), np.float32)
+            q, s = quantize_blocks_2d(w, bits=bits, block=tile)
+            qname, sname = name + "@qparam", name + "@qscale"
+            blk.create_var(name=qname, shape=tuple(q.shape), dtype="int8",
+                           persistable=True, stop_gradient=True)
+            blk.create_var(name=sname, shape=tuple(s.shape),
+                           dtype="float32", persistable=True,
+                           stop_gradient=True)
+            scope.set_var(qname, q)
+            scope.set_var(sname, s)
+            scope.erase(name)
+            blk.vars.pop(name, None)
+
+        for blk in program.blocks:
+            for i, op in enumerate(blk.ops):
+                if op.type == "mul":
+                    wname = op.inputs["Y"][0]
+                    if wname not in chosen:
+                        continue
+                    attrs = {"bits": bits, "x_num_col_dims":
+                             op.attrs.get("x_num_col_dims", 1)}
+                    if op.attrs.get("use_bf16", False):
+                        attrs["use_bf16"] = True
+                    new = Operator(
+                        blk, "qmatmul",
+                        inputs={"X": op.inputs["X"],
+                                "QW": [wname + "@qparam"],
+                                "Scales": [wname + "@qscale"]},
+                        outputs={"Out": op.outputs["Out"]}, attrs=attrs)
+                elif op.type == "lookup_table":
+                    wname = op.inputs["W"][0]
+                    if wname not in chosen:
+                        continue
+                    attrs = {"bits": bits}
+                    if op.attrs.get("padding_idx") is not None:
+                        attrs["padding_idx"] = op.attrs["padding_idx"]
+                    new = Operator(
+                        blk, "qlookup",
+                        inputs={"Ids": op.inputs["Ids"],
+                                "QW": [wname + "@qparam"],
+                                "Scales": [wname + "@qscale"]},
+                        outputs={"Out": op.outputs["Out"]}, attrs=attrs)
+                else:
+                    continue
+                blk.ops[i] = new
+                out = new.outputs["Out"][0]
+                if out in blk.vars:
+                    blk.vars[out].op = new
+        program._bump()
+        return program
+
+
 # ---------------------------------------------------------------------------
 # pipeline partitioning (≙ the reference's pipeline_trainer program-section
 # splitting: the transpiler that cuts a program into per-device sections and

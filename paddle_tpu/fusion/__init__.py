@@ -34,6 +34,8 @@ elsewhere; "pallas_interpret" runs the kernels through the Pallas
 interpreter so the CPU suite pins the same tiling logic the TPU runs.
 """
 
-from .decode_attention import fused_decode_attention  # noqa: F401
+from .decode_attention import (dequantize_kv_time_blocks,  # noqa: F401
+                               fused_decode_attention,
+                               quantize_kv_time_blocks)
 from .recurrent import (fused_gru_sequence,  # noqa: F401
                         fused_lstm_sequence)

@@ -150,7 +150,50 @@ def _decode_attention_bwd(scale, backend, res, g):
 _decode_attention.defvjp(_decode_attention_fwd, _decode_attention_bwd)
 
 
-def fused_decode_attention(q, k, v, bias, scale=1.0, backend=None):
+QUANT_KV_BLOCK_T = 8   # time-axis tile: one f32 scale per <=8 cache steps
+
+
+def _fit_time_block(t, block):
+    b = min(block, t)
+    while t % b:
+        b -= 1
+    return b
+
+
+def quantize_kv_time_blocks(kv, block=QUANT_KV_BLOCK_T):
+    """Symmetric int8 quantization of a KV cache along the time axis.
+
+    kv [..., T, dh] → (payload int8 [..., T, dh], scales f32 [..., T//bt])
+    where bt is the largest divisor of T that is <= block, so the payload
+    keeps the exact cache shape (no padding bytes). One scale covers a
+    [bt, dh] tile per leading index — the time-local amax tracks the
+    cache's per-step magnitude drift, which is what makes int8 caches
+    viable for decode attention (same rationale as the gradient path's
+    `quantize_blocks`, specialised to the cache layout)."""
+    t, dh = kv.shape[-2], kv.shape[-1]
+    bt = _fit_time_block(t, block)
+    lead = kv.shape[:-2]
+    tiles = jnp.asarray(kv, jnp.float32).reshape(lead + (t // bt, bt, dh))
+    amax = jnp.max(jnp.abs(tiles), axis=(-1, -2), keepdims=True)
+    sc = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(tiles / sc), -127, 127).astype(jnp.int8)
+    return q.reshape(kv.shape), sc.reshape(lead + (t // bt,))
+
+
+def dequantize_kv_time_blocks(q, scales, dtype=jnp.float32):
+    """Inverse of `quantize_kv_time_blocks`: payload int8 [..., T, dh] +
+    scales [..., T//bt] → dequantized [..., T, dh] in `dtype`."""
+    t, dh = q.shape[-2], q.shape[-1]
+    nb = scales.shape[-1]
+    bt = t // nb
+    lead = q.shape[:-2]
+    tiles = q.astype(jnp.float32).reshape(lead + (nb, bt, dh))
+    out = tiles * scales[..., :, None, None]
+    return out.reshape(q.shape).astype(dtype)
+
+
+def fused_decode_attention(q, k, v, bias, scale=1.0, backend=None,
+                           k_scale=None, v_scale=None):
     """One decode tick of cached attention in one kernel.
 
     q [..., nh, 1, dh] (single query position), k/v [..., nh, T, dh]
@@ -158,8 +201,18 @@ def fused_decode_attention(q, k, v, bias, scale=1.0, backend=None):
     hiding cache positions beyond the current tick). Returns
     [..., nh, 1, dh]. Equals matmul(q, k^T)*scale + bias → softmax →
     matmul(·, v) exactly.
+
+    Quantized variant: pass int8 k/v payloads plus `k_scale`/`v_scale`
+    from `quantize_kv_time_blocks` (f32 [..., nh, T//bt]); the caches are
+    dequantized per time block inside the lowering before the math —
+    XLA fuses the rescale into the single cache read, so the HBM traffic
+    is the int8 payload, not the f32 cache.
     """
     backend = backend or _auto_backend()
+    if k_scale is not None:
+        k = dequantize_kv_time_blocks(k, k_scale, dtype=q.dtype)
+    if v_scale is not None:
+        v = dequantize_kv_time_blocks(v, v_scale, dtype=q.dtype)
     lead = q.shape[:-3]
     nh, dh = q.shape[-3], q.shape[-1]
     t = k.shape[-2]
@@ -182,8 +235,12 @@ def _fused_decode_attention_op(ctx, ins, attrs):
     chain)."""
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
     bias = ins["Bias"][0]
+    ks = ins.get("KScale")
+    vs = ins.get("VScale")
     backend = attrs.get("backend") or _auto_backend()
     out = fused_decode_attention(q, k, v, bias,
                                  scale=attrs.get("scale", 1.0),
-                                 backend=backend)
+                                 backend=backend,
+                                 k_scale=ks[0] if ks else None,
+                                 v_scale=vs[0] if vs else None)
     return {"Out": [out]}

@@ -142,7 +142,8 @@ class LedgerRow:
     #: categories whose per-device bytes are EXACTLY predictable from
     #: declared shapes + placement markers (costs.memory_categories) —
     #: any drift is a placement/accounting bug, not noise
-    MEMORY_EXACT_CATEGORIES = ("params", "optimizer_state", "ef_residual",
+    MEMORY_EXACT_CATEGORIES = ("params", "params_quantized",
+                               "optimizer_state", "ef_residual",
                                "other_state", "feeds")
 
     def check_memory_identity(self, residual_frac: float = 0.10) -> Dict:
@@ -183,6 +184,7 @@ class LedgerRow:
         mcats = mem["state"]["categories"]
         measured = {
             "params": mcats["params"],
+            "params_quantized": mcats["params_quantized"],
             "optimizer_state": mcats["optimizer_state"],
             "ef_residual": mcats["ef_residual"],
             # kv_cache is the census's refinement of other_state (slot
@@ -259,8 +261,8 @@ class LedgerRow:
                   feeds=mem_p["feeds"]["per_device_bytes"])
         su = dict(mem_u["state"]["categories"],
                   feeds=mem_u["feeds"]["per_device_bytes"])
-        cats = ("params", "optimizer_state", "ef_residual", "kv_cache",
-                "other_state", "feeds")
+        cats = ("params", "params_quantized", "optimizer_state",
+                "ef_residual", "kv_cache", "other_state", "feeds")
         same_state = all(abs(sp[c] - su[c]) < 0.5 for c in cats)
         # record every compared category so a failing artifact row shows
         # WHICH one the plan perturbed

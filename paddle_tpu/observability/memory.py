@@ -179,14 +179,15 @@ def _var_category(v, name: str, kv_names) -> str:
 def state_census(scope, program, names: Sequence[str],
                  kv_names: Sequence[str] = ()) -> Dict:
     """Measured per-device state bytes by category for the named scope
-    vars (a compiled step's ro + rw lists): params / optimizer_state /
-    ef_residual / kv_cache / other_state, each from the ACTUAL device
-    arrays via `per_device_bytes`. `kv_names` marks the serving engine's
-    slot-cache vars (they are plain persistables to the program)."""
+    vars (a compiled step's ro + rw lists): params / params_quantized /
+    optimizer_state / ef_residual / kv_cache / other_state, each from the
+    ACTUAL device arrays via `per_device_bytes`. `kv_names` marks the
+    serving engine's slot-cache vars (they are plain persistables to the
+    program)."""
     kv = set(kv_names)
-    cats: Dict[str, float] = {"params": 0.0, "optimizer_state": 0.0,
-                              "ef_residual": 0.0, "kv_cache": 0.0,
-                              "other_state": 0.0}
+    cats: Dict[str, float] = {"params": 0.0, "params_quantized": 0.0,
+                              "optimizer_state": 0.0, "ef_residual": 0.0,
+                              "kv_cache": 0.0, "other_state": 0.0}
     per_var: Dict[str, Dict] = {}
     for name in names:
         if not scope.has_var(name):
@@ -202,7 +203,8 @@ def state_census(scope, program, names: Sequence[str],
         cats[cat] += nb
         per_var[name] = {"category": cat, "per_device_bytes": nb}
     cats["state_total"] = sum(cats[c] for c in
-                              ("params", "optimizer_state", "ef_residual",
+                              ("params", "params_quantized",
+                               "optimizer_state", "ef_residual",
                                "kv_cache", "other_state"))
     return {"categories": cats, "per_var": per_var}
 

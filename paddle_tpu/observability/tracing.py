@@ -51,6 +51,8 @@ SPAN_KINDS = frozenset({
                    # prefill/decode/transport, serving_engine.py)
     "memory",      # memory watermark sample (record_counter; rendered as
                    # a Chrome COUNTER track, observability/memory.py)
+    "dispatch",    # host-side argument assembly + write-back around the
+                   # compiled tick fn (serving engine zero-dispatch path)
     "user",        # RecordEvent-style user annotation
 })
 

@@ -65,6 +65,27 @@ def _mul(ctx, ins, attrs):
     return {"Out": [out]}
 
 
+@register_op("qmatmul")
+def _qmatmul(ctx, ins, attrs):
+    """Weight-only quantized fc matmul (quantize_params_pass rewrite of
+    `mul`): dequantizes the block-scaled int8/int4 payload per-tile inside
+    the kernel — XLA fuses the scale-multiply into the dot's operand read,
+    so no f32 copy of the weight ever lands in HBM — then follows the
+    `mul` path exactly (same bf16 policy, same accumulation dtype), so
+    quantized decode differs from f32 only by the quantization error."""
+    from ..parallel.collective import dequantize_blocks_2d
+    x, qw, scales = ins["X"][0], ins["QW"][0], ins["Scales"][0]
+    y = dequantize_blocks_2d(qw, scales, bits=attrs.get("bits", 8))
+    xd = attrs.get("x_num_col_dims", 1)
+    xs = x.shape
+    x2 = jnp.reshape(x, (dim_prod(xs[:xd]), -1))
+    x2, y2 = _maybe_bf16(x2, attrs), _maybe_bf16(y, attrs)
+    out = jnp.dot(x2, y2, preferred_element_type=jnp.float32)
+    out = jnp.reshape(out, xs[:xd] + y.shape[1:]).astype(
+        _matmul_out_dtype(x.dtype, attrs))
+    return {"Out": [out]}
+
+
 @register_op("matmul")
 def _matmul(ctx, ins, attrs):
     x, y = ins["X"][0], ins["Y"][0]

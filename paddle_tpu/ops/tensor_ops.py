@@ -346,6 +346,35 @@ def _lookup_table(ctx, ins, attrs):
     return {"Out": [out]}
 
 
+@register_op("qlookup")
+def _qlookup(ctx, ins, attrs):
+    """Weight-only quantized embedding lookup (quantize_params_pass rewrite
+    of `lookup_table`): gathers int8/int4 payload ROWS plus their row-block
+    scales and dequantizes only the gathered rows — the full f32 table is
+    never materialized on device."""
+    qw, scales, ids = ins["QW"][0], ins["Scales"][0], ins["Ids"][0]
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, axis=-1)
+    rows = jnp.take(qw, ids, axis=0)
+    if attrs.get("bits", 8) == 4:
+        from ..parallel.collective import unpack_int4
+        lead, c2 = rows.shape[:-1], rows.shape[-1]
+        rows = unpack_int4(rows.reshape(-1, c2)).reshape(lead + (2 * c2,))
+    nr, nc = scales.shape
+    br = qw.shape[0] // nr
+    bc = rows.shape[-1] // nc
+    s = jnp.take(scales, ids // br, axis=0)          # [..., nc]
+    out = (rows.astype(jnp.float32).reshape(rows.shape[:-1] + (nc, bc))
+           * s[..., :, None]).reshape(rows.shape)
+    padding_idx = attrs.get("padding_idx", None)
+    if padding_idx is not None:
+        if padding_idx < 0:
+            padding_idx += qw.shape[0]
+        mask = (ids != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return {"Out": [out]}
+
+
 @register_op("increment")
 def _increment(ctx, ins, attrs):
     x = ins["X"][0]

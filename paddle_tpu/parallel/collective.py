@@ -40,16 +40,19 @@ def reduce_scatter(x, axis_name: str, scatter_dim: int = 0):
     """≙ the Reduce-to-owner half of ReduceOpHandle (reduce_op_handle.h:34),
     generalized: every shard owns a slice of the reduction."""
     n = axis_size(axis_name)
-    enforce(0 <= scatter_dim < x.ndim,
+    # guards raise with full context but build their message only on the
+    # failing path — these run inside traced hot loops (same de-f-string
+    # discipline as memory.update_watermark)
+    if not 0 <= scatter_dim < x.ndim:
+        raise InvalidArgumentError(
             f"reduce_scatter: scatter_dim {scatter_dim} out of range for "
-            f"rank-{x.ndim} input",
-            exc=InvalidArgumentError)
-    enforce(x.shape[scatter_dim] % n == 0,
+            f"rank-{x.ndim} input")
+    if x.shape[scatter_dim] % n != 0:
+        raise InvalidArgumentError(
             f"reduce_scatter: dim {scatter_dim} of shape {tuple(x.shape)} is "
             f"not divisible by the {axis_name!r} axis size {n}; pad the "
             f"scattered dimension to a multiple of {n} (each shard owns an "
-            f"equal slice of the reduction) or scatter a different dim",
-            exc=InvalidArgumentError)
+            f"equal slice of the reduction) or scatter a different dim")
     return jax.lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dim,
                                 tiled=True)
 
@@ -134,10 +137,10 @@ def quantize_blocks(flat, block: int = QUANT_BLOCK):
     """Block-scaled symmetric int8 quantization of a flat f32 vector whose
     length is a multiple of `block`. Returns (q int8 [n//block, block],
     scales f32 [n//block, 1]); zero blocks get scale 1 so they stay exact."""
-    enforce(flat.ndim == 1 and flat.shape[0] % block == 0,
+    if flat.ndim != 1 or flat.shape[0] % block != 0:
+        raise InvalidArgumentError(
             f"quantize_blocks wants a flat block-multiple vector, got shape "
-            f"{tuple(flat.shape)} for block {block}",
-            exc=InvalidArgumentError)
+            f"{tuple(flat.shape)} for block {block}")
     xb = flat.reshape(-1, block)
     amax = jnp.max(jnp.abs(xb), axis=1, keepdims=True)
     scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
@@ -148,6 +151,89 @@ def quantize_blocks(flat, block: int = QUANT_BLOCK):
 def dequantize_blocks(q, scale):
     """Inverse of quantize_blocks: flat f32 vector."""
     return (q.astype(jnp.float32) * scale).reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# 2-D block quantization for weights-at-rest (r21 weight-only serving).
+#
+# The wire path above scales per contiguous 1-D run; weights want per-tile
+# scales so a single outlier row does not flatten a whole matrix. Tiles are
+# (br, bc) sub-blocks of the 2-D weight; each tile gets one f32 scale.
+# Int4 halves the payload again by packing two nibbles per int8 byte along
+# the column axis (column count must be even).
+# ---------------------------------------------------------------------------
+
+QUANT_BLOCK_2D = 64         # default tile edge: one f32 scale per <=64x64 tile
+
+
+def block_dims_2d(shape, block: int = QUANT_BLOCK_2D):
+    """Largest tile dims <= `block` that divide each axis of `shape` exactly
+    (falls back toward 1, which always divides), so payloads keep the exact
+    declared weight shape — no padding bytes to reconcile in the census."""
+    def fit(n):
+        b = min(block, n)
+        while n % b:
+            b -= 1
+        return b
+    return fit(shape[0]), fit(shape[1])
+
+
+def quantize_blocks_2d(w, bits: int = 8, block: int = QUANT_BLOCK_2D):
+    """Tile-scaled symmetric quantization of a 2-D f32 matrix.
+
+    Returns (payload int8 [R, C] — or [R, C//2] nibble-packed when bits=4 —
+    and scales f32 [R//br, C//bc]). Zero tiles get scale 1 so they stay
+    exact; int4 clips to [-7, 7] before packing.
+    """
+    if w.ndim != 2:
+        raise InvalidArgumentError(
+            f"quantize_blocks_2d wants a 2-D matrix, got shape "
+            f"{tuple(w.shape)}")
+    if bits not in (8, 4):
+        raise InvalidArgumentError(
+            f"quantize_blocks_2d supports bits in (8, 4), got {bits}")
+    r, c = w.shape
+    if bits == 4 and c % 2 != 0:
+        raise InvalidArgumentError(
+            f"int4 packing needs an even column count, got shape "
+            f"{tuple(w.shape)}")
+    br, bc = block_dims_2d(w.shape, block)
+    t = jnp.asarray(w, jnp.float32).reshape(r // br, br, c // bc, bc)
+    amax = jnp.max(jnp.abs(t), axis=(1, 3), keepdims=True)
+    qmax = 127.0 if bits == 8 else 7.0
+    scale = jnp.where(amax > 0, amax / qmax, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(t / scale), -qmax, qmax).astype(jnp.int8)
+    q = q.reshape(r, c)
+    if bits == 4:
+        q = pack_int4(q)
+    return q, scale.reshape(r // br, c // bc)
+
+
+def dequantize_blocks_2d(q, scales, bits: int = 8):
+    """Inverse of quantize_blocks_2d: f32 matrix [R, C]. `scales` carries the
+    tile grid [R//br, C//bc]; the payload is nibble-unpacked when bits=4."""
+    if bits == 4:
+        q = unpack_int4(q)
+    r, c = q.shape
+    nr, nc = scales.shape
+    t = q.astype(jnp.float32).reshape(nr, r // nr, nc, c // nc)
+    return (t * scales[:, None, :, None]).reshape(r, c)
+
+
+def pack_int4(q):
+    """Pack an int8 matrix with values in [-7, 7] into nibbles: columns
+    (2k, 2k+1) share byte k as (low, high). Returns int8 [R, C//2]."""
+    lo = q[:, 0::2]
+    hi = q[:, 1::2]
+    return ((lo & jnp.int8(0x0F)) | (hi << 4)).astype(jnp.int8)
+
+
+def unpack_int4(p):
+    """Inverse of pack_int4: int8 [R, C2] -> int8 [R, 2*C2]. Sign-extends
+    each nibble via arithmetic shifts (two's complement)."""
+    lo = ((p << 4).astype(jnp.int8) >> 4).astype(jnp.int8)
+    hi = (p >> 4).astype(jnp.int8)
+    return jnp.stack([lo, hi], axis=-1).reshape(p.shape[0], -1)
 
 
 def _compress(flat, wire_dtype: str, block: int):
@@ -207,10 +293,10 @@ def quantized_reduce_scatter_flat(flat, axis_name: str, *,
     destination chunk is compressed independently (block padding included) so
     the chunk boundary never splits a scale block."""
     n = axis_size(axis_name)
-    enforce(flat.ndim == 1 and flat.shape[0] % n == 0,
+    if flat.ndim != 1 or flat.shape[0] % n != 0:
+        raise InvalidArgumentError(
             f"quantized_reduce_scatter_flat wants a flat vector divisible by "
-            f"the {axis_name!r} axis size {n}, got {tuple(flat.shape)}",
-            exc=InvalidArgumentError)
+            f"the {axis_name!r} axis size {n}, got {tuple(flat.shape)}")
     chunk = flat.shape[0] // n
     cpad = -(-chunk // block) * block
     xb = flat.reshape(n, chunk)

@@ -216,7 +216,8 @@ class ContinuousBatchingEngine:
                  dropout: float = 0.0, packed: bool = False,
                  eos_id: Optional[int] = None, scope=None,
                  policy: str = "continuous",
-                 cache_prefix: Optional[str] = None):
+                 cache_prefix: Optional[str] = None,
+                 quant: Optional[str] = None):
         from ..core import unique_name
         from ..framework.executor import Executor
         from ..framework.program import Program, program_guard
@@ -249,12 +250,40 @@ class ContinuousBatchingEngine:
         self.scope = scope or global_scope()
         self._exe = Executor()
         self._init_missing_vars(Scope)
+        # weight-only quantized serving (quant='int8'/'int4'): rewrite the
+        # tick program's persistable f32 weights into block-scaled
+        # (payload, scales) pairs BEFORE the step is prepared. The freed
+        # f32 bytes (quant_freed_bytes) are KV headroom: at a fixed HBM
+        # budget they buy extra BlockPool blocks on the paged engine
+        # (tools/bench_qserve.py measures the admitted-concurrency win).
+        # Kill switch PTPU_QUANT_PARAMS=0 serves f32 regardless of `quant`.
+        enforce(quant in (None, "int8", "int4"),
+                f"quant must be None, 'int8' or 'int4', got {quant!r}",
+                exc=InvalidArgumentError)
+        self.quant = None
+        self.params_bytes_f32 = self._param_bytes()
+        self.quant_freed_bytes = 0
+        if quant is not None:
+            from ..core import flags as _flags
+            if _flags.get_flag("quant_params"):
+                from ..framework.passes import get_pass
+                get_pass("quantize_params_pass",
+                         bits=8 if quant == "int8" else 4)(
+                    self._program, self.scope)
+                self.quant = quant
+                self.params_bytes_quantized = self._param_bytes()
+                self.quant_freed_bytes = (self.params_bytes_f32
+                                          - self.params_bytes_quantized)
         self._feeds = self._init_tick_feeds()
         self._tok = self._feeds["tick_tok"]
         self._pos = self._feeds["tick_pos"]
         self._step = self._exe.prepare(
             self._program, dict(self._feeds), self._tick_fetches(),
             self.scope)
+        # zero-dispatch steady state: the prepared step is BOUND to the
+        # engine's in-place-mutated feed arrays — argument tuples are
+        # built once here, never per tick (PreparedStep.bind)
+        self._step.bind(self._feeds)
         # census counters (tools/bench_serve.py occupancy evidence)
         self.n_ticks = 0
         self.busy_slot_ticks = 0
@@ -354,6 +383,13 @@ class ContinuousBatchingEngine:
             "Wall latency of one decode tick.",
             buckets=(1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2,
                      2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0, 2.5))
+        self._m_dispatch = r.histogram(
+            "ptpu_engine_dispatch_seconds",
+            "Host-side dispatch share of one decode tick: feed fill + "
+            "bound-call argument handling up to the async-dispatch "
+            "return, excluding the realization barrier (device wait).",
+            buckets=(1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3,
+                     2.5e-3, 5e-3, 1e-2, 2.5e-2))
         for q, name in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
             r.gauge(f"ptpu_engine_tick_latency_{name}_seconds",
                     f"{name} decode-tick latency (histogram estimate).",
@@ -376,6 +412,24 @@ class ContinuousBatchingEngine:
             "End-to-end request latency (submit -> completion frame "
             "sent; -> completion when no server is attached).",
             buckets=req_buckets)
+
+    def _param_bytes(self) -> int:
+        """Resident bytes of the tick program's weight state (census
+        categories params + params_quantized) — the before/after pair of
+        the weight-only quantization claim."""
+        from ..framework.costs import state_category
+        seen, total = set(), 0
+        for b in self._program.blocks:
+            for name, v in b.vars.items():
+                if name in seen or not v.persistable \
+                        or not self.scope.has_var(name):
+                    continue
+                seen.add(name)
+                if state_category(v, name) in ("params",
+                                               "params_quantized"):
+                    total += int(_obs_memory.per_device_bytes(
+                        self.scope.get(name)))
+        return total
 
     def _kv_cache_bytes(self) -> int:
         total = 0
@@ -515,9 +569,16 @@ class ContinuousBatchingEngine:
                                          for r in active.values()]
         with _tracing.span("tick", "engine/tick", **span_attrs):
             self._fill_tick_feeds(active)
-            ids = self._step.run(self._feeds)[0]
-            ids = np.asarray(ids)          # realization barrier: the next
+            fetches = self._step.run_bound()   # zero-dispatch bound tick
+            td = time.perf_counter()           # async dispatch returned
+            ids = np.asarray(fetches[0])   # realization barrier: the next
             #                                tick's feed depends on it
+        self._m_dispatch.observe(td - t0)
+        if _tracing.enabled():
+            # the host-dispatch share of the tick as a named phase
+            # (PROBE_GAP_r07's `host_dispatch`, now first-class)
+            _tracing.record_span("dispatch", "engine/dispatch", t0, td,
+                                 active=len(active))
         self._m_tick_latency.observe(time.perf_counter() - t0)
         self._m_ticks.inc()
         self.n_ticks += 1

@@ -461,7 +461,8 @@ class PagedKVEngine(ContinuousBatchingEngine):
                  scope=None, policy: str = "continuous",
                  cache_prefix: Optional[str] = None, block_size: int = 8,
                  n_blocks: Optional[int] = None,
-                 prefix_sharing: bool = True, topk_k: int = 0):
+                 prefix_sharing: bool = True, topk_k: int = 0,
+                 quant: Optional[str] = None):
         self.block_size = int(block_size)
         self.blocks_per_req = -(-int(max_len) // self.block_size)
         self.prefix_sharing = bool(prefix_sharing)
@@ -486,7 +487,7 @@ class PagedKVEngine(ContinuousBatchingEngine):
             d_model=d_model, d_inner=d_inner, num_heads=num_heads,
             num_layers=num_layers, dropout=dropout, packed=packed,
             eos_id=eos_id, scope=scope, policy=policy,
-            cache_prefix=cache_prefix)
+            cache_prefix=cache_prefix, quant=quant)
 
     # -- tick program -----------------------------------------------------
     def _build_tick_program(self, n_slots, vocab, max_len, d_model,

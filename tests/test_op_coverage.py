@@ -1668,6 +1668,15 @@ COVERED_ELSEWHERE = {
     "tp_split": "tests/test_ztp_exec.py",
     "tp_allgather": "tests/test_ztp_exec.py",
     "tp_vocab_lookup": "tests/test_ztp_exec.py",
+    # paged KV serving (r20): pool-indexed cache write needs the block
+    # table + pool program context — op parity + engine identity live in
+    # the pager suite
+    "paged_cache_write": "tests/test_kv_pager.py",
+    # weight-only quantized serving (r21): payload+scale op pairs emitted
+    # by quantize_params_pass — rewrite structure, dequant error bounds,
+    # and decode parity live in the quant-serving suite
+    "qmatmul": "tests/test_quant_serving.py",
+    "qlookup": "tests/test_quant_serving.py",
 }
 
 

@@ -77,6 +77,31 @@ def _prefill():
     return None
 
 
+def _paged_decode_tick():
+    """The paged engine's compiled step (serving/kv_pager.py): block-table
+    gather + paged_cache_write over the shared pools."""
+    models.transformer.transformer_lm_paged_decode_tick(
+        n_slots=2, n_blocks=9, block_size=4, blocks_per_req=4,
+        vocab=100, d_model=32, d_inner=64, num_heads=4, num_layers=2)
+    return None
+
+
+def _quant_decode_tick():
+    """The weight-only quantized engine's compiled step: the decode tick
+    rewritten in place by quantize_params_pass (startup runs first so the
+    pass has real weight arrays to quantize) — keeps qmatmul/qlookup
+    shape inference green in the analyzer."""
+    import paddle_tpu as pt
+    from paddle_tpu.framework.passes import get_pass
+    models.transformer.transformer_lm_decode_tick(
+        n_slots=2, vocab=100, max_len=16, d_model=32, d_inner=64,
+        num_heads=4, num_layers=2)
+    pt.Executor().run(pt.default_startup_program())
+    get_pass("quantize_params_pass", bits=8)(
+        pt.default_main_program(), pt.global_scope())
+    return None
+
+
 # one builder per model module (small configs: the analyzer only cares
 # about the op DAG, not widths)
 MODEL_BUILDERS = {
@@ -101,6 +126,8 @@ MODEL_BUILDERS = {
         num_layers=2)[0],
     "transformer_lm_tp": _tp_transformer,
     "transformer_lm_decode_tick": _decode_tick,
+    "transformer_lm_paged_decode_tick": _paged_decode_tick,
+    "transformer_lm_quant_decode_tick": _quant_decode_tick,
     "transformer_lm_prefill": _prefill,
     "machine_translation": _mt_train,
 }

@@ -230,6 +230,73 @@ def _child_output(p):
     return out, err
 
 
+def _drive_quant_serving(args):
+    """--quant_params: the weight-only quantized serving column family.
+
+    Runs the continuous-batching decode engine twice on ONE weight set —
+    f32 baseline, then quantized (framework/passes.py
+    quantize_params_pass) — and prints one row per side with
+    params_bytes before/after, the per-tick host-dispatch share from the
+    engine's `ptpu_engine_dispatch_seconds` histogram (the zero-dispatch
+    bound-tick path), and generated tokens/s. Greedy argmax on shared
+    weights, so the token streams are also compared (int8 is typically
+    token-identical; divergence is reported, not asserted — the serving
+    tests pin the bound)."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.serving import ContinuousBatchingEngine
+
+    dims = dict(vocab=1000, max_len=64, d_model=64, d_inner=128,
+                num_heads=4, num_layers=2)
+    n_slots = max(2, min(args.batch_size, 8))
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    scope = pt.global_scope()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, dims["vocab"], 4).tolist()
+               for _ in range(4 * n_slots)]
+    rows, tokens = [], {}
+    for quant in (None, args.quant_params):
+        label = quant or "f32"
+        eng = ContinuousBatchingEngine(n_slots=n_slots, scope=scope,
+                                       cache_prefix=f"bq_{label}",
+                                       quant=quant, **dims)
+        warm = eng.submit([1], max_new=1)
+        eng.run_until_idle()
+        assert warm.done
+        t0 = time.time()
+        reqs = [eng.submit(list(p), max_new=16) for p in prompts]
+        eng.run_until_idle()
+        dt = time.time() - t0
+        n_tok = sum(len(r.tokens) for r in reqs)
+        tokens[label] = [r.tokens for r in reqs]
+        rows.append({
+            "engine": label,
+            "params_bytes": (eng.params_bytes_quantized if eng.quant
+                             else eng.params_bytes_f32),
+            "quant_freed_bytes": eng.quant_freed_bytes,
+            "dispatch_ms_p50": round(
+                (eng._m_dispatch.quantile(0.5) or 0.0) * 1e3, 4),
+            "tick_ms_p50": round(
+                (eng._m_tick_latency.quantile(0.5) or 0.0) * 1e3, 4),
+            "tokens_per_sec": round(n_tok / dt, 1),
+        })
+    import jax
+    print(json.dumps({
+        "model": "transformer_serving",
+        "quant_params": args.quant_params,
+        "batch_slots": n_slots,
+        "params_bytes_before": rows[0]["params_bytes"],
+        "params_bytes_after": rows[1]["params_bytes"],
+        "params_ratio": round(rows[0]["params_bytes"]
+                              / max(rows[1]["params_bytes"], 1), 3),
+        "decode_token_identical": tokens["f32"]
+            == tokens[args.quant_params],
+        "rows": rows,
+        "device": jax.devices()[0].platform,
+    }))
+
+
 def _drive_multiproc(args):
     """Parent of the N-process world: spawn N trainer children + a
     1-process collective baseline on the same total device count, report
@@ -445,6 +512,14 @@ def main():
                         "columns from its MEASURED census (one extra "
                         "compile; needs the census, i.e. not "
                         "--no_census)")
+    p.add_argument("--quant_params", choices=("int8", "int4"), default=None,
+                   help="serving mode: run the continuous-batching decode "
+                        "engine f32 vs weight-only-quantized "
+                        "(quantize_params_pass) on one weight set and "
+                        "print the quantized column family — params_bytes "
+                        "before/after, per-tick dispatch_ms (the "
+                        "zero-dispatch bound tick's host share), "
+                        "tokens/s. Ignores the training flags")
     p.add_argument("--no_bf16", action="store_true")
     p.add_argument("--profile", action="store_true")
     p.add_argument("--trace_dir", default=None,
@@ -461,6 +536,10 @@ def main():
             p.error("--auto owns the strategy; do not combine it with "
                     "--reduce_mode/--pipeline_stages/--tp/multiproc")
         args.update_method = "collective"
+
+    if args.quant_params:
+        _drive_quant_serving(args)
+        return
 
     if args.update_method == "multiproc":
         _drive_multiproc(args)

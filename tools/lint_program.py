@@ -77,6 +77,20 @@ def _builders():
             num_layers=2)
         return None
 
+    def quant_decode_tick():
+        # the weight-only quantized engine's compiled step: the decode
+        # tick rewritten in place by quantize_params_pass (startup runs
+        # first so the pass has real weight arrays to quantize)
+        import paddle_tpu as pt
+        from paddle_tpu.framework.passes import get_pass
+        models.transformer.transformer_lm_decode_tick(
+            n_slots=4, vocab=1000, max_len=32, d_model=64, d_inner=128,
+            num_heads=4, num_layers=2)
+        pt.Executor().run(pt.default_startup_program())
+        get_pass("quantize_params_pass", bits=8)(
+            pt.default_main_program(), pt.global_scope())
+        return None
+
     def prefill():
         # the teacher-forced prefill + greedy/beam generation program the
         # engine's prompt phase shares weights with
@@ -107,6 +121,7 @@ def _builders():
             num_layers=2)[0],
         "transformer_lm_tp": _tp_transformer,
         "transformer_lm_decode_tick": decode_tick,
+        "transformer_lm_quant_decode_tick": quant_decode_tick,
         "transformer_lm_paged_decode_tick": paged_decode_tick,
         "transformer_lm_prefill": prefill,
         "machine_translation": mt,

@@ -33,6 +33,8 @@ JAX_PLATFORMS=cpu python tools/lint_program.py \
     --model transformer_lm_decode_tick
 JAX_PLATFORMS=cpu python tools/lint_program.py \
     --model transformer_lm_paged_decode_tick
+JAX_PLATFORMS=cpu python tools/lint_program.py \
+    --model transformer_lm_quant_decode_tick
 JAX_PLATFORMS=cpu python tools/lint_program.py --model transformer_lm_prefill
 # tp lint: tp-annotated transformer through tp_shard_pass at tp=2; prints
 # the propagated sharding-spec table and fails on any propagation conflict
@@ -730,5 +732,73 @@ echo "== bench_serve_kv smoke (slot-vs-paged capacity harness) =="
 # main() (BENCH_SERVE_KV_r20.json is the committed full-shape run)
 JAX_PLATFORMS=cpu python tools/bench_serve_kv.py --smoke > /dev/null
 echo "bench_serve_kv smoke OK"
+
+echo "== quantized-serving smoke (r21: weight-only int8 + zero-dispatch tick) =="
+# quantize an mnist-scale LM tick in place: census ledger identity must
+# be EXACT (predicted params_quantized == measured, byte for byte),
+# int8 greedy decode must be token-identical to f32 on the shared
+# weights at this vocab, and the steady-state tick must be genuinely
+# zero-dispatch: the engine emits `dispatch` spans and the bound tick's
+# per-tick Python allocation stays under a pinned budget
+JAX_PLATFORMS=cpu python - <<'PY'
+import tracemalloc
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu.core import flags
+from paddle_tpu.framework.costs import memory_categories
+from paddle_tpu.observability import tracing
+from paddle_tpu.observability.memory import state_census
+from paddle_tpu.serving import ContinuousBatchingEngine
+
+DIMS = dict(vocab=50, max_len=16, d_model=32, d_inner=64, num_heads=4,
+            num_layers=2)
+scope = pt.global_scope()
+f32 = ContinuousBatchingEngine(n_slots=3, scope=scope, **DIMS)
+q8 = ContinuousBatchingEngine(n_slots=3, scope=scope, quant="int8",
+                              **DIMS)
+assert q8.quant == "int8" and q8.quant_freed_bytes > 0
+assert f32.params_bytes_f32 / q8._param_bytes() >= 2.0, \
+    (f32.params_bytes_f32, q8._param_bytes())
+
+# ledger identity: predicted category == measured census, exactly
+pred = memory_categories(q8._program)
+names = [n for n, v in q8._program.current_block().vars.items()
+         if v.persistable]
+meas = state_census(scope, q8._program, names)["categories"]
+assert int(pred["params_quantized"]) == int(meas["params_quantized"]) \
+    > 0, (pred, meas)
+
+# decode smoke: int8 tokens == f32 tokens on the shared weights
+prompts = [[7], [3, 9], [11, 2, 5]]
+a = [f32.submit(p, max_new=5) for p in prompts]
+f32.run_until_idle()
+flags.set_flag("trace", True)
+try:
+    mark = tracing.mark()
+    b = [q8.submit(p, max_new=5) for p in prompts]
+    q8.run_until_idle()
+    spans = [s for s in tracing.spans_since(mark)
+             if (s.kind, s.name) == ("dispatch", "engine/dispatch")]
+finally:
+    flags.set_flag("trace", False)
+assert [r.tokens for r in a] == [r.tokens for r in b], \
+    "int8 greedy decode diverged from f32"
+assert spans and q8._m_dispatch.count > 0
+
+# zero-dispatch: the bound tick allocates (almost) nothing per tick
+step = q8._step
+step.run_bound()
+tracemalloc.start()
+s0 = tracemalloc.take_snapshot()
+for _ in range(50):
+    out = step.run_bound()
+np.asarray(out[0])
+s1 = tracemalloc.take_snapshot()
+tracemalloc.stop()
+per_tick = sum(max(d.size_diff, 0)
+               for d in s1.compare_to(s0, "filename")) / 50
+assert per_tick < 2048, f"bound tick allocates {per_tick:.0f} B/tick"
+print(f"quantized-serving smoke OK ({per_tick:.0f} B/tick)")
+PY
 
 echo "CI OK"

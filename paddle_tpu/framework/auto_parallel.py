@@ -439,7 +439,8 @@ def plan(program, mesh_shape, *, nominal_batch: int = 8,
          seed: int = 0,
          measure_fn: Optional[Callable] = None,
          measure_k: int = 0,
-         measure_band: float = 0.10) -> PlanResult:
+         measure_band: float = 0.10,
+         speculative: Optional[Dict] = None) -> PlanResult:
     """Choose a BuildStrategy + mesh factorization for `program`.
 
     `mesh_shape`: an int device count (the planner owns the
@@ -449,6 +450,13 @@ def plan(program, mesh_shape, *, nominal_batch: int = 8,
     seconds` with `measure_k > 0` re-ranks the top of the predicted
     frontier by measurement (TVM-style; `row` is a frontier entry whose
     "strategy"/"point" fields describe the candidate).
+
+    `speculative` describes a speculative-decoding serving deployment
+    ({"gamma":, "acceptance":, ...} — `costs.speculative_expectation`'s
+    signature); the expectation is attached to the chosen report's
+    `speculative` section. An `acceptance` callable is evaluated HERE —
+    the hook that feeds a live engine's measured acceptance rate into
+    the plan, the same measured-refinement idea as measure_fn.
 
     Returns a PlanResult; raises InvalidArgumentError naming the tallied
     rejection reasons when NO point of the space is feasible."""
@@ -540,6 +548,9 @@ def plan(program, mesh_shape, *, nominal_batch: int = 8,
             measured_s = chosen["measured_s"]
         sp.attrs["chosen"] = chosen["point"].describe()
         sp.attrs["n_points"] = len(ev.rows)
+        if speculative is not None:
+            chosen["report"]["speculative"] = \
+                _costs.speculative_expectation(**speculative)
 
     result = PlanResult(
         point=chosen["point"],

@@ -427,6 +427,16 @@ def state_category(v, name: str) -> str:
     if v is not None and (getattr(v, "is_optimizer_state", False)
                           or getattr(v, "accumulator_of", None)):
         return "optimizer_state"
+    if name.startswith("draft_") and (
+            name.endswith("@qparam") or name.endswith("@qscale")
+            or (v is not None and getattr(v, "trainable", False))):
+        # speculative-decoding draft-model weights (serving/speculative.py
+        # copies target weights under the reserved `draft_` prefix): their
+        # own census category, so the target-weight claims (params /
+        # params_quantized) stay unchanged when a draft rides along. The
+        # prefix check precedes the suffix check — a quantized draft
+        # weight `draft_*@qparam` is params_draft, not params_quantized
+        return "params_draft"
     if name.endswith("@qparam") or name.endswith("@qscale"):
         # quantize_params_pass payload/scale pairs: classified by NAME
         # suffix (the pass's census contract) because Program.clone() only
@@ -470,6 +480,10 @@ def memory_categories(program, *, dp: int = 1, tp: int = 0,
                        when the tp pass marked a `tp_spec`)
       params_quantized block-scaled weight payload+scale pairs left by
                        quantize_params_pass (`@qparam`/`@qscale` suffix)
+      params_draft     speculative-decoding draft-model weights (the
+                       reserved `draft_` name prefix minted by
+                       serving/speculative.py; quantized draft payloads
+                       `draft_*@qparam` land here, not params_quantized)
       optimizer_state  accumulators (`is_optimizer_state`/`accumulator_of`);
                        dim 0 / dp when `dp_shard_update` (ZeRO-1)
       ef_residual      per-replica error-feedback state
@@ -487,8 +501,9 @@ def memory_categories(program, *, dp: int = 1, tp: int = 0,
     Placement rules mirror ParallelExecutor._state_sharding exactly; the
     SPMD Reduce heuristic (un-marked accumulator sharding) is NOT
     modeled — predict for the manual/explicit modes or dp=1."""
-    cats = {"params": 0, "params_quantized": 0, "optimizer_state": 0,
-            "ef_residual": 0, "other_state": 0, "feeds": 0, "seed": 4}
+    cats = {"params": 0, "params_quantized": 0, "params_draft": 0,
+            "optimizer_state": 0, "ef_residual": 0, "other_state": 0,
+            "feeds": 0, "seed": 4}
     if tp <= 1 and getattr(program, "_tp_applied", False):
         tp = int(getattr(program, "_tp_size", 0) or 0)
     seen = set()
@@ -596,8 +611,63 @@ def memory_categories(program, *, dp: int = 1, tp: int = 0,
 # ---------------------------------------------------------------------------
 
 
+def speculative_expectation(gamma: int, acceptance,
+                            draft_cost_ratio: Optional[float] = None,
+                            draft_layers: Optional[int] = None,
+                            num_layers: Optional[int] = None,
+                            draft_bits: int = 32,
+                            verify_widening: float = 0.05) -> Dict:
+    """Analytic expectation for speculative decoding (the `speculative`
+    section of `predict`): expected committed tokens per round under
+    per-token acceptance rate α is the truncated geometric sum
+    (1-α^(γ+1))/(1-α) — every round commits at least one token (the
+    target's own output) and at most γ+1 (full acceptance + bonus).
+
+    `acceptance` is a probability OR a zero-arg callable returning one —
+    the hook that feeds a MEASURED rate (e.g. a serving engine's
+    `spec.acceptance_rate`) into the model, TVM-style like
+    auto_parallel.plan's measure_fn. Costs are in PLAIN-TICK units: the
+    draft tick ratio defaults to (draft_layers/num_layers)·(bits/32) —
+    the memory-bound weight-read scaling of serving/speculative.py's
+    truncated, quantized draft — and the verify forward pays a widening
+    term per extra query position (the γ+1-wide window reads the same
+    weights/KV once; only activation compute widens)."""
+    from ..core.enforce import InvalidArgumentError, enforce
+    a = float(acceptance() if callable(acceptance) else acceptance)
+    enforce(0.0 <= a <= 1.0,
+            f"acceptance must be a probability, got {a}",
+            exc=InvalidArgumentError)
+    g = int(gamma)
+    enforce(g >= 1, "gamma must be >= 1", exc=InvalidArgumentError)
+    expected = (g + 1.0 if a >= 1.0
+                else (1.0 - a ** (g + 1)) / (1.0 - a))
+    if draft_cost_ratio is None:
+        lr = (float(draft_layers) / float(num_layers)
+              if draft_layers and num_layers else 1.0)
+        draft_cost_ratio = lr * (float(draft_bits) / 32.0)
+    draft_cost = (g + 1) * float(draft_cost_ratio)
+    verify_cost = 1.0 + float(verify_widening) * g
+    round_cost = draft_cost + verify_cost
+    return {
+        "gamma": g,
+        "acceptance": a,
+        "expected_tokens_per_round": expected,
+        # one target forward (the verify) per round: the amortization
+        # headline tools/bench_spec.py measures
+        "tokens_per_target_forward": expected,
+        "draft_ticks_per_round": g + 1,
+        "draft_cost_ratio": float(draft_cost_ratio),
+        "draft_cost_ticks": draft_cost,
+        "verify_widening": float(verify_widening),
+        "verify_cost_ticks": verify_cost,
+        "round_cost_ticks": round_cost,
+        "speedup_vs_plain_decode": expected / round_cost,
+    }
+
+
 def predict(program, strategy=None, *, dp: int = 1, tp: int = 0,
-            nominal_batch: int = 8) -> Dict:
+            nominal_batch: int = 8,
+            speculative: Optional[Dict] = None) -> Dict:
     """Joined analytic cost prediction for one program.
 
     `program` should be the program the executor will actually run — for
@@ -616,6 +686,10 @@ def predict(program, strategy=None, *, dp: int = 1, tp: int = 0,
       pipeline:  schedule_census bubble/stash model +
                  pp_boundary_wire_bytes, when the pp pass ran
       memory:    analysis.peak_live_bytes
+      speculative: speculative_expectation(**speculative), when the
+                 caller describes a speculative-decoding deployment
+                 ({"gamma":, "acceptance":, ...} — acceptance may be a
+                 callable reading a measured rate)
     Sections that don't apply are None — a ledger row records that the
     model was consulted and judged inapplicable, not silently skipped.
     """
@@ -630,6 +704,8 @@ def predict(program, strategy=None, *, dp: int = 1, tp: int = 0,
         "dp_comm": None,
         "tp_comm": None,
         "pipeline": None,
+        "speculative": (speculative_expectation(**speculative)
+                        if speculative else None),
         "memory": {
             **_analysis.peak_live_bytes(program,
                                         nominal_batch=nominal_batch),

@@ -186,6 +186,23 @@ class PreparedStep:
         self._b_seed_base = self._random_seed * 1000003
         return self
 
+    def refresh_state(self):
+        """Re-point the bound rw state at the scope's CURRENT arrays.
+
+        Two bound steps sharing read-write state (the serving engine's
+        plain decode tick and the speculative verify forward both own the
+        target KV caches) each hold the donated buffers from their own
+        last call — after step A writes the scope, step B's held tuple is
+        stale (and donated-dead). Call this on B before run_bound() when A
+        ran in between. No-op cost is len(rw_names) dict probes, so the
+        single-step steady state stays zero-dispatch by simply not calling
+        it."""
+        if self._b_rw_vals is not None:
+            scope = self._scope
+            self._b_rw_vals = tuple(
+                scope.get(n) for n in self._compiled.rw_names)
+        return self
+
     def run_bound(self):
         """The zero-dispatch steady-state tick over the buffers captured by
         bind(): donated rw state threads call-to-call through a precomputed

@@ -278,15 +278,19 @@ class FuseDecodeAttentionPass(Pass):
         vs, bs = self._shape(block, v), self._shape(block, bias)
         if qs is None or ks is None or vs is None or bs is None:
             return None
-        # single-position query over an equal-layout cache (no beam
+        # decode-width query over an equal-layout cache (no beam
         # broadcast on K/V — that pattern reads better through XLA's own
-        # batched matmul). Rank 3 ([B, 1, H] state over [B, T, H] encoder
-        # outputs — the GRU-attention NMT idiom) fuses too: the batch rows
-        # simply ride the fused kernel's head axis.
-        if len(qs) < 3 or qs[-2] != 1 or len(ks) != len(qs) or \
+        # batched matmul). Width 1 is the plain decode tick; 1 < G < T is
+        # a speculative verify window (γ+1 positions scored against the
+        # cache in one forward). Full-sequence chains (Tq == Tk) are NOT
+        # decode steps and stay unfused. Rank 3 ([B, 1, H] state over
+        # [B, T, H] encoder outputs — the GRU-attention NMT idiom) fuses
+        # too: the batch rows simply ride the fused kernel's head axis.
+        if len(qs) < 3 or len(ks) != len(qs) or \
+                not (qs[-2] == 1 or 1 < qs[-2] < ks[-2]) or \
                 tuple(ks[:-2]) != tuple(qs[:-2]) or tuple(vs) != tuple(ks):
             return None
-        tgt = tuple(qs[:-2]) + (1, ks[-2])
+        tgt = tuple(qs[:-2]) + (qs[-2], ks[-2])
         if len(bs) != len(tgt) or any(
                 bd != 1 and bd != td for bd, td in zip(bs, tgt)):
             return None
@@ -415,8 +419,16 @@ class QuantizeParamsPass(Pass):
             for name, var in blk.vars.items():
                 if (not var.persistable or name in written
                         or var.shape is None or len(var.shape) != 2
-                        or -1 in var.shape or str(var.dtype) != "float32"
-                        or not scope.has_var(name)):
+                        or -1 in var.shape or str(var.dtype) != "float32"):
+                    continue
+                # A twin program (e.g. a speculative verify forward sharing
+                # weights by name with an already-quantized serving program)
+                # sees the f32 payload gone from the scope but the quantized
+                # pair present: reuse the existing payloads instead of
+                # skipping, so both programs read the same HBM arrays.
+                reuse = not scope.has_var(name)
+                if reuse and not (scope.has_var(name + "@qparam")
+                                  and scope.has_var(name + "@qscale")):
                     continue
                 if bits == 4 and var.shape[1] % 2:
                     continue     # nibble packing needs even columns
@@ -434,22 +446,36 @@ class QuantizeParamsPass(Pass):
                         ok = False
                         break
                 if ok:
-                    chosen[name] = blk
+                    chosen[name] = (blk, reuse)
         if not chosen:
             return program
 
-        for name, blk in chosen.items():
-            w = np.asarray(scope.get(name), np.float32)
-            q, s = quantize_blocks_2d(w, bits=bits, block=tile)
+        for name, (blk, reuse) in chosen.items():
             qname, sname = name + "@qparam", name + "@qscale"
+            if reuse:
+                var = blk.vars[name]
+                q = np.asarray(scope.get(qname))
+                s = np.asarray(scope.get(sname))
+                want_cols = var.shape[1] // 2 if bits == 4 else var.shape[1]
+                if tuple(q.shape) != (var.shape[0], want_cols):
+                    raise InvalidArgumentError(
+                        f"existing quantized payload {qname} has shape "
+                        f"{tuple(q.shape)}, incompatible with {name} "
+                        f"{tuple(var.shape)} at bits={bits} — the twin "
+                        f"program must be quantized at the same bits as "
+                        f"the scope's resident payloads")
+            else:
+                w = np.asarray(scope.get(name), np.float32)
+                q, s = quantize_blocks_2d(w, bits=bits, block=tile)
             blk.create_var(name=qname, shape=tuple(q.shape), dtype="int8",
                            persistable=True, stop_gradient=True)
             blk.create_var(name=sname, shape=tuple(s.shape),
                            dtype="float32", persistable=True,
                            stop_gradient=True)
-            scope.set_var(qname, q)
-            scope.set_var(sname, s)
-            scope.erase(name)
+            if not reuse:
+                scope.set_var(qname, q)
+                scope.set_var(sname, s)
+                scope.erase(name)
             blk.vars.pop(name, None)
 
         for blk in program.blocks:

@@ -43,13 +43,15 @@ def _round_up(n, m):
     return -(-n // m) * m
 
 
-def _decode_xla(q4, k4, v4, bias3, scale):
-    """Normalized-shape composite: q4 [R, nh, 1, dh], k4/v4 [R, nh, T, dh],
-    bias3 [R, nh, T]. Replicates the unfused op chain's math exactly
-    (matmul in f32 preferred type, alpha after, softmax last-axis)."""
+def _decode_xla(q4, k4, v4, bias4, scale):
+    """Normalized-shape composite: q4 [R, nh, G, dh], k4/v4 [R, nh, T, dh],
+    bias4 [R, nh, G, T]. G is 1 for the plain decode tick and γ+1 for a
+    speculative verify forward. Replicates the unfused op chain's math
+    exactly (matmul in f32 preferred type, alpha after, softmax last-axis).
+    """
     s = jnp.matmul(q4, jnp.swapaxes(k4, -1, -2),
                    preferred_element_type=jnp.float32).astype(q4.dtype)
-    s = s * scale + bias3[:, :, None, :]
+    s = s * scale + bias4
     w = jax.nn.softmax(s, axis=-1)
     out = jnp.matmul(w, v4, preferred_element_type=jnp.float32)
     return out.astype(q4.dtype)
@@ -124,26 +126,28 @@ def _pallas_fits(nh, t, dh):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _decode_attention(q4, k4, v4, bias3, scale, backend):
-    if backend != "xla" and not _pallas_fits(q4.shape[1], k4.shape[2],
-                                             q4.shape[3]):
+def _decode_attention(q4, k4, v4, bias4, scale, backend):
+    if backend != "xla" and (
+            q4.shape[2] != 1   # Mosaic kernel is single-position only —
+                               # verify widening (G>1) takes the composite
+            or not _pallas_fits(q4.shape[1], k4.shape[2], q4.shape[3])):
         backend = "xla"   # cache block would blow the VMEM budget
     if backend == "xla":
-        return _decode_xla(q4, k4, v4, bias3, scale)
-    return _decode_pallas(q4, k4, v4, bias3, scale,
+        return _decode_xla(q4, k4, v4, bias4, scale)
+    return _decode_pallas(q4, k4, v4, bias4[:, :, 0, :], scale,
                           interpret=(backend == "pallas_interpret"))
 
 
-def _decode_attention_fwd(q4, k4, v4, bias3, scale, backend):
-    return (_decode_attention(q4, k4, v4, bias3, scale, backend),
-            (q4, k4, v4, bias3))
+def _decode_attention_fwd(q4, k4, v4, bias4, scale, backend):
+    return (_decode_attention(q4, k4, v4, bias4, scale, backend),
+            (q4, k4, v4, bias4))
 
 
 def _decode_attention_bwd(scale, backend, res, g):
-    q4, k4, v4, bias3 = res
+    q4, k4, v4, bias4 = res
     _, vjp = jax.vjp(
         lambda q_, k_, v_, b_: _decode_xla(q_, k_, v_, b_, scale),
-        q4, k4, v4, bias3)
+        q4, k4, v4, bias4)
     return vjp(g)
 
 
@@ -196,11 +200,13 @@ def fused_decode_attention(q, k, v, bias, scale=1.0, backend=None,
                            k_scale=None, v_scale=None):
     """One decode tick of cached attention in one kernel.
 
-    q [..., nh, 1, dh] (single query position), k/v [..., nh, T, dh]
-    (the KV cache), bias broadcastable to [..., nh, 1, T] (additive mask
-    hiding cache positions beyond the current tick). Returns
-    [..., nh, 1, dh]. Equals matmul(q, k^T)*scale + bias → softmax →
-    matmul(·, v) exactly.
+    q [..., nh, G, dh] (G query positions: 1 for the plain decode tick,
+    γ+1 for a speculative verify forward), k/v [..., nh, T, dh] (the KV
+    cache), bias broadcastable to [..., nh, G, T] (additive mask hiding
+    cache positions beyond each query's tick — causal within the verify
+    window). Returns [..., nh, G, dh]. Equals matmul(q, k^T)*scale + bias
+    → softmax → matmul(·, v) exactly. G == 1 may take the Pallas kernel;
+    G > 1 always lowers through the identical XLA composite.
 
     Quantized variant: pass int8 k/v payloads plus `k_scale`/`v_scale`
     from `quantize_kv_time_blocks` (f32 [..., nh, T//bt]); the caches are
@@ -214,18 +220,18 @@ def fused_decode_attention(q, k, v, bias, scale=1.0, backend=None,
     if v_scale is not None:
         v = dequantize_kv_time_blocks(v, v_scale, dtype=q.dtype)
     lead = q.shape[:-3]
-    nh, dh = q.shape[-3], q.shape[-1]
+    nh, g, dh = q.shape[-3], q.shape[-2], q.shape[-1]
     t = k.shape[-2]
     r = 1
     for d in lead:
         r *= d
-    q4 = q.reshape((r, nh, 1, dh))
+    q4 = q.reshape((r, nh, g, dh))
     k4 = jnp.broadcast_to(k, lead + k.shape[-3:]).reshape((r, nh, t, dh))
     v4 = jnp.broadcast_to(v, lead + v.shape[-3:]).reshape((r, nh, t, dh))
-    bias3 = jnp.broadcast_to(
-        bias, lead + (nh, 1, t)).reshape((r, nh, t)).astype(jnp.float32)
-    out = _decode_attention(q4, k4, v4, bias3, float(scale), backend)
-    return out.reshape(lead + (nh, 1, dh))
+    bias4 = jnp.broadcast_to(
+        bias, lead + (nh, g, t)).reshape((r, nh, g, t)).astype(jnp.float32)
+    out = _decode_attention(q4, k4, v4, bias4, float(scale), backend)
+    return out.reshape(lead + (nh, g, dh))
 
 
 @register_op("fused_decode_attention")

@@ -883,7 +883,15 @@ def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None,
 
 def elementwise_op_layer(op_type, x, y, axis=-1, act=None, name=None):
     helper = LayerHelper(op_type, name=name, act=act)
-    shape = x.shape if len(x.shape or ()) >= len(y.shape or ()) else y.shape
+    xs, ys = x.shape or (), y.shape or ()
+    if len(xs) == len(ys) and all(
+            d is not None and d != -1 for d in (*xs, *ys)):
+        # equal-rank operands: declare the true numpy broadcast shape
+        # (size-1 dims stretch), so e.g. [S,1,1] + [1,G,1] declares
+        # [S,G,1] — what the analyzer's inference derives
+        shape = [max(a, b) for a, b in zip(xs, ys)]
+    else:
+        shape = xs if len(xs) >= len(ys) else ys
     out = helper.create_tmp_variable(dtype=dtype_name(x.dtype), shape=shape)
     helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
                      outputs={"Out": [out]}, attrs={"axis": axis})
@@ -965,6 +973,34 @@ def paged_cache_write(pool, new, block_ids, offsets, out=None, name=None):
                              "Offsets": [offsets]},
                      outputs={"Out": [out]})
     return out
+
+
+def paged_cache_write_quant(pool, scales, new, block_ids, offsets,
+                            out=None, scales_out=None, name=None):
+    """int8 paged KV write: quantize each f32 row of `new` over its dh
+    vector (symmetric amax/127) and scatter payload + per-row scale into
+    `pool` (int8, [n_blocks, nh, block_size, dh]) and `scales` (f32,
+    [n_blocks, nh, block_size, 1]). Returns (pool_out, scales_out); pass
+    the pool variables themselves as `out`/`scales_out` to round-trip both
+    through the executor's donated-state path, as `paged_cache_write`
+    does. The read side dequantizes with one cast+multiply against the
+    gathered scale view — XLA fuses it into the cache read, so the HBM
+    resident AND streamed bytes are the int8 payload."""
+    helper = LayerHelper("paged_cache_write_quant", name=name)
+    if out is None:
+        out = helper.create_tmp_variable(dtype=dtype_name(pool.dtype),
+                                         shape=pool.shape,
+                                         stop_gradient=True)
+    if scales_out is None:
+        scales_out = helper.create_tmp_variable(
+            dtype=dtype_name(scales.dtype), shape=scales.shape,
+            stop_gradient=True)
+    helper.append_op(type="paged_cache_write_quant",
+                     inputs={"Cache": [pool], "Scales": [scales],
+                             "New": [new], "BlockIds": [block_ids],
+                             "Offsets": [offsets]},
+                     outputs={"Out": [out], "ScalesOut": [scales_out]})
+    return out, scales_out
 
 
 def lrn(input, n=5, k=2.0, alpha=1e-4, beta=0.75, name=None):

@@ -143,8 +143,8 @@ class LedgerRow:
     #: declared shapes + placement markers (costs.memory_categories) —
     #: any drift is a placement/accounting bug, not noise
     MEMORY_EXACT_CATEGORIES = ("params", "params_quantized",
-                               "optimizer_state", "ef_residual",
-                               "other_state", "feeds")
+                               "params_draft", "optimizer_state",
+                               "ef_residual", "other_state", "feeds")
 
     def check_memory_identity(self, residual_frac: float = 0.10) -> Dict:
         """The r17 memory accounting identity: every MEASURED per-device
@@ -185,6 +185,7 @@ class LedgerRow:
         measured = {
             "params": mcats["params"],
             "params_quantized": mcats["params_quantized"],
+            "params_draft": mcats["params_draft"],
             "optimizer_state": mcats["optimizer_state"],
             "ef_residual": mcats["ef_residual"],
             # kv_cache is the census's refinement of other_state (slot
@@ -261,8 +262,9 @@ class LedgerRow:
                   feeds=mem_p["feeds"]["per_device_bytes"])
         su = dict(mem_u["state"]["categories"],
                   feeds=mem_u["feeds"]["per_device_bytes"])
-        cats = ("params", "params_quantized", "optimizer_state",
-                "ef_residual", "kv_cache", "other_state", "feeds")
+        cats = ("params", "params_quantized", "params_draft",
+                "optimizer_state", "ef_residual", "kv_cache",
+                "other_state", "feeds")
         same_state = all(abs(sp[c] - su[c]) < 0.5 for c in cats)
         # record every compared category so a failing artifact row shows
         # WHICH one the plan perturbed

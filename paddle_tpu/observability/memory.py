@@ -186,6 +186,7 @@ def state_census(scope, program, names: Sequence[str],
     program)."""
     kv = set(kv_names)
     cats: Dict[str, float] = {"params": 0.0, "params_quantized": 0.0,
+                              "params_draft": 0.0,
                               "optimizer_state": 0.0, "ef_residual": 0.0,
                               "kv_cache": 0.0, "other_state": 0.0}
     per_var: Dict[str, Dict] = {}
@@ -204,8 +205,8 @@ def state_census(scope, program, names: Sequence[str],
         per_var[name] = {"category": cat, "per_device_bytes": nb}
     cats["state_total"] = sum(cats[c] for c in
                               ("params", "params_quantized",
-                               "optimizer_state", "ef_residual",
-                               "kv_cache", "other_state"))
+                               "params_draft", "optimizer_state",
+                               "ef_residual", "kv_cache", "other_state"))
     return {"categories": cats, "per_var": per_var}
 
 

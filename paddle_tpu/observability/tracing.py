@@ -53,6 +53,10 @@ SPAN_KINDS = frozenset({
                    # a Chrome COUNTER track, observability/memory.py)
     "dispatch",    # host-side argument assembly + write-back around the
                    # compiled tick fn (serving engine zero-dispatch path)
+    "speculate",   # one speculative round's draft-model propose phase
+                   # (γ+1 bound draft ticks, serving/speculative.py)
+    "verify",      # the round's single target verify forward over the
+                   # γ+1-wide window (serving/speculative.py)
     "user",        # RecordEvent-style user annotation
 })
 

@@ -242,6 +242,38 @@ def _paged_cache_write(ctx, ins, attrs):
     return {"Out": [pool.at[blocks, :, offs, :].set(new)]}
 
 
+@register_op("paged_cache_write_quant", stop_gradient=True)
+def _paged_cache_write_quant(ctx, ins, attrs):
+    """int8 variant of `paged_cache_write`: the pool stores int8 payloads
+    plus a per-row f32 scale pool (`Scales`, [n_blocks, nh, block_size, 1])
+    and each incoming f32 row is quantized symmetrically over its dh
+    vector on the way in — amax/127 scale per (slot, head) row, zero rows
+    pinned to scale 1.0 so dequantization is exact for them. The payload
+    scatter and the scale scatter are the same one-XLA-scatter shape as
+    the f32 write; the engine-side win is the pool's RESIDENT bytes
+    (f32 -> int8 + one scale per dh row), which the pager hands back as
+    extra admitted blocks. Same null-block steering contract as
+    `paged_cache_write`."""
+    pool = ins["Cache"][0]
+    scales = ins["Scales"][0]
+    new = jnp.asarray(ins["New"][0], jnp.float32)
+    blocks = ins["BlockIds"][0].reshape(-1).astype(jnp.int32)
+    offs = ins["Offsets"][0].reshape(-1).astype(jnp.int32)
+    if new.ndim != pool.ndim - 1:
+        raise ValueError(
+            f"paged_cache_write_quant: New must drop exactly the pool's "
+            f"block-size axis (pool {pool.shape}, New {new.shape})")
+    if blocks.shape != offs.shape:
+        raise ValueError(
+            f"paged_cache_write_quant: BlockIds {blocks.shape} and "
+            f"Offsets {offs.shape} must agree")
+    amax = jnp.max(jnp.abs(new), axis=-1, keepdims=True)
+    sc = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(new / sc), -127, 127).astype(jnp.int8)
+    return {"Out": [pool.at[blocks, :, offs, :].set(q)],
+            "ScalesOut": [scales.at[blocks, :, offs, :].set(sc)]}
+
+
 @register_op("one_hot", stop_gradient=True)
 def _one_hot(ctx, ins, attrs):
     x = ins["X"][0]

@@ -18,6 +18,11 @@ package (ISSUE r20 tentpole):
   block, and `PagedKVEngine` — the engine that decodes through it
   (token-identical to the slot engine, at a fraction of the KV bytes
   per request; `BENCH_SERVE_KV_r20.json`).
+- `speculative` — speculative decoding over either engine
+  (`SpecConfig`, `SpeculativeDecoder`): a quantized draft twin proposes
+  γ tokens, one γ+1-wide target forward verifies, rejected paged blocks
+  roll back through the pager (greedy mode token-identical to plain
+  decode; `BENCH_SPEC_r22.json`).
 """
 
 from __future__ import annotations
@@ -46,6 +51,13 @@ from .engine import (  # noqa: F401
     SlotAllocator,
     scrape_healthz,
     scrape_metrics,
+)
+
+# -- speculative decoding --------------------------------------------------
+from .speculative import (  # noqa: F401
+    SpecConfig,
+    SpeculativeDecoder,
+    rejection_sample,
 )
 
 # -- paged KV cache --------------------------------------------------------

@@ -115,7 +115,8 @@ class GenRequest:
                  "first_token_at", "done_at", "on_done", "_event",
                  "submitted_pc", "admitted_at", "admitted_pc",
                  "first_token_pc", "done_pc", "sent_at", "sent_pc",
-                 "defer_transport", "table", "shared_len")
+                 "defer_transport", "table", "shared_len",
+                 "spec_draft_s", "spec_verify_s")
 
     def __init__(self, rid, prompt, max_new, eos_id=None, on_done=None,
                  request_id: Optional[str] = None,
@@ -147,6 +148,12 @@ class GenRequest:
         #: slot engine.
         self.table = None
         self.shared_len = 0
+        #: speculative-decoding sub-phase accumulators: wall seconds this
+        #: request spent inside `speculate` (draft ticks) and `verify`
+        #: (target forward) rounds — SUB-phases of prefill+decode, not a
+        #: fifth/sixth partition member (phases(subphases=True))
+        self.spec_draft_s = 0.0
+        self.spec_verify_s = 0.0
         #: True when a server OWNS the transport phase (it will call
         #: engine.report_sent once the completion frame is on the wire
         #: — or immediately if the frame cannot be delivered); False =
@@ -162,21 +169,29 @@ class GenRequest:
     def latency_s(self) -> Optional[float]:
         return (self.done_at - self.submitted_at) if self.done else None
 
-    def phases(self) -> Optional[Dict[str, float]]:
+    def phases(self, subphases: bool = False) -> Optional[Dict[str, float]]:
         """{queue_wait, prefill, decode, transport} seconds (transport 0
         until/unless a server reports the completion frame sent); None
-        before completion."""
+        before completion. The four phases always partition
+        [submitted, sent] exactly. With `subphases=True`, a request
+        served speculatively additionally reports `spec_draft` and
+        `spec_verify` — SUB-phases of the prefill+decode window (their
+        sum is bounded by prefill+decode, not added to the partition)."""
         if self.done_pc is None:
             return None
         first = self.first_token_pc if self.first_token_pc is not None \
             else self.done_pc
-        return {
+        ph = {
             "queue_wait": self.admitted_pc - self.submitted_pc,
             "prefill": first - self.admitted_pc,
             "decode": self.done_pc - first,
             "transport": ((self.sent_pc - self.done_pc)
                           if self.sent_pc is not None else 0.0),
         }
+        if subphases:
+            ph["spec_draft"] = self.spec_draft_s
+            ph["spec_verify"] = self.spec_verify_s
+        return ph
 
     def e2e_s(self) -> Optional[float]:
         """Measured end-to-end latency on the perf_counter clock:
@@ -217,7 +232,8 @@ class ContinuousBatchingEngine:
                  eos_id: Optional[int] = None, scope=None,
                  policy: str = "continuous",
                  cache_prefix: Optional[str] = None,
-                 quant: Optional[str] = None):
+                 quant: Optional[str] = None,
+                 speculative=None):
         from ..core import unique_name
         from ..framework.executor import Executor
         from ..framework.program import Program, program_guard
@@ -235,6 +251,14 @@ class ContinuousBatchingEngine:
         self.n_slots = n_slots
         self.max_len = max_len
         self.eos_id = eos_id
+        #: the model dims + cache namespace, kept for the auxiliary
+        #: program builders (speculative draft/verify ticks must match
+        #: the main tick's architecture and share its cache names)
+        self._cache_prefix = cache_prefix
+        self._builder_dims = dict(
+            vocab=vocab, d_model=d_model, d_inner=d_inner,
+            num_heads=num_heads, num_layers=num_layers, dropout=dropout,
+            packed=packed)
         self._slots = SlotAllocator(n_slots)
         self._active: Dict[int, GenRequest] = {}      # slot -> request
         self._pending: "deque[GenRequest]" = deque()
@@ -250,6 +274,16 @@ class ContinuousBatchingEngine:
         self.scope = scope or global_scope()
         self._exe = Executor()
         self._init_missing_vars(Scope)
+        # speculative decoding (serving/speculative.py): the draft model
+        # COPIES the target's f32 weights under the reserved `draft_`
+        # prefix, so it must be built BEFORE the target quantize pass
+        # erases the f32 payloads; its prepared steps bind in
+        # `spec.finalize()` after the main step is bound below
+        self.spec = None
+        if speculative is not None and speculative is not False:
+            from .speculative import SpeculativeDecoder
+            self.spec = SpeculativeDecoder(self, speculative)
+            self.spec.build_draft()
         # weight-only quantized serving (quant='int8'/'int4'): rewrite the
         # tick program's persistable f32 weights into block-scaled
         # (payload, scales) pairs BEFORE the step is prepared. The freed
@@ -284,11 +318,21 @@ class ContinuousBatchingEngine:
         # engine's in-place-mutated feed arrays — argument tuples are
         # built once here, never per tick (PreparedStep.bind)
         self._step.bind(self._feeds)
+        # which bound step's held rw tuple points at the LIVE target
+        # caches: "main" (the plain tick) or "verify" (the speculative
+        # verify forward). The two share the donated cache buffers, so
+        # whichever runs after the other refreshes first
+        # (PreparedStep.refresh_state); pure steady states never refresh.
+        self._target_state_owner = "main"
         # census counters (tools/bench_serve.py occupancy evidence)
         self.n_ticks = 0
         self.busy_slot_ticks = 0
         self.total_slot_ticks = 0
         self.tokens_out = 0
+        #: TARGET-model forwards executed (plain ticks + verify
+        #: forwards): the denominator of tokens-per-target-forward — the
+        #: speculative amortization headline (tools/bench_spec.py)
+        self.target_forwards = 0
         self._started_at = time.time()
         #: wall time of the last executed decode tick (None before the
         #: first) — /healthz reports its age as the liveness signal
@@ -309,6 +353,11 @@ class ContinuousBatchingEngine:
         self._kv_bytes_per_token = (self._kv_bytes_static
                                     / max(n_slots * max_len, 1))
         self._stamp_kv_watermarks({})
+        if self.spec is not None:
+            # builds + quantizes the verify program (twin of the main
+            # tick — same resident payloads), binds both spec steps,
+            # registers the spec gauges
+            self.spec.finalize()
 
     # -- tick-program construction (overridden by PagedKVEngine) ----------
     def _build_tick_program(self, n_slots, vocab, max_len, d_model,
@@ -507,6 +556,48 @@ class ContinuousBatchingEngine:
         ran. The paged engine uses this to mark prefix blocks filled
         (sharable) the moment their last row lands."""
 
+    # -- speculative-decoding hooks (overridden by PagedKVEngine) ---------
+    def _build_verify_tick(self, gamma):
+        """Build the verify program (γ+1-wide window forward over the
+        TARGET's caches and weights, shared by name) into the current
+        default programs; returns (ids, logp, cache_names)."""
+        from ..models import transformer
+        d = self._builder_dims
+        return transformer.transformer_lm_spec_verify_tick(
+            n_slots=self.n_slots, gamma=gamma, vocab=d["vocab"],
+            max_len=self.max_len, d_model=d["d_model"],
+            d_inner=d["d_inner"], num_heads=d["num_heads"],
+            num_layers=d["num_layers"], dropout=d["dropout"],
+            packed=d["packed"], cache_prefix=self._cache_prefix)
+
+    def _init_verify_feeds(self, g: int) -> Dict[str, np.ndarray]:
+        """The verify forward's reusable feed arrays (g = γ+1)."""
+        return {"spec_tok": np.zeros((self.n_slots, g), np.int64),
+                "spec_pos": np.zeros((self.n_slots, 1, 1), np.float32)}
+
+    def _fill_verify_row(self, feeds, slot: int, req: GenRequest,
+                         g: int):
+        """Fill slot `slot`'s verify-feed rows for a window starting at
+        `req.fed` (spec_tok is filled batch-wide by the caller)."""
+        feeds["spec_pos"][slot, 0, 0] = float(req.fed)
+
+    def _spec_capable(self, req: GenRequest, g: int) -> bool:
+        """Can `req` take a full γ+1 window without overrunning its KV
+        span? A single ineligible slot degrades the whole step to one
+        plain tick (mixed windows aren't worth a second compiled
+        shape)."""
+        return req.fed + g <= self.max_len
+
+    def _spec_rollback(self, req: GenRequest, keep_len: int,
+                       written_len: int) -> int:
+        """Positions [keep_len, written_len) of `req` were written by a
+        verify forward but rejected. Slot engine: a no-op — the stale
+        rows sit above the slot's position mask and are rewritten before
+        they are ever exposed (the same write-before-expose argument as
+        slot reuse). The paged engine rolls fully-dead blocks back
+        through the pager. Returns the number of blocks rolled back."""
+        return 0
+
     def _admit(self):
         admitted = []
         with _tracing.span("admission", "engine/admit",
@@ -550,16 +641,77 @@ class ContinuousBatchingEngine:
         with self._lock:
             return len(self._pending)
 
+    def _advance_slot(self, req: GenRequest, out_id: int) -> bool:
+        """Advance `req` one position with the model's output `out_id`
+        for that position — the per-slot commit shared by the plain tick
+        and every speculative verify position (identical phase stamps and
+        finish semantics by construction). Returns True when the request
+        just finished (max_new / eos / out of room)."""
+        k = req.fed                    # the position just consumed
+        req.fed += 1
+        self._note_position_written(req, k)
+        if k < len(req.prompt) - 1:
+            req.next_tok = req.prompt[k + 1]     # still prefilling
+            return False
+        t = int(out_id)                          # sampled next token
+        if req.first_token_at is None:
+            req.first_token_at = time.time()
+            req.first_token_pc = time.perf_counter()
+        req.tokens.append(t)
+        self.tokens_out += 1
+        self._m_tokens.inc()
+        req.next_tok = t
+        hit_eos = (req.eos_id is not None and t == req.eos_id)
+        out_of_room = req.fed >= self.max_len
+        return len(req.tokens) >= req.max_new or hit_eos or out_of_room
+
     def step(self) -> List[GenRequest]:
-        """One decode tick: admit, run, collect. Returns the requests that
-        COMPLETED on this tick. A no-op (returns []) when nothing is
-        active or pending. Each executed tick is recorded as a "tick"
-        span and observed into the tick-latency histogram."""
+        """One decode step: admit, run, collect. Returns the requests
+        that COMPLETED on this step. A no-op (returns []) when nothing is
+        active or pending. Without speculation (or when any active
+        request is too close to its length cap to take a full window)
+        this is one plain tick, recorded as a "tick" span and observed
+        into the tick-latency histogram; with `speculative=` it is one
+        speculative round (γ+1 draft ticks + one verify forward —
+        `speculate`/`verify` spans) advancing every slot up to γ+1
+        positions."""
         self._admit()
         with self._lock:
             active = dict(self._active)
         if not active:
             return []
+        if self.spec is not None and all(
+                self._spec_capable(r, self.spec.cfg.gamma + 1)
+                for r in active.values()):
+            finished = self.spec.round(active)
+            self._m_ticks.inc()
+            self.n_ticks += 1
+            self.last_tick_at = time.time()
+            self._stamp_kv_watermarks(active)
+            self.busy_slot_ticks += len(active)
+            self.total_slot_ticks += self.n_slots
+        else:
+            finished = self._plain_tick(active)
+        if finished:
+            # complete (firing on_done -> writer.offer) BEFORE dropping
+            # the request from _active: a drain poll reading
+            # n_active==0 must imply every completion frame is already
+            # in its writer queue, or the drain could close the writer
+            # ahead of the final frame and silently drop it
+            for req in finished:
+                req._complete()
+            with self._lock:
+                for req in finished:
+                    del self._active[req.slot]
+                    self._slots.free(req.slot)
+                    self._release_request(req)
+            self._m_completed.inc(len(finished))
+            for req in finished:
+                self._finalize_request(req)
+        return finished
+
+    def _plain_tick(self, active: Dict[int, "GenRequest"]
+                    ) -> List[GenRequest]:
         t0 = time.perf_counter()
         # the rid list is trace provenance only — don't build it per
         # tick when tracing is off (the decode loop is the hot path)
@@ -569,7 +721,14 @@ class ContinuousBatchingEngine:
                                          for r in active.values()]
         with _tracing.span("tick", "engine/tick", **span_attrs):
             self._fill_tick_feeds(active)
+            if self._target_state_owner != "main":
+                # a speculative verify forward ran since the last plain
+                # tick and owns the donated target-cache buffers —
+                # re-point the bound step at the live arrays
+                self._step.refresh_state()
+                self._target_state_owner = "main"
             fetches = self._step.run_bound()   # zero-dispatch bound tick
+            self.target_forwards += 1
             td = time.perf_counter()           # async dispatch returned
             ids = np.asarray(fetches[0])   # realization barrier: the next
             #                                tick's feed depends on it
@@ -592,40 +751,8 @@ class ContinuousBatchingEngine:
         self.total_slot_ticks += self.n_slots
         finished = []
         for slot, req in active.items():
-            k = req.fed                    # the position just consumed
-            req.fed += 1
-            self._note_position_written(req, k)
-            if k < len(req.prompt) - 1:
-                req.next_tok = req.prompt[k + 1]     # still prefilling
-                continue
-            t = int(ids[slot, 0])                    # sampled next token
-            if req.first_token_at is None:
-                req.first_token_at = time.time()
-                req.first_token_pc = time.perf_counter()
-            req.tokens.append(t)
-            self.tokens_out += 1
-            self._m_tokens.inc()
-            req.next_tok = t
-            hit_eos = (req.eos_id is not None and t == req.eos_id)
-            out_of_room = req.fed >= self.max_len
-            if len(req.tokens) >= req.max_new or hit_eos or out_of_room:
+            if self._advance_slot(req, int(ids[slot, 0])):
                 finished.append(req)
-        if finished:
-            # complete (firing on_done -> writer.offer) BEFORE dropping
-            # the request from _active: a drain poll reading
-            # n_active==0 must imply every completion frame is already
-            # in its writer queue, or the drain could close the writer
-            # ahead of the final frame and silently drop it
-            for req in finished:
-                req._complete()
-            with self._lock:
-                for req in finished:
-                    del self._active[req.slot]
-                    self._slots.free(req.slot)
-                    self._release_request(req)
-            self._m_completed.inc(len(finished))
-            for req in finished:
-                self._finalize_request(req)
         return finished
 
     def _finalize_request(self, req: GenRequest):
@@ -701,6 +828,11 @@ class ContinuousBatchingEngine:
                                 if self.last_tick_at is not None
                                 else None),
             "uptime_s": now - self._started_at,
+            "target_forwards": self.target_forwards,
+            "tokens_per_target_forward": (
+                self.tokens_out / max(self.target_forwards, 1)),
+            "speculative": (self.spec.stats()
+                            if self.spec is not None else None),
         }
 
 

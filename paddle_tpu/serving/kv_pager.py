@@ -290,6 +290,7 @@ class KVPager:
         self.blocks_allocated_total = 0
         self.evictions = 0
         self.cow_copies = 0
+        self.rolled_back_blocks = 0     # speculative-decode rejected spans
 
     # -- admission --------------------------------------------------------
     def blocks_needed(self, length: int) -> int:
@@ -401,6 +402,41 @@ class KVPager:
             self.pool.release(b)
         table.blocks = []
 
+    def rollback(self, table: BlockTable, keep_len: int,
+                 written_len: int) -> int:
+        """Roll back the table entries whose EVERY position lies in a
+        speculative round's rejected span [keep_len, written_len):
+        release the dirty block and remap the entry to a fresh one. The
+        boundary block holding position keep_len-1 stays — its rejected
+        tail is dead under the position mask and the next round's writes
+        land on it before it is ever exposed.
+
+        Written blocks are always PRIVATE (writes never target shared
+        blocks — try_admit caps the shared span below the first write),
+        so each release frees its block; allocating right after can
+        therefore never come up dry (release-first guarantees the pool
+        holds at least the block just freed). Both halves are enforced:
+        a refcounted rollback block or a failed realloc is an invariant
+        breach, not a condition to handle."""
+        bs = self.block_size
+        first = -(-int(keep_len) // bs)          # first fully-rejected block
+        last = (int(written_len) - 1) // bs      # last written block
+        n = 0
+        for j in range(first, min(last + 1, len(table.blocks))):
+            freed = self.pool.release(table.blocks[j])
+            enforce(freed,
+                    f"speculative rollback hit shared block "
+                    f"{table.blocks[j]} (logical {j}) — writes must "
+                    f"never land in shared blocks",
+                    exc=InvalidArgumentError)
+            nb = self.pool.alloc()
+            enforce(nb is not None, "alloc after release came up dry",
+                    exc=InvalidArgumentError)
+            table.blocks[j] = nb
+            n += 1
+        self.rolled_back_blocks += n
+        return n
+
     # -- introspection ----------------------------------------------------
     def stats(self) -> Dict:
         return {
@@ -421,6 +457,7 @@ class KVPager:
                                    if self.n_admitted else 0.0),
             "evictions": self.evictions,
             "cow_copies": self.cow_copies,
+            "rolled_back_blocks": self.rolled_back_blocks,
         }
 
 
@@ -462,16 +499,35 @@ class PagedKVEngine(ContinuousBatchingEngine):
                  cache_prefix: Optional[str] = None, block_size: int = 8,
                  n_blocks: Optional[int] = None,
                  prefix_sharing: bool = True, topk_k: int = 0,
-                 quant: Optional[str] = None):
+                 quant: Optional[str] = None, kv_quant: bool = False,
+                 speculative=None):
         self.block_size = int(block_size)
         self.blocks_per_req = -(-int(max_len) // self.block_size)
         self.prefix_sharing = bool(prefix_sharing)
         self.topk_k = int(topk_k)
+        self.kv_quant = bool(kv_quant)
+        # int8 KV block pools (ROADMAP item 2's remaining leg): the pool
+        # payload is int8 with one f32 scale per (block, head, row), so a
+        # block costs bytes_int8 = nh*bs*(dh+4) instead of nh*bs*dh*4 per
+        # k/v per layer. At the SAME byte budget the freed bytes buy
+        # extra admitted blocks: the capacity-neutral default n_blocks is
+        # scaled up by bytes_f32/bytes_int8 (an explicit n_blocks is
+        # honored as-is — the caller owns the budget then).
+        dh = d_model // num_heads
+        per_blk_f32 = 2 * num_layers * num_heads * self.block_size * dh * 4
+        per_blk_i8 = 2 * num_layers * num_heads * self.block_size * (dh + 4)
+        self.kv_quant_freed_bytes = 0
         if n_blocks is None:
             # capacity-neutral default: every slot can hold a full-span
             # request (+ null block) — callers size DOWN from here to
             # realize the paging win at fixed bytes
             n_blocks = n_slots * self.blocks_per_req + 1
+            if self.kv_quant:
+                budget = (n_blocks - 1) * per_blk_f32
+                n_blocks = 1 + budget // per_blk_i8
+        if self.kv_quant:
+            self.kv_quant_freed_bytes = \
+                (int(n_blocks) - 1) * (per_blk_f32 - per_blk_i8)
         self.n_blocks = int(n_blocks)
         enforce(self.n_blocks >= self.blocks_per_req + 1,
                 f"pool of {self.n_blocks} blocks cannot hold one "
@@ -487,7 +543,8 @@ class PagedKVEngine(ContinuousBatchingEngine):
             d_model=d_model, d_inner=d_inner, num_heads=num_heads,
             num_layers=num_layers, dropout=dropout, packed=packed,
             eos_id=eos_id, scope=scope, policy=policy,
-            cache_prefix=cache_prefix, quant=quant)
+            cache_prefix=cache_prefix, quant=quant,
+            speculative=speculative)
 
     # -- tick program -----------------------------------------------------
     def _build_tick_program(self, n_slots, vocab, max_len, d_model,
@@ -500,7 +557,8 @@ class PagedKVEngine(ContinuousBatchingEngine):
             blocks_per_req=self.blocks_per_req, vocab=vocab,
             d_model=d_model, d_inner=d_inner, num_heads=num_heads,
             num_layers=num_layers, dropout=dropout, packed=packed,
-            cache_prefix=cache_prefix, topk_k=self.topk_k)
+            cache_prefix=cache_prefix, topk_k=self.topk_k,
+            kv_quant=self.kv_quant)
         if self.topk_k:
             (self._next_ids, self.cache_names,
              self._topk_logp, self._topk_ids) = outs
@@ -562,6 +620,46 @@ class PagedKVEngine(ContinuousBatchingEngine):
                                          pos // self.block_size,
                                          req.prompt)
 
+    # -- speculative-decoding hooks (serving/speculative.py) --------------
+    def _build_verify_tick(self, gamma):
+        from ..models import transformer
+        d = self._builder_dims
+        return transformer.transformer_lm_paged_spec_verify_tick(
+            self.n_slots, gamma, n_blocks=self.n_blocks,
+            block_size=self.block_size,
+            blocks_per_req=self.blocks_per_req, vocab=d["vocab"],
+            d_model=d["d_model"], d_inner=d["d_inner"],
+            num_heads=d["num_heads"], num_layers=d["num_layers"],
+            dropout=d["dropout"], packed=d["packed"],
+            cache_prefix=self._cache_prefix, kv_quant=self.kv_quant)
+
+    def _init_verify_feeds(self, g):
+        f = super()._init_verify_feeds(g)
+        f["spec_btab"] = np.zeros((self.n_slots, self.blocks_per_req),
+                                  np.int64)
+        f["spec_wblock"] = np.zeros((self.n_slots, g), np.int64)
+        f["spec_woff"] = np.zeros((self.n_slots, g), np.int64)
+        return f
+
+    def _fill_verify_row(self, feeds, slot, req, g):
+        super()._fill_verify_row(feeds, slot, req, g)
+        blocks = req.table.blocks
+        feeds["spec_btab"][slot, :len(blocks)] = blocks
+        bs = self.block_size
+        for j in range(g):
+            lb, off = divmod(req.fed + j, bs)
+            feeds["spec_wblock"][slot, j] = blocks[lb]
+            feeds["spec_woff"][slot, j] = off
+
+    def _spec_capable(self, req, g) -> bool:
+        # the round's G writes must stay inside the request's block-table
+        # span (host-side block lookup would index past the table)
+        return (req.fed + g <= self.max_len
+                and req.fed + g <= len(req.table.blocks) * self.block_size)
+
+    def _spec_rollback(self, req, keep_len, written_len) -> int:
+        return self.pager.rollback(req.table, keep_len, written_len)
+
     # -- limits / accounting ----------------------------------------------
     def _enforce_request_fits(self, prompt, max_new):
         enforce(len(prompt) + int(max_new) <= self.max_len,
@@ -612,6 +710,14 @@ class PagedKVEngine(ContinuousBatchingEngine):
         r.gauge("ptpu_engine_cow_copies_total",
                 "Copy-on-write block copies at fork divergence points.",
                 fn=lambda: pager.cow_copies)
+        r.gauge("ptpu_engine_spec_rolled_back_blocks_total",
+                "Block-table entries rolled back to fresh blocks after "
+                "speculative verify rejected their whole span.",
+                fn=lambda: pager.rolled_back_blocks)
+        r.gauge("ptpu_engine_kv_quant_freed_bytes",
+                "Bytes the int8 KV block pools save vs f32 pools at the "
+                "same block count (0 with kv_quant off).",
+                fn=lambda: self.kv_quant_freed_bytes)
 
     # -- device block ops -------------------------------------------------
     def _copy_block(self, src: int, dst: int):
@@ -631,6 +737,8 @@ class PagedKVEngine(ContinuousBatchingEngine):
     def stats(self) -> Dict:
         s = super().stats()
         s["pager"] = self.pager.stats()
+        s["kv_quant"] = {"enabled": self.kv_quant,
+                         "freed_bytes": self.kv_quant_freed_bytes}
         return s
 
 
@@ -706,6 +814,10 @@ def paged_beam_search(engine: PagedKVEngine, prompt: Sequence[int],
             feeds["tick_wblock"][slot] = table.blocks[lb]
             feeds["tick_woff"][slot] = off
         out = engine._step.run(feeds)
+        # run() re-pointed the main step's bound rw tuple at the live
+        # cache arrays — a co-resident speculative verify step must
+        # refresh before it next runs
+        engine._target_state_owner = "main"
         engine.n_ticks += 1
         engine.last_tick_at = time.time()
         return np.asarray(out[1]), np.asarray(out[2])

@@ -1677,6 +1677,10 @@ COVERED_ELSEWHERE = {
     # and decode parity live in the quant-serving suite
     "qmatmul": "tests/test_quant_serving.py",
     "qlookup": "tests/test_quant_serving.py",
+    # int8 KV block pools (r22): the quantizing pool write needs the
+    # block table + pool + scales program context — op behavior, engine
+    # identity, and pool accounting live in the speculative suite
+    "paged_cache_write_quant": "tests/test_speculative.py",
 }
 
 

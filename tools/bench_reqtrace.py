@@ -13,6 +13,12 @@ the band is float/callback-ordering headroom, not slack in the
 definition). Also scrapes /metrics once and asserts the labeled
 histogram family is present for all four phases.
 
+With --speculative the engine decodes speculatively and the check
+extends to the r22 SUB-phases: `phases(subphases=True)` additionally
+reports spec_draft/spec_verify, which are parts OF the prefill+decode
+window, so the 4-phase partition must STILL sum to e2e and the
+sub-phase pair must fit inside prefill+decode (same band).
+
     python tools/bench_reqtrace.py --out BENCH_REQTRACE_r16.json
 """
 
@@ -29,13 +35,17 @@ sys.path.insert(0, REPO)
 
 
 def run(n_requests: int = 12, n_slots: int = 2, max_new: int = 6,
-        band: float = 0.05) -> dict:
+        band: float = 0.05, speculative: bool = False) -> dict:
     from paddle_tpu.serving_engine import (ContinuousBatchingEngine,
                                            EngineClient, EngineServer,
                                            scrape_healthz, scrape_metrics)
+    spec = None
+    if speculative:
+        from paddle_tpu.serving import SpecConfig
+        spec = SpecConfig(gamma=2, draft="int8")
     eng = ContinuousBatchingEngine(n_slots=n_slots, vocab=100, max_len=16,
                                    d_model=32, d_inner=64, num_heads=4,
-                                   num_layers=2)
+                                   num_layers=2, speculative=spec)
     with EngineServer(eng) as srv:
         host, port = srv.address
         with EngineClient(host, port) as c:
@@ -59,7 +69,7 @@ def run(n_requests: int = 12, n_slots: int = 2, max_new: int = 6,
         ssum = sum(ph.values())
         err = abs(ssum - e2e) / e2e if e2e > 0 else 0.0
         worst = max(worst, err)
-        rows.append({
+        row = {
             "request_id": req.request_id,
             "prompt_len": len(req.prompt),
             "new_tokens": len(req.tokens),
@@ -68,10 +78,25 @@ def run(n_requests: int = 12, n_slots: int = 2, max_new: int = 6,
             "e2e_ms": round(e2e * 1e3, 4),
             "rel_err": round(err, 6),
             "conservation_ok": err <= band,
-        })
+        }
+        if speculative:
+            # sub-phase containment: spec_draft+spec_verify are parts
+            # of the prefill+decode window, never a fifth partition
+            # member — the 4-phase sum above must be untouched by them
+            sub = req.phases(subphases=True)
+            spec_s = sub["spec_draft"] + sub["spec_verify"]
+            window = ph["prefill"] + ph["decode"]
+            row["subphases_ms"] = {
+                "spec_draft": round(sub["spec_draft"] * 1e3, 4),
+                "spec_verify": round(sub["spec_verify"] * 1e3, 4)}
+            row["subphase_ok"] = spec_s <= window * (1 + band)
+        rows.append(row)
     assert len(rows) == n_requests, (len(rows), n_requests)
     assert all(r["conservation_ok"] for r in rows), \
         [r for r in rows if not r["conservation_ok"]]
+    if speculative:
+        assert all(r["subphase_ok"] for r in rows), \
+            [r for r in rows if not r["subphase_ok"]]
 
     series_ok = {
         phase: (f'phase="{phase}"' in metrics_text)
@@ -105,8 +130,12 @@ def main():
     ap.add_argument("--out", default=None)
     ap.add_argument("--requests", type=int, default=12)
     ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--speculative", action="store_true",
+                    help="decode speculatively; also check the "
+                         "spec_draft/spec_verify sub-phase containment")
     args = ap.parse_args()
-    doc = run(n_requests=args.requests, n_slots=args.slots)
+    doc = run(n_requests=args.requests, n_slots=args.slots,
+              speculative=args.speculative)
     doc["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
     out = json.dumps(doc, indent=1)
     if args.out:

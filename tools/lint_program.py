@@ -91,6 +91,34 @@ def _builders():
             pt.default_main_program(), pt.global_scope())
         return None
 
+    def draft_tick():
+        # the speculative draft model's compiled tick
+        # (serving/speculative.py builds exactly this shape: the
+        # target's architecture at half depth, weights under the
+        # reserved draft_ prefix, logp emitted for rejection sampling)
+        models.transformer.transformer_lm_decode_tick(
+            n_slots=4, vocab=1000, max_len=32, d_model=64, d_inner=128,
+            num_heads=4, num_layers=1, cache_prefix="lintdr",
+            param_prefix="draft_", emit_logp=True)
+        return None
+
+    def spec_verify_tick():
+        # the speculative verify forward: γ+1 window positions scored
+        # through ONE target forward against the slot caches
+        models.transformer.transformer_lm_spec_verify_tick(
+            n_slots=4, gamma=4, vocab=1000, max_len=32, d_model=64,
+            d_inner=128, num_heads=4, num_layers=2)
+        return None
+
+    def paged_spec_verify_tick():
+        # ... and its paged twin: the same window scored through the
+        # block-table gather + paged_cache_write path
+        models.transformer.transformer_lm_paged_spec_verify_tick(
+            n_slots=4, gamma=4, n_blocks=17, block_size=8,
+            blocks_per_req=4, vocab=1000, d_model=64, d_inner=128,
+            num_heads=4, num_layers=2)
+        return None
+
     def prefill():
         # the teacher-forced prefill + greedy/beam generation program the
         # engine's prompt phase shares weights with
@@ -123,6 +151,9 @@ def _builders():
         "transformer_lm_decode_tick": decode_tick,
         "transformer_lm_quant_decode_tick": quant_decode_tick,
         "transformer_lm_paged_decode_tick": paged_decode_tick,
+        "transformer_lm_draft_tick": draft_tick,
+        "transformer_lm_spec_verify_tick": spec_verify_tick,
+        "transformer_lm_paged_spec_verify_tick": paged_spec_verify_tick,
         "transformer_lm_prefill": prefill,
         "machine_translation": mt,
     }

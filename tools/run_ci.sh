@@ -35,6 +35,14 @@ JAX_PLATFORMS=cpu python tools/lint_program.py \
     --model transformer_lm_paged_decode_tick
 JAX_PLATFORMS=cpu python tools/lint_program.py \
     --model transformer_lm_quant_decode_tick
+# the r22 speculative-decoding programs: draft tick + both verify
+# forwards (serving/speculative.py builds exactly these shapes)
+JAX_PLATFORMS=cpu python tools/lint_program.py \
+    --model transformer_lm_draft_tick
+JAX_PLATFORMS=cpu python tools/lint_program.py \
+    --model transformer_lm_spec_verify_tick
+JAX_PLATFORMS=cpu python tools/lint_program.py \
+    --model transformer_lm_paged_spec_verify_tick
 JAX_PLATFORMS=cpu python tools/lint_program.py --model transformer_lm_prefill
 # tp lint: tp-annotated transformer through tp_shard_pass at tp=2; prints
 # the propagated sharding-spec table and fails on any propagation conflict
@@ -800,5 +808,56 @@ per_tick = sum(max(d.size_diff, 0)
 assert per_tick < 2048, f"bound tick allocates {per_tick:.0f} B/tick"
 print(f"quantized-serving smoke OK ({per_tick:.0f} B/tick)")
 PY
+
+echo "== speculative-decoding smoke (r22: draft propose + one-forward verify) =="
+# γ=4 greedy speculation on the paged engine: decode must be
+# TOKEN-IDENTICAL to the target-only twin on shared weights (the accept
+# rule is structural), the acceptance gauge must be live on the engine
+# registry, and the block pool must reconcile with per-round checks on
+# (rollbacks included). The full harness is tools/bench_spec.py
+# (BENCH_SPEC_r22.json is the committed full-shape run).
+JAX_PLATFORMS=cpu PTPU_SPEC_POOL_CHECK=1 python - <<'PY'
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu.serving import PagedKVEngine, SpecConfig
+
+DIMS = dict(vocab=100, max_len=16, d_model=32, d_inner=64, num_heads=4,
+            num_layers=2)
+scope = pt.global_scope()
+base = PagedKVEngine(n_slots=3, block_size=4, scope=scope, **DIMS)
+spec = PagedKVEngine(n_slots=3, block_size=4, scope=scope,
+                     speculative=SpecConfig(gamma=4, draft="int8"), **DIMS)
+rng = np.random.RandomState(0)
+prompts = [rng.randint(1, 100, size=rng.randint(2, 6)).tolist()
+           for _ in range(5)]
+a = [base.submit(p, max_new=6) for p in prompts]
+base.run_until_idle()
+b = [spec.submit(p, max_new=6) for p in prompts]
+spec.run_until_idle()
+assert [r.tokens for r in a] == [r.tokens for r in b], \
+    "speculative decode diverged from the target-only twin"
+s = spec.spec.stats()
+assert s["rounds"] > 0 and 0.0 <= s["acceptance_rate"] <= 1.0
+assert spec.target_forwards < base.target_forwards, \
+    (spec.target_forwards, base.target_forwards)
+text = spec.metrics_registry.expose()
+for series in ("ptpu_engine_spec_acceptance_rate",
+               "ptpu_engine_spec_tokens_per_target_forward",
+               "ptpu_engine_spec_rolled_back_blocks"):
+    assert series in text, series
+pool = spec.pager.pool
+pool.check()
+assert pool.n_used + pool.n_free == pool.n_blocks - 1
+print(f"speculative smoke OK (acceptance={s['acceptance_rate']:.3f}, "
+      f"{spec.tokens_out / spec.target_forwards:.2f} tok/target-fwd "
+      f"vs 1.0 plain)")
+PY
+
+echo "== bench_spec smoke (speculative amortization harness) =="
+# the r22 harness end to end in --smoke shape: asserts greedy identity,
+# the ≥1.5x tokens-per-target-forward bar at saturation, per-round pool
+# reconciliation, and the params_draft ledger identity inside main()
+JAX_PLATFORMS=cpu python tools/bench_spec.py --smoke > /dev/null
+echo "bench_spec smoke OK"
 
 echo "CI OK"

@@ -70,6 +70,14 @@ class StrategyPoint:
     quant: str = ""                  # '' | int8 | bf16
     bucket_bytes: int = _DEFAULT_BUCKET
     memory_plan: bool = False
+    # host-offload tier (framework/offload.py): '' = device-resident,
+    # 'optimizer' = ZeRO-offload the accumulator shards to the pinned
+    # host pool between steps. Priced by costs.predict's `offload`
+    # section — predicted_step_seconds charges the unhidden PCIe
+    # residual, so a point whose round-trip cannot overlap loses here
+    # instead of at runtime. Numerics-preserving (the round-trip is
+    # bitwise), so executor adoption inherits rather than searches it.
+    offload: str = ""                # '' | optimizer
 
     @property
     def explicit(self) -> bool:
@@ -129,6 +137,7 @@ class StrategyPoint:
                               base.num_microbatches),
             pipeline_schedule=self.schedule,
             memory_plan=self.memory_plan,
+            offload_optimizer_state=(self.offload == "optimizer"),
         )
 
     def census_exact(self) -> bool:
@@ -160,6 +169,8 @@ class StrategyPoint:
             parts.append(f"b{self.bucket_bytes >> 20}MiB")
         if self.memory_plan:
             parts.append("memplan")
+        if self.offload:
+            parts.append(f"offl-{self.offload[:3]}")
         return "x".join(parts[:1]) + "-" + "-".join(parts[1:])
 
 
@@ -182,6 +193,12 @@ class SearchSpace:
     microbatches: Tuple[int, ...] = (2, 4, 8)
     bucket_bytes: Tuple[int, ...] = (1 << 20, _DEFAULT_BUCKET, 16 << 20)
     memory_plan: Tuple[bool, ...] = (False, True)
+    # '' only by default: the HBM budget this container's planner prices
+    # against is the v5e constant, and offloading optimizer state is a
+    # capacity lever the operator pulls (bench_plan / lint --strategy
+    # pass offload_modes=("", "optimizer") to search it); the annealer
+    # reaches it in one move once it is in the space.
+    offload_modes: Tuple[str, ...] = ("",)
     max_pp: int = 8
     max_tp: int = 8
 
@@ -195,7 +212,13 @@ def numerics_preserving_space(strategy_base=None) -> SearchSpace:
     it remains a searched knob on the tooling surfaces (bench_plan,
     lint --strategy) where the operator asked for the full space."""
     quant = getattr(strategy_base, "quant_comm", "") or ""
-    return SearchSpace(quant_modes=(quant,))
+    # offload is numerics-preserving but stays PINNED to the user's own
+    # setting here too: it is a capacity/latency trade the operator
+    # chose, not a knob adoption should silently flip either way
+    offload = "optimizer" if getattr(strategy_base,
+                                     "offload_optimizer_state", False) \
+        else ""
+    return SearchSpace(quant_modes=(quant,), offload_modes=(offload,))
 
 
 def mesh_factorizations(n_devices: int, *, max_pp: int = 8,
@@ -389,7 +412,9 @@ def _coarse_points(factors, space: SearchSpace, nominal_batch: int
                 points.append(StrategyPoint(
                     dp=dp, pp=pp, tp=tp, microbatches=m,
                     schedule=space.schedules[0], reduce=reduce,
-                    quant=quant).canonical())
+                    quant=quant,
+                    offload=(space.offload_modes or ("",))[0],
+                    ).canonical())
     # dedupe preserving order
     seen, out = set(), []
     for p in points:
@@ -428,6 +453,9 @@ def _neighbors(point: StrategyPoint, factors, space: SearchSpace
     for mp in space.memory_plan:
         if mp != point.memory_plan:
             out.append(dataclasses.replace(point, memory_plan=mp))
+    for om in space.offload_modes:
+        if om != point.offload:
+            out.append(dataclasses.replace(point, offload=om))
     return [p.canonical() for p in out]
 
 
@@ -729,4 +757,7 @@ def _describe_strategy(strategy, axes: Dict[str, int]) -> str:
         schedule=strategy.pipeline_schedule,
         reduce=reduce, quant=strategy.quant_comm or "",
         bucket_bytes=int(strategy.comm_bucket_bytes),
-        memory_plan=bool(strategy.memory_plan)).canonical().describe()
+        memory_plan=bool(strategy.memory_plan),
+        offload=("optimizer" if getattr(strategy, "offload_optimizer_state",
+                                        False) else "")
+        ).canonical().describe()

@@ -42,6 +42,12 @@ V5E_HBM_BPS = 819e9
 # figure the ring models' per-device byte counts divide through.
 V5E_HBM_BYTES = 16 * (1 << 30)
 V5E_ICI_BPS = 45e9
+# host<->device PCIe bandwidth the offload roofline divides through
+# (framework/offload.py consumers: ZeRO-offload optimizer state, the
+# memory planner's stash-to-host candidate). v5e chips sit on PCIe
+# gen4 x16 (~32 GB/s one direction); like the constants above this is a
+# RELATIVE ranking figure, not a wall-clock forecast.
+V5E_PCIE_BPS = 32e9
 
 # dtype byte widths for parsing XLA shape strings — the ONE copy shared by
 # the probes (probe_caps) and the comm-structure tests. Covers every XLA
@@ -704,6 +710,7 @@ def predict(program, strategy=None, *, dp: int = 1, tp: int = 0,
         "dp_comm": None,
         "tp_comm": None,
         "pipeline": None,
+        "offload": None,
         "speculative": (speculative_expectation(**speculative)
                         if speculative else None),
         "memory": {
@@ -800,6 +807,32 @@ def predict(program, strategy=None, *, dp: int = 1, tp: int = 0,
                                   "microbatch_rows": mb_rows,
                                   "grad_psum_wire_bytes":
                                       _pp_grad_psum_bytes(program, k)}
+    if strategy is not None and getattr(strategy, "offload_optimizer_state",
+                                        False):
+        # host-offload pricing (framework/offload.py): the optimizer
+        # state's per-step PCIe round-trip (restore h2d before the step,
+        # spill d2h after) against the step's compute window. HBM keeps
+        # only ~one in-flight transfer bucket resident; the rest moves
+        # to the host tier. `hides` is the planner's verdict — when the
+        # round-trip exceeds the per-device compute window the residual
+        # is CHARGED to predicted_step_seconds, so an offload point that
+        # cannot overlap loses the search instead of lying about it.
+        per_dev = report["memory"]["per_device"]
+        opt_bytes = int(per_dev.get("optimizer_state", 0))
+        bucket = int(getattr(strategy, "comm_bucket_bytes", 0) or 0)
+        resident = min(opt_bytes, bucket) if bucket else opt_bytes
+        pcie_s = 2.0 * opt_bytes / V5E_PCIE_BPS
+        window = report["compute"]["roofline_s"] / max(dp, 1)
+        report["offload"] = {
+            "optimizer_state_bytes": opt_bytes,
+            "resident_bytes": resident,
+            "hbm_freed_bytes": max(0, opt_bytes - resident),
+            "pcie_bps": V5E_PCIE_BPS,
+            "pcie_roundtrip_s": pcie_s,
+            "overlap_window_s": window,
+            "residual_s": max(0.0, pcie_s - window),
+            "hides": pcie_s <= window,
+        }
     if strategy is not None:
         report["strategy"] = {
             "reduce_strategy": str(getattr(strategy, "reduce_strategy", "")),
@@ -877,7 +910,14 @@ def predicted_device_bytes(report: Dict, planned: bool = True) -> int:
     transient = per_dev["transient_peak"]
     if planned and "transient_peak_planned" in per_dev:
         transient = per_dev["transient_peak_planned"]
-    return int(total + transient)
+    off = report.get("offload")
+    if off:
+        # host-offloaded optimizer state: only the resident transfer
+        # window stays on device — the capacity lever the offload knob
+        # buys (the freed bytes are priced, not assumed: the same
+        # report's residual_s charges any unhidden round-trip time)
+        total -= int(off.get("hbm_freed_bytes", 0))
+    return int(max(0, total) + transient)
 
 
 def predicted_step_seconds(report: Dict, *, mesh_axes: Optional[Dict] = None,
@@ -909,6 +949,10 @@ def predicted_step_seconds(report: Dict, *, mesh_axes: Optional[Dict] = None,
       launch_s    per-collective launch overhead x the plan's launch
                   count — what makes comm_bucket_bytes a searched knob
                   (fewer, larger transfers) instead of a free one
+      offload_s   the unhidden residual of the offloaded optimizer
+                  state's PCIe round-trip (report `offload` section)
+                  after overlapping this point's per-device compute —
+                  zero when the transfer hides entirely
     """
     axes = dict(mesh_axes or {})
     dp = int(axes.get("dp", report.get("dp", 1)) or 1)
@@ -943,12 +987,21 @@ def predicted_step_seconds(report: Dict, *, mesh_axes: Optional[Dict] = None,
         pp_comm_s += boundary.get("pp_boundary_bytes", 0) / ici_bps
         launches += 2 * int(boundary.get("ticks_per_step", 0)) + 1
     launch_s = coll_launch_s * launches
+    offload_s = 0.0
+    off = report.get("offload")
+    if off:
+        # the optimizer-state PCIe round-trip overlaps THIS mesh point's
+        # per-device compute; only the unhidden residual is charged
+        # (recomputed against this point's compute so the term and the
+        # search window can never disagree)
+        offload_s = max(0.0, off.get("pcie_roundtrip_s", 0.0) - compute)
     total = (compute + bubble + dp_comm_s + tp_comm_s + pp_comm_s
-             + quant_s + launch_s)
+             + quant_s + launch_s + offload_s)
     return {"compute_s": compute, "bubble_s": bubble,
             "dp_comm_s": dp_comm_s, "tp_comm_s": tp_comm_s,
             "pp_comm_s": pp_comm_s, "quant_s": quant_s,
             "launch_s": launch_s, "n_collective_launches": launches,
+            "offload_s": offload_s,
             "total_s": total}
 
 

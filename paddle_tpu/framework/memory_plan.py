@@ -366,7 +366,8 @@ def search_remat(block, region_op, *, nominal_batch: int = 8,
                  protected: Sequence[str] = (),
                  time_budget_s: Optional[float] = None,
                  time_budget_frac: float = 0.02,
-                 prevent_cse: bool = False) -> Dict:
+                 prevent_cse: bool = False,
+                 stash_to_host: bool = False) -> Dict:
     """Search the remat-vs-stash curve of ONE vjp_region and apply the
     winner. Candidates: `_REMAT_CANDIDATES` (segment count x checkpoint
     policy) plus "stash" (no remat — keep every activation, the status
@@ -381,13 +382,29 @@ def search_remat(block, region_op, *, nominal_batch: int = 8,
                    segment for the default policy, the non-dot subset
                    under `dots_saveable`)
 
+    With `stash_to_host` a THIRD candidate class competes (ISSUE r23:
+    BuildStrategy.memory_plan_stash_to_host): keep every activation but
+    park the stash in the pinned host pool (framework/offload.py),
+    priced on the PCIe roofline (`costs.V5E_PCIE_BPS`) — freed bytes are
+    the whole stash minus a two-deep resident window (the in-flight d2h
+    at the forward edge plus the h2d restore beside its backward
+    consumer), and the round-trip must hide inside ~3x the forward's
+    roofline (forward + ~2x backward = the overlap window). Unlike the
+    CSE-able recompute bound, the PCIe transfer is real wire, so the
+    window ALWAYS gates this candidate.
+
     The best stash_freed whose extra_s fits the budget wins; the budget
     is `time_budget_s` when the caller measured a real step (CPU-mesh
     benches, where dispatch dominates the roofline) and
     `time_budget_frac` x the program's roofline step otherwise. Returns
     the decision record (chosen plan + every candidate's prediction);
     sets `remat_segments`/`remat_policy`/`live_out` on the region op when
-    a remat plan wins."""
+    a remat plan wins, `stash_to_host`/`live_out` when the host stash
+    wins (ADVISORY on this backend: jit consumes the whole stash at
+    dispatch, so the streamed per-value round-trip is priced and
+    recorded — the same discipline as the planner's pp stage decisions —
+    while the TPU lowering through the shared transfer stream remains
+    ROADMAP item 5(a); the record says so via `executed`)."""
     from .costs import op_cost_flops_bytes, op_time_cost
     from .lowering import remat_boundaries
 
@@ -440,10 +457,11 @@ def search_remat(block, region_op, *, nominal_batch: int = 8,
 
     # the stash the un-segmented region carries to the backward: every
     # transient the segment produces and does not publish
-    stash_total = sum(
-        _var_bytes(block, nm, nominal_batch)
+    stash_vars = [
+        (nm, _var_bytes(block, nm, nominal_batch))
         for i in seg for nm in set(block.ops[i].output_names())
-        if _transient(block, nm) and nm not in out_need)
+        if _transient(block, nm) and nm not in out_need]
+    stash_total = sum(b for _, b in stash_vars)
     cost_at = {i: c for i, c in zip(seg, op_costs)}
 
     best = None
@@ -498,8 +516,39 @@ def search_remat(block, region_op, *, nominal_batch: int = 8,
                 best is None
                 or predicted_stash < best["predicted_stash_bytes"]):
             best = dict(cand, seg_lists=seg_lists)
+    if stash_to_host and stash_total > 0:
+        from .costs import V5E_PCIE_BPS
+        biggest = max((b for _, b in stash_vars), default=0)
+        resident = min(stash_total, 2 * biggest)
+        transfer_s = 2.0 * stash_total / V5E_PCIE_BPS
+        window = 3.0 * total_s
+        cand = {"segments": 0, "policy": "stash_to_host",
+                "stash_freed_bytes": int(stash_total - resident),
+                "predicted_stash_bytes": int(resident),
+                "extra_seconds_bound": float(max(0.0,
+                                                 transfer_s - window)),
+                "pcie_transfer_s": float(transfer_s),
+                "overlap_window_s": float(window),
+                "fits_budget": transfer_s <= window}
+        record["candidates"].append(cand)
+        if cand["fits_budget"] and resident < stash_total and (
+                best is None
+                or resident < best["predicted_stash_bytes"]):
+            best = dict(cand, seg_lists=None)
     record["stash_bytes_unsegmented"] = int(stash_total)
     if best is None or best["stash_freed_bytes"] <= 0:
+        return record
+
+    if best["policy"] == "stash_to_host":
+        region_op.attrs["stash_to_host"] = True
+        region_op.attrs["live_out"] = sorted(live_out)
+        block.program._bump()
+        record.update(chosen="stash_to_host", segments=0,
+                      policy="stash_to_host",
+                      stash_freed_bytes=best["stash_freed_bytes"],
+                      predicted_stash_bytes=best["predicted_stash_bytes"],
+                      extra_seconds_bound=best["extra_seconds_bound"],
+                      executed="advisory")
         return record
 
     region_op.attrs["remat_segments"] = [list(lst)
@@ -584,7 +633,8 @@ def plan_program(program: Program, *, protected: Sequence[str] = (),
                  time_budget_frac: float = 0.02,
                  schedule: bool = True, color: bool = True,
                  remat: bool = True,
-                 remat_prevent_cse: bool = False) -> Program:
+                 remat_prevent_cse: bool = False,
+                 stash_to_host: bool = False) -> Program:
     """Apply the full static memory plan to a CLONE of `program` (the
     caller's program is never mutated): scheduling, coloring, and the
     remat-vs-stash search, in that order. Idempotent (`
@@ -636,7 +686,8 @@ def plan_program(program: Program, *, protected: Sequence[str] = (),
                     block, op, nominal_batch=nominal_batch,
                     protected=protected, time_budget_s=time_budget_s,
                     time_budget_frac=time_budget_frac,
-                    prevent_cse=remat_prevent_cse))
+                    prevent_cse=remat_prevent_cse,
+                    stash_to_host=stash_to_host))
             elif op.type == "pp_pipeline_region":
                 # exactly one per block (the partition pass enforces it)
                 report["pp_stages"] = _pp_stage_decisions(
@@ -660,6 +711,15 @@ def plan_program(program: Program, *, protected: Sequence[str] = (),
         max(0, rm.get("stash_bytes_unsegmented", 0)
             - rm.get("predicted_stash_bytes", 0))
         for rm in remat_records if rm.get("chosen") == "remat")
+    # a winning stash-to-host decision is ADVISORY on this backend (see
+    # search_remat): its freed bytes ride in a NAMED key instead of the
+    # executed predicted_peak_after, so the prediction never claims a
+    # reduction the runtime does not deliver
+    host_stash_freed = sum(
+        rm.get("stash_freed_bytes", 0) for rm in remat_records
+        if rm.get("chosen") == "stash_to_host")
+    if host_stash_freed:
+        report["stash_to_host_freed_bytes"] = int(host_stash_freed)
     # slots are deliberately NOT subtracted here: coloring only pairs
     # strictly-disjoint lifetimes, which the max-live walk already never
     # counts together — the slot table names bytes XLA's assignment can
@@ -703,7 +763,7 @@ class MemoryPlanPass(Pass):
 
     allowed_attrs = ("protected", "nominal_batch", "time_budget_s",
                      "time_budget_frac", "schedule", "color", "remat",
-                     "remat_prevent_cse")
+                     "remat_prevent_cse", "stash_to_host")
 
     def apply(self, program, scope=None):
         return plan_program(
@@ -717,4 +777,5 @@ class MemoryPlanPass(Pass):
             color=bool(self.attrs.get("color", True)),
             remat=bool(self.attrs.get("remat", True)),
             remat_prevent_cse=bool(self.attrs.get("remat_prevent_cse",
-                                                  False)))
+                                                  False)),
+            stash_to_host=bool(self.attrs.get("stash_to_host", False)))

@@ -52,7 +52,8 @@ from ..core.enforce import InvalidArgumentError, enforce
 #: minting a gauge no scrape ever finds)
 CHANNELS = ("device_state_bytes", "executor_temp_bytes",
             "kv_cache_bytes", "kv_cache_used_bytes",
-            "host_staging_bytes")
+            "host_staging_bytes", "host_kv_bytes",
+            "host_optimizer_bytes")
 
 _lock = threading.Lock()
 _marks: Dict[str, Dict[str, float]] = {
@@ -342,6 +343,7 @@ def device_memory_census(executor, feed: Dict[str, Any], scope, *,
             + max(0, xla["output_bytes"] - xla["alias_bytes"]))
     update_watermark("device_state_bytes", st["categories"]["state_total"])
     update_watermark("executor_temp_bytes", xla["temp_bytes"])
+    from ..framework import offload as _offload
     return {
         "state": st,
         "feeds": {"per_device_bytes": feed_bytes, "per_feed": per_feed,
@@ -350,4 +352,8 @@ def device_memory_census(executor, feed: Dict[str, Any], scope, *,
         "xla": xla,
         "live": live_array_census(scope),
         "peak_bytes": peak,
+        # the second tier, from the ONE host-byte ledger (r23): the same
+        # rows the host_*_bytes watermark channels publish, so a dossier
+        # and /healthz cannot disagree about host residency
+        "host_tier": _offload.shared_host_pool().rows(),
     }

@@ -57,6 +57,8 @@ SPAN_KINDS = frozenset({
                    # (γ+1 bound draft ticks, serving/speculative.py)
     "verify",      # the round's single target verify forward over the
                    # γ+1-wide window (serving/speculative.py)
+    "offload",     # one host-tier transfer job on the offload stream
+                   # (d2h spill / h2d prefetch, framework/offload.py)
     "user",        # RecordEvent-style user annotation
 })
 

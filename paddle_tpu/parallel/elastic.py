@@ -555,22 +555,20 @@ _serial_lock = threading.Lock()
 _last_serial = -1
 
 # host bytes currently staged for in-flight snapshot writes (the d2h
-# copies a background writer still holds) — the host_staging_bytes
-# memory watermark (observability/memory.py). Concurrent async saves
-# sum; the watermark's peak records the worst co-residency.
-_staged_bytes = 0.0
+# copies a background writer still holds) ride the SHARED pinned host
+# pool ledger (framework/offload.py, category "staging") — the
+# host_staging_bytes watermark, the census host-tier rows, and /healthz
+# all read the same accounting source as the KV spill and optimizer
+# tiers (ISSUE r23 satellite 6), so concurrent consumers sum instead of
+# double-reporting and the pool's peak records the worst co-residency.
 
 
 def _note_staging(delta: float):
-    global _staged_bytes
-    from ..observability import memory as _obs_memory
-    with _pending_lock:
-        _staged_bytes = max(0.0, _staged_bytes + delta)
-        # publish under the SAME lock that computed the total: two
-        # writers finishing together must publish in total order, or
-        # the channel's "current" can stick at a stale nonzero value
-        _obs_memory.update_watermark("host_staging_bytes",
-                                     _staged_bytes)
+    from ..framework import offload as _offload
+    # the pool's lock computes the total AND publishes the watermark in
+    # one critical section: two writers finishing together publish in
+    # total order, so the channel's "current" cannot stick stale
+    _offload.shared_host_pool()._credit("staging", int(delta))
 
 
 def _chunk_nbytes(chunks) -> float:

@@ -427,11 +427,13 @@ class ParallelExecutor(Executor):
                                    "memory_plan_prevent_cse", False))
         time_frac = float(getattr(self.build_strategy,
                                   "memory_plan_time_frac", 0.02))
+        stash_host = bool(getattr(self.build_strategy,
+                                  "memory_plan_stash_to_host", False))
         # every strategy field the plan reads is in the key: BuildStrategy
         # is a mutable dataclass, and a knob flipped between runs must
         # re-plan instead of silently serving the stale plan
         key = (id(program), program._version, int(batch), budget_s,
-               prevent_cse, time_frac)
+               prevent_cse, time_frac, stash_host)
         planned = cache.get(key)
         if planned is None:
             from ..framework.passes import get_pass
@@ -441,6 +443,7 @@ class ParallelExecutor(Executor):
                 time_budget_s=(budget_s or None),
                 time_budget_frac=time_frac,
                 remat_prevent_cse=prevent_cse,
+                stash_to_host=stash_host,
             )(program)
             cache[key] = planned
         return planned
@@ -918,6 +921,35 @@ class ParallelExecutor(Executor):
                 scope.set_var(v.name, jax.device_put(np.asarray(val), target))
             self._globalized = getattr(self, "_globalized", set()) | {key}
 
+    # -- host-offload optimizer state (framework/offload.py) ---------------
+    def _host_optimizer_state(self, program, scope):
+        """Lazily build (and cache per program/scope identity) the
+        HostOptimizerState for this step, or None when the knob is off,
+        the PTPU_OFFLOAD=0 kill switch is up, or the program carries no
+        optimizer accumulators yet (eval/startup programs)."""
+        import os
+        if not getattr(self.build_strategy, "offload_optimizer_state",
+                       False):
+            return None
+        if os.environ.get("PTPU_OFFLOAD", "1") == "0":
+            return None
+        from ..framework import offload as _offload
+        key = (id(program), getattr(program, "_version", 0), id(scope))
+        if getattr(self, "_host_opt_key", None) == key:
+            return self._host_opt
+        names = _offload.optimizer_state_names(program, scope)
+        if not names:
+            return None
+        prev = getattr(self, "_host_opt", None)
+        if prev is not None:
+            # program/scope changed under us: bring the old shards home
+            # and return their buffers before re-keying
+            prev.restore()
+            prev.release()
+        self._host_opt = _offload.HostOptimizerState(scope, names)
+        self._host_opt_key = key
+        return self._host_opt
+
     # -- run --------------------------------------------------------------
     def run(self,
             fetch_list: Optional[Sequence[Union[str, Variable]]] = None,
@@ -935,6 +967,14 @@ class ParallelExecutor(Executor):
             self._feed_shapes = {n: np.shape(v) for n, v in feed.items()}
         # see run_steps: placement below must read the REWRITTEN program
         program = self._prepare_program(program, scope)
+        # ZeRO-offload: the accumulator shards live on the host between
+        # steps — h2d them back BEFORE placement/dispatch, d2h them out
+        # after the fetches return (the d2h overlaps whatever the host
+        # does next; costs.predict's `offload` section prices whether
+        # the round-trip hides behind the step)
+        host_opt = self._host_optimizer_state(program, scope)
+        if host_opt is not None:
+            host_opt.restore()
         feed, real_b, padded_b = self._pad_for_dp(program, dict(feed or {}))
         # synthesize the batch-row mask BEFORE multi-process placement: the
         # base Executor would otherwise inject a host numpy array after the
@@ -964,6 +1004,8 @@ class ParallelExecutor(Executor):
         fetches = super().run(program=program, feed=feed,
                               fetch_list=fetch_list, scope=scope,
                               return_numpy=return_numpy)
+        if host_opt is not None:
+            host_opt.offload()
         if real_b is not None and padded_b != real_b:
             fetches = self._slice_padded_fetches(
                 fetches, self._batch_led_fetches(program, fetch_list),

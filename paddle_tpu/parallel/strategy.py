@@ -125,6 +125,25 @@ class BuildStrategy:
     # with M) or '1f1b' (warmup / 1-forward-1-backward steady state /
     # drain — stash bounded at <= K in-flight microbatches; the default).
     pipeline_schedule: str = "1f1b"
+    # --- host-offload tier (framework/offload.py) ------------------------
+    # ZeRO-offload optimizer state: the Reduce/ReduceScatter accumulator
+    # shards live in the pinned host pool between steps and round-trip
+    # per step on the shared transfer stream (restore before the step,
+    # spill after), overlapped behind forward/backward compute. HBM held
+    # by optimizer state drops to ~one in-flight bucket; costs.predict's
+    # `offload` section prices the PCIe round-trip against the overlap
+    # window so the planner can refuse it when the transfer cannot hide.
+    # Runtime kill switch: PTPU_OFFLOAD=0 keeps state device-resident
+    # regardless of this field.
+    offload_optimizer_state: bool = False
+    # Let the memory planner's remat-vs-stash search also consider
+    # stashing checkpointed activations to the host tier (third
+    # candidate class beside recompute and device stash), priced on the
+    # same PCIe roofline. On the CPU mesh the stash executes in
+    # ADVISORY mode (decision recorded + priced, transfer not lowered —
+    # same discipline as the planner's pp stage decisions); the TPU
+    # lowering is ROADMAP item 5(a).
+    memory_plan_stash_to_host: bool = False
     # --- auto-parallel planner (framework/auto_parallel.py) --------------
     # Let the framework CHOOSE the parallelism: on first prepare the
     # executor runs the cost-model-guided search over the dp x pp x tp

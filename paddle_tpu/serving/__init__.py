@@ -64,6 +64,7 @@ from .speculative import (  # noqa: F401
 from .kv_pager import (  # noqa: F401
     BlockPool,
     BlockTable,
+    HostTierConfig,
     KVPager,
     PagedKVEngine,
     RadixPrefixIndex,

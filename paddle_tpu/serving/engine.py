@@ -710,9 +710,20 @@ class ContinuousBatchingEngine:
                 self._finalize_request(req)
         return finished
 
+    def _pre_tick(self, active: Dict[int, "GenRequest"]
+                  ) -> Dict[int, "GenRequest"]:
+        """Scheduler hook run at the top of every plain tick, before the
+        feeds fill: the two-tier offload engine (serving/kv_pager.py,
+        `host_tier=`) resumes/suspends requests here — swapping KV
+        blocks against the host tier between ticks — and returns the
+        RESIDENT subset that actually ticks. Default: everything
+        admitted is resident."""
+        return active
+
     def _plain_tick(self, active: Dict[int, "GenRequest"]
                     ) -> List[GenRequest]:
         t0 = time.perf_counter()
+        active = self._pre_tick(active)
         # the rid list is trace provenance only — don't build it per
         # tick when tracing is off (the decode loop is the hot path)
         span_attrs = {"active": len(active)}

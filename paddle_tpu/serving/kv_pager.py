@@ -54,6 +54,25 @@ null block is neither) at every instant, and the census `kv_cache`
 category (pool bytes) splits into the reserved/used watermark pair
 (observability/memory.py channels `kv_cache_bytes` /
 `kv_cache_used_bytes`).
+
+Two-tier paging (ISSUE r23 tentpole): `PagedKVEngine(host_tier=
+HostTierConfig(...))` extends the hierarchy one level down. Requests
+keep being ADMITTED when the device pool is dry — they hold a tick
+slot in a SUSPENDED state (zero bytes on either tier until they have
+ticked) while the resident set decodes; a resident request's private
+blocks can be EVICTED to the pinned host pool (d2h on the shared
+transfer stream, overlapped with the next ticks — jax arrays are
+immutable, so the snapshot the stream reads stays consistent after the
+device blocks are rehandled) and PREFETCHED back `prefetch_distance`
+ticks ahead of the projected resume (`offload.prefetch_issue_tick`,
+the same helper `lint_program --offload` checks). Shared prefix-index
+blocks are pinned on device — they are the highest-fanout bytes.
+Per-slot decode is independent and deterministic, so suspend/resume
+changes WHICH slots tick, never what any slot computes: two-tier
+decode is token-identical to device-only decode (asserted by
+tests/test_offload.py and BENCH_OFFLOAD_r23.json). The two-pool
+accounting identity extends exactly: used_dev + used_host + free_dev +
+free_host == (n_blocks - 1) + host_blocks (`KVPager.check_two_tier`).
 """
 
 from __future__ import annotations
@@ -64,6 +83,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..core.enforce import InvalidArgumentError, enforce
+from ..framework import offload as _offload
+from ..framework.offload import HostTierConfig
 from ..observability import memory as _obs_memory
 from .engine import ContinuousBatchingEngine, GenRequest, _ENGINE_SEQ
 
@@ -271,18 +292,43 @@ class RadixPrefixIndex:
         return n
 
 
+class SpillRecord:
+    """One suspended request's host-tier residency: which LOGICAL table
+    entries were spilled (ascending), and how many host blocks they
+    hold. The physical device ids they came from are dead the moment
+    the spill releases them — only the engine's host buffers (keyed by
+    the same ascending order) carry the content."""
+
+    __slots__ = ("spilled", "n_blocks")
+
+    def __init__(self, spilled: List[int], n_blocks: int):
+        self.spilled = list(spilled)     # logical indices, ascending
+        self.n_blocks = int(n_blocks)    # len(table.blocks) at spill
+
+
 class KVPager:
     """The paged-KV policy engine: owns the BlockPool and the
     RadixPrefixIndex, makes the admission / share / CoW / release /
     eviction decisions, and keeps the counters the metrics registry
-    exposes. Device bytes are the engine's; this is the brain."""
+    exposes. Device bytes are the engine's; this is the brain.
+
+    With `host_tier=HostTierConfig(...)` the pager also arbitrates the
+    SECOND tier: `evict_table_to_host` trades a resident table's
+    private device blocks for host-block capacity, and
+    `reload_table_from_host` trades back. The pager still never
+    touches bytes — the engine moves them on the transfer stream; this
+    ledger only guarantees the two-pool identity
+    used_dev + used_host + free_dev + free_host == total."""
 
     def __init__(self, n_blocks: int, block_size: int,
-                 prefix_sharing: bool = True):
+                 prefix_sharing: bool = True,
+                 host_tier: Optional[HostTierConfig] = None):
         self.block_size = int(block_size)
         self.prefix_sharing = bool(prefix_sharing)
         self.pool = BlockPool(n_blocks, block_size)
         self.index = RadixPrefixIndex(block_size)
+        self.host_tier = host_tier
+        self.host_blocks_used = 0
         # -- counters (ptpu_engine_* gauges read these) --
         self.n_admitted = 0
         self.prefix_hits = 0            # admissions with shared_len > 0
@@ -291,6 +337,10 @@ class KVPager:
         self.evictions = 0
         self.cow_copies = 0
         self.rolled_back_blocks = 0     # speculative-decode rejected spans
+        self.host_evictions = 0         # blocks spilled device -> host
+        self.host_reloads = 0           # spilled blocks reloaded h -> d
+        self.host_prefetch_hits = 0     # resumes whose h2d had landed
+        self.host_prefetch_misses = 0   # resumes that waited on the h2d
 
     # -- admission --------------------------------------------------------
     def blocks_needed(self, length: int) -> int:
@@ -437,6 +487,91 @@ class KVPager:
         self.rolled_back_blocks += n
         return n
 
+    # -- two-tier (host) lifecycle -----------------------------------------
+    def evict_table_to_host(self, table: BlockTable,
+                            written_len: int) -> Optional[SpillRecord]:
+        """Suspend a resident table: release every PRIVATE device block
+        back to the pool and charge the CONTENT-bearing ones (logical
+        blocks covering positions [shared_len, written_len)) to the
+        host tier. Shared prefix blocks keep their refs — they are
+        pinned on device (highest-fanout bytes; HostTierConfig.
+        pin_index_nodes). Returns None — spill refused — when the host
+        tier cannot hold the content; otherwise the SpillRecord the
+        engine needs to know which logical entries to snapshot.
+
+        Private blocks must free on release (writes never land in
+        shared blocks — the same invariant `rollback` enforces); a
+        refcounted private block here is a breach, not a condition."""
+        enforce(self.host_tier is not None,
+                "evict_table_to_host without a host tier",
+                exc=InvalidArgumentError)
+        bs = self.block_size
+        n_content = -(-int(written_len) // bs)   # blocks with live rows
+        spilled = [j for j in range(table.n_shared,
+                                    min(n_content, len(table.blocks)))]
+        if self.host_blocks_used + len(spilled) \
+                > self.host_tier.host_blocks:
+            return None
+        for j in range(table.n_shared, len(table.blocks)):
+            # full prompt blocks may ALSO be held by the prefix index
+            # (note_block_filled registered them) — releasing our ref
+            # then leaves them device-resident as cache, possibly
+            # evicted later. The engine snapshots the content to host
+            # either way, so resume never depends on the index's whim.
+            self.pool.release(table.blocks[j])
+            table.blocks[j] = 0          # dead mapping until reload
+        self.host_blocks_used += len(spilled)
+        self.host_evictions += len(spilled)
+        return SpillRecord(spilled, len(table.blocks))
+
+    def reload_table_from_host(self, table: BlockTable,
+                               rec: SpillRecord
+                               ) -> Optional[List[Tuple[int, int]]]:
+        """Resume a suspended table: re-allocate a device block for
+        every private logical entry (evicting cached prefixes LRU under
+        pressure, exactly like admission) and release the host-tier
+        charge. Returns [(logical_j, new_physical)] for the
+        CONTENT-bearing entries — the h2d copy list, in the
+        SpillRecord's ascending order — or None (everything rolled
+        back, host charge untouched) when the device pool cannot cover
+        the resume yet."""
+        enforce(len(table.blocks) == rec.n_blocks,
+                f"spill record spans {rec.n_blocks} blocks but the "
+                f"table has {len(table.blocks)}",
+                exc=InvalidArgumentError)
+        got: List[int] = []
+        for j in range(table.n_shared, len(table.blocks)):
+            b = self._alloc_or_evict()
+            if b is None:                # roll back, stay suspended
+                for held in got:
+                    self.pool.release(held)
+                return None
+            got.append(b)
+        for j, b in zip(range(table.n_shared, len(table.blocks)), got):
+            table.blocks[j] = b
+        self.host_blocks_used -= len(rec.spilled)
+        self.host_reloads += len(rec.spilled)
+        self.blocks_allocated_total += len(got)
+        return [(j, table.blocks[j]) for j in rec.spilled]
+
+    def check_two_tier(self):
+        """The r23 accounting identity over BOTH tiers (the ISSUE's
+        `used_dev + used_host + free == total`), on top of the device
+        pool's own refcount/free-list exactness (`BlockPool.check`)."""
+        self.pool.check()
+        cap = self.host_tier.host_blocks if self.host_tier else 0
+        enforce(0 <= self.host_blocks_used <= cap,
+                f"host tier accounting broken: {self.host_blocks_used} "
+                f"used of {cap}", exc=InvalidArgumentError)
+        used_dev, free_dev = self.pool.n_used, self.pool.n_free
+        used_host = self.host_blocks_used
+        free_host = cap - used_host
+        total = (self.pool.n_blocks - 1) + cap
+        enforce(used_dev + used_host + free_dev + free_host == total,
+                f"two-tier identity broken: {used_dev}+{used_host}+"
+                f"{free_dev}+{free_host} != {total}",
+                exc=InvalidArgumentError)
+
     # -- introspection ----------------------------------------------------
     def stats(self) -> Dict:
         return {
@@ -458,6 +593,20 @@ class KVPager:
             "evictions": self.evictions,
             "cow_copies": self.cow_copies,
             "rolled_back_blocks": self.rolled_back_blocks,
+            "host_tier": None if self.host_tier is None else {
+                "host_blocks": self.host_tier.host_blocks,
+                "host_blocks_used": self.host_blocks_used,
+                "prefetch_distance": self.host_tier.prefetch_distance,
+                "rotate_quantum": self.host_tier.rotate_quantum,
+                "host_evictions": self.host_evictions,
+                "host_reloads": self.host_reloads,
+                "prefetch_hits": self.host_prefetch_hits,
+                "prefetch_misses": self.host_prefetch_misses,
+                "prefetch_hit_rate": (
+                    self.host_prefetch_hits
+                    / max(self.host_prefetch_hits
+                          + self.host_prefetch_misses, 1)),
+            },
         }
 
 
@@ -500,7 +649,21 @@ class PagedKVEngine(ContinuousBatchingEngine):
                  n_blocks: Optional[int] = None,
                  prefix_sharing: bool = True, topk_k: int = 0,
                  quant: Optional[str] = None, kv_quant: bool = False,
-                 speculative=None):
+                 speculative=None,
+                 host_tier: Optional[HostTierConfig] = None):
+        enforce(host_tier is None or speculative is None,
+                "host_tier does not compose with speculative decoding "
+                "yet: a speculative round's rollback remaps blocks the "
+                "suspend/resume swap may hold in flight on the stream — "
+                "pager-level rollback composition IS covered "
+                "(tests/test_offload.py); pick one per engine",
+                exc=InvalidArgumentError)
+        if host_tier is not None:
+            enforce(isinstance(host_tier, HostTierConfig),
+                    f"host_tier must be a HostTierConfig, got "
+                    f"{type(host_tier).__name__}",
+                    exc=InvalidArgumentError)
+        self.host_tier = host_tier
         self.block_size = int(block_size)
         self.blocks_per_req = -(-int(max_len) // self.block_size)
         self.prefix_sharing = bool(prefix_sharing)
@@ -534,7 +697,19 @@ class PagedKVEngine(ContinuousBatchingEngine):
                 f"full-span request ({self.blocks_per_req} blocks + the "
                 f"null block)", exc=InvalidArgumentError)
         self.pager = KVPager(self.n_blocks, self.block_size,
-                             prefix_sharing)
+                             prefix_sharing, host_tier=host_tier)
+        # two-tier scheduler state: per-rid host residency records and
+        # the FIFO of suspended requests (admission order — no
+        # starvation, same discipline as the head-of-line device wait)
+        self._ht_state: Dict[int, Dict] = {}
+        self._ht_queue: List[GenRequest] = []
+        self._ht_stream = _offload.shared_stream() \
+            if host_tier is not None else None
+        self._ht_pool = _offload.shared_host_pool() \
+            if host_tier is not None else None
+        self._ht_per_block_bytes = 0     # measured lazily (first spill)
+        self.ht_d2h_bytes = 0            # measured: actual buffer bytes
+        self.ht_h2d_bytes = 0
         if cache_prefix is None:
             cache_prefix = f"pgd{next(_ENGINE_SEQ)}"
         super().__init__(
@@ -598,27 +773,269 @@ class PagedKVEngine(ContinuousBatchingEngine):
     def _admit_request(self, req: GenRequest) -> bool:
         need_len = min(len(req.prompt) + req.max_new, self.max_len)
         table = self.pager.try_admit(req.prompt, need_len)
-        if table is None:
+        if table is not None:
+            req.table = table
+            req.shared_len = table.shared_len
+            if table.shared_len:
+                # the shared span's K/V is already resident and
+                # byte-exact (deterministic compute) — skip its
+                # prefill ticks
+                req.fed = table.shared_len
+                req.next_tok = req.prompt[table.shared_len]
+            if self.host_tier is not None:
+                self._ht_state[req.rid] = {"state": "resident",
+                                           "resume_tick": self.n_ticks}
+            return True
+        if self.host_tier is None:
             return False                         # head-of-line wait
-        req.table = table
-        req.shared_len = table.shared_len
-        if table.shared_len:
-            # the shared span's K/V is already resident and byte-exact
-            # (deterministic compute) — skip its prefill ticks
-            req.fed = table.shared_len
-            req.next_tok = req.prompt[table.shared_len]
+        # two-tier admission: the device pool is dry but tick slots are
+        # not — admit SUSPENDED. The request holds its slot with ZERO
+        # bytes on either tier (it has never ticked); it starts decoding
+        # when a resident finishes or the rotation quantum frees blocks.
+        # This is exactly where admitted concurrency beats the
+        # device-only ceiling (BENCH_OFFLOAD_r23.json).
+        req.table = None
+        self._ht_state[req.rid] = {"state": "waiting",
+                                   "spill": None, "bufs": None,
+                                   "d2h": None, "h2d": None,
+                                   "suspend_tick": self.n_ticks}
+        self._ht_queue.append(req)
         return True
 
     def _release_request(self, req: GenRequest):
         if req.table is not None:
             self.pager.release(req.table)
             req.table = None
+        st = self._ht_state.pop(req.rid, None)
+        if st is not None and st.get("bufs"):
+            # a request released while host-resident (drain/shutdown):
+            # its spill never reloads — return the host bytes
+            if st.get("d2h") is not None:
+                st["d2h"].wait(timeout=60.0)
+            for buf in st["bufs"].values():
+                self._ht_pool.free(buf)
+            self.pager.host_blocks_used -= len(st["spill"].spilled)
+        if st is not None and req in self._ht_queue:
+            self._ht_queue.remove(req)
 
     def _note_position_written(self, req: GenRequest, pos: int):
         if (pos + 1) % self.block_size == 0:
             self.pager.note_block_filled(req.table,
                                          pos // self.block_size,
                                          req.prompt)
+
+    # -- two-tier scheduler (host_tier=) ----------------------------------
+    @staticmethod
+    def _remaining_ticks(req: GenRequest) -> int:
+        """Upper bound on ticks until `req` finishes (eos can only
+        shorten it — a prefetch issued against this bound can be late,
+        never early; late shows up honestly as a prefetch miss)."""
+        prefill = max(0, len(req.prompt) - 1 - req.fed)
+        return prefill + max(0, req.max_new - len(req.tokens))
+
+    def _pre_tick(self, active: Dict[int, GenRequest]
+                  ) -> Dict[int, GenRequest]:
+        """The swap scheduler, run between ticks on the compute thread
+        (the single sanctioned writer of the donated cache arrays —
+        `PreparedStep.refresh_state` re-points the bound step after
+        commits). In order: resume waiters FIFO while the device pool
+        covers them; rotate (evict the resident with the most remaining
+        work) when the head waiter has starved a full quantum; issue
+        h2d prefetches `prefetch_distance` ticks ahead of the projected
+        resume. Returns the RESIDENT subset — suspended requests hold
+        their slots but do not tick."""
+        if self.host_tier is None:
+            return active
+        tick = self.n_ticks
+        while self._ht_queue and self._try_resume(self._ht_queue[0]):
+            self._ht_queue.pop(0)
+        quantum = self.host_tier.rotate_quantum
+        if self._ht_queue and quantum:
+            head = self._ht_queue[0]
+            if tick - self._ht_state[head.rid]["suspend_tick"] >= quantum:
+                victim = self._pick_victim(active, tick)
+                if victim is not None:
+                    self._suspend_resident(victim, tick)
+                    if self._try_resume(head):
+                        self._ht_queue.pop(0)
+        self._maybe_prefetch(active, tick)
+        resident = {s: r for s, r in active.items()
+                    if self._ht_state[r.rid]["state"] == "resident"}
+        if not resident and active:
+            # nothing resident can only mean the pool is all free or
+            # index-cached — the head waiter MUST resume (else the
+            # two-tier scheduler would deadlock; make that loud)
+            head = self._ht_queue[0]
+            enforce(self._try_resume(head),
+                    "two-tier scheduler wedged: no resident requests "
+                    "and the head waiter cannot acquire device blocks",
+                    exc=InvalidArgumentError)
+            self._ht_queue.pop(0)
+            resident = {s: r for s, r in active.items()
+                        if self._ht_state[r.rid]["state"] == "resident"}
+        return resident
+
+    def _try_resume(self, req: GenRequest) -> bool:
+        """Make a suspended request resident: never-ticked waiters go
+        through normal admission (prefix sharing included); spilled
+        waiters re-acquire device blocks and commit their staged h2d
+        content. False = capacity still short, stay queued."""
+        st = self._ht_state[req.rid]
+        if st["state"] == "waiting":
+            need_len = min(len(req.prompt) + req.max_new, self.max_len)
+            table = self.pager.try_admit(req.prompt, need_len)
+            if table is None:
+                return False
+            req.table = table
+            req.shared_len = table.shared_len
+            if table.shared_len:
+                req.fed = table.shared_len
+                req.next_tok = req.prompt[table.shared_len]
+        else:                                    # spilled, has content
+            moves = self.pager.reload_table_from_host(req.table,
+                                                      st["spill"])
+            if moves is None:
+                return False
+            if moves:
+                if st.get("d2h") is not None:
+                    # surfaces a failed spill copy here instead of
+                    # letting a zeroed host buffer reach the cache
+                    st["d2h"].wait(timeout=60.0)
+                ticket = st.get("h2d")
+                hit = ticket is not None and ticket.done()
+                if ticket is None:
+                    ticket = self._stage_h2d(st)
+                self.pager.host_prefetch_hits += 1 if hit else 0
+                self.pager.host_prefetch_misses += 0 if hit else 1
+                _offload.note_prefetch(hit)
+                staged = ticket.wait(timeout=60.0)
+                self._commit_h2d(moves, staged)
+                self.ht_h2d_bytes += ticket.nbytes
+            for buf in (st["bufs"] or {}).values():
+                self._ht_pool.free(buf)
+            st.update(spill=None, bufs=None, d2h=None, h2d=None)
+        st.update(state="resident", resume_tick=self.n_ticks)
+        return True
+
+    def _suspend_resident(self, req: GenRequest, tick: int):
+        """Evict a resident request's private blocks to the host tier:
+        the pager trades the device blocks for host capacity, the
+        engine gathers the spilled rows out of the cache arrays HERE,
+        on the compute thread, before the next tick can run — the r21
+        donated decode tick hands the cache buffers back to XLA every
+        dispatch, so a lazily-captured array may be backing a reused
+        buffer by the time a stream thread reads it (observed: silent
+        zeros, not an error). Only the host-side copy into the pinned
+        pool buffer rides the transfer stream; that copy is what the
+        d2h byte accounting and the `offload` span measure."""
+        st = self._ht_state[req.rid]
+        table = req.table
+        phys = {j: table.blocks[j] for j in range(len(table.blocks))}
+        rec = self.pager.evict_table_to_host(table, req.fed)
+        if rec is None:
+            return                               # host tier full: keep
+        st.update(state="spilled", spill=rec, suspend_tick=tick,
+                  d2h=None, h2d=None, bufs=None)
+        self._ht_queue.append(req)
+        if not rec.spilled:
+            return                               # no content to move
+        src = np.asarray([phys[j] for j in rec.spilled])
+        # eager gather (compute thread): forces the read BEFORE the
+        # next donated dispatch can recycle the cache buffers
+        snaps = {name: np.asarray(self.scope.get(name)[src])
+                 for name in self.cache_names}
+        bufs, total = {}, 0
+        for name, snap in snaps.items():
+            buf = self._ht_pool.alloc(snap.shape, snap.dtype, "kv")
+            bufs[name] = buf
+            total += buf.nbytes
+
+        def _spill(snaps=snaps, bufs=bufs):
+            for name, snap in snaps.items():
+                np.copyto(bufs[name].array, snap)
+
+        st["bufs"] = bufs
+        st["d2h"] = self._ht_stream.submit("d2h", _spill, total,
+                                           tag=req.request_id)
+        self.ht_d2h_bytes += total
+        if not self._ht_per_block_bytes:
+            self._ht_per_block_bytes = total // len(src)
+        _offload.note_eviction(len(src))
+
+    def _pick_victim(self, active: Dict[int, GenRequest],
+                     tick: int) -> Optional[GenRequest]:
+        """Rotation victim: the resident request with the MOST
+        remaining work (it blocks the queue longest), provided it has
+        been resident a full quantum (anti-thrash) and is not about to
+        finish anyway. None = nobody qualifies, head keeps waiting."""
+        quantum = self.host_tier.rotate_quantum
+        best, best_rem = None, 0
+        for req in active.values():
+            st = self._ht_state[req.rid]
+            if st["state"] != "resident":
+                continue
+            if tick - st.get("resume_tick", 0) < quantum:
+                continue
+            rem = self._remaining_ticks(req)
+            if rem > max(best_rem, 2):
+                best, best_rem = req, rem
+        return best
+
+    def _maybe_prefetch(self, active: Dict[int, GenRequest], tick: int):
+        """Issue the head waiter's h2d staging `prefetch_distance`
+        ticks ahead of its projected resume — the earlier of (a) the
+        soonest resident finish and (b) the next rotation boundary.
+        `offload.prefetch_issue_tick` is the ONE policy helper here and
+        in `lint_program --offload` (linted == shipped)."""
+        if not self._ht_queue:
+            return
+        head = self._ht_queue[0]
+        st = self._ht_state[head.rid]
+        if st["state"] != "spilled" or st["h2d"] is not None \
+                or not st["spill"].spilled:
+            return
+        etas = [self._remaining_ticks(r) for r in active.values()
+                if self._ht_state[r.rid]["state"] == "resident"]
+        eta = min(etas) if etas else 0
+        quantum = self.host_tier.rotate_quantum
+        if quantum:
+            eta = min(eta, max(quantum - (tick - st["suspend_tick"]), 0))
+        if _offload.prefetch_issue_tick(
+                tick + eta, self.host_tier.prefetch_distance) <= tick:
+            self._stage_h2d(st)
+
+    def _stage_h2d(self, st: Dict):
+        """Stage the spilled content as device-placed arrays on the
+        stream (on TPU this is the PCIe h2d; the block scatter at
+        commit is an on-device copy). FIFO ordering makes the
+        wait-for-d2h free: the spill job is ahead in the same queue."""
+        bufs = st["bufs"]
+        total = sum(b.nbytes for b in bufs.values())
+
+        def _stage(bufs=bufs):
+            import jax.numpy as jnp
+            return {name: jnp.asarray(b.array)
+                    for name, b in bufs.items()}
+
+        st["h2d"] = self._ht_stream.submit("h2d", _stage, total,
+                                           tag="prefetch")
+        return st["h2d"]
+
+    def _commit_h2d(self, moves: List[Tuple[int, int]], staged: Dict):
+        """Scatter the staged block rows into the live cache arrays at
+        their NEW physical ids, on the compute thread between ticks
+        (single-writer), then mark the bound step's state stale so
+        `_plain_tick` re-points it before dispatch."""
+        dst = np.asarray([b for _, b in moves])
+        for name, rows in staged.items():
+            arr = self.scope.get(name)
+            if hasattr(arr, "at"):
+                arr = arr.at[dst].set(rows)
+            else:
+                arr = np.asarray(arr)
+                arr[dst] = rows
+            self.scope.set_var(name, arr)
+        self._target_state_owner = "offload"
 
     # -- speculative-decoding hooks (serving/speculative.py) --------------
     def _build_verify_tick(self, gamma):
@@ -718,6 +1135,20 @@ class PagedKVEngine(ContinuousBatchingEngine):
                 "Bytes the int8 KV block pools save vs f32 pools at the "
                 "same block count (0 with kv_quant off).",
                 fn=lambda: self.kv_quant_freed_bytes)
+        if self.host_tier is not None:
+            _offload.offload_metrics()   # ptpu_offload_* (default reg)
+            r.gauge("ptpu_engine_host_blocks_used",
+                    "KV blocks resident on the host tier (spilled).",
+                    fn=lambda: pager.host_blocks_used)
+            r.gauge("ptpu_engine_suspended_requests",
+                    "Admitted requests currently holding a tick slot "
+                    "without device blocks (two-tier suspend).",
+                    fn=lambda: len(self._ht_queue))
+            r.gauge("ptpu_engine_host_prefetch_hit_rate",
+                    "Fraction of host-tier resumes whose h2d prefetch "
+                    "had already landed.",
+                    fn=lambda: pager.stats()["host_tier"]
+                    ["prefetch_hit_rate"])
 
     # -- device block ops -------------------------------------------------
     def _copy_block(self, src: int, dst: int):
@@ -739,6 +1170,16 @@ class PagedKVEngine(ContinuousBatchingEngine):
         s["pager"] = self.pager.stats()
         s["kv_quant"] = {"enabled": self.kv_quant,
                          "freed_bytes": self.kv_quant_freed_bytes}
+        if self.host_tier is not None:
+            # measured wire bytes (actual buffer sizes the stream moved)
+            # next to the per-block figure the prediction side uses —
+            # BENCH_OFFLOAD_r23.json asserts they reconcile EXACTLY
+            s["offload"] = {
+                "d2h_bytes": self.ht_d2h_bytes,
+                "h2d_bytes": self.ht_h2d_bytes,
+                "per_block_bytes": self._ht_per_block_bytes,
+                "suspended": len(self._ht_queue),
+            }
         return s
 
 

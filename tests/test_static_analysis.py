@@ -102,6 +102,36 @@ def _quant_decode_tick():
     return None
 
 
+def _draft_tick():
+    """The speculative draft model's compiled tick (serving/speculative.py):
+    the target architecture at half depth under the draft_ prefix, logp
+    emitted for rejection sampling."""
+    models.transformer.transformer_lm_decode_tick(
+        n_slots=2, vocab=100, max_len=16, d_model=32, d_inner=64,
+        num_heads=4, num_layers=1, cache_prefix="sadr",
+        param_prefix="draft_", emit_logp=True)
+    return None
+
+
+def _spec_verify_tick():
+    """The speculative verify forward: γ+1 window positions scored
+    through one target forward against the slot caches."""
+    models.transformer.transformer_lm_spec_verify_tick(
+        n_slots=2, gamma=3, vocab=100, max_len=16, d_model=32,
+        d_inner=64, num_heads=4, num_layers=2)
+    return None
+
+
+def _paged_spec_verify_tick():
+    """... and its paged twin: the same window through the block-table
+    gather + paged_cache_write path."""
+    models.transformer.transformer_lm_paged_spec_verify_tick(
+        n_slots=2, gamma=3, n_blocks=9, block_size=4,
+        blocks_per_req=4, vocab=100, d_model=32, d_inner=64,
+        num_heads=4, num_layers=2)
+    return None
+
+
 # one builder per model module (small configs: the analyzer only cares
 # about the op DAG, not widths)
 MODEL_BUILDERS = {
@@ -128,6 +158,9 @@ MODEL_BUILDERS = {
     "transformer_lm_decode_tick": _decode_tick,
     "transformer_lm_paged_decode_tick": _paged_decode_tick,
     "transformer_lm_quant_decode_tick": _quant_decode_tick,
+    "transformer_lm_draft_tick": _draft_tick,
+    "transformer_lm_spec_verify_tick": _spec_verify_tick,
+    "transformer_lm_paged_spec_verify_tick": _paged_spec_verify_tick,
     "transformer_lm_prefill": _prefill,
     "machine_translation": _mt_train,
 }

@@ -297,6 +297,95 @@ def _drive_quant_serving(args):
     }))
 
 
+def _drive_offload_serving(args):
+    """--offload: the two-tier host-offload serving column family.
+
+    Runs the paged decode engine twice at a deliberately tight device
+    block pool on ONE weight set — device-only (head-of-line admission)
+    vs two-tier (framework/offload.py host spill + prefetch) — and
+    prints one row per side with admitted concurrency under backlog,
+    tokens/s, the offload wire-byte columns, and the prefetch hit rate.
+    Decode must stay token-identical across the pair and the wire
+    census must reconcile EXACTLY (predicted = eviction/reload counters
+    x per-block bytes vs the transfer stream's measured bytes) — both
+    are asserted, same discipline as BENCH_OFFLOAD_r23.json."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.framework import offload as _offload
+    from paddle_tpu.serving import HostTierConfig, PagedKVEngine
+
+    dims = dict(vocab=1000, max_len=64, d_model=64, d_inner=128,
+                num_heads=4, num_layers=2)
+    n_slots = max(2, min(args.batch_size, 16))
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    scope = pt.global_scope()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, dims["vocab"], 4).tolist()
+               for _ in range(3 * n_slots)]
+    tier = HostTierConfig(host_blocks=64, prefetch_distance=2,
+                          rotate_quantum=8)
+    rows, tokens = [], {}
+    for label, host_tier in (("device_only", None), ("two_tier", tier)):
+        _offload.reset_offload()
+        eng = PagedKVEngine(n_slots=n_slots, block_size=8, n_blocks=13,
+                            scope=scope, cache_prefix=f"bo_{label}",
+                            host_tier=host_tier, **dims)
+        warm = eng.submit([1], max_new=1)
+        eng.run_until_idle()
+        assert warm.done
+        eng.ht_d2h_bytes = eng.ht_h2d_bytes = 0
+        eng.pager.host_evictions = eng.pager.host_reloads = 0
+        eng.pager.host_prefetch_hits = eng.pager.host_prefetch_misses = 0
+        t0 = time.time()
+        reqs = [eng.submit(list(p), max_new=16) for p in prompts]
+        active = []
+        while eng.n_active or eng.n_pending:
+            backlogged = eng.n_pending > 0
+            eng.step()
+            if backlogged and eng.n_active:
+                active.append(eng.n_active)
+        dt = time.time() - t0
+        tokens[label] = [list(r.tokens) for r in reqs]
+        ht = eng.pager.stats()["host_tier"]
+        per = eng._ht_per_block_bytes
+        census_exact = True
+        if host_tier is not None:
+            eng.pager.check_two_tier()
+            census_exact = (
+                eng.ht_d2h_bytes == ht["host_evictions"] * per
+                and eng.ht_h2d_bytes == ht["host_reloads"] * per)
+        rows.append({
+            "engine": label,
+            "admitted_concurrency": round(
+                float(np.mean(active)) if active else 0.0, 2),
+            "tokens_per_sec": round(
+                sum(len(r.tokens) for r in reqs) / dt, 1),
+            "offload_d2h_bytes": int(eng.ht_d2h_bytes),
+            "offload_h2d_bytes": int(eng.ht_h2d_bytes),
+            "prefetch_hit_rate": round(
+                ht["prefetch_hit_rate"], 3) if ht else 0.0,
+            "census_exact": bool(census_exact),
+        })
+    identical = tokens["device_only"] == tokens["two_tier"]
+    import jax
+    print(json.dumps({
+        "model": "transformer_serving_paged",
+        "offload": True,
+        "batch_slots": n_slots,
+        "n_blocks": 13,
+        "host_tier": {"host_blocks": tier.host_blocks,
+                      "prefetch_distance": tier.prefetch_distance,
+                      "rotate_quantum": tier.rotate_quantum},
+        "decode_token_identical": bool(identical),
+        "rows": rows,
+        "device": jax.devices()[0].platform,
+    }))
+    assert identical, "two-tier decode diverged from device-only"
+    assert all(r["census_exact"] for r in rows), \
+        "offload wire census did not reconcile"
+
+
 def _drive_multiproc(args):
     """Parent of the N-process world: spawn N trainer children + a
     1-process collective baseline on the same total device count, report
@@ -520,6 +609,15 @@ def main():
                         "before/after, per-tick dispatch_ms (the "
                         "zero-dispatch bound tick's host share), "
                         "tokens/s. Ignores the training flags")
+    p.add_argument("--offload", action="store_true",
+                   help="serving mode: run the paged decode engine at a "
+                        "tight device block pool, device-only vs "
+                        "two-tier host offload (framework/offload.py), "
+                        "and print the offload column family — admitted "
+                        "concurrency under backlog, tokens/s, "
+                        "offload_{d2h,h2d}_bytes, prefetch_hit_rate. "
+                        "Asserts token identity and the exact wire-byte "
+                        "census. Ignores the training flags")
     p.add_argument("--no_bf16", action="store_true")
     p.add_argument("--profile", action="store_true")
     p.add_argument("--trace_dir", default=None,
@@ -539,6 +637,10 @@ def main():
 
     if args.quant_params:
         _drive_quant_serving(args)
+        return
+
+    if args.offload:
+        _drive_offload_serving(args)
         return
 
     if args.update_method == "multiproc":

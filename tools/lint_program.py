@@ -190,13 +190,15 @@ def _config_desc(args):
         cfg["num_microbatches"] = args.num_microbatches
     if args.memory_plan:
         cfg["memory_plan"] = True
+    if getattr(args, "offload", False):
+        cfg["offload"] = True
     if args.strategy:
         cfg["strategy"] = args.strategy
     return cfg
 
 
 _STRATEGY_KEYS = ("dp", "pp", "tp", "microbatches", "schedule", "reduce",
-                  "quant", "bucket_bytes", "memory_plan")
+                  "quant", "bucket_bytes", "memory_plan", "offload")
 
 
 def _parse_strategy(text):
@@ -368,6 +370,37 @@ def _restore_diagnostics(prog, args):
     return diags
 
 
+def _offload_diagnostics(prog, loss, args):
+    """--offload: statically check the host-tier transfer schedules
+    (framework/offload.py) of the program being linted.
+
+    Train-step programs (loss is not None): walk the block for
+    optimizer-state reads/writes and verify the ZeRO-offload round-trip
+    (restore at step entry, spill after last access) never reads a var
+    before its h2d arrives — `offload-use-before-arrival` BY NAME when
+    it would (r13 named-diagnostic discipline; the per-code mutation
+    test lives in tests/test_offload.py).
+
+    Serving tick programs (loss is None): build the two-tier prefetch
+    schedule for a window of suspended requests through the SHIPPED
+    policy helper (`offload.prefetch_issue_tick` — shared code with
+    PagedKVEngine, not a copy) and run the same checker, so a policy
+    edit that issues prefetches after their read fails lint before it
+    ships."""
+    from paddle_tpu.framework import offload as _offload
+    if loss is not None:
+        events = _offload.optimizer_roundtrip_events(prog)
+        kind = "optimizer_roundtrip"
+    else:
+        distance = 2
+        reads = {f"resume_t{t}": t for t in range(distance, distance + 4)}
+        events = _offload.kv_prefetch_events(reads, distance)
+        kind = "kv_prefetch"
+    diags = _offload.check_schedule(events)
+    return ({"schedule": kind, "events": len(events),
+             "violations": len(diags)}, diags)
+
+
 def lint_one(name, build, args):
     """Returns the per-model report dict (the --json row)."""
     import paddle_tpu as pt
@@ -425,6 +458,11 @@ def lint_one(name, build, args):
         shard_res = _sharding.propagate_sharding(
             prog, tp_size=args.tp if args.tp >= 2 else None)
         diags += shard_res.diagnostics
+    offload_check = None
+    if getattr(args, "offload", False):
+        offload_check, offload_diags = _offload_diagnostics(prog, loss,
+                                                            args)
+        diags += offload_diags
     mem = analysis.peak_live_bytes(prog, nominal_batch=args.batch_size)
     plan = None
     if args.memory_plan and getattr(prog, "_memory_plan_applied", False):
@@ -464,6 +502,8 @@ def lint_one(name, build, args):
                               if len(rms) > 1 else None),
             "pp_stages": plan.get("pp_stages"),
         }
+    if offload_check is not None:
+        report["offload"] = offload_check
 
     if args.json:
         return report
@@ -590,6 +630,14 @@ def main():
                         "and the predicted peak before/after; any "
                         "error-severity diagnostic the plan introduces "
                         "(the r13 buffer-reuse detectors) exits 1")
+    p.add_argument("--offload", action="store_true",
+                   help="check the host-tier transfer schedules "
+                        "(framework/offload.py): the ZeRO-offload "
+                        "optimizer round-trip for train-step programs, "
+                        "the two-tier KV prefetch policy for serving "
+                        "ticks — a transfer arriving after its first "
+                        "read is the error-severity "
+                        "offload-use-before-arrival diagnostic")
     p.add_argument("--tp", type=int, default=0,
                    help="tensor-parallel degree: apply tp_shard_pass to a "
                         "tp-annotated program (e.g. --model "

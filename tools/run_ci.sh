@@ -860,4 +860,65 @@ echo "== bench_spec smoke (speculative amortization harness) =="
 JAX_PLATFORMS=cpu python tools/bench_spec.py --smoke > /dev/null
 echo "bench_spec smoke OK"
 
+echo "== two-tier host-offload smoke (r23: spill + prefetch + exact census) =="
+# a paged engine at a deliberately tight device pool with the host tier
+# on: decode must be TOKEN-IDENTICAL to an unconstrained-pool twin,
+# real spills must have happened, the wire-byte census must reconcile
+# EXACTLY (eviction/reload counters x per-block bytes == the transfer
+# stream's measured bytes), and the two-pool accounting identity must
+# hold. The offload schedule lint must pass on the shipped prefetch
+# policy. Full harness: tools/bench_offload.py (BENCH_OFFLOAD_r23.json
+# is the committed full-shape run).
+JAX_PLATFORMS=cpu python - <<'PY'
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu.framework import offload as ofl
+from paddle_tpu.serving import HostTierConfig, PagedKVEngine
+
+DIMS = dict(vocab=100, max_len=16, d_model=32, d_inner=64, num_heads=4,
+            num_layers=2)
+scope = pt.global_scope()
+rng = np.random.RandomState(0)
+prompts = [rng.randint(1, 100, size=rng.randint(3, 9)).tolist()
+           for _ in range(8)]
+base = PagedKVEngine(n_slots=6, block_size=4, scope=scope, **DIMS)
+a = [base.submit(p, max_new=6) for p in prompts]
+base.run_until_idle()
+two = PagedKVEngine(n_slots=6, block_size=4, n_blocks=9, scope=scope,
+                    host_tier=HostTierConfig(host_blocks=32,
+                                             prefetch_distance=2,
+                                             rotate_quantum=4), **DIMS)
+b = [two.submit(p, max_new=6) for p in prompts]
+two.run_until_idle()
+assert [r.tokens for r in a] == [r.tokens for r in b], \
+    "two-tier decode diverged from the unconstrained twin"
+assert two.pager.host_evictions > 0, "no spill pressure — smoke is dead"
+per = two._ht_per_block_bytes
+assert two.ht_d2h_bytes == two.pager.host_evictions * per, \
+    (two.ht_d2h_bytes, two.pager.host_evictions, per)
+assert two.ht_h2d_bytes == two.pager.host_reloads * per, \
+    (two.ht_h2d_bytes, two.pager.host_reloads, per)
+two.pager.check_two_tier()
+events = ofl.kv_prefetch_events({"r%d" % t: t for t in range(2, 6)}, 2)
+assert ofl.check_schedule(events) == [], "shipped prefetch policy lints dirty"
+print(f"offload smoke OK ({two.pager.host_evictions} spills, "
+      f"{two.ht_d2h_bytes} B d2h == census, hit_rate="
+      f"{two.pager.stats()['host_tier']['prefetch_hit_rate']:.2f})")
+PY
+
+echo "== lint_program --offload (named diagnostic: offload-use-before-arrival) =="
+JAX_PLATFORMS=cpu python tools/lint_program.py --model mnist --offload > /dev/null
+JAX_PLATFORMS=cpu python tools/lint_program.py \
+    --model transformer_lm_paged_decode_tick --offload > /dev/null
+echo "lint --offload OK"
+
+echo "== bench_offload smoke (two-tier capacity harness) =="
+# the r23 harness end to end in --smoke shape: asserts token identity,
+# the exact per-cell wire census, the ≥1.5x admitted-concurrency bar at
+# the anchor pool, optimizer-offload loss identity, and the planner's
+# refuse/accept verdicts on the stash roofline inside main()
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python tools/bench_offload.py --smoke > /dev/null
+echo "bench_offload smoke OK"
+
 echo "CI OK"

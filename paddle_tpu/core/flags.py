@@ -168,6 +168,18 @@ define_bool("auto_parallel", True,
             "if a plan ever misbehaves in production. Part of the "
             "executor's compile cache key (framework/executor.py "
             "_fusion_flags_key).")
+define_bool("kv_sanitize", False,
+            "Shadow-state KV sanitizer (serving/sanitizer.py): mirror "
+            "every BlockPool/KVPager/host-tier mutation into the abstract "
+            "ownership model (framework/ownership.py) and raise "
+            "SanitizerDivergence naming the op, block, and invariant on "
+            "the first drift. Off by default in production (the shadow "
+            "bookkeeping costs a few percent of the host tick loop); "
+            "pinned ON for the whole test suite via PTPU_KV_SANITIZE=1 "
+            "in tests/conftest.py, same discipline as PTPU_VERIFY_PASSES. "
+            "Read at KVPager construction (attach-or-None), and part of "
+            "the executor's compile cache key so a mid-process toggle "
+            "never shares cached state with its instrumented twin.")
 define_bool("quant_comm", True,
             "Allow quantized gradient collectives when the BuildStrategy "
             "requests them (quant_comm='int8'/'bf16'). Kill switch: "

@@ -55,7 +55,8 @@ from .program import Block, Operator, Program
 from .registry import lookup_effect_rule
 
 __all__ = [
-    "DefUse", "Effects", "Taint", "dataflow_checks", "def_use_chains",
+    "CACHE_WRITE_OPS", "DefUse", "Effects", "Taint",
+    "cache_write_aliasing", "dataflow_checks", "def_use_chains",
     "divergence_taints", "interference_graph", "op_effects", "propagate",
     "var_lifetimes",
 ]
@@ -874,6 +875,83 @@ def _check_buffer_reuse(program, diags):
                     f"{block.ops[j].type!r} still reads {rin!r} after "
                     f"the aliasing write overwrote its buffer"))
     _check_cross_block_slots(program, all_groups, diags)
+
+
+# ---------------------------------------------------------------------------
+# serving cache-write aliasing (r24) — opt-in via lint_program --serving
+# ---------------------------------------------------------------------------
+
+# The executor's donated-state path rebinds each persistable KV pool in
+# place: builders pass `out=pool` so Cache and Out are the SAME var and
+# the dispatch loop can donate the buffer. Either aliasing mistake
+# silently corrupts serving state instead of crashing, which is why this
+# is a static check and not a runtime assert.
+CACHE_WRITE_OPS = ("cache_write", "paged_cache_write",
+                   "paged_cache_write_quant")
+
+
+def cache_write_aliasing(program: Program) -> List[Diagnostic]:
+    """Serving-tier cache-write aliasing checks (lint_program --serving).
+
+    Two named diagnostics over the tick/prefill program's cache-write
+    ops (`CACHE_WRITE_OPS`; the Scales plane of the quantized write is
+    checked as its own (Scales, ScalesOut) pair):
+
+    - `serving-cache-write-alias`: a pool var with more than one writer
+      in a block (two scatters race on one donated buffer — the executor
+      aliases Out onto Cache, so op order stops being observable), or a
+      PERSISTABLE pool written to a different Out var (the update lands
+      in a temporary; the persistable state the next tick reads never
+      advances — a silent fork of the serving cache).
+    - `serving-cache-stale-read`: an op after the write still reading
+      the old Cache name when Out is a fresh var — the reader sees the
+      pre-write bytes (exactly the offload-use-before-arrival hazard,
+      one tier up).
+    """
+    diags: List[Diagnostic] = []
+    for block in program.blocks:
+        writers: Dict[str, List[Tuple[int, Operator, str]]] = {}
+        for idx, op in enumerate(block.ops):
+            if op.type not in CACHE_WRITE_OPS:
+                continue
+            pairs = [("Cache", "Out")]
+            if op.type == "paged_cache_write_quant":
+                pairs.append(("Scales", "ScalesOut"))
+            for cin, cout in pairs:
+                cache = (op.inputs.get(cin) or [None])[0]
+                outn = (op.outputs.get(cout) or [None])[0]
+                if cache is None or outn is None:
+                    continue
+                writers.setdefault(cache, []).append((idx, op, outn))
+        for cache, ws in sorted(writers.items()):
+            if len(ws) > 1:
+                idx, op, _ = ws[1]
+                diags.append(Diagnostic(
+                    "serving-cache-write-alias", op_loc(block, idx, op),
+                    f"cache var {cache!r} has {len(ws)} writers in one "
+                    f"block (first at op#{ws[0][0]}) — scatters race on "
+                    f"the donated pool buffer"))
+            for idx, op, outn in ws:
+                if outn == cache:
+                    continue
+                var = block.vars.get(cache)
+                if var is not None and getattr(var, "persistable", False):
+                    diags.append(Diagnostic(
+                        "serving-cache-write-alias", op_loc(block, idx, op),
+                        f"persistable cache {cache!r} written to a "
+                        f"different var {outn!r} — the serving state "
+                        f"forks into a temporary and never advances"))
+                for j in range(idx + 1, len(block.ops)):
+                    later = block.ops[j]
+                    if cache in later.input_names():
+                        diags.append(Diagnostic(
+                            "serving-cache-stale-read",
+                            op_loc(block, j, later),
+                            f"op#{j} {later.type!r} reads {cache!r} "
+                            f"after op#{idx} rewrote it into {outn!r} — "
+                            f"the reader sees the pre-write cache"))
+                        break
+    return diags
 
 
 # ---------------------------------------------------------------------------

@@ -45,7 +45,12 @@ def _fusion_flags_key():
             flags.get_flag("pipeline"),
             flags.get_flag("tp_shard"),
             flags.get_flag("memory_plan"),
-            flags.get_flag("auto_parallel"))
+            flags.get_flag("auto_parallel"),
+            # kv_sanitize rewrites nothing today (the shadow bookkeeping
+            # is pure host-side), but the kill switch joins the key so a
+            # toggled run can never share cached compiled state with its
+            # instrumented twin
+            flags.get_flag("kv_sanitize"))
 
 
 def _feed_signature(feed: Dict[str, Any]):

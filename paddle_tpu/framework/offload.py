@@ -683,11 +683,21 @@ def optimizer_roundtrip_events(program, *, restore_at: int = 0
     return events
 
 
-def check_schedule(events: Sequence[TransferEvent]) -> List[Any]:
+def check_schedule(events: Sequence[TransferEvent],
+                   rollback_windows: Optional[Dict[str, Sequence[int]]]
+                   = None) -> List[Any]:
     """r13 named-diagnostic discipline: a transfer that arrives (or is
     even issued) after its first read is the error-severity
     `offload-use-before-arrival` diagnostic. Returns
-    `analysis.Diagnostic` rows for `lint_program --offload`."""
+    `analysis.Diagnostic` rows for `lint_program --offload`.
+
+    r24: `rollback_windows` ({var -> rollback ticks}) extends the check
+    to speculative serving. A rollback at tick t rewrites the var's
+    device blocks; any in-flight transfer issued BEFORE t but consumed
+    AT-OR-AFTER t carries the pre-rollback bytes — the reader would see
+    tokens the verifier already rejected. That is
+    `offload-stale-after-rollback`: the transfer must be re-issued
+    after the rollback it straddles."""
     from .analysis import Diagnostic
     out = []
     for ev in events:
@@ -700,4 +710,17 @@ def check_schedule(events: Sequence[TransferEvent]) -> List[Any]:
                          f"but first read is tick {ev.read_tick} — the "
                          f"consumer would see the stale tier"),
                 severity="error"))
+    for ev in events:
+        for t in (rollback_windows or {}).get(ev.var, ()):
+            if ev.issue_tick < t <= ev.read_tick:
+                out.append(Diagnostic(
+                    code="offload-stale-after-rollback",
+                    loc=ev.var,
+                    message=(f"{ev.direction} issued at tick "
+                             f"{ev.issue_tick} straddles the rollback "
+                             f"at tick {t} (read at {ev.read_tick}) — "
+                             f"the transfer carries rejected "
+                             f"speculative bytes and must be re-issued "
+                             f"after the rollback"),
+                    severity="error"))
     return out

@@ -18,6 +18,11 @@ package (ISSUE r20 tentpole):
   block, and `PagedKVEngine` — the engine that decodes through it
   (token-identical to the slot engine, at a fraction of the KV bytes
   per request; `BENCH_SERVE_KV_r20.json`).
+- `sanitizer`  — the shadow-state sanitizer over the paged KV stack
+  (r24): with the `kv_sanitize` flag on (`PTPU_KV_SANITIZE=1`), every
+  `KVPager` mirrors its block-lifetime mutations against the abstract
+  ownership model (`framework/ownership.py`) and raises
+  `SanitizerDivergence` naming op/block/invariant on the first drift.
 - `speculative` — speculative decoding over either engine
   (`SpecConfig`, `SpeculativeDecoder`): a quantized draft twin proposes
   γ tokens, one γ+1-wide target forward verifies, rejected paged blocks
@@ -69,4 +74,10 @@ from .kv_pager import (  # noqa: F401
     PagedKVEngine,
     RadixPrefixIndex,
     paged_beam_search,
+)
+
+# -- shadow-state sanitizer (r24) ------------------------------------------
+from .sanitizer import (  # noqa: F401
+    KVSanitizer,
+    SanitizerDivergence,
 )

@@ -556,6 +556,14 @@ class ContinuousBatchingEngine:
         ran. The paged engine uses this to mark prefix blocks filled
         (sharable) the moment their last row lands."""
 
+    def _note_tick_writes(self, active: Dict[int, "GenRequest"]):
+        """Pre-dispatch hook naming the cache positions the imminent
+        tick will write. The paged engine's shadow-state sanitizer
+        (`PTPU_KV_SANITIZE=1`) checks each one against the ownership
+        model here — a write into a shared or freed block raises its
+        named diagnostic BEFORE the scatter runs. Default: no-op (the
+        slot engine's per-slot rows cannot alias)."""
+
     # -- speculative-decoding hooks (overridden by PagedKVEngine) ---------
     def _build_verify_tick(self, gamma):
         """Build the verify program (γ+1-wide window forward over the
@@ -732,6 +740,7 @@ class ContinuousBatchingEngine:
                                          for r in active.values()]
         with _tracing.span("tick", "engine/tick", **span_attrs):
             self._fill_tick_feeds(active)
+            self._note_tick_writes(active)
             if self._target_state_owner != "main":
                 # a speculative verify forward ran since the last plain
                 # tick and owns the donated target-cache buffers —

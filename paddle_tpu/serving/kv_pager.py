@@ -73,6 +73,14 @@ decode is token-identical to device-only decode (asserted by
 tests/test_offload.py and BENCH_OFFLOAD_r23.json). The two-pool
 accounting identity extends exactly: used_dev + used_host + free_dev +
 free_host == (n_blocks - 1) + host_blocks (`KVPager.check_two_tier`).
+
+Ownership verification (ISSUE r24): every mutation this module makes
+is modeled declaratively in `framework/ownership.py` — the
+depth-bounded model checker proves the protocol's invariants over all
+op interleavings at small scope, and with `PTPU_KV_SANITIZE=1` the
+runtime shadow (`serving/sanitizer.py`, attached in
+`KVPager.__init__`) mirrors each real mutation into that model and
+raises the named diagnostic on any divergence.
 """
 
 from __future__ import annotations
@@ -341,6 +349,12 @@ class KVPager:
         self.host_reloads = 0           # spilled blocks reloaded h -> d
         self.host_prefetch_hits = 0     # resumes whose h2d had landed
         self.host_prefetch_misses = 0   # resumes that waited on the h2d
+        # shadow-state sanitizer (PTPU_KV_SANITIZE=1): mirrors every
+        # pool/pager mutation into the framework/ownership.py model and
+        # raises the named diagnostic on divergence; None when off —
+        # nothing is wrapped, so the off path costs nothing per op
+        from . import sanitizer as _sanitizer
+        self.sanitizer = _sanitizer.attach(self)
 
     # -- admission --------------------------------------------------------
     def blocks_needed(self, length: int) -> int:
@@ -445,11 +459,16 @@ class KVPager:
         return BlockTable(blocks, table.n_shared, table.shared_len)
 
     def release(self, table: BlockTable):
-        """Drop the table's ref on every block (completion or fork
-        retirement). Blocks the prefix index also holds stay resident
-        (cached) until evicted; everything else frees."""
+        """Drop the table's ref on every LIVE mapping (completion or
+        fork retirement). Blocks the prefix index also holds stay
+        resident (cached) until evicted; everything else frees. Dead
+        (zeroed) mappings — a table released while its content is
+        host-resident, the drain/shutdown path — are skipped: their
+        device refs were already traded for the host charge at spill
+        time (the caller refunds that via `refund_host_charge`)."""
         for b in table.blocks:
-            self.pool.release(b)
+            if b:
+                self.pool.release(b)
         table.blocks = []
 
     def rollback(self, table: BlockTable, keep_len: int,
@@ -553,6 +572,18 @@ class KVPager:
         self.host_reloads += len(rec.spilled)
         self.blocks_allocated_total += len(got)
         return [(j, table.blocks[j]) for j in rec.spilled]
+
+    def refund_host_charge(self, n: int):
+        """Return `n` host-tier blocks whose spill will never reload —
+        a request released while host-resident (drain/shutdown). A
+        pager METHOD (not a raw ledger write) so the shadow-state
+        sanitizer can mirror the refund and hold the two-tier identity
+        through it."""
+        enforce(0 <= n <= self.host_blocks_used,
+                f"host refund of {n} blocks underflows the ledger "
+                f"({self.host_blocks_used} used)",
+                exc=InvalidArgumentError)
+        self.host_blocks_used -= n
 
     def check_two_tier(self):
         """The r23 accounting identity over BOTH tiers (the ISSUE's
@@ -769,6 +800,15 @@ class PagedKVEngine(ContinuousBatchingEngine):
             wblock[slot] = blocks[lb]
             woff[slot] = off
 
+    def _note_tick_writes(self, active: Dict[int, GenRequest]):
+        # shadow-state sanitizer: every position this tick writes must
+        # target a live, EXCLUSIVELY-held block (the CoW contract) —
+        # checked against the ownership model before dispatch
+        san = self.pager.sanitizer
+        if san is not None:
+            for req in active.values():
+                san.note_write(req.table, req.fed)
+
     # -- scheduler hooks --------------------------------------------------
     def _admit_request(self, req: GenRequest) -> bool:
         need_len = min(len(req.prompt) + req.max_new, self.max_len)
@@ -814,7 +854,7 @@ class PagedKVEngine(ContinuousBatchingEngine):
                 st["d2h"].wait(timeout=60.0)
             for buf in st["bufs"].values():
                 self._ht_pool.free(buf)
-            self.pager.host_blocks_used -= len(st["spill"].spilled)
+            self.pager.refund_host_charge(len(st["spill"].spilled))
         if st is not None and req in self._ht_queue:
             self._ht_queue.remove(req)
 
@@ -909,6 +949,11 @@ class PagedKVEngine(ContinuousBatchingEngine):
                 self.pager.host_prefetch_misses += 0 if hit else 1
                 _offload.note_prefetch(hit)
                 staged = ticket.wait(timeout=60.0)
+                san = self.pager.sanitizer
+                if san is not None:
+                    # prefetch-after-use gate: the wait() above must
+                    # have landed the ticket before the scatter commits
+                    san.note_h2d_commit(ticket)
                 self._commit_h2d(moves, staged)
                 self.ht_h2d_bytes += ticket.nbytes
             for buf in (st["bufs"] or {}).values():
@@ -1063,10 +1108,15 @@ class PagedKVEngine(ContinuousBatchingEngine):
         blocks = req.table.blocks
         feeds["spec_btab"][slot, :len(blocks)] = blocks
         bs = self.block_size
+        san = self.pager.sanitizer
         for j in range(g):
             lb, off = divmod(req.fed + j, bs)
             feeds["spec_wblock"][slot, j] = blocks[lb]
             feeds["spec_woff"][slot, j] = off
+            if san is not None:
+                # every speculative verify lane writes in place — each
+                # target must be exclusively held (CoW contract)
+                san.note_write(req.table, req.fed + j)
 
     def _spec_capable(self, req, g) -> bool:
         # the round's G writes must stay inside the request's block-table
@@ -1247,6 +1297,7 @@ def paged_beam_search(engine: PagedKVEngine, prompt: Sequence[int],
         """slots: {slot: (tok, pos, table)} — run one compiled tick,
         return (topk_logp [S,1,k], topk_ids [S,1,k]) as numpy."""
         _zero()
+        san = pager.sanitizer
         for slot, (tok, pos, table) in slots.items():
             feeds["tick_tok"][slot, 0] = tok
             feeds["tick_pos"][slot, 0, 0] = float(pos)
@@ -1254,6 +1305,10 @@ def paged_beam_search(engine: PagedKVEngine, prompt: Sequence[int],
             lb, off = divmod(pos, bs)
             feeds["tick_wblock"][slot] = table.blocks[lb]
             feeds["tick_woff"][slot] = off
+            if san is not None:
+                # beam writes ride the CoW contract too: each live
+                # hypothesis must own its write block exclusively
+                san.note_write(table, pos)
         out = engine._step.run(feeds)
         # run() re-pointed the main step's bound rw tuple at the live
         # cache arrays — a co-resident speculative verify step must

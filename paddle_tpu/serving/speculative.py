@@ -476,4 +476,10 @@ class SpeculativeDecoder:
                                                        k0 + g)
         if self._pool_check and hasattr(eng, "pager"):
             eng.pager.pool.check()
+        san = getattr(getattr(eng, "pager", None), "sanitizer", None)
+        if san is not None:
+            # shadow-state census after every round: rollback remapped
+            # blocks and the accept path advanced write frontiers — the
+            # full ownership invariants must hold at the boundary
+            san.verify_full("speculative-round")
         return finished

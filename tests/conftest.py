@@ -14,6 +14,12 @@ import sys
 # silently un-verify the tier; use flags.set_flag in a test to opt out.
 os.environ["PTPU_VERIFY_PASSES"] = "1"
 
+# Same discipline for the KV shadow-state sanitizer (serving/sanitizer.py):
+# every KVPager the suite constructs mirrors its block-lifetime mutations
+# against the abstract ownership model and raises SanitizerDivergence on
+# the first drift — existing serving tests double as protocol tests.
+os.environ["PTPU_KV_SANITIZE"] = "1"
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # Single source of truth for the axon-plugin workaround + virtual-device
 # bootstrap (shared with the driver's multichip dryrun).

@@ -34,6 +34,7 @@ the program structure).
 
 from __future__ import annotations
 
+import gc
 import json
 import os
 import sys
@@ -287,8 +288,192 @@ def bench(n_requests=48, mean_interarrival_s=0.002, smoke=False):
     return out
 
 
+def _saturated_wall_s(scope, rng_seed, n_requests):
+    """One saturated closed-loop run on a fresh paged engine: submit the
+    whole trace at t=0, tick to idle, return (wall_s, ticks). The engine
+    is rebuilt per call so the kv_sanitize flag state at CONSTRUCTION
+    (attach-or-None) is what gets measured."""
+    from paddle_tpu.serving import PagedKVEngine
+
+    rng = np.random.RandomState(rng_seed)
+    trace, prefixes = _trace(rng, n_requests, 0.0, "saturated")
+    eng = PagedKVEngine(n_slots=_PAGED_SLOTS, max_len=_MAX_LEN,
+                        block_size=_BLOCK_SIZE, n_blocks=_PAGED_BLOCKS,
+                        scope=scope, **_DIMS)
+    warm = [eng.submit([1], max_new=1)]
+    eng.run_until_idle()
+    assert all(r.done for r in warm)
+    reqs = [eng.submit(list(p), max_new=m) for _, p, m in trace]
+    # GC hygiene: collections triggered mid-run cost time proportional
+    # to the WHOLE heap (which grows with every engine this process
+    # built), and the sanitized state allocates more — without this the
+    # "overhead" measured is mostly who paid for the next gen2 pause
+    gc.collect()
+    gc.disable()
+    try:
+        t0 = time.perf_counter()
+        eng.run_until_idle(max_ticks=200000)
+        wall = time.perf_counter() - t0
+    finally:
+        gc.enable()
+    assert all(r.done for r in reqs)
+    san = eng.pager.sanitizer
+    return wall, eng.n_ticks, (san.stats() if san is not None else None)
+
+
+def bench_sanitize(n_requests=48, repeats=10, smoke=False):
+    """The r24 overhead budget for the shadow-state sanitizer, measured
+    on the saturated-trace cell (the backlog never empties, so every
+    tick carries a full slot set — the worst case for per-op shadow
+    bookkeeping).
+
+    Three states, engine rebuilt per run, best-of-`repeats` wall time:
+    - `baseline`: kill switch off AND the per-tick engine hooks no-op'd
+      (the pre-instrumentation tick loop);
+    - `off`: kill switch off — shipped default. The only residue is the
+      `pager.sanitizer is None` guard in the per-tick hooks, so the
+      wall-clock delta vs baseline is pure noise; the committed 0.5%
+      budget is therefore ALSO pinned by a deterministic micro-measure
+      of the guard cost scaled to calls-per-tick;
+    - `on`: kill switch on — full shadow mirroring + census.
+    """
+    import paddle_tpu as pt
+    from paddle_tpu.core import flags
+    from paddle_tpu.serving import PagedKVEngine
+
+    if smoke:
+        n_requests, repeats = 12, 3
+    repeats = max(repeats, 3)          # rotation needs all three orders
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    scope = pt.global_scope()
+
+    def one(state):
+        # one FIXED seed for every state and repeat: the trace (and so
+        # the tick count and admit/release schedule) is identical
+        # across cells, so wall time is directly comparable
+        if state == "baseline":
+            flags.set_flag("kv_sanitize", False)
+            real = PagedKVEngine._note_tick_writes
+            PagedKVEngine._note_tick_writes = lambda self, active: None
+            try:
+                return _saturated_wall_s(scope, 20, n_requests)
+            finally:
+                PagedKVEngine._note_tick_writes = real
+        flags.set_flag("kv_sanitize", state == "on")
+        return _saturated_wall_s(scope, 20, n_requests)
+
+    # INTERLEAVED rounds, rotated order: run-to-run drift (scope/pool
+    # growth, allocator state, CPU clocking) at this tick size is
+    # larger than the sanitizer itself, so measuring each state's
+    # repeats back-to-back would bias whichever state runs last —
+    # every round visits all three states and the order rotates
+    states = ("baseline", "off", "on")
+    runs = {s: [] for s in states}
+    one("baseline")                               # discard: cold caches
+    for r in range(repeats):
+        for s in states[r % 3:] + states[:r % 3]:
+            runs[s].append(one(s))
+
+    # the overhead claim compares per-state MINIMA: run-to-run noise
+    # here is one-sided (scheduler/dispatch interference only ever ADDS
+    # time — same state and seed swings +-30% while min-of-N is stable)
+    # so the minimum over interleaved rounds converges on the true cost
+    def med(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2] if len(xs) % 2 else \
+            0.5 * (xs[len(xs) // 2 - 1] + xs[len(xs) // 2])
+
+    med_ratios = {
+        s: round(med([runs[s][r][0] / runs["baseline"][r][0]
+                      for r in range(repeats)]) - 1, 4)
+        for s in ("off", "on")}
+
+    def best(state):
+        wall, ticks, stats = min(runs[state],
+                                 key=lambda x: x[0] / max(x[1], 1))
+        return {"wall_s": round(wall, 4), "ticks": ticks,
+                "s_per_tick": round(wall / max(ticks, 1), 6),
+                "sanitizer": stats}
+
+    cells = {s: best(s) for s in states}
+    flags.set_flag("kv_sanitize", False)
+
+    # deterministic guard-cost micro-measure for the off budget: the
+    # ONLY off-state residue is `san = pager.sanitizer; if san is None`
+    # once per tick (plus one None-check per verify/resume event)
+    class _P:
+        sanitizer = None
+    pager = _P()
+    n_iter = 1_000_000
+    t0 = time.perf_counter()
+    for _ in range(n_iter):
+        san = pager.sanitizer
+        if san is not None:
+            raise AssertionError
+    guard_s = (time.perf_counter() - t0) / n_iter
+    guard_per_tick = guard_s * 4          # hook + resume + verify + slack
+    off_frac = guard_per_tick / cells["off"]["s_per_tick"]
+
+    on_over = cells["on"]["wall_s"] / cells["baseline"]["wall_s"]
+    off_over = cells["off"]["wall_s"] / cells["baseline"]["wall_s"]
+    out = {
+        "bench": "kv_sanitize_overhead", "round": 24, "smoke": bool(smoke),
+        "model": dict(_DIMS, max_len=_MAX_LEN),
+        "cell": {"trace": "saturated", "n_requests": n_requests,
+                 "repeats_best_of": repeats},
+        "cells": cells,
+        "guard_cost_s": round(guard_s, 10),
+        "overhead": {
+            "on_vs_baseline_min": round(on_over - 1, 4),
+            "off_vs_baseline_min": round(off_over - 1, 4),
+            "median_paired_ratios": med_ratios,
+            "off_guard_bound_frac": round(off_frac, 7),
+        },
+        "claims": {
+            "sanitize_on_overhead_le_5pct": bool(on_over - 1 <= 0.05),
+            "sanitize_off_guard_le_0p5pct": bool(off_frac <= 0.005),
+        },
+        "notes": "CPU-mesh measured. Overhead compares per-state "
+                 "MINIMUM wall over interleaved rotated rounds on an "
+                 "identical trace seed: run-to-run interference here "
+                 "is one-sided (+-30% on identical runs) so the min "
+                 "converges on the true cost where means/medians "
+                 "cannot; median paired ratios are reported for "
+                 "reference. The OFF "
+                 "budget is additionally pinned by the deterministic "
+                 "guard micro-measure (the only off-state residue is "
+                 "one attribute load + None test per hook); the kill "
+                 "switch is absence — with the flag off no wrapper is "
+                 "installed (tests/test_ownership.py TestKillSwitch) "
+                 "and the flag participates in the executor compile "
+                 "cache key.",
+    }
+    return out
+
+
 def main():
     smoke = "--smoke" in sys.argv
+    if "--sanitize-overhead" in sys.argv:
+        out = bench_sanitize(smoke=smoke)
+        doc = json.dumps(out, indent=1)
+        print(doc, flush=True)
+        if not smoke:
+            repo = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            with open(os.path.join(repo, "BENCH_KV_SANITIZE_r24.json"),
+                      "w") as f:
+                f.write(doc + "\n")
+        ok = out["claims"]
+        assert ok["sanitize_off_guard_le_0p5pct"], \
+            "sanitizer OFF guard cost exceeds the 0.5% budget"
+        # the wall-clock ON budget is only meaningful at full scale —
+        # smoke runs are ~40ms and the paired-median noise floor alone
+        # is a few percent of that
+        if not smoke:
+            assert ok["sanitize_on_overhead_le_5pct"], \
+                "sanitizer ON overhead exceeds the 5% budget"
+        return
     out = bench(smoke=smoke)
     doc = json.dumps(out, indent=1)
     print(doc, flush=True)

@@ -401,6 +401,56 @@ def _offload_diagnostics(prog, loss, args):
              "violations": len(diags)}, diags)
 
 
+def _serving_diagnostics(prog, loss, args):
+    """--serving: the serving-tier ownership verifier (r24).
+
+    Three static surfaces, all named-diagnostic (r13 discipline; the
+    per-code mutation tests live in tests/test_ownership.py):
+
+    1. cache-write aliasing over the program being linted
+       (dataflow.cache_write_aliasing): `serving-cache-write-alias` /
+       `serving-cache-stale-read` against the executor's donated-state
+       contract (builders pass out=pool, so Cache IS Out).
+    2. the two-tier prefetch schedule re-checked under speculative
+       rollback windows (offload.check_schedule rollback_windows): the
+       shipped policy re-issues prefetches AFTER a rollback, so a window
+       at the issue tick is clean — a policy edit that lets a transfer
+       straddle a rollback is `offload-stale-after-rollback` by name.
+    3. the pager-protocol model check (framework/ownership.py): a
+       depth-bounded exhaustive exploration of alloc/share/release,
+       radix register/evict, CoW fork, speculative rollback and
+       spill/reload interleavings over a small pool, verifying every
+       lifetime invariant after every transition; any violation joins
+       the diagnostics by its ownership code.
+    """
+    from paddle_tpu.framework import offload as _offload
+    from paddle_tpu.framework import ownership as _ownership
+    from paddle_tpu.framework.analysis import Diagnostic
+    from paddle_tpu.framework.dataflow import cache_write_aliasing
+
+    diags = list(cache_write_aliasing(prog))
+
+    distance = 2
+    reads = {f"resume_t{t}": t for t in range(distance, distance + 4)}
+    events = _offload.kv_prefetch_events(reads, distance)
+    # the shipped contract: any rollback precedes (or lands on) the
+    # re-issued prefetch, so windows at the issue tick must be clean
+    windows = {ev.var: [ev.issue_tick] for ev in events}
+    diags += _offload.check_schedule(events, rollback_windows=windows)
+
+    checker = _ownership.ModelChecker()
+    res = checker.run()
+    for v in res.violations:
+        diags.append(Diagnostic(v["code"], f"model-check:{v['op']}",
+                                v["message"]))
+    return ({"model_check": {"states_explored": res.states_explored,
+                             "transitions": res.transitions,
+                             "depth": res.depth,
+                             "violations": len(res.violations)},
+             "schedule_events": len(events),
+             "violations": len(diags)}, diags)
+
+
 def lint_one(name, build, args):
     """Returns the per-model report dict (the --json row)."""
     import paddle_tpu as pt
@@ -463,6 +513,11 @@ def lint_one(name, build, args):
         offload_check, offload_diags = _offload_diagnostics(prog, loss,
                                                             args)
         diags += offload_diags
+    serving_check = None
+    if getattr(args, "serving", False):
+        serving_check, serving_diags = _serving_diagnostics(prog, loss,
+                                                            args)
+        diags += serving_diags
     mem = analysis.peak_live_bytes(prog, nominal_batch=args.batch_size)
     plan = None
     if args.memory_plan and getattr(prog, "_memory_plan_applied", False):
@@ -504,6 +559,8 @@ def lint_one(name, build, args):
         }
     if offload_check is not None:
         report["offload"] = offload_check
+    if serving_check is not None:
+        report["serving"] = serving_check
 
     if args.json:
         return report
@@ -573,6 +630,14 @@ def lint_one(name, build, args):
           f"feeds {_human(mem['feed_bytes'])}, "
           f"peak transient {_human(mem['peak_transient_bytes'])} "
           f"at {mem['peak_at']}{sub_txt}")
+    if serving_check is not None:
+        mc = serving_check["model_check"]
+        print(f"  serving verifier: model check explored "
+              f"{mc['states_explored']} states / {mc['transitions']} "
+              f"transitions at depth {mc['depth']}, "
+              f"{mc['violations']} violation(s); "
+              f"{serving_check['schedule_events']} schedule event(s) "
+              f"rollback-checked")
     if not diags:
         print("  diagnostics: clean")
     else:
@@ -638,6 +703,17 @@ def main():
                         "ticks — a transfer arriving after its first "
                         "read is the error-severity "
                         "offload-use-before-arrival diagnostic")
+    p.add_argument("--serving", action="store_true",
+                   help="serving-tier ownership verifier: cache-write "
+                        "aliasing over the linted program "
+                        "(serving-cache-write-alias / "
+                        "serving-cache-stale-read), the prefetch "
+                        "schedule under speculative rollback windows "
+                        "(offload-stale-after-rollback), and the "
+                        "exhaustive small-scope model check of the "
+                        "pager protocol (framework/ownership.py) — the "
+                        "state count lands in the --json report, any "
+                        "violation exits 1 under its ownership code")
     p.add_argument("--tp", type=int, default=0,
                    help="tensor-parallel degree: apply tp_shard_pass to a "
                         "tp-annotated program (e.g. --model "

@@ -41,6 +41,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.inferencer",
     "paddle_tpu.serving",
     "paddle_tpu.serving.kv_pager",
+    "paddle_tpu.serving.sanitizer",
     "paddle_tpu.serving_engine",
     "paddle_tpu.nets",
     "paddle_tpu.concurrency",
@@ -51,6 +52,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.framework.costs",
     "paddle_tpu.framework.dataflow",
     "paddle_tpu.framework.memory_plan",
+    "paddle_tpu.framework.ownership",
     "paddle_tpu.framework.sharding",
     "paddle_tpu.observability",
     "paddle_tpu.observability.tracing",

@@ -921,4 +921,41 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python tools/bench_offload.py --smoke > /dev/null
 echo "bench_offload smoke OK"
 
+echo "== serving ownership verifier (r24: model check + seeded mutation + lint contract) =="
+# the block-lifetime model checker must exhaustively clear the shipped
+# pager protocol at its default scope (the state count is the proof of
+# coverage), and a seeded protocol mutation must be caught BY NAME —
+# both halves of the static_analysis.md §5 contract
+JAX_PLATFORMS=cpu python - <<'PY'
+from paddle_tpu.framework.ownership import ModelChecker, MUTATIONS
+
+res = ModelChecker().run()
+assert res.ok, res.violations
+assert res.states_explored == 233 and res.transitions == 676, \
+    (res.states_explored, res.transitions)
+mut = ModelChecker(mutation="leaked-release").run()
+assert not mut.ok and MUTATIONS["leaked-release"] in mut.codes(), \
+    mut.codes()
+print(f"ownership model check OK ({res.states_explored} states / "
+      f"{res.transitions} transitions clean; seeded leaked-release "
+      f"caught as {MUTATIONS['leaked-release']})")
+PY
+
+# lint --serving: clean on the shipped paged tick builder (exit 0, the
+# report's serving section populated) and the --json exit-code contract
+JAX_PLATFORMS=cpu python tools/lint_program.py \
+    --model transformer_lm_paged_decode_tick --serving > /dev/null
+JAX_PLATFORMS=cpu python tools/lint_program.py \
+    --model transformer_lm_paged_decode_tick --serving --json \
+    | python -c '
+import json, sys
+reports = json.load(sys.stdin)
+sv = reports[0]["serving"]
+mc = sv["model_check"]
+assert mc["violations"] == 0 and mc["states_explored"] == 233, mc
+assert sv["violations"] == 0, sv["violations"]
+print("lint --serving OK (json contract, model check "
+      "%d states)" % mc["states_explored"])
+'
+
 echo "CI OK"
